@@ -1,0 +1,121 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capability surface of Horovod (reference: jiaqianjing/horovod, a fork of
+horovod/horovod; see SURVEY.md).
+
+Import convention mirrors the reference's per-framework modules
+(``import horovod.torch as hvd`` [V]):
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = hvd.mesh()                       # the world: 1 chip = 1 rank
+    out = hvd.allreduce(hvd.replicate(x))   # eager, fused + async-capable
+    # ... or the TPU fast path: hvd.traced.allreduce inside jit/shard_map.
+
+Architecture (SURVEY.md §7): traced collectives lower to XLA collectives
+over ICI — the compiler statically schedules, fuses, and overlaps them,
+replacing the reference's background negotiate-fuse-execute thread. The
+eager API keeps Horovod's async-handle semantics on top of a fusion-cycle
+dispatcher (ops/fusion.py). Everything honors the HOROVOD_* env contract.
+"""
+
+from .common.basics import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    add_process_set,
+    ccl_built,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    ddl_built,
+    get_config,
+    get_process_set,
+    get_process_set_ids,
+    gloo_built,
+    gloo_enabled,
+    global_process_set,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mesh,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    remove_process_set,
+    rocm_built,
+    shutdown,
+    size,
+    topology,
+    tpu_enabled,
+    xla_built,
+)
+from .common.process_sets import ProcessSet  # noqa: F401
+from .common.topology import (  # noqa: F401
+    WORLD_AXIS,
+    rank_sharding,
+    replicated_sharding,
+    shard_from_rank_fn,
+)
+from .ops.reduction_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+)
+from .ops.compression import Compression  # noqa: F401
+from .ops.eager import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    alltoall,
+    alltoall_async,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    first,
+    flush,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    join,
+    join_ranks,
+    poll,
+    reducescatter,
+    reducescatter_async,
+    replicate,
+    synchronize,
+)
+from . import ops  # noqa: F401
+from .ops import traced  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Runtime timeline activation (ref: hvd.start_timeline, v0.21+ [V])."""
+    from .common import basics as _basics
+    from .common.timeline import Timeline
+
+    st = _basics._require_init()
+    if st.timeline is None:
+        st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+        st.fusion.timeline = st.timeline
+    st.timeline.start()
+
+
+def stop_timeline() -> None:
+    from .common import basics as _basics
+
+    st = _basics._require_init()
+    if st.timeline is not None:
+        st.timeline.stop()

@@ -1,0 +1,3 @@
+"""Framework-agnostic core: config, topology, global state, process sets,
+timeline, stall inspection, autotune. TPU-native rebuild of
+horovod/common/ [V] (SURVEY.md §2.1)."""

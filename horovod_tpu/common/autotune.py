@@ -1,0 +1,204 @@
+"""Online autotuning of eager-fusion parameters via Bayesian optimization.
+
+TPU-native rebuild of the reference's parameter manager + GP/EI stack
+(ref: horovod/common/parameter_manager.cc, optim/bayesian_optimization.cc,
+optim/gaussian_process.cc [V], SURVEY.md §2.1): scores each sample window by
+throughput (bytes/sec through the fusion pipeline), models score as a
+Gaussian process over (log2 fusion_threshold, cycle_time_ms), and proposes
+the next candidate by expected improvement. Where the reference maximizes EI
+with LBFGS over Eigen matrices, we use dense candidate sampling over the
+bounded 2-D box — same acquisition, simpler machinery, numpy only.
+
+Enabled by HOROVOD_AUTOTUNE=1; HOROVOD_AUTOTUNE_LOG dumps the search.
+Only the *eager* path is tuned — traced collectives are scheduled by XLA
+and have no runtime parameters to tune (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# Search bounds: threshold 1 KB .. 512 MB (log2 scale), cycle 0.1 .. 25 ms
+# (the reference tunes the same two knobs over similar ranges [V]).
+_LOG2_THRESH_LO, _LOG2_THRESH_HI = 10.0, 29.0
+_CYCLE_LO, _CYCLE_HI = 0.1, 25.0
+
+
+class GaussianProcess:
+    """GP regression with an RBF kernel on unit-box-normalized inputs
+    (ref: gaussian_process.cc [V])."""
+
+    def __init__(self, noise: float = 0.8, length_scale: float = 0.2):
+        self.noise = noise
+        self.length_scale = length_scale
+        self._x: Optional[np.ndarray] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+        self._alpha: Optional[np.ndarray] = None
+        self._l_chol: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / self.length_scale**2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        self._x = np.atleast_2d(x)
+        y = np.asarray(y, dtype=np.float64)
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yn = (y - self._y_mean) / self._y_std
+        k = self._kernel(self._x, self._x)
+        k[np.diag_indices_from(k)] += self.noise**2
+        self._l_chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._l_chol.T, np.linalg.solve(self._l_chol, yn)
+        )
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.atleast_2d(x)
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._alpha
+        v = np.linalg.solve(self._l_chol, ks.T)
+        var = np.clip(1.0 - (v**2).sum(0), 1e-12, None)
+        return mu * self._y_std + self._y_mean, np.sqrt(var) * self._y_std
+
+
+def expected_improvement(
+    mu: np.ndarray, sigma: np.ndarray, best: float, xi: float = 0.01
+) -> np.ndarray:
+    """EI acquisition (ref: bayesian_optimization.cc [V])."""
+    from math import erf, sqrt
+
+    z = (mu - best - xi) / sigma
+    cdf = 0.5 * (1.0 + np.vectorize(erf)(z / sqrt(2.0)))
+    pdf = np.exp(-0.5 * z**2) / math.sqrt(2 * math.pi)
+    return (mu - best - xi) * cdf + sigma * pdf
+
+
+class BayesianOptimizer:
+    """Propose-next-candidate loop over the (threshold, cycle) box."""
+
+    def __init__(self, noise: float = 0.8, seed: int = 0):
+        self._gp = GaussianProcess(noise=noise)
+        self._rng = np.random.default_rng(seed)
+        self._xs: List[np.ndarray] = []
+        self._ys: List[float] = []
+
+    @staticmethod
+    def _normalize(threshold_log2: float, cycle_ms: float) -> np.ndarray:
+        return np.array(
+            [
+                (threshold_log2 - _LOG2_THRESH_LO)
+                / (_LOG2_THRESH_HI - _LOG2_THRESH_LO),
+                (cycle_ms - _CYCLE_LO) / (_CYCLE_HI - _CYCLE_LO),
+            ]
+        )
+
+    @staticmethod
+    def _denormalize(p: np.ndarray) -> Tuple[int, float]:
+        log2t = _LOG2_THRESH_LO + p[0] * (_LOG2_THRESH_HI - _LOG2_THRESH_LO)
+        cycle = _CYCLE_LO + p[1] * (_CYCLE_HI - _CYCLE_LO)
+        return int(2 ** round(log2t)), float(round(cycle, 2))
+
+    def observe(self, threshold_bytes: int, cycle_ms: float, score: float):
+        self._xs.append(
+            self._normalize(math.log2(max(threshold_bytes, 1)), cycle_ms)
+        )
+        self._ys.append(score)
+
+    def suggest(self) -> Tuple[int, float]:
+        if len(self._xs) < 2:
+            p = self._rng.uniform(size=2)
+            return self._denormalize(p)
+        self._gp.fit(np.stack(self._xs), np.array(self._ys))
+        cands = self._rng.uniform(size=(256, 2))
+        mu, sigma = self._gp.predict(cands)
+        ei = expected_improvement(mu, sigma, best=max(self._ys))
+        return self._denormalize(cands[int(np.argmax(ei))])
+
+    def best(self) -> Tuple[int, float]:
+        i = int(np.argmax(self._ys))
+        return self._denormalize(self._xs[i])
+
+
+class ParameterManager:
+    """Drives sampling windows over live traffic (ref: parameter_manager.cc
+    Tune()/Step() [V]). The fusion manager calls record() once per flush;
+    we aggregate steps_per_sample flushes into one score sample."""
+
+    def __init__(
+        self,
+        initial_threshold: int,
+        initial_cycle_ms: float,
+        warmup_samples: int = 3,
+        steps_per_sample: int = 10,
+        max_samples: int = 20,
+        gp_noise: float = 0.8,
+        log_path: Optional[str] = None,
+    ):
+        self._threshold = initial_threshold
+        self._cycle_ms = initial_cycle_ms
+        self._warmup_left = warmup_samples
+        self._steps_per_sample = steps_per_sample
+        self._max_samples = max_samples
+        self._optimizer = BayesianOptimizer(noise=gp_noise)
+        self._log_path = log_path
+        self._bytes = 0
+        self._seconds = 0.0
+        self._steps = 0
+        self._samples = 0
+        self._frozen = False
+
+    @classmethod
+    def from_config(cls, cfg) -> "ParameterManager":
+        return cls(
+            initial_threshold=cfg.fusion_threshold_bytes,
+            initial_cycle_ms=cfg.cycle_time_ms,
+            warmup_samples=cfg.autotune_warmup_samples,
+            steps_per_sample=cfg.autotune_steps_per_sample,
+            max_samples=cfg.autotune_bayes_opt_max_samples,
+            gp_noise=cfg.autotune_gaussian_process_noise,
+            log_path=cfg.autotune_log,
+        )
+
+    def current(self) -> Tuple[int, float]:
+        return self._threshold, self._cycle_ms
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    def record(self, bytes_: int, seconds: float) -> None:
+        if self._frozen:
+            return
+        self._bytes += bytes_
+        self._seconds += seconds
+        self._steps += 1
+        if self._steps < self._steps_per_sample:
+            return
+        score = self._bytes / max(self._seconds, 1e-9)
+        self._log(score)
+        self._bytes, self._seconds, self._steps = 0, 0.0, 0
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            return
+        self._samples += 1
+        self._optimizer.observe(self._threshold, self._cycle_ms, score)
+        if self._samples >= self._max_samples:
+            self._threshold, self._cycle_ms = self._optimizer.best()
+            self._frozen = True
+            self._log(None, note="frozen")
+        else:
+            self._threshold, self._cycle_ms = self._optimizer.suggest()
+
+    def _log(self, score, note: str = "") -> None:
+        if not self._log_path:
+            return
+        with open(self._log_path, "a") as f:
+            f.write(
+                f"threshold={self._threshold} cycle_ms={self._cycle_ms} "
+                f"score={'' if score is None else f'{score:.3e}'} {note}\n"
+            )

@@ -1,0 +1,267 @@
+"""Global runtime state and the init/shutdown lifecycle.
+
+TPU-native re-design of the reference's C-API bootstrap + global state
+(ref: horovod/common/operations.cc `horovod_init`/`InitializeHorovodOnce` +
+horovod/common/global_state.h `HorovodGlobalState` + horovod/common/basics.py
+`HorovodBasics` [V], SURVEY.md §2.1/§3.1).
+
+What is deliberately *absent* relative to the reference: the background
+coordination thread and the Request/Response negotiation protocol. On TPU,
+XLA's static schedule plays that role for traced code (SURVEY.md §5.8); the
+eager path batches through a fusion manager (ops/fusion.py) driven from the
+dispatching thread, so no dedicated coordinator thread is needed — dispatch
+order is identical on every process because eager dispatch happens on the
+single controller.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from . import config as config_mod
+from . import topology as topo_mod
+from .process_sets import ProcessSet, ProcessSetTable
+
+
+class HorovodInternalError(RuntimeError):
+    """A collective failed (peer/slice died). Elastic catches this
+    (ref: horovod/common/exceptions [V], surfaced to hvd.elastic.run)."""
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Cluster membership changed; current state is still good
+    (ref: horovod/common/elastic.py [V])."""
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self):
+        super().__init__(
+            "horovod_tpu has not been initialized; call hvd.init() first."
+        )
+
+
+class _GlobalState:
+    """Singleton mirroring HorovodGlobalState (global_state.h [V])."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.config: Optional[config_mod.Config] = None
+        self.topology: Optional[topo_mod.Topology] = None
+        self.mesh = None
+        self.process_set_table: Optional[ProcessSetTable] = None
+        self.fusion = None  # FusionManager, attached by ops.eager on init
+        self.timeline = None  # Timeline, attached when HOROVOD_TIMELINE set
+        self.parameter_manager = None  # autotune, attached when enabled
+        self.stall_inspector = None
+
+
+_state = _GlobalState()
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def state() -> _GlobalState:
+    return _state
+
+
+def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
+    """Initialize the runtime: read config, discover topology, build the
+    world mesh, register process sets, start aux subsystems.
+
+    Idempotent like the reference's InitializeHorovodOnce
+    (operations.cc [V]). Unlike the reference there is no thread to spawn:
+    collective scheduling is XLA's job.
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        cfg = config_mod.Config.from_env()
+        topology = topo_mod.discover(cfg)
+        _state.config = cfg
+        _state.topology = topology
+        _state.mesh = topology.world_mesh()
+        _state.process_set_table = ProcessSetTable(topology.size)
+        if process_sets:
+            for ps in process_sets:
+                _state.process_set_table.register(ps)
+
+        # Aux subsystems — imported lazily to keep the init dependency graph
+        # one-directional (they all depend on basics).
+        from ..ops.fusion import FusionManager
+
+        _state.fusion = FusionManager(
+            mesh=_state.mesh,
+            threshold_bytes=cfg.fusion_threshold_bytes,
+            cycle_time_ms=cfg.cycle_time_ms,
+        )
+        if cfg.timeline:
+            from .timeline import Timeline
+
+            _state.timeline = Timeline(cfg.timeline, mark_cycles=cfg.timeline_mark_cycles)
+            _state.fusion.timeline = _state.timeline
+        if not cfg.stall_check_disable:
+            from .stall_inspector import StallInspector
+
+            _state.stall_inspector = StallInspector(
+                warning_seconds=cfg.stall_warning_seconds,
+                shutdown_seconds=cfg.stall_shutdown_seconds,
+            )
+            _state.fusion.stall_inspector = _state.stall_inspector
+        if cfg.autotune:
+            from .autotune import ParameterManager
+
+            _state.parameter_manager = ParameterManager.from_config(cfg)
+            _state.fusion.parameter_manager = _state.parameter_manager
+        _state.initialized = True
+
+
+def shutdown() -> None:
+    """Tear down (ref: horovod_shutdown in operations.cc [V])."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.fusion is not None:
+            _state.fusion.flush()
+        if _state.timeline is not None:
+            _state.timeline.close()
+        _state.initialized = False
+        _state.config = None
+        _state.topology = None
+        _state.mesh = None
+        _state.process_set_table = None
+        _state.fusion = None
+        _state.timeline = None
+        _state.parameter_manager = None
+        _state.stall_inspector = None
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+# --- rank/size queries (ref: HorovodBasics in horovod/common/basics.py [V]) ---
+
+
+def size() -> int:
+    return _require_init().topology.size
+
+
+def rank() -> int:
+    return _require_init().topology.rank
+
+
+def local_size() -> int:
+    return _require_init().topology.local_size
+
+
+def local_rank() -> int:
+    return _require_init().topology.local_rank
+
+
+def cross_size() -> int:
+    return _require_init().topology.cross_size
+
+
+def cross_rank() -> int:
+    return _require_init().topology.cross_rank
+
+
+def mesh():
+    return _require_init().mesh
+
+
+def topology() -> topo_mod.Topology:
+    return _require_init().topology
+
+
+def get_config() -> config_mod.Config:
+    return _require_init().config
+
+
+def is_homogeneous() -> bool:
+    """True when every host drives the same number of chips
+    (ref: horovod_is_homogeneous [V]; always true on a TPU slice)."""
+    st = _require_init()
+    return st.topology.size == st.topology.cross_size * st.topology.local_size
+
+
+# --- build-capability predicates, API parity with basics.py [V] ---
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    return True
+
+
+def tpu_enabled() -> bool:
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+# --- process-set API (ref: horovod/common/process_sets.py [V]) ---
+
+
+def add_process_set(ranks: Sequence[int]) -> ProcessSet:
+    st = _require_init()
+    ps = ranks if isinstance(ranks, ProcessSet) else ProcessSet(ranks)
+    return st.process_set_table.register(ps)
+
+
+def remove_process_set(ps: ProcessSet) -> None:
+    _require_init().process_set_table.remove(ps)
+
+
+def get_process_set_ids() -> Sequence[int]:
+    return _require_init().process_set_table.ids()
+
+
+def get_process_set(process_set_id: int) -> ProcessSet:
+    return _require_init().process_set_table.get(process_set_id)
+
+
+def global_process_set() -> ProcessSet:
+    return _require_init().process_set_table.global_set

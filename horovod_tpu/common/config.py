@@ -1,0 +1,186 @@
+"""Typed configuration backed by the ``HOROVOD_*`` environment-variable contract.
+
+TPU-native re-design of the reference's two-tier config system
+(ref: horovod/common/utils/env_parser.cc + horovod/runner/launch.py [V] —
+see SURVEY.md §5.6; the reference mount was empty, citations are structural).
+
+The reference parses ~30 HOROVOD_* env vars scattered across C++ and Python.
+Here the full behavioral surface lives in one frozen dataclass, parsed once at
+``hvd.init()`` time, while keeping the env-var names so existing launch scripts
+keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+# Default fusion threshold matches the reference: 64 MB
+# (ref: horovod/common/fusion_buffer_manager.cc [V]).
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+# Background-cycle batching window, milliseconds
+# (ref: HOROVOD_CYCLE_TIME in horovod/common/operations.cc [V]).
+DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_WARNING_SECONDS = 60.0
+DEFAULT_STALL_SHUTDOWN_SECONDS = 0.0  # 0 = never shut down
+DEFAULT_ELASTIC_DISCOVERY_INTERVAL = 1.0
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {val!r}")
+
+
+def _env_float(name: str, default: float) -> float:
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        raise ValueError(f"{name} must be a float, got {val!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Snapshot of every knob the framework honors.
+
+    Field groups mirror the reference's env surface (SURVEY.md §5.6) plus
+    TPU-specific additions prefixed ``mesh_*``.
+    """
+
+    # --- fusion / eager dispatch ---
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    batch_d2d_memcopies: bool = True
+
+    # --- reduction behavior ---
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    # --- autotune ---
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+
+    # --- timeline ---
+    timeline: Optional[str] = None
+    timeline_mark_cycles: bool = False
+
+    # --- stall inspector ---
+    stall_check_disable: bool = False
+    stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
+    stall_shutdown_seconds: float = DEFAULT_STALL_SHUTDOWN_SECONDS
+
+    # --- logging ---
+    log_level: str = "warning"
+    log_timestamp: bool = True
+
+    # --- rank / rendezvous contract (set by the runner for each worker) ---
+    rank: Optional[int] = None
+    size: Optional[int] = None
+    local_rank: Optional[int] = None
+    local_size: Optional[int] = None
+    cross_rank: Optional[int] = None
+    cross_size: Optional[int] = None
+    controller: str = "tpu"
+    cpu_operations: str = "xla"
+    rendezvous_addr: Optional[str] = None
+    rendezvous_port: Optional[int] = None
+    gloo_timeout_seconds: float = 30.0
+
+    # --- elastic ---
+    elastic_discovery_interval: float = DEFAULT_ELASTIC_DISCOVERY_INTERVAL
+
+    # --- TPU mesh ---
+    mesh_shape: Optional[str] = None  # e.g. "dp=8" or "dp=4,tp=2"
+    num_streams: int = 1
+
+    @staticmethod
+    def from_env() -> "Config":
+        env = os.environ
+        rendezvous_port = env.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
+        return Config(
+            fusion_threshold_bytes=_env_int(
+                "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD
+            ),
+            cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", DEFAULT_CACHE_CAPACITY),
+            batch_d2d_memcopies=_env_bool("HOROVOD_BATCH_D2D_MEMCOPIES", True),
+            hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
+            hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            autotune=_env_bool("HOROVOD_AUTOTUNE"),
+            autotune_log=env.get("HOROVOD_AUTOTUNE_LOG"),
+            autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_steps_per_sample=_env_int(
+                "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10
+            ),
+            autotune_bayes_opt_max_samples=_env_int(
+                "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20
+            ),
+            autotune_gaussian_process_noise=_env_float(
+                "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8
+            ),
+            timeline=env.get("HOROVOD_TIMELINE"),
+            timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+            stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
+            stall_warning_seconds=_env_float(
+                "HOROVOD_STALL_CHECK_TIME_SECONDS", DEFAULT_STALL_WARNING_SECONDS
+            ),
+            stall_shutdown_seconds=_env_float(
+                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", DEFAULT_STALL_SHUTDOWN_SECONDS
+            ),
+            log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
+            log_timestamp=_env_bool("HOROVOD_LOG_TIMESTAMP", True),
+            rank=_env_int("HOROVOD_RANK", -1) if "HOROVOD_RANK" in env else None,
+            size=_env_int("HOROVOD_SIZE", -1) if "HOROVOD_SIZE" in env else None,
+            local_rank=(
+                _env_int("HOROVOD_LOCAL_RANK", -1)
+                if "HOROVOD_LOCAL_RANK" in env
+                else None
+            ),
+            local_size=(
+                _env_int("HOROVOD_LOCAL_SIZE", -1)
+                if "HOROVOD_LOCAL_SIZE" in env
+                else None
+            ),
+            cross_rank=(
+                _env_int("HOROVOD_CROSS_RANK", -1)
+                if "HOROVOD_CROSS_RANK" in env
+                else None
+            ),
+            cross_size=(
+                _env_int("HOROVOD_CROSS_SIZE", -1)
+                if "HOROVOD_CROSS_SIZE" in env
+                else None
+            ),
+            controller=env.get("HOROVOD_CONTROLLER", "tpu").lower(),
+            cpu_operations=env.get("HOROVOD_CPU_OPERATIONS", "xla").lower(),
+            rendezvous_addr=env.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"),
+            rendezvous_port=int(rendezvous_port) if rendezvous_port else None,
+            gloo_timeout_seconds=_env_float("HOROVOD_GLOO_TIMEOUT_SECONDS", 30.0),
+            elastic_discovery_interval=_env_float(
+                "HOROVOD_ELASTIC_DISCOVERY_INTERVAL",
+                DEFAULT_ELASTIC_DISCOVERY_INTERVAL,
+            ),
+            mesh_shape=env.get("HOROVOD_TPU_MESH"),
+            num_streams=_env_int("HOROVOD_NUM_STREAMS", 1),
+        )

@@ -1,0 +1,101 @@
+"""Process sets: named subsets of ranks with their own collective scope.
+
+TPU-native equivalent of the reference's process-set table
+(ref: horovod/common/process_set.cc/.h + horovod/common/process_sets.py [V],
+SURVEY.md §2.1): where the reference allocates a sub-communicator (MPI comm /
+NCCL comm) per set, we allocate (a) a sub-mesh over the set's chips for eager
+dispatch and (b) ``axis_index_groups`` for traced collectives — XLA lowers
+those to collectives over exactly the set's ICI links.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class ProcessSet:
+    """A named subset of ranks. ``process_set_id`` 0 is the global set."""
+
+    def __init__(self, ranks: Sequence[int]):
+        self.ranks: List[int] = sorted(int(r) for r in ranks)
+        if len(set(self.ranks)) != len(self.ranks):
+            raise ValueError(f"duplicate ranks in process set: {ranks}")
+        self.process_set_id: Optional[int] = None  # assigned at registration
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def included(self, rank: int) -> bool:
+        return rank in self.ranks
+
+    def rank_in_set(self, rank: int) -> int:
+        """Position of a global rank within this set (ref: the per-set rank
+        remap in process_set.cc [V])."""
+        try:
+            return self.ranks.index(rank)
+        except ValueError:
+            raise ValueError(f"rank {rank} not in process set {self.ranks}")
+
+    def axis_index_groups(self, world_size: int):
+        """Groups argument for lax.psum & friends restricting the collective
+        to this set. Ranks outside the set form singleton groups (they
+        participate in the program but reduce with themselves only)."""
+        if self.size == world_size:
+            return None
+        groups = [list(self.ranks)]
+        for r in range(world_size):
+            if r not in self.ranks:
+                groups.append([r])
+        return groups
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+class ProcessSetTable:
+    """Registry mapping ids → ProcessSet, id 0 = global
+    (ref: ProcessSetTable in horovod/common/process_set.h [V])."""
+
+    def __init__(self, world_size: int):
+        self._lock = threading.Lock()
+        self._world_size = world_size
+        self._by_id: Dict[int, ProcessSet] = {}
+        self._next_id = 0
+        global_set = ProcessSet(range(world_size))
+        self.register(global_set)  # gets id 0
+
+    @property
+    def global_set(self) -> ProcessSet:
+        return self._by_id[0]
+
+    def register(self, ps: ProcessSet) -> ProcessSet:
+        with self._lock:
+            for existing in self._by_id.values():
+                if existing.ranks == ps.ranks:
+                    return existing
+            bad = [r for r in ps.ranks if not 0 <= r < self._world_size]
+            if bad:
+                raise ValueError(
+                    f"ranks {bad} out of range for world size {self._world_size}"
+                )
+            ps.process_set_id = self._next_id
+            self._next_id += 1
+            self._by_id[ps.process_set_id] = ps
+            return ps
+
+    def remove(self, ps: ProcessSet) -> None:
+        with self._lock:
+            if ps.process_set_id == 0:
+                raise ValueError("cannot remove the global process set")
+            self._by_id.pop(ps.process_set_id, None)
+            ps.process_set_id = None
+
+    def get(self, process_set_id: int) -> ProcessSet:
+        with self._lock:
+            return self._by_id[process_set_id]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._by_id)

@@ -1,0 +1,60 @@
+"""Stall detection for eager collectives.
+
+TPU-native rebuild of horovod/common/stall_inspector.cc/.h [V]
+(SURVEY.md §2.1): the reference warns when some ranks have submitted a tensor
+and others haven't for >60s. Under a single controller, cross-rank submission
+skew cannot happen — the equivalent failure mode is a handle that is enqueued
+but never synchronized/flushed (a leak or a deadlocked consumer), so that is
+what we track: entries pending in the fusion queue past the warning age.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict
+
+from .basics import HorovodInternalError
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class StallInspector:
+    def __init__(
+        self, warning_seconds: float = 60.0, shutdown_seconds: float = 0.0
+    ):
+        self.warning_seconds = warning_seconds
+        self.shutdown_seconds = shutdown_seconds
+        self._pending: Dict[str, float] = {}
+        self._warned: set = set()
+
+    def record_enqueue(self, name: str) -> None:
+        self._pending.setdefault(name, time.monotonic())
+
+    def record_complete(self, name: str) -> None:
+        self._pending.pop(name, None)
+        self._warned.discard(name)
+
+    def check(self) -> None:
+        """Called once per fusion cycle (the reference checks once per
+        background-loop cycle, stall_inspector.cc::CheckForStalledTensors
+        [V])."""
+        now = time.monotonic()
+        for name, t in list(self._pending.items()):
+            age = now - t
+            if (
+                self.shutdown_seconds > 0
+                and age > self.shutdown_seconds
+            ):
+                raise HorovodInternalError(
+                    f"collective '{name}' stalled for {age:.0f}s "
+                    f"(> HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)"
+                )
+            if age > self.warning_seconds and name not in self._warned:
+                self._warned.add(name)
+                logger.warning(
+                    "One or more collectives submitted but not completed "
+                    "for %.0fs: %s. A consumer may be stalled.",
+                    age,
+                    name,
+                )

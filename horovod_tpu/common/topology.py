@@ -1,0 +1,156 @@
+"""Device topology discovery and world-mesh construction.
+
+TPU-native replacement for the reference's rank/communicator bootstrap
+(ref: horovod/common/mpi/mpi_context.cc + horovod/common/gloo/gloo_context.cc
+[V], SURVEY.md §2.1): where the reference derives (rank, local_rank,
+cross_rank) from MPI communicators or rendezvous env vars, we derive them from
+the JAX runtime's view of the TPU slice, with the ``HOROVOD_*`` env contract
+as an override so the runner keeps working.
+
+Rank semantics on TPU (documented divergence, SURVEY.md §7.1): Horovod runs
+one process per accelerator; single-controller JAX runs one process per host
+driving ``local_size`` chips. We keep Horovod's *one rank per chip* contract:
+
+- ``size``        = total chips in the slice (the parallel width),
+- ``local_size``  = chips driven by this process,
+- ``rank``        = global index of this process's lead chip,
+- ``cross_rank``  = this process's index among processes (one per host),
+- ``cross_size``  = number of processes.
+
+Per-chip rank identity inside a collective is ``lax.axis_index('hvd')`` in
+traced code; eager helpers (`shard_from_rank_fn`) construct rank-dependent
+global arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .config import Config
+
+# The canonical data-parallel ("world") mesh axis name, used everywhere the
+# reference would say "the global communicator".
+WORLD_AXIS = "hvd"
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Immutable view of the slice this job runs on."""
+
+    devices: tuple  # all addressable + non-addressable devices, rank order
+    process_index: int
+    process_count: int
+    local_device_count: int
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def local_size(self) -> int:
+        return self.local_device_count
+
+    @property
+    def rank(self) -> int:
+        return self.process_index * self.local_device_count
+
+    @property
+    def local_rank(self) -> int:
+        return 0
+
+    @property
+    def cross_rank(self) -> int:
+        return self.process_index
+
+    @property
+    def cross_size(self) -> int:
+        return self.process_count
+
+    def world_mesh(self) -> Mesh:
+        """1-D mesh over every chip: the global communicator equivalent."""
+        return Mesh(np.asarray(self.devices), (WORLD_AXIS,))
+
+    def sub_mesh(self, ranks: Sequence[int]) -> Mesh:
+        """Mesh over a subset of chips — the process-set communicator
+        equivalent (ref: horovod/common/process_set.cc [V])."""
+        devs = np.asarray([self.devices[r] for r in ranks])
+        return Mesh(devs, (WORLD_AXIS,))
+
+
+def discover(config: Optional[Config] = None) -> Topology:
+    """Build the topology from the JAX runtime and validate it against the
+    HOROVOD_* env contract.
+
+    The reference learns world shape from MPI_Init or rendezvous env
+    (HOROVOD_RANK/SIZE/...); under JAX those arrive via
+    ``jax.distributed.initialize``, which the runner performs before user
+    code. When the launcher additionally exported HOROVOD_RANK/SIZE/...,
+    they must agree with what the runtime reports — a silent mismatch
+    would mean the job is running on a different slice than the launcher
+    assigned, so it is an error.
+    """
+    devices = tuple(jax.devices())
+    topo = Topology(
+        devices=devices,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+    )
+    if config is not None:
+        checks = [
+            ("HOROVOD_SIZE", config.size, topo.size),
+            ("HOROVOD_LOCAL_SIZE", config.local_size, topo.local_size),
+            ("HOROVOD_CROSS_SIZE", config.cross_size, topo.cross_size),
+            ("HOROVOD_RANK", config.rank, topo.rank),
+            ("HOROVOD_LOCAL_RANK", config.local_rank, topo.local_rank),
+            ("HOROVOD_CROSS_RANK", config.cross_rank, topo.cross_rank),
+        ]
+        mismatches = [
+            f"{name}={want} but the JAX runtime reports {got}"
+            for name, want, got in checks
+            if want is not None and want != got
+        ]
+        if mismatches:
+            raise ValueError(
+                "HOROVOD_* env contract does not match the discovered "
+                "slice topology: " + "; ".join(mismatches)
+            )
+    return topo
+
+
+# ---------------------------------------------------------------------------
+# Rank-major global arrays: the eager-mode data model.
+#
+# An eager Horovod collective sees one same-shaped tensor per rank. Under a
+# single controller the natural representation is one global jax.Array with a
+# leading "rank" axis of length `size`, sharded over the world mesh so row r
+# lives on chip r. Collectives over it lower to real ICI collectives.
+# ---------------------------------------------------------------------------
+
+
+def rank_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(WORLD_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_from_rank_fn(
+    fn: Callable[[int], np.ndarray], mesh: Mesh, dtype=None
+) -> jax.Array:
+    """Build a rank-major global array where row r = fn(r), placed on chip r.
+
+    Test/benchmark helper mirroring the reference's per-rank tensor
+    construction pattern (`tensor = torch.ones(...) * hvd.rank()` in
+    test/parallel/test_torch.py [V]).
+    """
+    n = mesh.devices.size
+    rows = [np.asarray(fn(r), dtype=dtype) for r in range(n)]
+    stacked = np.stack(rows, axis=0)
+    return jax.device_put(stacked, rank_sharding(mesh))

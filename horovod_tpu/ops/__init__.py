@@ -1,0 +1,12 @@
+"""Collective operations: traced (inside jit/shard_map) and eager (dispatch
++ fusion) flavors. TPU-native replacement for horovod/common/ops/ [V]."""
+
+from .reduction_ops import (  # noqa: F401
+    Average,
+    Sum,
+    Adasum,
+    Min,
+    Max,
+    Product,
+    ReduceOp,
+)

@@ -1,0 +1,430 @@
+"""Eager collective API: async handles + blocking wrappers.
+
+API-parity layer with the reference's per-framework op modules
+(ref: horovod/torch/mpi_ops.py — allreduce/allreduce_async/allreduce_/
+allgather/broadcast/alltoall/reducescatter/synchronize/poll [V],
+SURVEY.md §2.4), dispatching into the fusion manager (fusion.py).
+
+Data model (single controller): each eager collective operates on a
+**rank-major global array** — leading axis of length ``hvd.size()``, row r
+being rank r's tensor, sharded one row per chip (see
+common/topology.py). Helpers:
+
+* ``hvd.replicate(x)``      — every rank contributes the same ``x``.
+* ``hvd.shard_from_rank_fn``— row r = fn(r)  (test/benchmark pattern).
+* Results are rank-major too; ``result[r]`` is what rank r receives.
+
+Uneven-shape support (allgather-v, alltoall-v) follows the reference's
+MPI_*v semantics via padding on the fused path or host repack.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import basics
+from ..common.topology import rank_sharding
+from ..common.process_sets import ProcessSet
+from .fusion import Handle, _Entry
+from .reduction_ops import Average, ReduceOp, resolve_op
+
+_name_counter = itertools.count()
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    if name is not None:
+        return name
+    return f"{prefix}.noname.{next(_name_counter)}"
+
+
+def _fusion():
+    return basics._require_init().fusion
+
+
+def _world() -> int:
+    return basics.size()
+
+
+def _as_rank_major(tensor, world: int) -> jax.Array:
+    arr = jnp.asarray(tensor)
+    if arr.ndim == 0 or arr.shape[0] != world:
+        raise ValueError(
+            f"eager collectives take rank-major input with leading axis "
+            f"hvd.size()={world}; got shape {arr.shape}. Wrap per-rank-"
+            f"identical input with hvd.replicate(x)."
+        )
+    return arr
+
+
+def replicate(tensor) -> jax.Array:
+    """Rank-major array where every rank contributes the same value."""
+    st = basics._require_init()
+    arr = jnp.asarray(tensor)
+    return jnp.broadcast_to(arr[None], (st.topology.size,) + arr.shape)
+
+
+def first(result) -> jax.Array:
+    """Rank 0's view of a rank-major result."""
+    return result[0]
+
+
+# ----------------------------------------------------------------- allreduce
+
+
+def allreduce_async(
+    tensor,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    mask: Optional[np.ndarray] = None,
+) -> Handle:
+    op = resolve_op(op, average)
+    fusion = _fusion()
+    payload = _as_rank_major(tensor, fusion.world)
+    if mask is None:
+        mask = JoinContext._active_mask
+    entry = _Entry(
+        name=_auto_name("allreduce", name),
+        kind="allreduce",
+        payload=payload,
+        op=op,
+        prescale=float(prescale_factor),
+        postscale=float(postscale_factor),
+        process_set=process_set,
+        mask=None if mask is None else np.asarray(mask, dtype=bool),
+    )
+    return fusion.enqueue(entry)
+
+
+def allreduce(tensor, *args, **kwargs):
+    return allreduce_async(tensor, *args, **kwargs).wait()
+
+
+# In-place spellings: JAX arrays are immutable, so the _ variants return the
+# new value like their functional counterparts (documented divergence).
+allreduce_ = allreduce
+allreduce_async_ = allreduce_async
+
+
+def grouped_allreduce_async(
+    tensors: Sequence,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> List[Handle]:
+    """Enqueue a list atomically (ref: hvd.grouped_allreduce /
+    group_table.cc [V]): all members land in the same cycle, so the fusion
+    pass reduces them in one fused collective."""
+    base = _auto_name("grouped_allreduce", name)
+    fusion = _fusion()
+    mask = JoinContext._active_mask
+    handles = []
+    entries = []
+    for i, t in enumerate(tensors):
+        entry = _Entry(
+            name=f"{base}.{i}",
+            kind="allreduce",
+            payload=_as_rank_major(t, fusion.world),
+            op=resolve_op(op, average),
+            prescale=float(prescale_factor),
+            postscale=float(postscale_factor),
+            process_set=process_set,
+            mask=None if mask is None else np.asarray(mask, dtype=bool),
+        )
+        entries.append(entry)
+    # Suppress threshold-triggered flushes between group members: enqueue
+    # all, then let normal cycle logic run.
+    for entry in entries:
+        handles.append(fusion.enqueue(entry))
+    return handles
+
+
+def grouped_allreduce(tensors, *args, **kwargs):
+    return [h.wait() for h in grouped_allreduce_async(tensors, *args, **kwargs)]
+
+
+# ----------------------------------------------------------------- allgather
+
+
+def allgather_async(
+    tensor: Union[jax.Array, Sequence],
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    """Gather-v (ref: hvd.allgather / MPI_Allgatherv [V]). Input is either a
+    rank-major array (equal dim0 per rank) or a list of per-rank arrays with
+    possibly different dim0 — the v-case, handled by padding to the max and
+    slicing after the fused gather."""
+    fusion = _fusion()
+    world = fusion.world
+    lengths = None
+    if isinstance(tensor, (list, tuple)):
+        if len(tensor) != world:
+            raise ValueError(
+                f"allgather list input must have hvd.size()={world} entries"
+            )
+        rows = [jnp.asarray(t) for t in tensor]
+        lengths = [int(r.shape[0]) for r in rows]
+        if len(set(lengths)) == 1:
+            payload = jnp.stack(rows)
+            lengths = None
+        else:
+            max_n = max(lengths)
+            padded = [
+                jnp.pad(r, [(0, max_n - r.shape[0])] + [(0, 0)] * (r.ndim - 1))
+                for r in rows
+            ]
+            payload = jnp.stack(padded)
+    else:
+        payload = _as_rank_major(tensor, world)
+    entry = _Entry(
+        name=_auto_name("allgather", name),
+        kind="allgather",
+        payload=payload,
+        process_set=process_set,
+        extra=lengths,
+    )
+    return fusion.enqueue(entry)
+
+
+def allgather(tensor, *args, **kwargs):
+    return allgather_async(tensor, *args, **kwargs).wait()
+
+
+# ----------------------------------------------------------------- broadcast
+
+
+def broadcast_async(
+    tensor,
+    root_rank: int,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    fusion = _fusion()
+    entry = _Entry(
+        name=_auto_name("broadcast", name),
+        kind="broadcast",
+        payload=_as_rank_major(tensor, fusion.world),
+        root_rank=int(root_rank),
+        process_set=process_set,
+    )
+    return fusion.enqueue(entry)
+
+
+def broadcast(tensor, root_rank, *args, **kwargs):
+    return broadcast_async(tensor, root_rank, *args, **kwargs).wait()
+
+
+broadcast_ = broadcast
+broadcast_async_ = broadcast_async
+
+
+# ------------------------------------------------------------------ alltoall
+
+
+def alltoall_async(
+    tensor,
+    splits: Optional[Sequence[Sequence[int]]] = None,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    """All-to-all (ref: hvd.alltoall / MPI_Alltoallv [V]).
+
+    Equal-split case (no ``splits``): rank-major input [world, n, ...] with
+    n % world == 0 → one fused XLA all_to_all on ICI.
+    Uneven case: ``splits[r]`` = dim0 split sizes rank r sends to each peer;
+    handled by a host-side repack (the v-variant is control-plane-bound in
+    the reference too). Returns (output, received_splits) via the handle
+    when splits are given.
+    """
+    fusion = _fusion()
+    world = fusion.world
+    if splits is None:
+        payload = _as_rank_major(tensor, world)
+        # Divisibility by the participating rank count (world or process-set
+        # size) is validated at dispatch in the fusion manager.
+        entry = _Entry(
+            name=_auto_name("alltoall", name),
+            kind="alltoall",
+            payload=payload,
+            process_set=process_set,
+        )
+        return fusion.enqueue(entry)
+    # Uneven: repack on host, fulfill immediately.
+    rows = (
+        [np.asarray(t) for t in tensor]
+        if isinstance(tensor, (list, tuple))
+        else [np.asarray(tensor[r]) for r in range(world)]
+    )
+    splits = [list(map(int, s)) for s in splits]
+    outputs, recv_splits = [], []
+    offsets = [np.concatenate([[0], np.cumsum(s)]) for s in splits]
+    for dst in range(world):
+        pieces = [
+            rows[src][offsets[src][dst] : offsets[src][dst + 1]]
+            for src in range(world)
+        ]
+        outputs.append(jnp.concatenate(pieces, axis=0))
+        recv_splits.append([splits[src][dst] for src in range(world)])
+    handle = Handle(fusion, None)
+    handle._fulfill((outputs, recv_splits))
+    return handle
+
+
+def alltoall(tensor, *args, **kwargs):
+    return alltoall_async(tensor, *args, **kwargs).wait()
+
+
+# ------------------------------------------------------------- reducescatter
+
+
+def reducescatter_async(
+    tensor,
+    op: Optional[ReduceOp] = None,
+    name: Optional[str] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    """Reduce-scatter (ref: hvd.reducescatter, upstream v0.27+ [V]).
+
+    Return type depends on divisibility, because per-rank shard shapes do:
+    when dim1 divides by the rank count every rank's shard is the same
+    shape and the result is one rank-major array [world, n/world, ...];
+    in the uneven case (MPI_Reduce_scatter-v parity: earlier ranks get one
+    extra element) shard shapes differ per rank, so the result is a
+    *list* of per-rank arrays — the honest representation of a
+    heterogeneous result under a single controller."""
+    fusion = _fusion()
+    payload = _as_rank_major(tensor, fusion.world)
+    op = resolve_op(op, None)
+    participants = (
+        list(range(fusion.world))
+        if process_set is None or process_set.process_set_id == 0
+        else list(process_set.ranks)
+    )
+    if payload.shape[1] % len(participants) != 0:
+        h = allreduce_async(
+            tensor,
+            op=op,
+            name=name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=process_set,
+        )
+
+        class _SliceHandle(Handle):
+            def wait(self_inner):
+                full = h.wait()
+                n = full.shape[1]
+                base, rem = divmod(n, len(participants))
+                out_rows = []
+                off = 0
+                for i, r in enumerate(participants):
+                    ln = base + (1 if i < rem else 0)
+                    out_rows.append(full[r, off : off + ln])
+                    off += ln
+                return out_rows
+
+            def poll(self_inner):
+                return h.poll()
+
+        return _SliceHandle(fusion, None)
+    entry = _Entry(
+        name=_auto_name("reducescatter", name),
+        kind="reducescatter",
+        payload=payload,
+        op=op,
+        prescale=float(prescale_factor),
+        postscale=float(postscale_factor),
+        process_set=process_set,
+    )
+    return fusion.enqueue(entry)
+
+
+def reducescatter(tensor, *args, **kwargs):
+    return reducescatter_async(tensor, *args, **kwargs).wait()
+
+
+# ------------------------------------------------------------- sync / poll
+
+
+def synchronize(handle: Handle):
+    """Block until the handle's collective completes (ref:
+    horovod/torch/mpi_ops.py::synchronize → WaitAndClear [V])."""
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    return handle.poll()
+
+
+def flush() -> None:
+    """Force an eager fusion cycle now (no direct reference analog — the
+    background thread did this on a timer)."""
+    _fusion().flush()
+
+
+# ------------------------------------------------------------------- join
+
+
+class JoinContext:
+    """Masked participation for uneven data (ref: hvd.join / JoinOp in
+    collective_operations.cc [V], SURVEY.md §7.3 hard part #3).
+
+    The reference's join lets a rank that ran out of data drop out of
+    subsequent allreduces; averages divide by the number of non-joined
+    ranks. Under a single controller the set of joined ranks is known, so
+    join becomes a mask applied to eager allreduces:
+
+        with hvd.join_ranks([3]):         # rank 3 has no more data
+            out = hvd.allreduce(x)        # rows averaged over ranks != 3
+    """
+
+    _active_mask: Optional[np.ndarray] = None
+
+    def __init__(self, joined_ranks: Sequence[int]):
+        world = _world()
+        mask = np.ones(world, dtype=bool)
+        for r in joined_ranks:
+            mask[r] = False
+        self._mask = mask
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = JoinContext._active_mask
+        JoinContext._active_mask = self._mask
+        return self
+
+    def __exit__(self, *exc):
+        JoinContext._active_mask = self._prev
+        return False
+
+
+def join_ranks(joined: Sequence[int]) -> JoinContext:
+    return JoinContext(joined)
+
+
+def current_join_mask() -> Optional[np.ndarray]:
+    return JoinContext._active_mask
+
+
+def join(joined_ranks: Optional[Sequence[int]] = None) -> int:
+    """API-parity join. With ``joined_ranks`` returns the last joined rank
+    (matching the reference's return of last_joined_rank [V]); bare
+    ``join()`` is a no-op barrier under a single controller."""
+    _fusion().flush()
+    if joined_ranks:
+        return max(joined_ranks)
+    return -1

@@ -1,0 +1,499 @@
+"""Eager-mode dispatch: tensor queue, fusion buffer, cycle batching, handles.
+
+This module is the TPU re-design of the reference's core machine —
+background loop + tensor queue + fusion buffer + response cache
+(ref: horovod/common/operations.cc RunLoopOnce, tensor_queue.cc,
+fusion_buffer_manager.cc, response_cache.cc [V]; SURVEY.md §2.1, §3.2) —
+re-thought for a single controller:
+
+* No negotiation: every process sees the same eager dispatch order, so
+  tensor-readiness agreement is structural. What the reference's controller
+  negotiates dynamically, the single controller knows trivially.
+* Fusion survives: many small eager collectives are still slow if dispatched
+  one XLA executable each. Entries accumulate in a queue; a *cycle* flush
+  concatenates same-typed allreduces into one flat [world, N] buffer and
+  dispatches ONE fused collective (`HOROVOD_FUSION_THRESHOLD` caps each
+  fused batch, `HOROVOD_CYCLE_TIME` bounds queue latency — same env
+  contract, same semantics).
+* The response cache's job (skip re-negotiation for repeating tensor sets)
+  is played by the executor cache: repeated (op, dtype, shape) batches hit
+  an already-compiled XLA executable.
+* Flushing is cooperative (on enqueue-over-threshold, cycle expiry at next
+  enqueue, or synchronize()) — there is no background thread to race with
+  JAX dispatch.
+
+Handles reproduce the async API: `allreduce_async_` returns a handle;
+`synchronize(handle)` blocks (ref: horovod/torch/handle_manager.cc [V]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..common.topology import WORLD_AXIS, rank_sharding
+from ..common.process_sets import ProcessSet
+from .reduction_ops import Average, Sum, Adasum, Min, Max, Product, ReduceOp
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One pending collective (ref: TensorTableEntry in common.h [V])."""
+
+    name: str
+    kind: str  # 'allreduce' | 'allgather' | 'broadcast' | 'alltoall' | 'reducescatter'
+    payload: Any  # rank-major jax.Array [world, ...]
+    op: ReduceOp = Average
+    prescale: float = 1.0
+    postscale: float = 1.0
+    root_rank: int = 0
+    process_set: Optional[ProcessSet] = None
+    mask: Optional[np.ndarray] = None  # [world] bool; False = rank joined
+    extra: Any = None  # op-specific (e.g. uneven-length info)
+    handle: "Handle" = None
+    enqueue_t: float = 0.0
+
+
+class Handle:
+    """Async completion handle (ref: handle_manager.cc [V])."""
+
+    def __init__(self, fusion: "FusionManager", entry: _Entry):
+        self._fusion = fusion
+        self._entry = entry
+        self._result = None
+        self._done = False
+
+    def _fulfill(self, result) -> None:
+        self._result = result
+        self._done = True
+
+    def poll(self) -> bool:
+        """Non-blocking done check; also drives a cooperative cycle tick."""
+        if not self._done:
+            self._fusion.maybe_cycle()
+        return self._done
+
+    def wait(self):
+        if not self._done:
+            self._fusion.flush()
+        assert self._done, "flush did not fulfill handle"
+        return self._result
+
+
+def _group_key(e: _Entry) -> Tuple:
+    mask_key = None if e.mask is None else e.mask.tobytes()
+    pset = 0 if e.process_set is None else e.process_set.process_set_id
+    return (
+        e.kind,
+        int(e.op),
+        e.payload.dtype.name,
+        e.prescale,
+        e.postscale,
+        e.root_rank,
+        pset,
+        mask_key,
+    )
+
+
+class FusionManager:
+    def __init__(self, mesh: Mesh, threshold_bytes: int, cycle_time_ms: float):
+        self.mesh = mesh
+        self.threshold_bytes = threshold_bytes
+        self.cycle_time_ms = cycle_time_ms
+        self.world = int(mesh.devices.size)
+        self.pending: List[_Entry] = []
+        self.pending_bytes = 0
+        self.cycle_start: Optional[float] = None
+        self._sub_meshes: Dict[Tuple[int, ...], Mesh] = {}
+        # attached by basics.init:
+        self.timeline = None
+        self.stall_inspector = None
+        self.parameter_manager = None
+        # executor cache — the response-cache analog:
+        self._executors: Dict[Tuple, Callable] = {}
+        self.cycles = 0
+
+    # ------------------------------------------------------------------ queue
+
+    def enqueue(self, entry: _Entry) -> Handle:
+        entry.enqueue_t = time.monotonic()
+        entry.handle = Handle(self, entry)
+        if self.timeline is not None:
+            self.timeline.begin(entry.name, "QUEUE")
+        if self.stall_inspector is not None:
+            self.stall_inspector.record_enqueue(entry.name)
+        if self.cycle_start is None:
+            self.cycle_start = entry.enqueue_t
+        self.pending.append(entry)
+        self.pending_bytes += int(entry.payload.nbytes)
+        if (
+            self.pending_bytes >= self.threshold_bytes
+            or self._cycle_expired()
+        ):
+            self.flush()
+        return entry.handle
+
+    def _cycle_expired(self) -> bool:
+        return (
+            self.cycle_start is not None
+            and (time.monotonic() - self.cycle_start) * 1e3 >= self.cycle_time_ms
+        )
+
+    def maybe_cycle(self) -> None:
+        if self.pending and self._cycle_expired():
+            self.flush()
+
+    # ------------------------------------------------------------------ flush
+
+    def flush(self) -> None:
+        if not self.pending:
+            return
+        t0 = time.monotonic()
+        entries, self.pending = self.pending, []
+        flushed_bytes, self.pending_bytes = self.pending_bytes, 0
+        self.cycle_start = None
+        self.cycles += 1
+        if self.timeline is not None:
+            self.timeline.mark_cycle()
+        if self.stall_inspector is not None:
+            self.stall_inspector.check()
+
+        # Group fusable entries; preserve dispatch order within groups.
+        groups: Dict[Tuple, List[_Entry]] = {}
+        for e in entries:
+            groups.setdefault(_group_key(e), []).append(e)
+        for key, group in groups.items():
+            kind = key[0]
+            if kind == "allreduce":
+                if ReduceOp(key[1]) == Adasum:
+                    # Adasum's dot-product coefficients are per-tensor;
+                    # concatenating entries would compute joint projections
+                    # over the fused buffer. Execute one entry at a time.
+                    for e in group:
+                        self._execute_fused_allreduce([e])
+                else:
+                    for batch in self._batches_by_threshold(group):
+                        self._execute_fused_allreduce(batch)
+            else:
+                for e in group:
+                    self._execute_single(e)
+
+        for e in entries:
+            if self.timeline is not None:
+                self.timeline.end(e.name, "QUEUE")
+            if self.stall_inspector is not None:
+                self.stall_inspector.record_complete(e.name)
+        if self.parameter_manager is not None:
+            self.parameter_manager.record(
+                bytes_=flushed_bytes, seconds=time.monotonic() - t0
+            )
+            self.threshold_bytes, self.cycle_time_ms = (
+                self.parameter_manager.current()
+            )
+
+    def _batches_by_threshold(self, group: List[_Entry]):
+        """Split a fusable group into batches of <= threshold bytes,
+        mirroring the fusion buffer's capacity (fusion_buffer_manager.cc
+        [V]). A single over-threshold entry still goes alone."""
+        batch, batch_bytes = [], 0
+        for e in group:
+            nbytes = int(e.payload.nbytes)
+            if batch and batch_bytes + nbytes > self.threshold_bytes:
+                yield batch
+                batch, batch_bytes = [], 0
+            batch.append(e)
+            batch_bytes += nbytes
+        if batch:
+            yield batch
+
+    # ------------------------------------------------------------- executors
+
+    def _pset_groups(self, e: _Entry):
+        if e.process_set is None or e.process_set.process_set_id == 0:
+            return None
+        return tuple(
+            tuple(g) for g in e.process_set.axis_index_groups(self.world)
+        )
+
+    def _pset_ranks(self, e: _Entry) -> Optional[Tuple[int, ...]]:
+        if e.process_set is None or e.process_set.process_set_id == 0:
+            return None
+        return tuple(e.process_set.ranks)
+
+    def _executor(self, key: Tuple, builder: Callable) -> Callable:
+        fn = self._executors.get(key)
+        if fn is None:
+            fn = builder()
+            self._executors[key] = fn
+        return fn
+
+    def _sub_mesh(self, ranks: Tuple[int, ...]) -> Mesh:
+        """Sub-communicator mesh over a process set's chips
+        (ref: per-set MPI/NCCL communicators in process_set.cc [V]).
+        Gather-family collectives on a subset run here because XLA's
+        axis_index_groups requires equal-sized groups, which a
+        set+singletons partition cannot provide."""
+        mesh = self._sub_meshes.get(ranks)
+        if mesh is None:
+            flat = list(self.mesh.devices.flat)
+            mesh = Mesh(
+                np.asarray([flat[r] for r in ranks]), (WORLD_AXIS,)
+            )
+            self._sub_meshes[ranks] = mesh
+        return mesh
+
+    def _shard_map(self, fn, mesh=None, out_specs=P(WORLD_AXIS)):
+        return shard_map(
+            fn,
+            mesh=self.mesh if mesh is None else mesh,
+            in_specs=P(WORLD_AXIS),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+
+    def _execute_fused_allreduce(self, batch: List[_Entry]) -> None:
+        e0 = batch[0]
+        for e in batch:
+            if self.timeline is not None and len(batch) > 1:
+                self.timeline.begin(e.name, "MEMCPY_IN_FUSION_BUFFER")
+        # Fusion buffer: flatten each per-rank tensor and concat → [world, N].
+        flats = [
+            e.payload.reshape(self.world, -1) for e in batch
+        ]
+        sizes = [f.shape[1] for f in flats]
+        buf = flats[0] if len(flats) == 1 else jnp.concatenate(flats, axis=1)
+        if self.timeline is not None:
+            for e in batch:
+                if len(batch) > 1:
+                    self.timeline.end(e.name, "MEMCPY_IN_FUSION_BUFFER")
+                self.timeline.begin(e.name, "ALLREDUCE")
+
+        groups = self._pset_groups(e0)
+        mask = None if e0.mask is None else tuple(bool(b) for b in e0.mask)
+        if e0.op == Adasum and groups is not None:
+            # Adasum over a process set runs on the set's sub-mesh (its
+            # all-gather stage needs equal-sized groups); non-members pass
+            # their input through unchanged.
+            ranks = self._pset_ranks(e0)
+            sub = self._sub_mesh(ranks)
+            key = ("adasum_pset", e0.prescale, e0.postscale, ranks)
+            fn = self._executor(
+                key,
+                lambda: self._build_allreduce(
+                    Adasum, e0.prescale, e0.postscale, None, None, mesh=sub
+                ),
+            )
+            member_out = fn(jnp.take(buf, jnp.asarray(ranks), axis=0))
+            out = buf.at[jnp.asarray(ranks)].set(member_out)
+        else:
+            key = (
+                "allreduce", int(e0.op), e0.prescale, e0.postscale, groups, mask,
+            )
+            fn = self._executor(key, lambda: self._build_allreduce(
+                e0.op, e0.prescale, e0.postscale, groups, mask))
+            out = fn(buf)
+        # Scatter results back out of the fusion buffer.
+        offset = 0
+        for e, n in zip(batch, sizes):
+            piece = out[:, offset : offset + n].reshape(e.payload.shape)
+            offset += n
+            if self.timeline is not None:
+                self.timeline.end(e.name, "ALLREDUCE")
+            e.handle._fulfill(piece)
+
+    def _build_allreduce(self, op, prescale, postscale, groups, mask, mesh=None):
+        world = self.world if mesh is None else int(mesh.devices.size)
+        op = ReduceOp(op)
+        mask_arr = (
+            None if mask is None else np.asarray(mask, dtype=bool)
+        )
+
+        def per_shard(x):  # x: [1, N] — this rank's slice of the buffer
+            idx = lax.axis_index(WORLD_AXIS)
+            if prescale != 1.0:
+                x = x * jnp.asarray(prescale, x.dtype)
+            if mask_arr is not None:
+                active = jnp.asarray(mask_arr)[idx]
+                contrib = jnp.where(active, x, jnp.zeros_like(x))
+            else:
+                active = jnp.asarray(True)
+                contrib = x
+            if op in (Average, Sum):
+                out = lax.psum(contrib, WORLD_AXIS, axis_index_groups=groups)
+                if op == Average:
+                    count = lax.psum(
+                        active.astype(x.dtype), WORLD_AXIS, axis_index_groups=groups
+                    )
+                    out = out / jnp.maximum(count, 1)
+            elif op == Min:
+                big = jnp.full_like(x, _max_value(x.dtype))
+                contrib = jnp.where(active, x, big) if mask_arr is not None else x
+                out = lax.pmin(contrib, WORLD_AXIS, axis_index_groups=groups)
+            elif op == Max:
+                small = jnp.full_like(x, _min_value(x.dtype))
+                contrib = jnp.where(active, x, small) if mask_arr is not None else x
+                out = lax.pmax(contrib, WORLD_AXIS, axis_index_groups=groups)
+            elif op == Product:
+                contrib = (
+                    jnp.where(active, x, jnp.ones_like(x))
+                    if mask_arr is not None
+                    else x
+                )
+                gathered = lax.all_gather(
+                    contrib, WORLD_AXIS, axis_index_groups=groups
+                )
+                out = jnp.prod(gathered, axis=0)
+            elif op == Adasum:
+                from .adasum import adasum_allreduce
+
+                out = adasum_allreduce(x, axis_name=WORLD_AXIS, groups=groups)
+            else:
+                raise ValueError(f"unsupported op {op}")
+            if postscale != 1.0:
+                out = out * jnp.asarray(postscale, out.dtype)
+            # Ranks fully outside the process set keep their input
+            # (reference: non-members don't participate at all).
+            if groups is not None:
+                in_singleton = _singleton_mask(groups, world)
+                out = jnp.where(jnp.asarray(in_singleton)[idx], x, out)
+            return out
+
+        return jax.jit(self._shard_map(per_shard, mesh=mesh))
+
+    def _execute_single(self, e: _Entry) -> None:
+        if self.timeline is not None:
+            self.timeline.begin(e.name, e.kind.upper())
+        if e.kind == "broadcast":
+            groups = self._pset_groups(e)
+            key = ("broadcast", e.root_rank, groups)
+            fn = self._executor(
+                key, lambda: self._build_broadcast(e.root_rank, groups)
+            )
+            out = fn(e.payload)
+        elif e.kind in ("allgather", "alltoall", "reducescatter"):
+            # Gather-family ops on a process set run on the set's sub-mesh
+            # (XLA needs equal-sized replica groups); non-member output
+            # rows are zeros — they receive nothing.
+            ranks = self._pset_ranks(e)
+            mesh = self.mesh if ranks is None else self._sub_mesh(ranks)
+            n_ranks = self.world if ranks is None else len(ranks)
+            payload = (
+                e.payload
+                if ranks is None
+                else jnp.take(e.payload, jnp.asarray(ranks), axis=0)
+            )
+            if e.kind == "allgather":
+                key = ("allgather", ranks)
+                fn = self._executor(key, lambda: self._build_allgather(mesh))
+            elif e.kind == "alltoall":
+                if payload.shape[1] % n_ranks != 0:
+                    raise ValueError(
+                        f"equal-split alltoall needs dim1 divisible by the "
+                        f"participating rank count {n_ranks}"
+                    )
+                key = ("alltoall", ranks)
+                fn = self._executor(key, lambda: self._build_alltoall(mesh))
+            else:
+                key = ("reducescatter", int(e.op), e.prescale, e.postscale, ranks)
+                fn = self._executor(
+                    key,
+                    lambda: self._build_reducescatter(
+                        e.op, e.prescale, e.postscale, mesh
+                    ),
+                )
+            out = fn(payload)
+            if e.kind == "allgather" and e.extra is not None:
+                # Uneven dim0: rows were padded to max length; slice each
+                # rank's valid prefix and concat (MPI_Allgatherv parity).
+                lengths = e.extra
+                srcs = range(self.world) if ranks is None else ranks
+                pieces = [out[:, i, : lengths[s]] for i, s in enumerate(srcs)]
+                out = jnp.concatenate(pieces, axis=1)
+            if ranks is not None:
+                full_shape = (self.world,) + tuple(out.shape[1:])
+                out = (
+                    jnp.zeros(full_shape, out.dtype)
+                    .at[jnp.asarray(ranks)]
+                    .set(out)
+                )
+        else:
+            raise ValueError(f"unknown kind {e.kind}")
+        if self.timeline is not None:
+            self.timeline.end(e.name, e.kind.upper())
+        e.handle._fulfill(out)
+
+    def _build_broadcast(self, root_rank, groups):
+        def per_shard(x):
+            idx = lax.axis_index(WORLD_AXIS)
+            contrib = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+            out = lax.psum(contrib, WORLD_AXIS, axis_index_groups=groups)
+            # Non-members of the process set keep their input unchanged
+            # (reference: they don't participate at all).
+            if groups is not None:
+                in_singleton = _singleton_mask(groups, self.world)
+                out = jnp.where(jnp.asarray(in_singleton)[idx], x, out)
+            return out
+
+        return jax.jit(self._shard_map(per_shard))
+
+    def _build_allgather(self, mesh):
+        def per_shard(x):  # [1, n, ...] → [1, n_ranks, n, ...]
+            g = lax.all_gather(x[0], WORLD_AXIS)  # [n_ranks, n, ...]
+            return g[None]
+
+        return jax.jit(self._shard_map(per_shard, mesh=mesh))
+
+    def _build_alltoall(self, mesh):
+        def per_shard(x):  # [1, n, ...]; n % n_ranks == 0
+            return lax.all_to_all(
+                x, WORLD_AXIS, split_axis=1, concat_axis=1, tiled=True
+            )
+
+        return jax.jit(self._shard_map(per_shard, mesh=mesh))
+
+    def _build_reducescatter(self, op, prescale, postscale, mesh):
+        op = ReduceOp(op)
+        n_ranks = int(mesh.devices.size)
+
+        def per_shard(x):  # [1, n, ...]; n % n_ranks == 0
+            if prescale != 1.0:
+                x = x * jnp.asarray(prescale, x.dtype)
+            out = lax.psum_scatter(
+                x, WORLD_AXIS, scatter_dimension=1, tiled=True
+            )
+            if op == Average:
+                out = out / jnp.asarray(n_ranks, out.dtype)
+            if postscale != 1.0:
+                out = out * jnp.asarray(postscale, out.dtype)
+            return out
+
+        return jax.jit(self._shard_map(per_shard, mesh=mesh))
+
+
+def _singleton_mask(groups, world: int) -> np.ndarray:
+    m = np.zeros(world, dtype=bool)
+    for g in groups:
+        if len(g) == 1:
+            m[g[0]] = True
+    return m
+
+
+def _max_value(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.finfo(dtype).max
+    return jnp.iinfo(dtype).max
+
+
+def _min_value(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.finfo(dtype).min
+    return jnp.iinfo(dtype).min

@@ -1,0 +1,37 @@
+"""Reduction-op constants, API parity with the reference's op enum
+(ref: horovod/common/message.h ReduceOp + horovod/torch/mpi_ops.py
+Average/Sum/Adasum/Min/Max/Product [V], SURVEY.md §2.4)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.IntEnum):
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Module-level aliases matching `hvd.Average` etc.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+def resolve_op(op, average):
+    """Reconcile the legacy ``average=`` kwarg with ``op=`` the way the
+    reference does (horovod/torch/mpi_ops.py::_allreduce_function_factory
+    handling [V]): passing both is an error; ``average`` maps to
+    AVERAGE/SUM."""
+    if average is not None:
+        if op is not None:
+            raise ValueError("'op' and deprecated 'average' cannot both be set")
+        return Average if average else Sum
+    return Average if op is None else ReduceOp(op)

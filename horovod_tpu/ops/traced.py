@@ -1,0 +1,227 @@
+"""Traced-mode collectives: the TPU fast path.
+
+These functions are called *inside* ``jit`` / ``shard_map`` over a mesh axis
+(default ``'hvd'``). XLA sees the collective, fuses and schedules it, and
+overlaps it with compute — statically doing what the reference's background
+negotiate-fuse-execute machine (horovod/common/operations.cc RunLoopOnce +
+horovod/common/ops/nccl_operations.cc [V], SURVEY.md §3.2) does dynamically.
+There is deliberately no fusion buffer here: XLA's combiner pass is the
+fusion buffer.
+
+Process-set restriction maps to ``axis_index_groups``
+(ref: per-set communicators in horovod/common/process_set.cc [V]).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.topology import WORLD_AXIS
+from ..common.process_sets import ProcessSet
+from .reduction_ops import Average, Sum, Adasum, Min, Max, Product, resolve_op
+
+
+def _groups(process_set: Optional[ProcessSet], axis_name):
+    if process_set is None or process_set.process_set_id == 0:
+        return None, None
+    world = None
+    # World size along the axis is static at trace time.
+    world = lax.axis_size(axis_name)
+    return process_set.axis_index_groups(world), process_set.size
+
+
+def rank(axis_name: str = WORLD_AXIS):
+    """Per-chip rank inside a traced region (= hvd.rank() of the owning
+    rank in the reference's per-process model)."""
+    return lax.axis_index(axis_name)
+
+
+def size(axis_name: str = WORLD_AXIS) -> int:
+    return lax.axis_size(axis_name)
+
+
+def allreduce(
+    tensor,
+    average: Optional[bool] = None,
+    op=None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: str = WORLD_AXIS,
+):
+    """Allreduce across the mesh axis (ref: hvd.allreduce,
+    horovod/torch/mpi_ops.py + MPI/NCCL Allreduce ops [V]).
+
+    pre/postscale mirror HOROVOD's prescale_factor/postscale_factor —
+    applied before/after the reduction, fused into the XLA program (the
+    reference needs a dedicated ScaleBuffer CUDA kernel; XLA fuses the
+    multiply for free, SURVEY.md §2.2 GPU context row).
+    """
+    op = resolve_op(op, average)
+    groups, set_size = _groups(process_set, axis_name)
+    n = set_size if set_size is not None else lax.axis_size(axis_name)
+
+    if op == Adasum:
+        from .adasum import adasum_allreduce
+
+        if groups is not None:
+            raise NotImplementedError(
+                "traced Adasum over a process set needs equal-sized XLA "
+                "replica groups; use the eager API (hvd.allreduce with "
+                "op=Adasum), which dispatches on the set's sub-mesh"
+            )
+        if prescale_factor != 1.0:
+            tensor = tensor * jnp.asarray(prescale_factor, tensor.dtype)
+        out = adasum_allreduce(tensor, axis_name=axis_name)
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, out.dtype)
+        return out
+
+    if prescale_factor != 1.0:
+        tensor = tensor * jnp.asarray(prescale_factor, dtype=tensor.dtype)
+    if op in (Average, Sum):
+        out = lax.psum(tensor, axis_name, axis_index_groups=groups)
+        if op == Average:
+            out = out / jnp.asarray(n, dtype=out.dtype)
+    elif op == Min:
+        out = lax.pmin(tensor, axis_name, axis_index_groups=groups)
+    elif op == Max:
+        out = lax.pmax(tensor, axis_name, axis_index_groups=groups)
+    elif op == Product:
+        gathered = lax.all_gather(tensor, axis_name, axis_index_groups=groups)
+        out = jnp.prod(gathered, axis=0)
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    return out
+
+
+def grouped_allreduce(
+    tensors,
+    average: Optional[bool] = None,
+    op=None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: str = WORLD_AXIS,
+):
+    """Reduce a list of tensors as one logical op (ref: hvd.grouped_allreduce
+    / group_table.cc [V]). In traced mode the group contract — all members
+    reduced atomically in one fused collective — is expressed by a single
+    psum over the tuple; XLA emits one fused all-reduce."""
+    op = resolve_op(op, average)
+    groups, set_size = _groups(process_set, axis_name)
+    n = set_size if set_size is not None else lax.axis_size(axis_name)
+    if op == Adasum:
+        return [
+            allreduce(
+                t,
+                op=Adasum,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set,
+                axis_name=axis_name,
+            )
+            for t in tensors
+        ]
+    if prescale_factor != 1.0:
+        tensors = [t * jnp.asarray(prescale_factor, t.dtype) for t in tensors]
+    if op in (Average, Sum):
+        outs = lax.psum(tuple(tensors), axis_name, axis_index_groups=groups)
+        if op == Average:
+            outs = tuple(o / jnp.asarray(n, o.dtype) for o in outs)
+    elif op == Min:
+        outs = lax.pmin(tuple(tensors), axis_name, axis_index_groups=groups)
+    elif op == Max:
+        outs = lax.pmax(tuple(tensors), axis_name, axis_index_groups=groups)
+    else:
+        raise ValueError(f"unsupported grouped reduce op {op}")
+    outs = list(outs)
+    if postscale_factor != 1.0:
+        outs = [o * jnp.asarray(postscale_factor, o.dtype) for o in outs]
+    return outs
+
+
+def allgather(
+    tensor,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: str = WORLD_AXIS,
+):
+    """Concatenate each rank's tensor along axis 0 (ref: hvd.allgather /
+    MPI_Allgatherv path [V]). Traced mode requires equal shapes (static
+    shapes under jit); the eager path supports uneven dim0 via padding."""
+    if process_set is not None and process_set.process_set_id != 0:
+        raise NotImplementedError(
+            "traced allgather over a process set needs equal-sized XLA "
+            "replica groups; use the eager hvd.allgather, which dispatches "
+            "on the set's sub-mesh"
+        )
+    return lax.all_gather(tensor, axis_name, axis=0, tiled=True)
+
+
+def broadcast(
+    tensor,
+    root_rank: int,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: str = WORLD_AXIS,
+):
+    """Every rank receives root_rank's value (ref: hvd.broadcast /
+    NCCLBroadcast [V]). Implemented as a masked psum — XLA lowers this to a
+    broadcast-from-source collective on ICI; ranks outside the process set
+    (if any) keep zeros."""
+    groups, _ = _groups(process_set, axis_name)
+    idx = lax.axis_index(axis_name)
+    contribution = jnp.where(idx == root_rank, tensor, jnp.zeros_like(tensor))
+    return lax.psum(contribution, axis_name, axis_index_groups=groups)
+
+
+def alltoall(
+    tensor,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: str = WORLD_AXIS,
+):
+    """Scatter dim-0 blocks to peers, gather their blocks (ref: hvd.alltoall
+    / MPI_Alltoallv [V]). Traced mode is the equal-splits case (dim0 %
+    axis size == 0); uneven splits are an eager-mode feature."""
+    if process_set is not None and process_set.process_set_id != 0:
+        raise NotImplementedError(
+            "traced alltoall over a process set needs equal-sized XLA "
+            "replica groups; use the eager hvd.alltoall, which dispatches "
+            "on the set's sub-mesh"
+        )
+    return lax.all_to_all(
+        tensor, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+
+
+def reducescatter(
+    tensor,
+    op=None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: str = WORLD_AXIS,
+):
+    """Reduce then scatter dim-0 shards (ref: hvd.reducescatter, upstream
+    v0.27+ [V]). Maps directly onto the ICI-optimal psum_scatter."""
+    op = resolve_op(op, None)
+    if process_set is not None and process_set.process_set_id != 0:
+        raise NotImplementedError(
+            "traced reducescatter over a process set needs equal-sized XLA "
+            "replica groups; use the eager hvd.reducescatter, which "
+            "dispatches on the set's sub-mesh"
+        )
+    n = lax.axis_size(axis_name)
+    if prescale_factor != 1.0:
+        tensor = tensor * jnp.asarray(prescale_factor, tensor.dtype)
+    out = lax.psum_scatter(tensor, axis_name, scatter_dimension=0, tiled=True)
+    if op == Average:
+        out = out / jnp.asarray(n, out.dtype)
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, out.dtype)
+    return out

@@ -1,0 +1,42 @@
+"""Test harness: simulate an 8-chip slice on CPU.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference runs
+`horovodrun -np 2` multi-process on localhost; we run an 8-device
+host-platform mesh in one process — same closed-form collective math, real
+XLA collectives, no TPU hardware needed.
+"""
+
+import os
+
+# Must happen before jax initializes its backends.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The sandbox's sitecustomize force-selects the axon TPU platform; override
+# it back to CPU before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def hvd():
+    """Initialized horovod_tpu with clean state per test."""
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,95 @@
+"""Init/lifecycle/rank/size/process-set tests.
+
+Reference model: test/parallel/test_torch.py's basics section + process-set
+tests in test/parallel/test_process_sets*.py [V] (SURVEY.md §4.1), adapted
+to the 8-device single-controller world.
+"""
+
+import os
+
+import pytest
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()  # second init is a no-op like InitializeHorovodOnce [V]
+    assert hvd.is_initialized()
+
+
+def test_world_shape(hvd):
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_mesh_axis(hvd):
+    mesh = hvd.mesh()
+    assert mesh.axis_names == (hvd.WORLD_AXIS,)
+    assert mesh.devices.size == 8
+
+
+def test_build_predicates(hvd):
+    assert hvd.xla_built()
+    assert hvd.tpu_enabled()
+    assert not hvd.mpi_enabled()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_enabled()
+
+
+def test_not_initialized_raises():
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    with pytest.raises(RuntimeError):
+        hvd.size()
+
+
+def test_config_env_roundtrip(monkeypatch):
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 20))
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2.5")
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "99")
+    monkeypatch.setenv("HOROVOD_TIMELINE_MARK_CYCLES", "1")
+    monkeypatch.setenv("HOROVOD_LOG_LEVEL", "DEBUG")
+    hvd.init()
+    cfg = hvd.get_config()
+    assert cfg.fusion_threshold_bytes == 1 << 20
+    assert cfg.cycle_time_ms == 2.5
+    assert cfg.cache_capacity == 99
+    assert cfg.timeline_mark_cycles is True
+    assert cfg.log_level == "debug"
+    hvd.shutdown()
+
+
+def test_process_set_registration(hvd):
+    ps = hvd.add_process_set([0, 2, 4])
+    assert ps.process_set_id is not None and ps.process_set_id > 0
+    assert ps.size == 3
+    assert ps.rank_in_set(4) == 2
+    # duplicate registration returns the existing set
+    again = hvd.add_process_set([4, 0, 2])
+    assert again.process_set_id == ps.process_set_id
+    assert 0 in hvd.get_process_set_ids()
+    hvd.remove_process_set(ps)
+    assert ps.process_set_id is None
+
+
+def test_process_set_axis_groups(hvd):
+    ps = hvd.add_process_set([1, 3])
+    groups = ps.axis_index_groups(8)
+    assert [1, 3] in groups
+    flat = sorted(r for g in groups for r in g)
+    assert flat == list(range(8))  # a full partition of the axis
+
+
+def test_global_process_set(hvd):
+    gps = hvd.global_process_set()
+    assert gps.process_set_id == 0
+    assert gps.size == 8
+    assert gps.axis_index_groups(8) is None

@@ -1,0 +1,213 @@
+"""Eager dispatch: fusion cycles, async handles, v-variants, join masks.
+
+Reference model: the async/handle sections of test/parallel/test_torch.py
+(allreduce_async + synchronize, grouped ops, join with uneven tensors) [V]
+(SURVEY.md §4.1), plus fusion behavior the reference only exercises
+implicitly via HOROVOD_FUSION_THRESHOLD.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+
+
+def rank_major(fn, dtype=np.float32):
+    return np.stack([np.asarray(fn(r), dtype=dtype) for r in range(8)])
+
+
+def test_allreduce_average(hvd):
+    x = rank_major(lambda r: np.full((3, 2), float(r)))
+    out = hvd.allreduce(x)
+    assert out.shape == (8, 3, 2)
+    for r in range(8):
+        np.testing.assert_allclose(np.asarray(out[r]), np.full((3, 2), 3.5))
+
+
+def test_allreduce_sum_int(hvd):
+    x = rank_major(lambda r: np.full((4,), r), dtype=np.int32)
+    out = hvd.allreduce(x, op=hvd_mod.Sum)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.full(4, 28))
+
+
+def test_allreduce_replicate_helper(hvd):
+    out = hvd.allreduce(hvd.replicate(np.ones(5)), op=hvd_mod.Sum)
+    np.testing.assert_allclose(np.asarray(hvd.first(out)), np.full(5, 8.0))
+
+
+def test_allreduce_rejects_non_rank_major(hvd):
+    with pytest.raises(ValueError):
+        hvd.allreduce(np.ones((3, 5)))
+
+
+def test_async_handle_poll_and_wait(hvd):
+    x = rank_major(lambda r: np.full((2,), float(r + 1)))
+    handle = hvd.allreduce_async(x, op=hvd_mod.Sum)
+    # flush() resolves pending work; wait() forces it.
+    out = hvd.synchronize(handle)
+    assert handle.poll()
+    np.testing.assert_allclose(np.asarray(out[2]), np.full(2, 36.0))
+
+
+def test_fusion_batches_multiple_tensors(hvd):
+    """Multiple pending allreduces of one dtype flush as one fused dispatch."""
+    fusion = hvd_mod.common.basics.state().fusion
+    fusion.cycle_time_ms = 1e6  # no time-based flush during this test
+    before = fusion.cycles
+    tensors = [
+        rank_major(lambda r, i=i: np.full((5,), float(r * i))) for i in range(4)
+    ]
+    handles = [
+        hvd.allreduce_async(t, op=hvd_mod.Sum, name=f"t{i}")
+        for i, t in enumerate(tensors)
+    ]
+    outs = [h.wait() for h in handles]
+    assert fusion.cycles == before + 1  # one cycle, one fused buffer
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(out[0]), np.full(5, 28.0 * i))
+
+
+def test_fusion_threshold_triggers_flush(hvd):
+    fusion = hvd_mod.common.basics.state().fusion
+    fusion.threshold_bytes = 64  # tiny: every enqueue flushes
+    h = hvd.allreduce_async(rank_major(lambda r: np.ones(16)), op=hvd_mod.Sum)
+    assert h.poll()  # already flushed by threshold
+
+
+def test_grouped_allreduce(hvd):
+    xs = [
+        rank_major(lambda r: np.full((3,), float(r))),
+        rank_major(lambda r: np.full((2, 2), 2.0 * r)),
+    ]
+    outs = hvd.grouped_allreduce(xs, op=hvd_mod.Average)
+    np.testing.assert_allclose(np.asarray(outs[0][0]), np.full(3, 3.5))
+    np.testing.assert_allclose(np.asarray(outs[1][0]), np.full((2, 2), 7.0))
+
+
+def test_allreduce_min_max_product(hvd):
+    x = rank_major(lambda r: np.array([float(r)]))
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd_mod.Min)[0]), [0.0]
+    )
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd_mod.Max)[5]), [7.0]
+    )
+    x2 = rank_major(lambda r: np.array([2.0]))
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x2, op=hvd_mod.Product)[0]), [256.0]
+    )
+
+
+def test_allreduce_process_set(hvd):
+    ps = hvd.add_process_set([0, 1])
+    x = rank_major(lambda r: np.full((2,), float(r + 1)))
+    out = hvd.allreduce(x, op=hvd_mod.Sum, process_set=ps)
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(2, 3.0))
+    np.testing.assert_allclose(np.asarray(out[1]), np.full(2, 3.0))
+    # ranks outside the set keep their input
+    np.testing.assert_allclose(np.asarray(out[5]), np.full(2, 6.0))
+
+
+def test_allgather_even(hvd):
+    x = rank_major(lambda r: np.full((2, 3), float(r)))
+    out = hvd.allgather(x)
+    assert out.shape == (8, 8, 2, 3)
+    # Horovod semantics: concat along dim0; our rank-major rows hold the
+    # stacked per-rank contributions.
+    flat = np.asarray(out[4]).reshape(16, 3)
+    expected = np.concatenate([np.full((2, 3), float(r)) for r in range(8)])
+    np.testing.assert_allclose(flat, expected)
+
+
+def test_allgather_uneven(hvd):
+    rows = [np.full((r + 1, 2), float(r), dtype=np.float32) for r in range(8)]
+    out = hvd.allgather(rows)
+    total = sum(r + 1 for r in range(8))
+    assert out.shape == (8, total, 2)
+    expected = np.concatenate(rows)
+    np.testing.assert_allclose(np.asarray(out[3]), expected)
+
+
+def test_broadcast(hvd):
+    x = rank_major(lambda r: np.full((4,), float(r)))
+    out = hvd.broadcast(x, root_rank=5)
+    for r in range(8):
+        np.testing.assert_allclose(np.asarray(out[r]), np.full(4, 5.0))
+
+
+def test_alltoall_even(hvd):
+    x = rank_major(lambda r: np.array([r * 10.0 + j for j in range(8)]))
+    out = hvd.alltoall(x)
+    np.testing.assert_allclose(
+        np.asarray(out[2]), [s * 10.0 + 2 for s in range(8)]
+    )
+
+
+def test_alltoall_uneven(hvd):
+    # rank r sends j+1 elements to peer j, all valued r.
+    rows = [
+        np.full((sum(j + 1 for j in range(8)),), float(r), dtype=np.float32)
+        for r in range(8)
+    ]
+    splits = [[j + 1 for j in range(8)] for _ in range(8)]
+    outs, recv = hvd.alltoall(rows, splits=splits)
+    # peer j receives j+1 elements from each rank → 8*(j+1) total
+    assert outs[3].shape == (8 * 4,)
+    np.testing.assert_allclose(
+        np.asarray(outs[3][:4]), np.zeros(4)
+    )  # from rank 0
+    assert recv[3] == [4] * 8
+
+
+def test_reducescatter_even(hvd):
+    x = rank_major(lambda r: np.arange(16.0) + r)
+    out = hvd.reducescatter(x, op=hvd_mod.Sum)
+    reduced = 8 * np.arange(16.0) + 28.0
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(out[3]), reduced[6:8])
+
+
+def test_reducescatter_uneven(hvd):
+    x = rank_major(lambda r: np.arange(10.0))
+    out = hvd.reducescatter(x, op=hvd_mod.Sum)
+    # 10 = 8*1 + 2 → ranks 0,1 get 2 elements, ranks 2..7 get 1.
+    reduced = 8 * np.arange(10.0)
+    np.testing.assert_allclose(np.asarray(out[0]), reduced[0:2])
+    np.testing.assert_allclose(np.asarray(out[2]), reduced[4:5])
+    np.testing.assert_allclose(np.asarray(out[7]), reduced[9:10])
+
+
+def test_join_mask_average(hvd):
+    x = rank_major(lambda r: np.full((3,), float(r)))
+    with hvd.join_ranks([6, 7]):
+        out = hvd.allreduce(x)  # average over ranks 0..5
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(3, 2.5))
+
+
+def test_join_mask_sum(hvd):
+    x = rank_major(lambda r: np.full((2,), 1.0))
+    with hvd.join_ranks([0]):
+        out = hvd.allreduce(x, op=hvd_mod.Sum)
+    np.testing.assert_allclose(np.asarray(out[3]), np.full(2, 7.0))
+
+
+def test_join_barrier_returns_last_joined(hvd):
+    assert hvd.join([2, 5]) == 5
+    assert hvd.join() == -1
+
+
+def test_prescale_postscale(hvd):
+    x = rank_major(lambda r: np.ones(4))
+    out = hvd.allreduce(
+        x, op=hvd_mod.Sum, prescale_factor=0.25, postscale_factor=2.0
+    )
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(4, 4.0))
+
+
+def test_executor_cache_reuse(hvd):
+    fusion = hvd_mod.common.basics.state().fusion
+    x = rank_major(lambda r: np.ones(4))
+    hvd.allreduce(x, op=hvd_mod.Sum)
+    n = len(fusion._executors)
+    hvd.allreduce(x * 2, op=hvd_mod.Sum)
+    assert len(fusion._executors) == n  # response-cache analog hit

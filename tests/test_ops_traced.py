@@ -1,0 +1,181 @@
+"""Traced-mode collective math: closed-form expectations across the mesh.
+
+Reference model: test/parallel/test_torch.py / test_tensorflow.py — every op
+x dtype x avg/sum x prescale with rank-dependent inputs and closed-form
+expected values [V] (SURVEY.md §4.1). Here the per-rank program is the
+shard_map body and ranks are chips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.ops import traced
+
+
+def run_spmd(hvd, fn, *rank_inputs, out_specs=P(hvd_mod.WORLD_AXIS)):
+    """Run fn as an 8-rank SPMD program.
+
+    rank_inputs are rank-major [8, ...]; fn sees each rank's bare tensor
+    (leading rank axis stripped), exactly like per-process code in the
+    reference's test_torch.py, and its output gets the rank axis back.
+    """
+    mesh = hvd.mesh()
+
+    def per_shard(*blocks):
+        outs = fn(*(b[0] for b in blocks))
+        if isinstance(outs, tuple):
+            return tuple(o[None] for o in outs)
+        return outs[None]
+
+    mapped = jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=P(hvd_mod.WORLD_AXIS),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    return mapped(*rank_inputs)
+
+
+def rank_major(fn, dtype=np.float32):
+    return np.stack([np.asarray(fn(r), dtype=dtype) for r in range(8)])
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, jnp.bfloat16])
+def test_allreduce_sum(hvd, dtype):
+    x = rank_major(lambda r: np.full((4, 3), r + 1), dtype=np.float32).astype(
+        dtype
+    )
+    out = run_spmd(hvd, lambda t: traced.allreduce(t, op=hvd_mod.Sum), x)
+    expected = np.full((4, 3), sum(range(1, 9)), dtype=np.float32)
+    for r in range(8):
+        np.testing.assert_allclose(
+            np.asarray(out[r], dtype=np.float32), expected
+        )
+
+
+def test_allreduce_average(hvd):
+    x = rank_major(lambda r: np.full((5,), float(r)))
+    out = run_spmd(hvd, lambda t: traced.allreduce(t, op=hvd_mod.Average), x)
+    np.testing.assert_allclose(np.asarray(out[3]), np.full((5,), 3.5))
+
+
+def test_allreduce_average_kwarg_conflict(hvd):
+    with pytest.raises(ValueError):
+        traced.allreduce(jnp.ones(3), average=True, op=hvd_mod.Sum)
+
+
+def test_allreduce_prescale_postscale(hvd):
+    x = rank_major(lambda r: np.ones(7))
+    out = run_spmd(
+        hvd,
+        lambda t: traced.allreduce(
+            t, op=hvd_mod.Sum, prescale_factor=0.5, postscale_factor=10.0
+        ),
+        x,
+    )
+    # sum(0.5 * 1 over 8 ranks) * 10 = 40
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(7, 40.0))
+
+
+def test_allreduce_min_max(hvd):
+    x = rank_major(lambda r: np.array([r, -r, r * 2.0]))
+    out_min = run_spmd(hvd, lambda t: traced.allreduce(t, op=hvd_mod.Min), x)
+    out_max = run_spmd(hvd, lambda t: traced.allreduce(t, op=hvd_mod.Max), x)
+    np.testing.assert_allclose(np.asarray(out_min[4]), [0, -7, 0])
+    np.testing.assert_allclose(np.asarray(out_max[4]), [7, 0, 14])
+
+
+def test_allreduce_product(hvd):
+    x = rank_major(lambda r: np.full((2,), 2.0))
+    out = run_spmd(hvd, lambda t: traced.allreduce(t, op=hvd_mod.Product), x)
+    np.testing.assert_allclose(np.asarray(out[1]), np.full(2, 2.0**8))
+
+
+def test_allreduce_process_set(hvd):
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    x = rank_major(lambda r: np.full((3,), float(r)))
+    out = run_spmd(
+        hvd,
+        lambda t: traced.allreduce(t, op=hvd_mod.Sum, process_set=ps),
+        x,
+    )
+    # members reduce among {0,1,2,3} → 0+1+2+3 = 6; non-members form
+    # singleton groups and reduce with themselves only.
+    np.testing.assert_allclose(np.asarray(out[2]), np.full(3, 6.0))
+    np.testing.assert_allclose(np.asarray(out[6]), np.full(3, 6.0))
+    np.testing.assert_allclose(np.asarray(out[5]), np.full(3, 5.0))
+
+
+def test_grouped_allreduce(hvd):
+    xs = [
+        rank_major(lambda r: np.full((3,), float(r))),
+        rank_major(lambda r: np.full((2, 2), 2.0 * r)),
+    ]
+    outs = run_spmd(
+        hvd,
+        lambda a, b: tuple(
+            traced.grouped_allreduce([a, b], op=hvd_mod.Average)
+        ),
+        *xs,
+        out_specs=(P(hvd_mod.WORLD_AXIS), P(hvd_mod.WORLD_AXIS)),
+    )
+    np.testing.assert_allclose(np.asarray(outs[0][0]), np.full(3, 3.5))
+    np.testing.assert_allclose(np.asarray(outs[1][0]), np.full((2, 2), 7.0))
+
+
+def test_allgather(hvd):
+    x = rank_major(lambda r: np.full((2, 3), float(r)))
+    out = run_spmd(hvd, lambda t: traced.allgather(t), x)
+    # each rank's output: concat along dim0 → [16, 3]
+    assert out.shape == (8, 16, 3)
+    expected = np.concatenate([np.full((2, 3), float(r)) for r in range(8)])
+    np.testing.assert_allclose(np.asarray(out[5]), expected)
+
+
+def test_broadcast(hvd):
+    x = rank_major(lambda r: np.full((4,), float(r + 1)))
+    out = run_spmd(hvd, lambda t: traced.broadcast(t, root_rank=3), x)
+    for r in range(8):
+        np.testing.assert_allclose(np.asarray(out[r]), np.full(4, 4.0))
+
+
+def test_alltoall(hvd):
+    # rank r sends block j = [r*10 + j]; rank j receives [0*10+j, 1*10+j, ...]
+    x = rank_major(lambda r: np.array([r * 10.0 + j for j in range(8)]))
+    out = run_spmd(hvd, lambda t: traced.alltoall(t), x)
+    np.testing.assert_allclose(
+        np.asarray(out[2]), np.array([s * 10.0 + 2 for s in range(8)])
+    )
+
+
+def test_reducescatter(hvd):
+    x = rank_major(lambda r: np.arange(16.0) + r)
+    out = run_spmd(hvd, lambda t: traced.reducescatter(t, op=hvd_mod.Sum), x)
+    # reduced = 8*arange(16) + sum(0..7); rank r gets shard [2r, 2r+2)
+    reduced = 8 * np.arange(16.0) + 28.0
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(out[3]), reduced[6:8])
+
+
+def test_reducescatter_average(hvd):
+    x = rank_major(lambda r: np.arange(8.0))
+    out = run_spmd(hvd, lambda t: traced.reducescatter(t, op=hvd_mod.Average), x)
+    np.testing.assert_allclose(np.asarray(out[0]), [0.0])
+
+
+def test_rank_size_in_trace(hvd):
+    out = run_spmd(
+        hvd,
+        lambda t: t * 0
+        + traced.rank()
+        + 100 * traced.size(),
+        rank_major(lambda r: np.zeros(1)),
+    )
+    np.testing.assert_allclose(np.asarray(out[:, 0]), 800 + np.arange(8.0))

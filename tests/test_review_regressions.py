@@ -1,0 +1,174 @@
+"""Regression tests for the code-review findings on the eager/traced core:
+Adasum fusion isolation, process-set semantics on every op family, join
+masks in grouped ops, autotune bootstrap, env-contract validation."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+
+
+def rank_major(fn, dtype=np.float32):
+    return np.stack([np.asarray(fn(r), dtype=dtype) for r in range(8)])
+
+
+def test_adasum_entries_not_cross_fused(hvd, rng):
+    """Two Adasum allreduces in one cycle must equal two solo dispatches."""
+    fusion = hvd_mod.common.basics.state().fusion
+    fusion.cycle_time_ms = 1e6
+    a = rank_major(lambda r: rng.normal(size=5))
+    b = rank_major(lambda r: rng.normal(size=5))
+    ha = hvd.allreduce_async(a, op=hvd_mod.Adasum, name="a")
+    hb = hvd.allreduce_async(b, op=hvd_mod.Adasum, name="b")
+    fused_a, fused_b = ha.wait(), hb.wait()
+    solo_a = hvd.allreduce(a, op=hvd_mod.Adasum, name="a2")
+    solo_b = hvd.allreduce(b, op=hvd_mod.Adasum, name="b2")
+    np.testing.assert_allclose(
+        np.asarray(fused_a), np.asarray(solo_a), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(fused_b), np.asarray(solo_b), rtol=1e-5
+    )
+
+
+def test_broadcast_process_set_nonmembers_unchanged(hvd):
+    ps = hvd.add_process_set([0, 1])
+    x = rank_major(lambda r: np.full((2,), float(r + 1)))
+    out = hvd.broadcast(x, root_rank=0, process_set=ps)
+    np.testing.assert_allclose(np.asarray(out[1]), [1.0, 1.0])
+    # non-members keep their own tensor, not zeros
+    np.testing.assert_allclose(np.asarray(out[5]), [6.0, 6.0])
+
+
+def test_grouped_allreduce_respects_join(hvd):
+    x = rank_major(lambda r: np.full((3,), float(r)))
+    with hvd.join_ranks([3, 4, 5, 6, 7]):
+        outs = hvd.grouped_allreduce([x])
+    # average over ranks 0,1,2 only
+    np.testing.assert_allclose(np.asarray(outs[0][0]), np.full(3, 1.0))
+
+
+def test_allgather_process_set(hvd):
+    ps = hvd.add_process_set([2, 5])
+    x = rank_major(lambda r: np.full((2, 3), float(r)))
+    out = hvd.allgather(x, process_set=ps)
+    # members see both contributions stacked
+    got = np.asarray(out[2]).reshape(4, 3)
+    expected = np.concatenate([np.full((2, 3), 2.0), np.full((2, 3), 5.0)])
+    np.testing.assert_allclose(got, expected)
+    np.testing.assert_allclose(np.asarray(out[5]).reshape(4, 3), expected)
+    # non-members receive nothing (zeros)
+    np.testing.assert_allclose(np.asarray(out[0]), np.zeros_like(out[0]))
+
+
+def test_alltoall_process_set(hvd):
+    ps = hvd.add_process_set([0, 4])
+    # 2 participants; per-rank payload dim1=4 splits into 2 blocks of 2
+    x = rank_major(lambda r: np.array([r * 10.0 + j for j in range(4)]))
+    out = hvd.alltoall(x, process_set=ps)
+    # member 0 receives its own first block and member 4's first block
+    np.testing.assert_allclose(np.asarray(out[0]), [0.0, 1.0, 40.0, 41.0])
+    np.testing.assert_allclose(np.asarray(out[4]), [2.0, 3.0, 42.0, 43.0])
+
+
+def test_reducescatter_process_set(hvd):
+    ps = hvd.add_process_set([1, 3])
+    x = rank_major(lambda r: np.arange(4.0) + r)
+    out = hvd.reducescatter(x, op=hvd_mod.Sum, process_set=ps)
+    # members reduce rows 1 and 3: [1,2,3,4]+[3,4,5,6] = [4,6,8,10]
+    np.testing.assert_allclose(np.asarray(out[1]), [4.0, 6.0])
+    np.testing.assert_allclose(np.asarray(out[3]), [8.0, 10.0])
+
+
+def test_adasum_process_set_eager(hvd, rng):
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    x = rank_major(lambda r: rng.normal(size=6))
+    out = hvd.allreduce(x, op=hvd_mod.Adasum, process_set=ps)
+    # members agree (to float32 collective tolerance); non-members pass
+    # through exactly
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(out[3]), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(out[6]), x[6], rtol=1e-6)
+
+
+def test_traced_gather_family_pset_raises(hvd):
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import traced
+
+    ps = hvd.add_process_set([0, 1])
+    for fn in (
+        lambda: traced.allgather(jnp.ones(4), process_set=ps),
+        lambda: traced.alltoall(jnp.ones(8), process_set=ps),
+        lambda: traced.reducescatter(jnp.ones(8), process_set=ps),
+    ):
+        with pytest.raises(NotImplementedError):
+            fn()
+
+
+def test_autotune_init_does_not_crash(monkeypatch):
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    hvd.init()
+    st = hvd_mod.common.basics.state()
+    assert st.parameter_manager is not None
+    # drive enough flushes to move through warmup + a few samples
+    x = rank_major(lambda r: np.ones(64))
+    for _ in range(45):
+        hvd.allreduce(x, op=hvd_mod.Sum)
+    thr, cyc = st.parameter_manager.current()
+    assert thr > 0 and cyc > 0
+    hvd.shutdown()
+
+
+def test_env_contract_mismatch_raises(monkeypatch):
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_SIZE", "4")  # runtime reports 8
+    with pytest.raises(ValueError, match="HOROVOD_SIZE=4"):
+        hvd.init()
+    hvd.shutdown()
+
+
+def test_env_contract_match_accepted(monkeypatch):
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_SIZE", "8")
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    monkeypatch.setenv("HOROVOD_CROSS_SIZE", "1")
+    hvd.init()
+    assert hvd.size() == 8
+    hvd.shutdown()
+
+
+def test_traced_adasum_prescale_applied(hvd, rng):
+    """prescale on traced Adasum must scale the result (adasum is
+    1-homogeneous when all ranks scale identically)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import traced
+
+    x = rank_major(lambda r: rng.normal(size=4))
+    mesh = hvd.mesh()
+
+    def run(prescale):
+        f = jax.jit(
+            jax.shard_map(
+                lambda t: traced.allreduce(
+                    t[0], op=hvd_mod.Adasum, prescale_factor=prescale
+                )[None],
+                mesh=mesh,
+                in_specs=P(hvd_mod.WORLD_AXIS),
+                out_specs=P(hvd_mod.WORLD_AXIS),
+                check_vma=False,
+            )
+        )
+        return np.asarray(f(x))
+
+    np.testing.assert_allclose(run(2.0), 2.0 * run(1.0), rtol=1e-5)
