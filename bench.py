@@ -1,0 +1,104 @@
+"""Synthetic ResNet-50 benchmark — parity with the reference's headline
+harness (ref: examples/pytorch/pytorch_synthetic_benchmark.py [V]:
+ResNet-50, synthetic ImageNet batches, reports img/sec; BASELINE.md
+north star tracks the same metric on TPU).
+
+Prints ONE JSON line:
+  {"metric": "resnet50_synth_img_per_sec", "value": N, "unit": "img/s",
+   "vs_baseline": R}
+
+vs_baseline compares against the canonical single-P100 fp32 ResNet-50
+throughput (~219 img/s, the tf_cnn_benchmarks number contemporaneous with
+the reference's published scaling figures — BASELINE.md [V]): the
+reference's own benchmark prints absolute img/sec per device, so the
+honest single-chip comparison is chip vs chip.
+
+Env knobs: BENCH_BATCH (default 32, the reference harness default),
+BENCH_ITERS, BENCH_WARMUP, BENCH_PLATFORM=cpu to force the host platform.
+"""
+
+import json
+import os
+import time
+
+P100_FP32_IMG_PER_SEC = 219.0
+
+batch = int(os.environ.get("BENCH_BATCH", "32"))
+n_iters = int(os.environ.get("BENCH_ITERS", "20"))
+n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+import jax  # noqa: E402
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from horovod_tpu.models import ResNet50  # noqa: E402
+
+
+def main():
+    model = ResNet50(dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(size=(batch, 224, 224, 3)),
+        jnp.bfloat16,
+    )
+    labels = jnp.zeros((batch,), jnp.int32)
+    variables = jax.jit(lambda: model.init(rng, images, train=False))()
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt = optax.sgd(0.01, momentum=0.9)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    for _ in range(n_warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels
+        )
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels
+        )
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * n_iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_synth_img_per_sec",
+                "value": round(img_per_sec, 2),
+                "unit": "img/s",
+                "vs_baseline": round(img_per_sec / P100_FP32_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,14 @@ from .ops.eager import (  # noqa: F401
     replicate,
     synchronize,
 )
+from .optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+    grad,
+    value_and_grad,
+)
 from . import ops  # noqa: F401
 from .ops import traced  # noqa: F401
 

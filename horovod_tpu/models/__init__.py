@@ -1,0 +1,10 @@
+"""Model zoo mirroring the reference's benchmark/example configs
+(BASELINE.json: MNIST ConvNet, ResNet-50, BERT-large, GPT-2 medium,
+ViT-B/16; ref: examples/pytorch/pytorch_mnist.py,
+examples/pytorch/pytorch_synthetic_benchmark.py [V]), implemented
+TPU-first in flax: bfloat16-friendly, static shapes, remat hooks."""
+
+from .mnist import MNISTConvNet  # noqa: F401
+from .resnet import ResNet50  # noqa: F401
+from .transformer import Transformer, TransformerConfig  # noqa: F401
+from .vit import ViT, ViTConfig  # noqa: F401

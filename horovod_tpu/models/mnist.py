@@ -1,0 +1,22 @@
+"""2-layer ConvNet: the reference's MNIST example model
+(ref: examples/pytorch/pytorch_mnist.py Net — conv(10)→conv(20)→fc50→fc10
+[V]; BASELINE.json config #1). Same capacity, TPU-idiomatic NHWC layout."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MNISTConvNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 28, 28, 1] NHWC (TPU-native layout)
+        x = nn.Conv(10, (5, 5), padding="VALID")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
+        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(50)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

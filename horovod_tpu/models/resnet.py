@@ -1,0 +1,138 @@
+"""ResNet-v1.5 family — the reference's headline benchmark model
+(ref: examples/pytorch/pytorch_synthetic_benchmark.py uses
+torchvision resnet50; docs/benchmarks.rst scaling figures [V];
+BASELINE.md north star: ResNet-50 synthetic img/s).
+
+TPU-first choices: NHWC layout (TPU conv native), bfloat16 compute with
+fp32 params/batch-stats, fused conv+BN+relu left to XLA, optional
+SyncBatchNorm that reduces batch statistics across the world axis the way
+the reference's hvd.SyncBatchNorm does (horovod/torch/sync_batch_norm.py
+[V]) — expressed as a psum inside the traced step instead of a custom
+autograd function.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+
+class SyncBatchNorm(nn.Module):
+    """Cross-replica batch norm (ref: horovod/torch/sync_batch_norm.py [V]):
+    batch statistics are psum-averaged over the mesh axis so every replica
+    normalizes with global-batch statistics."""
+
+    axis_name: Optional[str] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        features = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros(features, jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones(features, jnp.float32)
+        )
+        scale = self.param("scale", nn.initializers.ones, (features,))
+        bias = self.param("bias", nn.initializers.zeros, (features,))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(xf, axis=axes)
+            mean2 = jnp.mean(xf * xf, axis=axes)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                mean2 = lax.pmean(mean2, self.axis_name)
+            var = mean2 - mean * mean
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+
+        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
+        y = y * scale + bias
+        return y.astype(self.dtype)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            SyncBatchNorm, axis_name=self.axis_name, dtype=self.dtype
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = nn.relu(norm()(y, use_running_average=not train))
+        y = conv(self.features, (3, 3), strides=self.strides)(y)
+        y = nn.relu(norm()(y, use_running_average=not train))
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm()(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1), strides=self.strides,
+                name="proj_conv",
+            )(residual)
+            residual = norm(name="proj_bn")(
+                residual, use_running_average=not train
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype,
+        )(x)
+        x = SyncBatchNorm(axis_name=self.axis_name, dtype=self.dtype)(
+            x, use_running_average=not train
+        )
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = Bottleneck(
+                    self.width * 2**i,
+                    strides=strides,
+                    axis_name=self.axis_name,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        # Classifier head in fp32 for numerically stable softmax/loss.
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+
+
+def ResNet50(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kwargs)
+
+
+def ResNet101(**kwargs) -> ResNet:
+    """ref benchmark family member (docs/benchmarks.rst [V])."""
+    return ResNet(stage_sizes=(3, 4, 23, 3), **kwargs)

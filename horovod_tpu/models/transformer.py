@@ -1,0 +1,138 @@
+"""Transformer family covering the reference's language-model benchmark
+configs (BASELINE.json: BERT-large pretraining, GPT-2 medium [V]).
+
+One configurable implementation: ``causal=True`` → GPT-2-style decoder;
+``causal=False`` → BERT-style encoder. TPU-first: bfloat16 activations,
+fp32 layernorm/softmax accumulation, static shapes, `remat` for
+HBM-bound configs, head dims sized for the MXU (multiples of 128 at
+real scale).
+
+The distributed execution path (tp/sp/pp/ep over a mesh) lives in
+horovod_tpu/parallel/ — this module is the single-chip / pure-DP model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    d_ff: int = 3072
+    max_len: int = 1024
+    causal: bool = True
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @staticmethod
+    def gpt2_medium() -> "TransformerConfig":
+        """BASELINE.json config #4 (GPT-2 medium, 345M)."""
+        return TransformerConfig(
+            num_layers=24, d_model=1024, num_heads=16, d_ff=4096, causal=True
+        )
+
+    @staticmethod
+    def bert_large() -> "TransformerConfig":
+        """BASELINE.json config #3 (BERT-large, 340M)."""
+        return TransformerConfig(
+            vocab_size=30522,
+            num_layers=24,
+            d_model=1024,
+            num_heads=16,
+            d_ff=4096,
+            max_len=512,
+            causal=False,
+        )
+
+    @staticmethod
+    def tiny(causal: bool = True) -> "TransformerConfig":
+        """Test-sized config."""
+        return TransformerConfig(
+            vocab_size=256,
+            num_layers=2,
+            d_model=64,
+            num_heads=4,
+            d_ff=128,
+            max_len=128,
+            causal=causal,
+            dtype=jnp.float32,
+        )
+
+
+class MultiHeadAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.num_heads
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
+        )(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        # scores in fp32 for softmax stability
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(head_dim).astype(jnp.float32)
+        if cfg.causal:
+            t = x.shape[1]
+            causal_mask = jnp.tril(jnp.ones((t, t), bool))
+            scores = jnp.where(causal_mask[None, None], scores, -1e30)
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(out)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = True):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = MultiHeadAttention(cfg)(h, mask)
+        h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype)(h)
+        h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
+        return x + h
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, train: bool = True):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype)(tokens)
+        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype)(
+            jnp.arange(tokens.shape[1])[None]
+        )
+        x = x + pos
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x, mask, train)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        # logits in fp32
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")(
+            x.astype(jnp.float32)
+        )

@@ -1,0 +1,87 @@
+"""ViT-B/16 — the reference's elastic-training benchmark model
+(BASELINE.json config #5: ViT-B/16 Elastic Horovod [V]). Reuses the
+transformer encoder blocks; patchify via a strided conv (MXU-friendly)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import Block, TransformerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def b16() -> "ViTConfig":
+        return ViTConfig()
+
+    @staticmethod
+    def tiny() -> "ViTConfig":
+        return ViTConfig(
+            image_size=32,
+            patch_size=8,
+            num_classes=10,
+            num_layers=2,
+            d_model=64,
+            num_heads=4,
+            d_ff=128,
+            dtype=jnp.float32,
+        )
+
+    def encoder_config(self) -> TransformerConfig:
+        n_patches = (self.image_size // self.patch_size) ** 2
+        return TransformerConfig(
+            vocab_size=1,  # unused
+            num_layers=self.num_layers,
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            d_ff=self.d_ff,
+            max_len=n_patches + 1,
+            causal=False,
+            dtype=self.dtype,
+        )
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.cfg
+        enc = cfg.encoder_config()
+        p = cfg.patch_size
+        x = nn.Conv(
+            cfg.d_model, (p, p), strides=(p, p), dtype=cfg.dtype,
+            name="patchify",
+        )(images.astype(cfg.dtype))
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, cfg.d_model)
+        ).astype(cfg.dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, c)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, x.shape[1], cfg.d_model),
+        ).astype(cfg.dtype)
+        x = x + pos
+        for i in range(cfg.num_layers):
+            x = Block(enc, name=f"block_{i}")(x, None, train)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(
+            x[:, 0].astype(jnp.float32)
+        )

@@ -1,0 +1,16 @@
+"""Multi-dimensional parallelism over TPU meshes.
+
+The reference implements data parallelism only (SURVEY.md §2.6); the only
+adjacent primitives it ships are alltoall (the expert-parallel building
+block) and process sets. This package is the TPU-native superset the
+survey's build plan calls for: the same collectives the reference exposes,
+composed into tensor (tp), sequence/context (sp, ring attention), pipeline
+(pp) and expert (ep) parallelism over a `jax.sharding.Mesh` — each axis
+riding ICI via XLA collectives.
+"""
+
+from .mesh import MeshSpec  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .tp import column_parallel_dense, row_parallel_dense  # noqa: F401
+from .pipeline import gpipe  # noqa: F401
+from .moe import MoEParams, moe_ffn, init_moe_params  # noqa: F401

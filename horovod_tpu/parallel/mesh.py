@@ -1,0 +1,62 @@
+"""Named multi-axis mesh construction.
+
+Extends the world-mesh bootstrap (common/topology.py) to the standard
+dp/pp/sp/tp/ep axis factorization. Axis order is chosen so the most
+bandwidth-hungry axis (tp) maps to the innermost/fastest ICI dimension —
+the layout discipline the scaling-book recipe prescribes; the reference's
+analog is its hierarchical intra/inter-node split
+(HOROVOD_HIERARCHICAL_ALLREDUCE, nccl_operations.cc [V])."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Outer→inner order: dp spans hosts/DCN first, tp stays innermost on ICI.
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.ep * self.sp * self.tp
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        if len(devices) != self.size:
+            raise ValueError(
+                f"mesh spec {self} needs {self.size} devices, "
+                f"got {len(devices)}"
+            )
+        shape = tuple(getattr(self, a) for a in AXIS_ORDER)
+        return Mesh(np.asarray(devices).reshape(shape), AXIS_ORDER)
+
+    @staticmethod
+    def auto(
+        n_devices: int,
+        tp: Optional[int] = None,
+        sp: int = 1,
+        pp: int = 1,
+        ep: int = 1,
+    ) -> "MeshSpec":
+        """Factor n_devices into a sensible default: fix the model axes,
+        give the remainder to dp (the reference's only axis)."""
+        tp = tp if tp is not None else 1
+        denom = tp * sp * pp * ep
+        if n_devices % denom != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by tp*sp*pp*ep={denom}"
+            )
+        return MeshSpec(dp=n_devices // denom, pp=pp, ep=ep, sp=sp, tp=tp)

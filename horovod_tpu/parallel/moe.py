@@ -1,0 +1,125 @@
+"""Expert parallelism: switch-style MoE FFN over the 'ep' axis.
+
+The reference ships only the building block — the alltoall collective
+(SURVEY.md §2.6: "the alltoall collective is the EP building block;
+reference ships the primitive only"). Here it becomes the real thing:
+experts are sharded across the 'ep' mesh axis, tokens are routed top-1
+(switch transformer style) with a fixed capacity per expert (static
+shapes — XLA requirement), dispatched to their expert's chip with
+`lax.all_to_all`, transformed, and returned by the inverse all_to_all.
+
+Per-device code for use inside shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEParams(NamedTuple):
+    router: jnp.ndarray  # [D, E_total]
+    w1: jnp.ndarray  # [E_local, D, F]
+    b1: jnp.ndarray  # [E_local, F]
+    w2: jnp.ndarray  # [E_local, F, D]
+    b2: jnp.ndarray  # [E_local, D]
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts_local: int,
+                    n_experts_total: int, dtype=jnp.float32) -> MoEParams:
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / jnp.sqrt(d_model)
+    s2 = 1.0 / jnp.sqrt(d_ff)
+    return MoEParams(
+        router=(jax.random.normal(k1, (d_model, n_experts_total)) * s1).astype(dtype),
+        w1=(jax.random.normal(k2, (n_experts_local, d_model, d_ff)) * s1).astype(dtype),
+        b1=jnp.zeros((n_experts_local, d_ff), dtype),
+        w2=(jax.random.normal(k3, (n_experts_local, d_ff, d_model)) * s2).astype(dtype),
+        b2=jnp.zeros((n_experts_local, d_model), dtype),
+    )
+
+
+def moe_ffn(
+    params: MoEParams,
+    x,
+    axis_name: str = "ep",
+    capacity_factor: float = 1.25,
+):
+    """x: [T_local, D] tokens on this chip → [T_local, D].
+
+    Routing: top-1 over E_total experts; expert e lives on chip
+    e // E_local of the 'ep' axis. Tokens over capacity are dropped
+    (switch-style; their output is zero and the residual connection
+    carries them)."""
+    ep = lax.axis_size(axis_name)
+    t_local, d = x.shape
+    e_local = params.w1.shape[0]
+    e_total = e_local * ep
+
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                        params.router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    # Per-destination-chip capacity (static).
+    capacity = int(max(1, round(capacity_factor * t_local / ep)))
+
+    dest_chip = expert_idx // e_local  # [T]
+    # position of each token within its destination chip's buffer
+    onehot_chip = jax.nn.one_hot(dest_chip, ep, dtype=jnp.int32)  # [T, ep]
+    pos_in_chip = (jnp.cumsum(onehot_chip, axis=0) - 1)  # [T, ep]
+    my_pos = jnp.take_along_axis(
+        pos_in_chip, dest_chip[:, None], axis=1
+    )[:, 0]  # [T]
+    keep = my_pos < capacity
+
+    # Scatter tokens into the dispatch buffer [ep, capacity, D]. Dropped
+    # tokens get an out-of-range index → mode='drop' discards them, so
+    # empty slots keep their init value (-1 sentinel in the expert map).
+    idx_chip = jnp.where(keep, dest_chip, ep)
+    idx_pos = jnp.where(keep, my_pos, 0)
+    dispatch = (
+        jnp.zeros((ep, capacity, d), x.dtype)
+        .at[idx_chip, idx_pos]
+        .set(x, mode="drop")
+    )
+    token_expert = (
+        jnp.full((ep, capacity), -1, jnp.int32)
+        .at[idx_chip, idx_pos]
+        .set((expert_idx % e_local).astype(jnp.int32), mode="drop")
+    )
+
+    # To each chip its tokens: [ep, C, D] -> all_to_all over axis 0.
+    recv = lax.all_to_all(dispatch, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    recv_expert = lax.all_to_all(token_expert, axis_name, split_axis=0,
+                                 concat_axis=0, tiled=True)
+    # recv: [ep*C, D] tokens for MY local experts (concat over sources).
+    recv = recv.reshape(ep * capacity, d)
+    which_expert = recv_expert.reshape(ep * capacity)
+
+    # Apply each local expert to its tokens (dense einsum over one-hot —
+    # MXU-friendly, no gather/scatter in the hot loop).
+    sel = jax.nn.one_hot(which_expert, e_local, dtype=recv.dtype)  # [N, E_l]
+    h = jnp.einsum("nd,edf,ne->nf", recv, params.w1, sel)
+    h = h + jnp.einsum("ef,ne->nf", params.b1, sel)
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("nf,efd,ne->nd", h, params.w2, sel)
+    y = y + jnp.einsum("ed,ne->nd", params.b2, sel)
+    # tokens that carried expert=-1 (padding) produce zeros
+    y = y * (which_expert >= 0)[:, None]
+
+    # Return to origin chips: inverse all_to_all.
+    y_back = lax.all_to_all(
+        y.reshape(ep, capacity, d), axis_name, split_axis=0, concat_axis=0,
+        tiled=True,
+    ).reshape(ep, capacity, d)
+
+    # Un-scatter: token i's result sits at [dest_chip[i], my_pos[i]].
+    out = y_back[idx_chip, idx_pos]
+    out = jnp.where(keep[:, None], out, 0.0)
+    return (out * gate[:, None]).astype(x.dtype)

@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the 'pp' axis.
+
+Absent from the reference (SURVEY.md §2.6); built TPU-first: stages are
+chips along the 'pp' mesh axis, activations hop stage→stage with
+`ppermute`, and the fill/drain schedule is a `lax.scan` — fully static,
+so XLA overlaps each hop with the next microbatch's compute.
+
+Per-device code for use inside shard_map: every chip runs the same scan;
+chip s applies its own stage parameters. The classic GPipe bubble is
+(pp-1)/(n_micro+pp-1); callers pick n_micro >> pp to amortize it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(
+    stage_fn: Callable,
+    stage_params,
+    x_micro,
+    axis_name: str = "pp",
+):
+    """Run microbatches through the pipeline.
+
+    stage_fn(params, x) -> y: this chip's stage (shapes preserved).
+    stage_params: this chip's stage parameters (pp-sharded pytree leaf(s)).
+    x_micro: [n_micro, ...] microbatched input. Only stage 0's copy is
+        consumed; other stages may pass the same array (ignored).
+
+    Returns [n_micro, ...] outputs, valid on the LAST stage (other stages
+    return zeros) — broadcast back with a psum or collective if every
+    stage needs them.
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    total = n_micro + pp - 1  # fill + drain
+    micro_shape = x_micro.shape[1:]
+
+    # Send each stage's output to the next stage; the wrap-around edge
+    # (last → 0) carries drained values nobody reads.
+    perm = [(j, (j + 1) % pp) for j in range(pp)]
+
+    def step(carry, t):
+        out_acc = carry["out"]
+        prev_act = carry["act"]  # activation received from previous stage
+        # Stage 0 injects microbatch t (zeros once drained); others use
+        # what arrived over the ring.
+        inject = jnp.where(
+            t < n_micro,
+            lax.dynamic_index_in_dim(
+                x_micro, jnp.minimum(t, n_micro - 1), keepdims=False
+            ),
+            jnp.zeros(micro_shape, x_micro.dtype),
+        )
+        x_in = jnp.where(stage == 0, inject, prev_act)
+        y = stage_fn(stage_params, x_in)
+        # Last stage: microbatch index t - (pp-1) completes at step t.
+        done_idx = t - (pp - 1)
+        is_done = jnp.logical_and(done_idx >= 0, stage == pp - 1)
+        out_acc = lax.cond(
+            is_done,
+            lambda acc: lax.dynamic_update_index_in_dim(
+                acc, y, jnp.maximum(done_idx, 0), axis=0
+            ),
+            lambda acc: acc,
+            out_acc,
+        )
+        act_next = lax.ppermute(y, axis_name, perm)
+        return {"out": out_acc, "act": act_next}, None
+
+    init = {
+        "out": jnp.zeros((n_micro,) + micro_shape, x_micro.dtype),
+        "act": jnp.zeros(micro_shape, x_micro.dtype),
+    }
+    final, _ = lax.scan(step, init, jnp.arange(total))
+    return final["out"]

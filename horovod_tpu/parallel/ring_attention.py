@@ -1,0 +1,78 @@
+"""Ring attention: exact attention over sequences sharded across chips.
+
+Long-context sequence/context parallelism is absent from the reference
+(SURVEY.md §5.7 — "no ring attention, no context parallel ... of any
+kind"); the survey's build plan adds it as the TPU-native long-context
+path: shard the sequence over the 'sp' mesh axis and rotate K/V blocks
+around the ring with `ppermute` while accumulating attention online
+(flash-attention-style running max/denominator), so each chip only ever
+holds seq_len/sp keys — memory O(T/sp) with exact results, and each
+ppermute hop overlaps with the block's compute on ICI.
+
+Per-device code for use inside shard_map. Causal masking uses global
+positions derived from each block's rank of origin.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+    """q, k, v: [B, T_local, H, Dh] (this chip's sequence shard).
+
+    Returns [B, T_local, H, Dh] — exact softmax(QKᵀ)V over the full
+    (sp·T_local)-token sequence.
+    """
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+
+    q_pos = my * t + jnp.arange(t)  # global positions of our queries
+
+    # Ring schedule: at step i we hold the block that originated on rank
+    # (my - i) mod sp; after computing we pass it to (my + 1) mod sp.
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(carry, i):
+        k_cur, v_cur, out, m, denom = carry
+        src = (my - i) % sp
+        k_pos = src * t + jnp.arange(t)
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                qf,
+                k_cur.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        block_max = jnp.max(scores, axis=-1)  # [B,H,Tq]
+        new_m = jnp.maximum(m, block_max)
+        # With causal masking a whole block can be -inf; guard the exp.
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        correction = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+        p = jnp.exp(scores - safe_m[..., None])  # masked entries → 0
+        denom = denom * correction + jnp.sum(p, axis=-1)
+        out = out * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, out, new_m, denom), None
+
+    out0 = jnp.zeros((b, h, t, d), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    denom0 = jnp.zeros((b, h, t), jnp.float32)
+    (_, _, out, _, denom), _ = lax.scan(
+        step, (k, v, out0, m0, denom0), jnp.arange(sp)
+    )
+    out = out / jnp.maximum(denom[..., None], 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)

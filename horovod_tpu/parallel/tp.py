@@ -1,0 +1,39 @@
+"""Tensor parallelism: Megatron-style column/row parallel matmuls.
+
+Absent from the reference (SURVEY.md §2.6 lists TP as ❌); built here
+because on TPU it falls out of the same collectives the reference ships —
+a row-parallel matmul is a matmul plus the reference's allreduce.
+Functions are per-device code for use inside shard_map: weight shards live
+on the 'tp' axis, activations stay replicated across it.
+
+- column parallel: W split along output features → local matmul, no comm;
+  activations become tp-sharded on the feature dim.
+- row parallel: W split along input features → local matmul + psum('tp')
+  (one ICI all-reduce, exactly where Megatron places it).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel_dense(x, w_shard, b_shard=None):
+    """x: [..., D]; w_shard: [D, F/tp] → [..., F/tp]. No communication."""
+    y = jnp.einsum("...d,df->...f", x, w_shard)
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel_dense(x_shard, w_shard, b=None, axis_name: str = "tp"):
+    """x_shard: [..., F/tp]; w_shard: [F/tp, D] → psum over tp → [..., D].
+
+    The bias is added after the reduce on every rank (it is replicated)."""
+    y = jnp.einsum("...f,fd->...d", x_shard, w_shard)
+    y = lax.psum(y, axis_name)
+    if b is not None:
+        y = y + b
+    return y

@@ -1,0 +1,293 @@
+"""The flagship distributed model: a causal transformer trained with
+dp x pp x ep x sp x tp parallelism composed over one mesh.
+
+This is the survey build-plan's "exceed parity" layer (SURVEY.md §2.6):
+the reference stops at data parallelism; here every axis of
+horovod_tpu/parallel/ composes in one SPMD program:
+
+- dp: batch sharded; gradients pmean'd across ('dp','sp') — the
+  reference's entire job, one psum here.
+- pp: layers split into stages, GPipe microbatch schedule (pipeline.py).
+- sp: sequence sharded; exact attention via ring_attention (ppermute ring).
+- tp: heads + FFN sharded Megatron-style (tp.py), one psum per block.
+- ep: a switch-MoE FFN block after the pipelined stack, tokens routed
+  across 'ep' with all_to_all (moe.py).
+
+Everything is per-device code executed under one
+``jit(shard_map(step, mesh, ...))`` — XLA sees every collective and
+schedules them against compute on ICI.
+
+Data layout: the batch is sharded over ('dp','ep') — the 'ep' axis acts
+as additional data parallelism for the dense layers, and the MoE block's
+all_to_all then routes each shard's tokens to their experts across 'ep'
+(so expert parallelism splits real tokens, not replicas); the sequence is
+sharded over 'sp'.
+
+Gradient synchronization (``_sync_grads``) follows one rule derived from
+shard_map's transpose semantics (each device's loss output is seeded with
+cotangent 1, and every psum/all_to_all edge transposes to a psum of
+cotangents, multiplying the upstream cotangent by the replica count):
+
+    for each parameter leaf with partition spec S:
+      g ← pmean(g, every mesh axis NOT in S)   # combines per-shard
+                                               # partials; replicated-path
+                                               # contributions are equal
+                                               # so pmean keeps them 1x
+      g ← g / Π(size of axes in S ∩ {pp, ep, tp})
+           # sharded-axis params received their cotangent through a
+           # collective edge once per replica of the downstream loss —
+           # uniform over-count by exactly that axis size
+
+This is validated numerically: one train step produces identical
+parameters on every mesh factorization (tests/test_parallel.py's
+cross-mesh equivalence test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MeshSpec
+from .moe import MoEParams, init_moe_params, moe_ffn
+from .pipeline import gpipe
+from .ring_attention import ring_attention
+from .tp import column_parallel_dense, row_parallel_dense
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTransformerConfig:
+    vocab_size: int = 256
+    num_layers: int = 4  # total; must divide by pp
+    d_model: int = 64
+    num_heads: int = 4  # must divide by tp
+    d_ff: int = 128  # must divide by tp
+    max_len: int = 128
+    n_experts: int = 4  # total; must divide by ep
+    moe_capacity_factor: float = 2.0
+    n_microbatches: int = 2
+    dtype: Any = jnp.float32
+    learning_rate: float = 1e-2
+
+
+Params = Dict[str, Any]
+
+
+def _init_full_params(cfg: ParallelTransformerConfig, key) -> Params:
+    """Full (unsharded) parameter pytree; sharding slices it per device."""
+    d, f, h = cfg.d_model, cfg.d_ff, cfg.num_heads
+    hd = d // h
+    L, V = cfg.num_layers, cfg.vocab_size
+    ks = jax.random.split(key, 8)
+    s = 0.02
+    dt = cfg.dtype
+    params = {
+        "embed": {
+            "tok": (jax.random.normal(ks[0], (V, d)) * s).astype(dt),
+            "pos": (jax.random.normal(ks[1], (cfg.max_len, d)) * s).astype(dt),
+        },
+        "stages": {
+            # leading axis L: layer-stacked, later split into pp stages
+            "ln1_scale": jnp.ones((L, d), dt),
+            "ln1_bias": jnp.zeros((L, d), dt),
+            "wqkv": (jax.random.normal(ks[2], (L, d, 3, h, hd)) * s).astype(dt),
+            "wo": (jax.random.normal(ks[3], (L, h, hd, d)) * s).astype(dt),
+            "ln2_scale": jnp.ones((L, d), dt),
+            "ln2_bias": jnp.zeros((L, d), dt),
+            "w1": (jax.random.normal(ks[4], (L, d, f)) * s).astype(dt),
+            "b1": jnp.zeros((L, f), dt),
+            "w2": (jax.random.normal(ks[5], (L, f, d)) * s).astype(dt),
+            "b2": jnp.zeros((L, d), dt),
+        },
+        "tail": {
+            "lnf_scale": jnp.ones((d,), dt),
+            "lnf_bias": jnp.zeros((d,), dt),
+            "lm_head": (jax.random.normal(ks[6], (d, V)) * s).astype(dt),
+            "moe": init_moe_params(
+                ks[7], d, f, cfg.n_experts, cfg.n_experts, dtype=dt
+            ),
+        },
+    }
+    return params
+
+
+def param_specs(cfg: ParallelTransformerConfig) -> Params:
+    """PartitionSpecs for every leaf: how the global pytree shards over
+    the mesh axes (dp/pp/ep/sp/tp)."""
+    return {
+        "embed": {"tok": P(), "pos": P()},
+        "stages": {
+            "ln1_scale": P("pp"),
+            "ln1_bias": P("pp"),
+            "wqkv": P("pp", None, None, "tp", None),
+            "wo": P("pp", "tp", None, None),
+            "ln2_scale": P("pp"),
+            "ln2_bias": P("pp"),
+            "w1": P("pp", None, "tp"),
+            "b1": P("pp", "tp"),
+            "w2": P("pp", "tp", None),
+            "b2": P("pp"),
+        },
+        "tail": {
+            "lnf_scale": P(),
+            "lnf_bias": P(),
+            "lm_head": P(),
+            "moe": MoEParams(
+                router=P(),
+                w1=P("ep"),
+                b1=P("ep"),
+                w2=P("ep"),
+                b2=P("ep"),
+            ),
+        },
+    }
+
+
+def make_sharded_params(
+    cfg: ParallelTransformerConfig, mesh: Mesh, key
+) -> Params:
+    full = _init_full_params(cfg, key)
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)), full, specs
+    )
+
+
+def _layer_norm(x, scale, bias):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + 1e-5) * scale + bias).astype(x.dtype)
+
+
+def _block(layer_params, x):
+    """One transformer block, per-device view: heads/FFN tp-sharded,
+    sequence sp-sharded (ring attention handles the full context)."""
+    h = _layer_norm(x, layer_params["ln1_scale"], layer_params["ln1_bias"])
+    qkv = jnp.einsum("btd,dchx->btchx", h, layer_params["wqkv"])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H/tp,hd]
+    attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+    proj = jnp.einsum("bthx,hxd->btd", attn, layer_params["wo"])
+    x = x + lax.psum(proj, "tp")
+    h = _layer_norm(x, layer_params["ln2_scale"], layer_params["ln2_bias"])
+    h = column_parallel_dense(h, layer_params["w1"], layer_params["b1"])
+    h = jax.nn.gelu(h)
+    h = row_parallel_dense(h, layer_params["w2"], axis_name="tp")
+    return x + h + layer_params["b2"]
+
+
+def _stage_fn(stage_params, x):
+    """Apply this pp stage's layer stack (scan over its layers)."""
+
+    def body(h, layer):
+        return _block(layer, h), None
+
+    out, _ = lax.scan(body, x, stage_params)
+    return out
+
+
+DATA_AXES = ("dp", "ep", "sp")  # batch over dp+ep, sequence over sp
+
+
+def _forward_loss(params, tokens, labels, cfg: ParallelTransformerConfig):
+    """Per-device forward + loss. tokens/labels: [B_local, T_local]."""
+    sp_idx = lax.axis_index("sp")
+    t_local = tokens.shape[1]
+    x = params["embed"]["tok"][tokens]
+    pos = params["embed"]["pos"][sp_idx * t_local + jnp.arange(t_local)]
+    x = x + pos[None]
+
+    # Pipeline over microbatches (batch split).
+    b_local = x.shape[0]
+    n_micro = min(cfg.n_microbatches, b_local)
+    xm = x.reshape(n_micro, b_local // n_micro, t_local, -1)
+    out = gpipe(_stage_fn, params["stages"], xm, axis_name="pp")
+    # Output lives on the last pp stage; broadcast to all stages so the
+    # tail (loss) is computed everywhere (keeps the program SPMD-uniform).
+    pp = lax.axis_size("pp")
+    stage = lax.axis_index("pp")
+    out = lax.psum(jnp.where(stage == pp - 1, out, jnp.zeros_like(out)), "pp")
+    x = out.reshape(b_local, t_local, -1)
+
+    # Expert-parallel MoE block (switch-style) + residual.
+    flat = x.reshape(b_local * t_local, -1)
+    x = x + moe_ffn(
+        params["tail"]["moe"],
+        flat,
+        axis_name="ep",
+        capacity_factor=cfg.moe_capacity_factor,
+    ).reshape(x.shape)
+
+    x = _layer_norm(x, params["tail"]["lnf_scale"], params["tail"]["lnf_bias"])
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        params["tail"]["lm_head"].astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    loss = nll.mean()
+    return lax.pmean(loss, DATA_AXES)
+
+
+def _spec_axes(spec) -> set:
+    """Mesh axes a PartitionSpec shards over."""
+    axes = set()
+    for part in spec:
+        if part is None:
+            continue
+        for a in part if isinstance(part, tuple) else (part,):
+            axes.add(a)
+    return axes
+
+
+def _sync_grads(grads, specs, axis_sizes):
+    """Per-leaf gradient synchronization (rule in module docstring)."""
+    all_axes = tuple(axis_sizes)
+
+    def one(g, spec):
+        sharded = _spec_axes(spec)
+        reduce_axes = tuple(a for a in all_axes if a not in sharded)
+        if reduce_axes:
+            g = lax.pmean(g, reduce_axes)
+        div = 1
+        for a in sharded & {"pp", "ep", "tp"}:
+            div *= axis_sizes[a]
+        if div != 1:
+            g = g / div
+        return g
+
+    return jax.tree_util.tree_map(one, grads, specs)
+
+
+def make_train_step(cfg: ParallelTransformerConfig, mesh: Mesh):
+    """Build the jitted full train step over the mesh: forward, backward,
+    gradient sync on every axis, SGD update. Returns step(params, tokens,
+    labels) -> (params, loss)."""
+    specs = param_specs(cfg)
+    data_spec = P(("dp", "ep"), "sp")
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def per_device_step(params, tokens, labels):
+        loss, grads = jax.value_and_grad(_forward_loss)(
+            params, tokens, labels, cfg
+        )
+        grads = _sync_grads(grads, specs, axis_sizes)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - cfg.learning_rate * g.astype(p.dtype),
+            params,
+            grads,
+        )
+        return params, loss
+
+    mapped = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -1,0 +1,75 @@
+"""Numerical properties of the Adasum combiner.
+
+Reference model: test/parallel/test_adasum_pytorch.py — checks Adasum's
+defining properties rather than exact values [V] (SURVEY.md §4.1):
+identical inputs → identity; orthogonal inputs → sum; parallel inputs →
+average; scale invariance of the mixing coefficients.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops.adasum import adasum_pair, _tree_combine
+
+
+def test_identical_inputs_average_to_self():
+    a = jnp.asarray(np.random.default_rng(0).normal(size=16).astype(np.float32))
+    out = adasum_pair(a, a)
+    # dot = ||a||² → coefs = 1 - 1/2 = 1/2 each → result = a
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a), rtol=1e-6)
+
+
+def test_orthogonal_inputs_add():
+    a = jnp.asarray([1.0, 0.0, 0.0, 0.0])
+    b = jnp.asarray([0.0, 2.0, 0.0, 0.0])
+    out = adasum_pair(a, b)
+    np.testing.assert_allclose(np.asarray(out), [1.0, 2.0, 0.0, 0.0])
+
+
+def test_parallel_inputs_average():
+    a = jnp.asarray([2.0, 4.0])
+    b = jnp.asarray([4.0, 8.0])  # b = 2a
+    out = adasum_pair(a, b)
+    # parallel case: result = (a + b)/2 * ... exact: coefs (1 - 2asq/2asq)=0
+    # for a? dot=2||a||², acoef = 1 - 2||a||²/(2||a||²) = 0,
+    # bcoef = 1 - 2||a||²/(2·4||a||²) = 3/4 → out = 3/4·b = [3, 6]
+    np.testing.assert_allclose(np.asarray(out), [3.0, 6.0], rtol=1e-6)
+
+
+def test_zero_input_passthrough():
+    a = jnp.zeros(4)
+    b = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(adasum_pair(a, b)), np.asarray(b))
+    np.testing.assert_allclose(np.asarray(adasum_pair(b, a)), np.asarray(b))
+
+
+def test_scale_homogeneous():
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.normal(size=8).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=8).astype(np.float32))
+    out1 = adasum_pair(a, b)
+    out2 = adasum_pair(3.0 * a, 3.0 * b)
+    np.testing.assert_allclose(np.asarray(out2), 3.0 * np.asarray(out1), rtol=1e-5)
+
+
+def test_symmetry():
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.normal(size=8).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=8).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(adasum_pair(a, b)), np.asarray(adasum_pair(b, a)), rtol=1e-6
+    )
+
+
+def test_tree_combine_odd_count():
+    vals = [jnp.full(4, float(i + 1)) for i in range(5)]
+    out = _tree_combine(vals)
+    assert out.shape == (4,)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_bf16_inputs_keep_dtype():
+    a = jnp.ones(8, dtype=jnp.bfloat16)
+    b = jnp.ones(8, dtype=jnp.bfloat16)
+    out = adasum_pair(a, b)
+    assert out.dtype == jnp.bfloat16

@@ -1,0 +1,41 @@
+"""Compression round-trips (ref: compression handling asserted inside
+test_torch.py's fp16 allreduce cases [V])."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops.compression import Compression
+
+
+def test_none_identity():
+    x = jnp.asarray([1.5, 2.5])
+    wire, ctx = Compression.none.compress(x)
+    assert wire is x
+    assert Compression.none.decompress(wire, ctx) is x
+
+
+def test_fp16_roundtrip():
+    x = jnp.asarray(np.linspace(-4, 4, 16, dtype=np.float32))
+    wire, ctx = Compression.fp16.compress(x)
+    assert wire.dtype == jnp.float16
+    out = Compression.fp16.decompress(wire, ctx)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-3)
+
+
+def test_bf16_roundtrip_preserves_range():
+    x = jnp.asarray([1e30, -1e-30, 3.0], dtype=np.float32)
+    wire, ctx = Compression.bf16.compress(x)
+    assert wire.dtype == jnp.bfloat16
+    out = Compression.bf16.decompress(wire, ctx)
+    assert out.dtype == jnp.float32
+    # bf16 keeps fp32's exponent range — 1e30 survives (fp16 would inf)
+    np.testing.assert_allclose(np.asarray(out)[0], 1e30, rtol=1e-2)
+
+
+def test_int_passthrough():
+    x = jnp.asarray([1, 2, 3], dtype=jnp.int32)
+    wire, ctx = Compression.fp16.compress(x)
+    assert wire.dtype == jnp.int32  # non-float left alone
+    out = Compression.fp16.decompress(wire, ctx)
+    assert out.dtype == jnp.int32
