@@ -77,7 +77,8 @@ def main():
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels
         )
-    jax.block_until_ready(loss)
+    if n_warmup > 0:
+        jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
     for _ in range(n_iters):

@@ -60,6 +60,32 @@ class _GlobalState:
 _state = _GlobalState()
 
 
+def _maybe_init_jax_distributed(cfg: config_mod.Config) -> None:
+    """Join the jax.distributed coordination service when the runner
+    exported coordinator env (HOROVOD_COORDINATOR_ADDR/PORT +
+    HOROVOD_NUM_PROCESSES/PROCESS_ID).
+
+    This is the TPU-native replacement for the reference's MPI_Init /
+    Gloo-rendezvous bootstrap inside BackgroundThreadLoop (ref:
+    horovod/common/operations.cc §3.1 [V]): rank-0's host runs the
+    coordination service; everyone else dials in. Must happen before the
+    first jax.devices() call, which is why it lives at the top of init().
+    """
+    if not cfg.coordinator_addr or not cfg.num_processes:
+        return
+    if cfg.num_processes <= 1:
+        return
+    import jax
+
+    if jax.distributed.is_initialized():
+        return  # already joined (e.g. TPU-VM auto-bootstrap)
+    jax.distributed.initialize(
+        coordinator_address=f"{cfg.coordinator_addr}:{cfg.coordinator_port}",
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+
+
 def _require_init() -> _GlobalState:
     if not _state.initialized:
         raise NotInitializedError()
@@ -82,6 +108,7 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
         if _state.initialized:
             return
         cfg = config_mod.Config.from_env()
+        _maybe_init_jax_distributed(cfg)
         topology = topo_mod.discover(cfg)
         _state.config = cfg
         _state.topology = topology

@@ -106,6 +106,13 @@ class Config:
     rendezvous_addr: Optional[str] = None
     rendezvous_port: Optional[int] = None
     gloo_timeout_seconds: float = 30.0
+    # jax.distributed coordination service (set by the runner; replaces
+    # the reference's MPI_Init / Gloo rendezvous bootstrap — SURVEY §5.8)
+    coordinator_addr: Optional[str] = None
+    coordinator_port: Optional[int] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    secret_key_hex: Optional[str] = None
 
     # --- elastic ---
     elastic_discovery_interval: float = DEFAULT_ELASTIC_DISCOVERY_INTERVAL
@@ -177,6 +184,23 @@ class Config:
             rendezvous_addr=env.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"),
             rendezvous_port=int(rendezvous_port) if rendezvous_port else None,
             gloo_timeout_seconds=_env_float("HOROVOD_GLOO_TIMEOUT_SECONDS", 30.0),
+            coordinator_addr=env.get("HOROVOD_COORDINATOR_ADDR"),
+            coordinator_port=(
+                int(env["HOROVOD_COORDINATOR_PORT"])
+                if env.get("HOROVOD_COORDINATOR_PORT")
+                else None
+            ),
+            num_processes=(
+                _env_int("HOROVOD_NUM_PROCESSES", -1)
+                if "HOROVOD_NUM_PROCESSES" in env
+                else None
+            ),
+            process_id=(
+                _env_int("HOROVOD_PROCESS_ID", -1)
+                if "HOROVOD_PROCESS_ID" in env
+                else None
+            ),
+            secret_key_hex=env.get("HOROVOD_SECRET_KEY"),
             elastic_discovery_interval=_env_float(
                 "HOROVOD_ELASTIC_DISCOVERY_INTERVAL",
                 DEFAULT_ELASTIC_DISCOVERY_INTERVAL,

@@ -1,0 +1,35 @@
+"""Launcher / runner layer — TPU-native rebuild of the reference's
+``horovodrun`` stack (ref: horovod/runner/ [V] — SURVEY.md §2.5, §3.3;
+the reference mount was empty, citations are structural).
+
+What survives the TPU redesign and what changes:
+
+* The reference's launcher probes NICs over SSH and builds an ``mpirun``
+  or Gloo command line; its workers rendezvous through an HTTP KV store.
+  On TPU the *data plane* is XLA collectives over ICI, so the runner's
+  only jobs are (a) process bootstrap with the ``HOROVOD_*`` env
+  contract, (b) wiring the ``jax.distributed`` coordination service
+  (rank-0 host is the coordinator), and (c) watching workers and
+  collecting exit codes.
+* The HTTP KV rendezvous survives (elastic re-keying and the env
+  contract depend on it) — see ``rendezvous.py``.
+* NIC probing is replaced by TPU slice-topology discovery from
+  environment metadata — see ``tpu_discovery.py``.
+
+Public API mirrors ``horovod.run.run()`` / the ``horovodrun`` CLI:
+
+    python -m horovod_tpu.runner -np 8 python train.py
+    from horovod_tpu.runner import run
+"""
+
+from .hosts import (  # noqa: F401
+    HostInfo,
+    SlotInfo,
+    assign_slots,
+    parse_hostfile,
+    parse_hosts,
+)
+from .launch import main, parse_args, run, run_commandline  # noqa: F401
+from .rendezvous import KVStore, RendezvousServer  # noqa: F401
+from .secret import make_secret_key, sign, verify  # noqa: F401
+from .service import BasicClient, BasicService  # noqa: F401

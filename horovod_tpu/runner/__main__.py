@@ -1,0 +1,6 @@
+"""``python -m horovod_tpu.runner`` — the hvdrun entry point
+(ref: the ``horovodrun`` console script, horovod/runner/launch.py [V])."""
+
+from .launch import main
+
+main()

@@ -1,0 +1,147 @@
+"""Host / slot bookkeeping for the launcher.
+
+TPU-native rebuild of the reference's host parsing and slot assignment
+(ref: horovod/runner/launch.py + horovod/runner/elastic/driver.py slot
+math [V] — SURVEY.md §2.5; empty mount, structural citations).
+
+A "host" is a TPU-VM worker (one process per chip by default); a "slot"
+is one rank. ``assign_slots`` produces the rank/local_rank/cross_rank
+numbering the env contract exposes: ranks are dense in host order, the
+same ordering the reference derives from its hostfile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInfo:
+    hostname: str
+    slots: int
+
+    @staticmethod
+    def from_string(spec: str) -> "HostInfo":
+        """Parse ``host:slots`` (``host`` alone means 1 slot)."""
+        spec = spec.strip()
+        if not spec:
+            raise ValueError("empty host spec")
+        if ":" in spec:
+            host, _, slots = spec.rpartition(":")
+            try:
+                n = int(slots)
+            except ValueError:
+                raise ValueError(f"bad slot count in host spec {spec!r}")
+        else:
+            host, n = spec, 1
+        if n < 1:
+            raise ValueError(f"slot count must be >= 1 in {spec!r}")
+        return HostInfo(host, n)
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotInfo:
+    """One rank's coordinates — exactly the fields of the reference's env
+    contract (HOROVOD_RANK/SIZE/LOCAL_RANK/LOCAL_SIZE/CROSS_RANK/
+    CROSS_SIZE [V])."""
+
+    hostname: str
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+
+    def to_env(self) -> Dict[str, str]:
+        return {
+            "HOROVOD_HOSTNAME": self.hostname,
+            "HOROVOD_RANK": str(self.rank),
+            "HOROVOD_SIZE": str(self.size),
+            "HOROVOD_LOCAL_RANK": str(self.local_rank),
+            "HOROVOD_LOCAL_SIZE": str(self.local_size),
+            "HOROVOD_CROSS_RANK": str(self.cross_rank),
+            "HOROVOD_CROSS_SIZE": str(self.cross_size),
+        }
+
+
+def parse_hosts(hosts: str) -> List[HostInfo]:
+    """Parse ``host1:4,host2:4`` (commas or whitespace)."""
+    specs = [s for s in re.split(r"[,\s]+", hosts.strip()) if s]
+    if not specs:
+        raise ValueError(f"no hosts in {hosts!r}")
+    out = [HostInfo.from_string(s) for s in specs]
+    seen = set()
+    for h in out:
+        if h.hostname in seen:
+            raise ValueError(f"duplicate host {h.hostname!r}")
+        seen.add(h.hostname)
+    return out
+
+
+def parse_hostfile(path: str) -> List[HostInfo]:
+    """One ``host slots=N`` (or ``host:N`` / bare ``host``) per line;
+    ``#`` comments allowed — the reference accepts the mpirun-style
+    ``slots=`` form [V]."""
+    hosts: List[HostInfo] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(\S+)\s+slots\s*=\s*(\d+)\s*$", line)
+            if m:
+                hosts.append(HostInfo(m.group(1), int(m.group(2))))
+            else:
+                hosts.append(HostInfo.from_string(line))
+    if not hosts:
+        raise ValueError(f"hostfile {path!r} contains no hosts")
+    seen = set()
+    for h in hosts:
+        if h.hostname in seen:
+            raise ValueError(f"duplicate host {h.hostname!r} in hostfile")
+        seen.add(h.hostname)
+    return hosts
+
+
+def assign_slots(hosts: Sequence[HostInfo], np: int) -> List[SlotInfo]:
+    """Dense rank assignment over hosts in order, matching the
+    reference's numbering: rank-major by host, local_rank within host,
+    cross_rank = index of the host among used hosts (ranks with the same
+    local_rank form a cross set) [V]."""
+    capacity = sum(h.slots for h in hosts)
+    if np < 1:
+        raise ValueError("np must be >= 1")
+    if np > capacity:
+        raise ValueError(
+            f"requested np={np} exceeds total slots {capacity} across "
+            f"{len(hosts)} host(s)"
+        )
+    # How many ranks land on each host (fill hosts in order).
+    remaining = np
+    per_host: List[int] = []
+    for h in hosts:
+        take = min(h.slots, remaining)
+        per_host.append(take)
+        remaining -= take
+    used = [(h, n) for h, n in zip(hosts, per_host) if n > 0]
+    cross_size = len(used)
+    slots: List[SlotInfo] = []
+    rank = 0
+    for cross_rank, (h, n) in enumerate(used):
+        for local_rank in range(n):
+            slots.append(
+                SlotInfo(
+                    hostname=h.hostname,
+                    rank=rank,
+                    size=np,
+                    local_rank=local_rank,
+                    local_size=n,
+                    cross_rank=cross_rank,
+                    cross_size=cross_size,
+                )
+            )
+            rank += 1
+    return slots

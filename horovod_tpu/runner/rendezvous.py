@@ -1,0 +1,259 @@
+"""HTTP key-value rendezvous server.
+
+Rebuild of the reference's Gloo rendezvous (ref:
+horovod/runner/http/http_server.py [V] — SURVEY.md §2.5, §3.3; empty
+mount, structural citations): the driver runs a threaded HTTP server
+holding a scoped KV store; each worker PUTs its own address material and
+GETs (polling) its peers' until the world has converged. Elastic re-keys
+by bumping the scope (one scope per rendezvous round).
+
+On TPU the payloads are the ``jax.distributed`` coordinator address and
+per-host topology rather than Gloo connection strings, but the protocol
+(scoped KV over HTTP, driver-hosted) is the same.
+
+Wire protocol:
+    GET    /kv/<scope>/<key>   -> 200 value | 404
+    PUT    /kv/<scope>/<key>   body = value -> 200
+    DELETE /kv/<scope>         -> 200 (drop whole scope)
+    GET    /scope/<scope>      -> 200 JSON list of keys
+
+If the server was created with a secret key, every request must carry
+``X-Horovod-Digest: hex(hmac_sha256(secret, method + path + body))``;
+bad or missing digests get 403 (parity with the HMAC-signed services,
+SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .secret import sign
+
+
+class KVStore:
+    """Thread-safe scoped key-value store."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, bytes]] = {}
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data.setdefault(scope, {})[key] = value
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(scope, {}).get(key)
+
+    def keys(self, scope: str) -> List[str]:
+        with self._lock:
+            return sorted(self._data.get(scope, {}).keys())
+
+    def drop_scope(self, scope: str) -> None:
+        with self._lock:
+            self._data.pop(scope, None)
+
+
+def _make_handler(store: KVStore, secret_key: Optional[bytes]):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(length) if length else b""
+
+        def _authed(self, body: bytes) -> bool:
+            if secret_key is None:
+                return True
+            digest = self.headers.get("X-Horovod-Digest", "")
+            want = sign(
+                secret_key, self.command.encode() + self.path.encode() + body
+            ).hex()
+            import hmac as _hmac
+
+            return _hmac.compare_digest(digest, want)
+
+        def _reply(self, code: int, body: bytes = b"") -> None:
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if not self._authed(b""):
+                return self._reply(403)
+            parts = self.path.strip("/").split("/")
+            if len(parts) == 3 and parts[0] == "kv":
+                value = store.get(parts[1], parts[2])
+                if value is None:
+                    return self._reply(404)
+                return self._reply(200, value)
+            if len(parts) == 2 and parts[0] == "scope":
+                return self._reply(
+                    200, json.dumps(store.keys(parts[1])).encode()
+                )
+            return self._reply(404)
+
+        def do_PUT(self):
+            body = self._body()
+            if not self._authed(body):
+                return self._reply(403)
+            parts = self.path.strip("/").split("/")
+            if len(parts) == 3 and parts[0] == "kv":
+                store.put(parts[1], parts[2], body)
+                return self._reply(200)
+            return self._reply(404)
+
+        def do_DELETE(self):
+            if not self._authed(b""):
+                return self._reply(403)
+            parts = self.path.strip("/").split("/")
+            if len(parts) == 2 and parts[0] == "kv":
+                store.drop_scope(parts[1])
+                return self._reply(200)
+            return self._reply(404)
+
+    return Handler
+
+
+class RendezvousServer:
+    """Driver-side rendezvous: own thread, ephemeral or fixed port."""
+
+    def __init__(
+        self, port: int = 0, secret_key: Optional[bytes] = None
+    ) -> None:
+        self.store = KVStore()
+        self._secret_key = secret_key
+        self._httpd = ThreadingHTTPServer(
+            ("0.0.0.0", port), _make_handler(self.store, secret_key)
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-rendezvous", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class RendezvousClient:
+    """Worker-side accessor for the driver's KV store."""
+
+    def __init__(
+        self, addr: str, port: int, secret_key: Optional[bytes] = None
+    ) -> None:
+        self._base = f"http://{addr}:{port}"
+        self._secret_key = secret_key
+
+    def _request(self, method: str, path: str, body: bytes = b""):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._base + path, data=body if method == "PUT" else None,
+            method=method,
+        )
+        if self._secret_key is not None:
+            req.add_header(
+                "X-Horovod-Digest",
+                sign(
+                    self._secret_key, method.encode() + path.encode() + body
+                ).hex(),
+            )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, b""
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        status, _ = self._request("PUT", f"/kv/{scope}/{key}", value)
+        if status != 200:
+            raise RuntimeError(f"rendezvous PUT failed with HTTP {status}")
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        status, body = self._request("GET", f"/kv/{scope}/{key}")
+        return body if status == 200 else None
+
+    def wait(
+        self, scope: str, key: str, timeout: float = 30.0, interval: float = 0.05
+    ) -> bytes:
+        """Poll until the key appears — the worker-side rendezvous loop."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            value = self.get(scope, key)
+            if value is not None:
+                return value
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"rendezvous key {scope}/{key} not published in {timeout}s"
+                )
+            time.sleep(interval)
+
+    def keys(self, scope: str) -> List[str]:
+        status, body = self._request("GET", f"/scope/{scope}")
+        return json.loads(body) if status == 200 else []
+
+
+_broadcast_counter = 0
+
+
+def broadcast_via_kv(obj, root_rank: int = 0, name: Optional[str] = None):
+    """Object broadcast through the job's rendezvous KV store — the
+    multi-controller backend of ``hvd.broadcast_object`` (ref:
+    horovod/torch/functions.py broadcast_object, pickle-over-collective
+    [V]). The process owning ``root_rank`` publishes the pickled object;
+    everyone else polls for it. The channel is HMAC-authenticated with
+    the per-job secret, which is what makes pickle acceptable here: only
+    holders of the job secret can publish payloads.
+    """
+    import pickle
+
+    from ..common import basics
+
+    global _broadcast_counter
+    cfg = basics.get_config()
+    if not cfg.rendezvous_addr or not cfg.rendezvous_port:
+        raise RuntimeError(
+            "broadcast_object across processes needs the runner's "
+            "rendezvous (HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT not set)"
+        )
+    secret = (
+        bytes.fromhex(cfg.secret_key_hex) if cfg.secret_key_hex else None
+    )
+    client = RendezvousClient(
+        cfg.rendezvous_addr, cfg.rendezvous_port, secret_key=secret
+    )
+    if name is None:
+        name = f"broadcast_object.{_broadcast_counter}"
+        _broadcast_counter += 1
+    topo = basics.topology()
+    lead = topo.rank
+    owns_root = lead <= root_rank < lead + topo.local_size
+    if owns_root:
+        client.put("broadcast", name, pickle.dumps(obj))
+        return obj
+    payload = client.wait(
+        "broadcast", name, timeout=cfg.gloo_timeout_seconds
+    )
+    return pickle.loads(payload)

@@ -1,0 +1,30 @@
+"""HMAC message signing for runner RPC.
+
+Rebuild of the reference's signed-payload scheme (ref:
+horovod/runner/common/util/secret.py [V] — SURVEY.md §2.5 "RPC
+plumbing"): the driver generates a per-job secret key, every
+request/response body is authenticated with HMAC-SHA256, and services
+reject anything whose digest doesn't verify. This is what stops a
+stray process on the cluster network from injecting rendezvous traffic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets as _secrets
+
+DIGEST_BYTES = hashlib.sha256().digest_size
+
+
+def make_secret_key() -> bytes:
+    """Fresh 256-bit random key, one per launched job."""
+    return _secrets.token_bytes(32)
+
+
+def sign(key: bytes, payload: bytes) -> bytes:
+    return hmac.new(key, payload, hashlib.sha256).digest()
+
+
+def verify(key: bytes, payload: bytes, digest: bytes) -> bool:
+    return hmac.compare_digest(sign(key, payload), digest)

@@ -1,0 +1,56 @@
+"""TPU slice topology discovery.
+
+Replaces the reference's SSH-based NIC/interface probing (ref:
+horovod/runner/driver/driver_service.py [V] — SURVEY.md §2.5): on TPU
+the launcher doesn't need to elect network interfaces (ICI is the data
+plane and fixed); it needs the list of worker hosts in the slice and the
+chip count per host. Those come from TPU-VM environment metadata, with a
+local fallback so the same code path works on a dev box.
+
+Recognized sources, in order:
+1. ``HOROVOD_TPU_HOSTS`` — explicit override, same syntax as ``-H``.
+2. ``TPU_WORKER_HOSTNAMES`` + ``TPU_WORKER_ID`` — set on TPU VMs by the
+   infrastructure (comma-separated host list).
+3. The local JAX runtime (``jax.local_device_count()``) — single-host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .hosts import HostInfo, parse_hosts
+
+
+def chips_per_host(default: int = 4, env: Optional[dict] = None) -> int:
+    """Chips driven by each worker. TPU_CHIPS_PER_HOST_BOUNDS is
+    "x,y,z" (product = chip count); fall back to asking JAX."""
+    env = os.environ if env is None else env
+    bounds = env.get("TPU_CHIPS_PER_HOST_BOUNDS")
+    if bounds:
+        n = 1
+        for part in bounds.split(","):
+            n *= int(part)
+        return n
+    try:
+        import jax
+
+        return jax.local_device_count()
+    except Exception:  # noqa: BLE001 — discovery must not hard-fail
+        return default
+
+
+def discover_hosts(env: Optional[dict] = None) -> List[HostInfo]:
+    env = os.environ if env is None else env
+    override = env.get("HOROVOD_TPU_HOSTS")
+    if override:
+        return parse_hosts(override)
+    hostnames = env.get("TPU_WORKER_HOSTNAMES")
+    if hostnames:
+        per_host = chips_per_host(env=env)
+        return [
+            HostInfo(h.strip(), per_host)
+            for h in hostnames.split(",")
+            if h.strip()
+        ]
+    return [HostInfo("localhost", chips_per_host(default=1))]

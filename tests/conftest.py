@@ -26,6 +26,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running multi-process integration test"
+    )
+
+
 @pytest.fixture
 def hvd():
     """Initialized horovod_tpu with clean state per test."""
