@@ -105,6 +105,7 @@ from .optimizer import (  # noqa: F401
 )
 from . import ops  # noqa: F401
 from .ops import traced  # noqa: F401
+from . import elastic  # noqa: F401  (hvd.elastic.run / State, ref [V])
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,43 @@
+"""Elastic training — fault tolerance and dynamic worker membership.
+
+TPU-native rebuild of Elastic Horovod (ref: horovod/runner/elastic/* +
+horovod/common/elastic.py + horovod/torch/elastic/ [V] — SURVEY.md §2.5,
+§3.4; empty mount, structural citations).
+
+Semantic divergence, by design (SURVEY.md §5.3): on GPU clusters the
+reference resizes the world in place by rebuilding NCCL communicators.
+A TPU slice has fixed ICI topology, so "elastic" here means *slice
+re-acquisition*: on preemption or membership change the driver restarts
+workers on the surviving/new hosts and the training loop resumes from
+the last committed ``State``. The user-facing API is unchanged:
+
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    state = elastic.JaxState(params=params, opt_state=opt_state, step=0)
+
+    @elastic.run
+    def train(state):
+        while state.step < total_steps:
+            ...
+            state.step += 1
+            if state.step % 100 == 0:
+                state.commit()
+
+    train(state)
+"""
+
+from .discovery import (  # noqa: F401
+    FixedHosts,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+)
+from .driver import ElasticDriver, SlotAssignment  # noqa: F401
+from .state import JaxState, ObjectState, State  # noqa: F401
+from .worker import (  # noqa: F401
+    WorkerNotificationManager,
+    WorkerNotificationService,
+    notification_manager,
+    run,
+)

@@ -1,0 +1,114 @@
+"""Host discovery for elastic jobs.
+
+Rebuild of the reference's discovery layer (ref:
+horovod/runner/elastic/discovery.py [V] — SURVEY.md §2.5): the driver
+periodically asks "which hosts (with how many slots) are available right
+now?", diffs against the current world, and triggers
+rendezvous re-keying when the answer changes. The canonical source is a
+user-supplied ``--host-discovery-script`` whose stdout lists one
+``hostname:slots`` per line — kept verbatim, because every elastic
+integration test in the reference drives membership by mutating that
+script's output (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+from ..runner.hosts import HostInfo, parse_hosts
+
+
+class HostDiscovery:
+    """Interface: subclass and return the currently-available hosts.
+
+    Tests subclass this with scripted sequences — the reference's own
+    testing pattern (test_elastic_driver.py fake discovery [V]).
+    """
+
+    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs the user's discovery script; stdout = one host[:slots] per
+    line. Non-zero exit or empty output means "no hosts right now"."""
+
+    def __init__(self, script: str, default_slots: int = 1) -> None:
+        self._script = script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+        try:
+            out = subprocess.run(
+                self._script, shell=True, capture_output=True, timeout=60
+            )
+        except subprocess.TimeoutExpired:
+            return []
+        if out.returncode != 0:
+            return []
+        hosts: List[HostInfo] = []
+        for line in out.stdout.decode().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" not in line:
+                line = f"{line}:{self._default_slots}"
+            hosts.extend(parse_hosts(line))
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    """Static host list — elastic driver over a non-elastic allocation."""
+
+    def __init__(self, hosts: List[HostInfo]) -> None:
+        self._hosts = hosts
+
+    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+        return list(self._hosts)
+
+
+class HostManager:
+    """Tracks available vs blacklisted hosts across discovery rounds
+    (ref: HostManager in discovery.py + blacklist logic in driver.py [V]).
+
+    A host lands on the blacklist when a worker on it fails; it stays
+    there until the job ends (the reference's behavior — a flapping host
+    is worse than a small world)."""
+
+    def __init__(self, discovery: HostDiscovery) -> None:
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._blacklist: set = set()
+        self._current: Dict[str, HostInfo] = {}
+
+    def blacklist(self, hostname: str) -> None:
+        with self._lock:
+            self._blacklist.add(hostname)
+            self._current.pop(hostname, None)
+
+    def is_blacklisted(self, hostname: str) -> bool:
+        with self._lock:
+            return hostname in self._blacklist
+
+    @property
+    def blacklisted(self) -> List[str]:
+        with self._lock:
+            return sorted(self._blacklist)
+
+    def current_hosts(self) -> List[HostInfo]:
+        with self._lock:
+            return [self._current[k] for k in sorted(self._current)]
+
+    def refresh(self) -> bool:
+        """Poll discovery; returns True when membership changed."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            usable = {
+                h.hostname: h for h in found
+                if h.hostname not in self._blacklist
+            }
+            changed = usable != self._current
+            self._current = usable
+            return changed

@@ -1,0 +1,302 @@
+"""The elastic driver: membership tracking, gang (re)launch, notification.
+
+Rebuild of the reference's ElasticDriver (ref:
+horovod/runner/elastic/driver.py + registration.py + rendezvous.py [V] —
+SURVEY.md §2.5, §3.4). Same responsibilities: poll discovery on an
+interval, compute slot assignments within [min_np, max_np], blacklist
+hosts whose workers fail, re-key the rendezvous, notify live workers,
+and collect exit codes.
+
+TPU divergence (SURVEY.md §5.3): the world cannot be resized in place —
+ICI topology is fixed per slice — so every membership change is a *gang
+restart*: terminate the current processes, bump the rendezvous epoch,
+relaunch on the new host set. Workers resume from their last committed
+``State`` (state.py), which is exactly the reference's recovery path
+after a HorovodInternalError; the only thing lost relative to the
+reference is in-place continuation on *grow*, which TPU hardware cannot
+express anyway.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..runner.hosts import HostInfo, SlotInfo, assign_slots
+from ..runner.launch import _free_port, _is_local, worker_envs
+from ..runner.rendezvous import RendezvousServer
+from ..runner.secret import make_secret_key
+from ..runner.service import BasicClient
+from .discovery import HostDiscovery, HostManager
+
+
+class SlotAssignment:
+    """One epoch's worth of placement: which ranks on which hosts."""
+
+    def __init__(self, epoch: int, slots: Sequence[SlotInfo]) -> None:
+        self.epoch = epoch
+        self.slots = list(slots)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.slots)
+
+    @property
+    def hostnames(self) -> List[str]:
+        return sorted({s.hostname for s in self.slots})
+
+
+class ElasticDriver:
+    """Supervises an elastic job.
+
+    Synchronous core + optional background monitor thread, so tests can
+    drive every transition in-process with fake discovery — the
+    reference's own test strategy (test_elastic_driver.py [V],
+    SURVEY.md §4.2).
+    """
+
+    def __init__(
+        self,
+        discovery: HostDiscovery,
+        command: Sequence[str],
+        min_np: int,
+        max_np: Optional[int] = None,
+        slots_per_host: Optional[int] = None,
+        discovery_interval: float = 1.0,
+        placement: str = "auto",
+        start_timeout: float = 600.0,
+        output_filename: Optional[str] = None,
+        reset_limit: Optional[int] = None,
+    ) -> None:
+        self.host_manager = HostManager(discovery)
+        self._command = list(command)
+        self._min_np = min_np
+        self._max_np = max_np or 2**31
+        self._slots_per_host = slots_per_host
+        self._interval = discovery_interval
+        self._placement = placement
+        self._start_timeout = start_timeout
+        self._output_filename = output_filename
+        self._reset_limit = reset_limit
+        self._epoch = 0
+        self._resets = 0
+        self._secret = make_secret_key()
+        self._server: Optional[RendezvousServer] = None
+        self._procs: List[subprocess.Popen] = []
+        self._blocks: List[Dict[str, str]] = []
+        self._assignment: Optional[SlotAssignment] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- planning
+
+    def compute_assignment(self, epoch: Optional[int] = None) -> Optional[SlotAssignment]:
+        """Slot assignment for the current host set, or None when the
+        available capacity is below min_np (ref: driver.py
+        _update_host_assignments [V])."""
+        hosts = self.host_manager.current_hosts()
+        if self._slots_per_host is not None:
+            hosts = [HostInfo(h.hostname, self._slots_per_host) for h in hosts]
+        capacity = sum(h.slots for h in hosts)
+        if capacity < self._min_np:
+            return None
+        np_ = min(capacity, self._max_np)
+        return SlotAssignment(
+            self._epoch if epoch is None else epoch,
+            assign_slots(hosts, np_),
+        )
+
+    def handle_host_failure(self, hostname: str) -> None:
+        """Blacklist + force re-plan (ref: blacklist on worker failure)."""
+        self.host_manager.blacklist(hostname)
+
+    # ---------------------------------------------------------- gang ops
+
+    def _rendezvous(self) -> RendezvousServer:
+        if self._server is None:
+            self._server = RendezvousServer(secret_key=self._secret)
+            self._server.start()
+        return self._server
+
+    def _launch_gang(self, assignment: SlotAssignment) -> None:
+        server = self._rendezvous()
+        placement = self._placement
+        if placement == "auto":
+            placement = (
+                "per-slot"
+                if all(_is_local(h) for h in assignment.hostnames)
+                else "per-host"
+            )
+        addr = "127.0.0.1" if all(
+            _is_local(h) for h in assignment.hostnames
+        ) else os.uname().nodename
+        blocks = worker_envs(
+            assignment.slots,
+            placement,
+            addr,
+            server.port,
+            _free_port(),
+            self._secret.hex(),
+            extra={
+                "HOROVOD_ELASTIC_EPOCH": str(assignment.epoch),
+                "HOROVOD_ELASTIC": "1",
+            },
+        )
+        procs: List[subprocess.Popen] = []
+        for block in blocks:
+            env = dict(os.environ)
+            env.update(block)
+            cwd = os.getcwd()
+            prior = env.get("PYTHONPATH")
+            env["PYTHONPATH"] = cwd if not prior else cwd + os.pathsep + prior
+            stdout = stderr = None
+            if self._output_filename:
+                os.makedirs(self._output_filename, exist_ok=True)
+                tag = f"epoch.{assignment.epoch}.rank.{block['HOROVOD_RANK']}"
+                stdout = open(
+                    os.path.join(self._output_filename, tag + ".out"), "wb"
+                )
+                stderr = open(
+                    os.path.join(self._output_filename, tag + ".err"), "wb"
+                )
+            procs.append(
+                subprocess.Popen(
+                    self._command, env=env, stdout=stdout, stderr=stderr
+                )
+            )
+        with self._lock:
+            self._procs = procs
+            self._blocks = blocks
+            self._assignment = assignment
+
+    def _terminate_gang(self, grace: float = 10.0) -> None:
+        with self._lock:
+            procs = list(self._procs)
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + grace
+        for p in procs:
+            timeout = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=5)
+
+    def _notify_workers(self, message_type: str) -> None:
+        """Tell every live worker the membership changed (ref:
+        WorkerNotificationService HTTP ping [V]). Worker addresses come
+        from the rendezvous KV, where each notification manager
+        registers itself."""
+        server = self._rendezvous()
+        scope = f"workers.{self._epoch}"
+        for key in server.store.keys(scope):
+            value = server.store.get(scope, key)
+            if value is None:
+                continue
+            host, _, port = value.decode().partition(":")
+            try:
+                BasicClient(host, int(port), self._secret, timeout=5).request(
+                    {"type": message_type, "epoch": self._epoch}
+                )
+            except OSError:
+                pass  # worker already gone; its exit will be collected
+
+    # ---------------------------------------------------------- main loop
+
+    def _poll_gang(self) -> Optional[int]:
+        """Collect exits. Returns an overall exit code when the gang is
+        done (0 only if ALL workers exited 0), or None while running.
+        Worker failure blacklists its host and triggers a reset."""
+        with self._lock:
+            procs = list(self._procs)
+            blocks = list(self._blocks)
+        if not procs:
+            return None
+        codes = [p.poll() for p in procs]
+        failed = [
+            (blocks[i]["HOROVOD_HOSTNAME"], rc)
+            for i, rc in enumerate(codes)
+            if rc not in (None, 0)
+        ]
+        if failed:
+            for hostname, _ in failed:
+                self.handle_host_failure(hostname)
+            return failed[0][1]
+        if all(rc == 0 for rc in codes):
+            return 0
+        return None
+
+    def run(self) -> int:
+        """Supervise until success, stop(), or capacity exhaustion.
+        Returns the job's exit code."""
+        last_refresh = 0.0
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - last_refresh >= self._interval:
+                changed = self.host_manager.refresh()
+                last_refresh = now
+                if changed and self._assignment is not None:
+                    # Membership changed under a live gang: tell workers
+                    # (they commit + exit for re-launch), then restart.
+                    self._notify_workers("hosts_updated")
+                    self._terminate_gang()
+                    if not self._reset(reason="membership change"):
+                        return 1
+                    continue
+            if self._assignment is None:
+                new = self.compute_assignment()
+                if new is not None:
+                    self._launch_gang(new)
+                elif not self._wait_for_capacity(last_refresh):
+                    return 1
+                continue
+            rc = self._poll_gang()
+            if rc == 0:
+                return 0
+            if rc is not None:
+                self._terminate_gang()
+                if not self._reset(reason=f"worker failed rc={rc}"):
+                    return rc
+            time.sleep(0.05)
+        self._terminate_gang()
+        return 0
+
+    def _reset(self, reason: str) -> bool:
+        """Bump epoch and clear the assignment so the loop relaunches.
+        False when the reset budget is exhausted (HOROVOD_ELASTIC
+        reset_limit parity [V])."""
+        self._resets += 1
+        if self._reset_limit is not None and self._resets > self._reset_limit:
+            return False
+        self._epoch += 1
+        with self._lock:
+            self._assignment = None
+            self._procs = []
+            self._blocks = []
+        return True
+
+    def _wait_for_capacity(self, last_refresh: float) -> bool:
+        """Below min_np: keep polling discovery up to start_timeout."""
+        deadline = time.monotonic() + self._start_timeout
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            time.sleep(self._interval)
+            self.host_manager.refresh()
+            if self.compute_assignment() is not None:
+                return True
+        return self.compute_assignment() is not None
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def shutdown(self) -> None:
+        self.stop()
+        self._terminate_gang()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None

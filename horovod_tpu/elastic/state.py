@@ -1,0 +1,174 @@
+"""Elastic state objects: commit / restore / sync.
+
+Rebuild of the reference's State hierarchy (ref:
+horovod/common/elastic.py `State`/`ObjectState` +
+horovod/torch/elastic/state.py `TorchState` [V] — SURVEY.md §2.5, §5.4):
+a State wraps everything that must survive a membership change —
+model/optimizer pytrees plus scalars like the step counter.
+
+* ``commit()`` snapshots to host memory (the reference's in-memory
+  checkpoint) and checks for pending host updates;
+* ``restore()`` rolls back to the last commit after a failure;
+* ``sync()`` re-replicates state across the (new) world at the top of
+  every elastic retry.
+
+``JaxState`` is the TorchState analog: registered pytrees are committed
+with ``jax.device_get`` (host numpy) and restored with
+``jax.device_put`` back to replicated placement on the current mesh —
+after a gang restart the mesh object itself is new, which is why restore
+re-resolves it through basics rather than caching shardings.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+class State:
+    """Commit/restore/sync interface + reset callbacks
+    (ref: horovod/common/elastic.py State [V])."""
+
+    def __init__(self) -> None:
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(
+        self, callbacks: List[Callable[[], None]]
+    ) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for callback in self._reset_callbacks:
+            callback()
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt when the driver signalled a
+        membership change (delivered via WorkerNotificationManager)."""
+        from .worker import notification_manager
+
+        notification_manager.raise_if_updated()
+
+    # subclass surface
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """State over plain-Python attributes; commit = deepcopy
+    (ref: ObjectState [V])."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for key, value in kwargs.items():
+            setattr(self, key, value)
+        self._known = list(kwargs)
+        ObjectState.save(self)
+
+    def _attrs(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._known}
+
+    def save(self) -> None:
+        self._saved = copy.deepcopy(self._attrs())
+
+    def restore(self) -> None:
+        for key, value in copy.deepcopy(self._saved).items():
+            setattr(self, key, value)
+
+    def sync(self) -> None:
+        """Broadcast plain attributes from the root across processes
+        (rank 0's values win — ref: ObjectState.sync broadcast_object
+        [V])."""
+        from ..optimizer import broadcast_object
+
+        synced = broadcast_object(self._attrs(), root_rank=0)
+        for key, value in synced.items():
+            setattr(self, key, value)
+
+
+class JaxState(ObjectState):
+    """State whose pytree attributes are device arrays (params,
+    opt_state, batch_stats, ...). Scalars ride the ObjectState path;
+    pytrees are snapshotted to host numpy and re-placed on the current
+    mesh, replicated, on restore/sync — the broadcast-from-root that
+    TorchState does with hvd.broadcast_parameters [V].
+    """
+
+    _TREE_PREFIX = "_tree_"
+
+    def __init__(self, **kwargs: Any) -> None:
+        trees = {
+            k: v for k, v in kwargs.items() if self._is_tree(v)
+        }
+        scalars = {k: v for k, v in kwargs.items() if k not in trees}
+        self._trees: Dict[str, Any] = {}
+        self._trees_saved: Dict[str, Any] = {}
+        super().__init__(**scalars)
+        for key, value in trees.items():
+            self._trees[key] = value
+        self.save()
+
+    @staticmethod
+    def _is_tree(value: Any) -> bool:
+        leaves = jax.tree_util.tree_leaves(value)
+        return any(
+            isinstance(leaf, (jax.Array, np.ndarray)) for leaf in leaves
+        )
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails
+        trees = object.__getattribute__(self, "__dict__").get("_trees", {})
+        if name in trees:
+            return trees[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name != "_trees" and hasattr(self, "_trees") and name in self._trees:
+            self._trees[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def save(self) -> None:
+        super().save()
+        self._trees_saved = {
+            key: jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+            for key, tree in self._trees.items()
+        }
+
+    def _replicate(self, tree):
+        from ..common import basics
+        from ..common.topology import replicated_sharding
+
+        if not basics.is_initialized():
+            return jax.tree_util.tree_map(jax.numpy.asarray, tree)
+        sharding = replicated_sharding(basics.mesh())
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), tree
+        )
+
+    def restore(self) -> None:
+        super().restore()
+        for key, host_tree in self._trees_saved.items():
+            self._trees[key] = self._replicate(host_tree)
+
+    def sync(self) -> None:
+        super().sync()
+        for key, tree in self._trees.items():
+            self._trees[key] = self._replicate(jax.device_get(tree))

@@ -1,0 +1,137 @@
+"""Worker-side elastic machinery: notifications + the ``run`` wrapper.
+
+Rebuild of the reference's worker half (ref: horovod/common/elastic.py
+`run` + horovod/runner/elastic/worker.py WorkerNotificationService/
+Manager [V] — SURVEY.md §2.5, §3.4).
+
+Flow (§3.4): the wrapped train function loops — ``state.sync()``, run
+the body; on ``HorovodInternalError`` restore to the last commit, on
+``HostsUpdatedInterrupt`` keep current state; either way shut down and
+re-init the runtime against the new world, then retry the body.
+"""
+
+from __future__ import annotations
+
+import functools
+import socket
+import threading
+from typing import Optional
+
+from ..common.basics import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from ..runner.service import BasicService
+
+
+class WorkerNotificationService(BasicService):
+    """Tiny RPC endpoint inside each worker the driver pings on
+    membership changes (ref: WorkerNotificationService [V])."""
+
+    def __init__(self, secret_key: bytes, manager: "WorkerNotificationManager"):
+        super().__init__("worker-notification", secret_key)
+        self.register("hosts_updated", manager._on_hosts_updated)
+
+
+class WorkerNotificationManager:
+    """Registers with the driver's rendezvous, listens for updates,
+    surfaces them as HostsUpdatedInterrupt at commit boundaries
+    (ref: WorkerNotificationManager [V])."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._service: Optional[WorkerNotificationService] = None
+        self._updated = threading.Event()
+
+    def init(self) -> None:
+        """Start the notification endpoint and advertise it in the
+        driver's KV store under workers.<epoch>/<process_id>. No-op
+        when not under an elastic driver (env absent) or already up."""
+        with self._lock:
+            if self._service is not None:
+                return
+            from ..common import config as config_mod
+
+            cfg = config_mod.Config.from_env()
+            if not (
+                cfg.rendezvous_addr
+                and cfg.rendezvous_port
+                and cfg.secret_key_hex
+            ):
+                return
+            import os
+
+            secret = bytes.fromhex(cfg.secret_key_hex)
+            self._service = WorkerNotificationService(secret, self)
+            port = self._service.start()
+            epoch = os.environ.get("HOROVOD_ELASTIC_EPOCH", "0")
+            process_id = os.environ.get("HOROVOD_PROCESS_ID", "0")
+            # our address as the driver should dial it
+            hostname = os.environ.get("HOROVOD_HOSTNAME", "")
+            if hostname in ("localhost", "127.0.0.1", "", socket.gethostname()):
+                hostname = "127.0.0.1"
+            from ..runner.rendezvous import RendezvousClient
+
+            RendezvousClient(
+                cfg.rendezvous_addr, cfg.rendezvous_port, secret_key=secret
+            ).put(f"workers.{epoch}", process_id, f"{hostname}:{port}".encode())
+
+    def _on_hosts_updated(self, request: dict) -> dict:
+        self._updated.set()
+        return {}
+
+    def raise_if_updated(self) -> None:
+        if self._updated.is_set():
+            self._updated.clear()
+            raise HostsUpdatedInterrupt()
+
+    def reset(self) -> None:
+        self._updated.clear()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._service is not None:
+                self._service.stop()
+                self._service = None
+
+
+notification_manager = WorkerNotificationManager()
+
+
+def _reset_runtime() -> None:
+    """Tear down and re-init against the (possibly new) world —
+    the reference's hvd.shutdown()/hvd.init() reinit boundary (§3.4)."""
+    from ..common import basics
+
+    basics.shutdown()
+    basics.init()
+
+
+def run(func):
+    """``@hvd.elastic.run`` — retry loop with commit/restore semantics
+    (ref: horovod/common/elastic.py run_fn [V]).
+
+    The wrapped function's first argument must be a ``State``.
+    """
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        notification_manager.init()
+        skip_sync = False
+        while True:
+            if not skip_sync:
+                state.sync()
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                # a peer died mid-collective: roll back to last commit
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt:
+                # membership changed but our state is good: keep it
+                skip_sync = True
+            _reset_runtime()
+            notification_manager.reset()
+            state.on_reset()
+
+    return wrapper

@@ -24,18 +24,36 @@ class HostInfo:
 
     @staticmethod
     def from_string(spec: str) -> "HostInfo":
-        """Parse ``host:slots`` (``host`` alone means 1 slot)."""
+        """Parse ``host:slots`` (``host`` alone means 1 slot). IPv6
+        addresses use brackets: ``[::1]:4``; a bare multi-colon string
+        is taken whole as an IPv6 hostname with 1 slot."""
         spec = spec.strip()
         if not spec:
             raise ValueError("empty host spec")
-        if ":" in spec:
-            host, _, slots = spec.rpartition(":")
+        if spec.startswith("["):
+            addr, bracket, rest = spec.partition("]")
+            if not bracket:
+                raise ValueError(f"unterminated '[' in host spec {spec!r}")
+            host = addr[1:]
+            if not rest:
+                n = 1
+            elif rest.startswith(":"):
+                try:
+                    n = int(rest[1:])
+                except ValueError:
+                    raise ValueError(f"bad slot count in host spec {spec!r}")
+            else:
+                raise ValueError(f"bad host spec {spec!r}")
+        elif spec.count(":") == 1:
+            host, _, slots = spec.partition(":")
             try:
                 n = int(slots)
             except ValueError:
                 raise ValueError(f"bad slot count in host spec {spec!r}")
         else:
             host, n = spec, 1
+        if not host:
+            raise ValueError(f"empty hostname in host spec {spec!r}")
         if n < 1:
             raise ValueError(f"slot count must be >= 1 in {spec!r}")
         return HostInfo(host, n)

@@ -115,7 +115,16 @@ def _resolve_hosts(args: argparse.Namespace) -> List[HostInfo]:
         return parse_hosts(args.hosts)
     if args.hostfile:
         return parse_hostfile(args.hostfile)
-    return [HostInfo("localhost", args.num_proc)]
+    # No explicit hosts: consult TPU slice metadata (the reference's
+    # NIC-probing slot, SURVEY §2.5 → tpu_discovery) before assuming a
+    # single local machine.
+    from .tpu_discovery import discover_hosts
+
+    hosts = discover_hosts()
+    if len(hosts) == 1 and _is_local(hosts[0].hostname):
+        # single-host: allow oversubscription up to the requested np
+        return [HostInfo(hosts[0].hostname, max(hosts[0].slots, args.num_proc))]
+    return hosts
 
 
 def _runtime_env(args: argparse.Namespace) -> Dict[str, str]:
@@ -195,9 +204,11 @@ def worker_envs(
         raise ValueError(f"unknown placement {placement!r}")
     # The jax.distributed coordinator runs inside process 0, i.e. on the
     # FIRST WORKER's host — not on the driver (which may be a separate
-    # head node). Workers must dial that host.
+    # head node). Workers must dial that host. Loopback is only valid
+    # when EVERY worker is local; in a mixed job remote workers need a
+    # routable name for host 0.
     coordinator_host = blocks[0]["HOROVOD_HOSTNAME"]
-    if _is_local(coordinator_host):
+    if all(_is_local(b["HOROVOD_HOSTNAME"]) for b in blocks):
         coordinator_host = "127.0.0.1"
     for env in blocks:
         env["HOROVOD_CONTROLLER"] = "tpu"
@@ -316,6 +327,10 @@ def launch_processes(
                 prc.kill()
         return exit_code
     finally:
+        # A mid-spawn exception must not orphan already-started workers.
+        for prc in procs:
+            if prc.poll() is None:
+                prc.kill()
         for f in files:
             f.close()
 
@@ -373,7 +388,7 @@ def run(
         flag = "--" + key.replace("_", "-")
         if value is True:
             argv.append(flag)
-        elif value not in (None, False):
+        elif value is not None and value is not False:
             argv += [flag, str(value)]
     argv += ["--", *command]
     return run_commandline(argv)

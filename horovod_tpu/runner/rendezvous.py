@@ -215,7 +215,7 @@ class RendezvousClient:
         return json.loads(body) if status == 200 else []
 
 
-_broadcast_counter = 0
+_broadcast_counts: Dict[str, int] = {}
 
 
 def broadcast_via_kv(obj, root_rank: int = 0, name: Optional[str] = None):
@@ -231,7 +231,6 @@ def broadcast_via_kv(obj, root_rank: int = 0, name: Optional[str] = None):
 
     from ..common import basics
 
-    global _broadcast_counter
     cfg = basics.get_config()
     if not cfg.rendezvous_addr or not cfg.rendezvous_port:
         raise RuntimeError(
@@ -244,9 +243,15 @@ def broadcast_via_kv(obj, root_rank: int = 0, name: Optional[str] = None):
     client = RendezvousClient(
         cfg.rendezvous_addr, cfg.rendezvous_port, secret_key=secret
     )
-    if name is None:
-        name = f"broadcast_object.{_broadcast_counter}"
-        _broadcast_counter += 1
+    # Broadcast is a collective: every process calls it in the same
+    # order, so a per-name call counter is identical everywhere. Folding
+    # it into the key makes each round a fresh key — a reused explicit
+    # ``name`` must not hand non-root processes the previous round's
+    # payload.
+    base = "broadcast_object" if name is None else name
+    count = _broadcast_counts.get(base, 0)
+    _broadcast_counts[base] = count + 1
+    name = f"{base}.{count}"
     topo = basics.topology()
     lead = topo.rank
     owns_root = lead <= root_rank < lead + topo.local_size

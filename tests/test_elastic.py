@@ -1,0 +1,328 @@
+"""Elastic tests — the reference's model (SURVEY.md §4.2/§4.3):
+driver logic in-process against fake scripted discovery; integration via
+real localhost gangs with file-mutation membership changes and failing
+workers."""
+
+import os
+import sys
+import time
+from typing import List
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.elastic import (
+    ElasticDriver,
+    FixedHosts,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+    JaxState,
+    ObjectState,
+)
+from horovod_tpu.elastic.worker import notification_manager, run as elastic_run
+from horovod_tpu.common.basics import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.runner.hosts import HostInfo
+
+
+class FakeDiscovery(HostDiscovery):
+    """Scripted host sequences — the reference's fake-discovery test
+    pattern (test_elastic_driver.py [V])."""
+
+    def __init__(self, hosts: List[HostInfo]):
+        self.hosts = list(hosts)
+
+    def find_available_hosts_and_slots(self):
+        return list(self.hosts)
+
+
+class TestDiscovery:
+    def test_script_discovery(self, tmp_path):
+        listing = tmp_path / "hosts.txt"
+        listing.write_text("a:2\nb:2\n")
+        disc = HostDiscoveryScript(f"cat {listing}")
+        assert disc.find_available_hosts_and_slots() == [
+            HostInfo("a", 2),
+            HostInfo("b", 2),
+        ]
+        # membership driven by mutating the file — §4.3's mechanism
+        listing.write_text("a:2\n")
+        assert disc.find_available_hosts_and_slots() == [HostInfo("a", 2)]
+
+    def test_script_failure_means_no_hosts(self):
+        assert HostDiscoveryScript("exit 1").find_available_hosts_and_slots() == []
+
+    def test_default_slots(self, tmp_path):
+        listing = tmp_path / "hosts.txt"
+        listing.write_text("a\n")
+        disc = HostDiscoveryScript(f"cat {listing}", default_slots=4)
+        assert disc.find_available_hosts_and_slots() == [HostInfo("a", 4)]
+
+    def test_host_manager_blacklist(self):
+        disc = FakeDiscovery([HostInfo("a", 2), HostInfo("b", 2)])
+        mgr = HostManager(disc)
+        assert mgr.refresh() is True
+        assert [h.hostname for h in mgr.current_hosts()] == ["a", "b"]
+        mgr.blacklist("a")
+        assert mgr.is_blacklisted("a")
+        assert [h.hostname for h in mgr.current_hosts()] == ["b"]
+        # blacklisted host keeps being filtered on refresh
+        mgr.refresh()
+        assert [h.hostname for h in mgr.current_hosts()] == ["b"]
+
+    def test_refresh_reports_change(self):
+        disc = FakeDiscovery([HostInfo("a", 2)])
+        mgr = HostManager(disc)
+        assert mgr.refresh() is True
+        assert mgr.refresh() is False
+        disc.hosts.append(HostInfo("b", 2))
+        assert mgr.refresh() is True
+
+
+class TestAssignment:
+    def _driver(self, disc, **kw):
+        kw.setdefault("min_np", 1)
+        return ElasticDriver(disc, ["true"], **kw)
+
+    def test_below_min_np_is_none(self):
+        d = self._driver(FakeDiscovery([HostInfo("a", 2)]), min_np=4)
+        d.host_manager.refresh()
+        assert d.compute_assignment() is None
+
+    def test_max_np_clamps(self):
+        d = self._driver(
+            FakeDiscovery([HostInfo("a", 4), HostInfo("b", 4)]), max_np=6
+        )
+        d.host_manager.refresh()
+        a = d.compute_assignment()
+        assert a.world_size == 6
+        # ranks dense, reference numbering
+        assert [s.rank for s in a.slots] == list(range(6))
+
+    def test_failure_then_reassignment(self):
+        d = self._driver(FakeDiscovery([HostInfo("a", 2), HostInfo("b", 2)]))
+        d.host_manager.refresh()
+        assert d.compute_assignment().world_size == 4
+        d.handle_host_failure("a")
+        a = d.compute_assignment()
+        assert a.world_size == 2
+        assert a.hostnames == ["b"]
+
+    def test_slots_per_host_override(self):
+        d = self._driver(
+            FakeDiscovery([HostInfo("a", 1)]), slots_per_host=4
+        )
+        d.host_manager.refresh()
+        assert d.compute_assignment().world_size == 4
+
+
+class TestState:
+    def test_object_state_commit_restore(self):
+        s = ObjectState(step=0, best=1.5)
+        s.step = 10
+        s.commit()
+        s.step = 99
+        s.restore()
+        assert s.step == 10 and s.best == 1.5
+
+    def test_object_state_initial_save(self):
+        s = ObjectState(step=5)
+        s.step = 7
+        s.restore()  # never committed → back to construction values
+        assert s.step == 5
+
+    def test_jax_state_tree_commit_restore(self, hvd):
+        import jax.numpy as jnp
+
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+        s = JaxState(params=params, step=0)
+        s.params = {"w": jnp.full((4, 4), 2.0), "b": jnp.ones(4)}
+        s.step = 3
+        s.commit()
+        s.params = {"w": jnp.full((4, 4), -1.0), "b": jnp.ones(4)}
+        s.step = 8
+        s.restore()
+        assert s.step == 3
+        np.testing.assert_allclose(np.asarray(s.params["w"]), 2.0)
+        np.testing.assert_allclose(np.asarray(s.params["b"]), 1.0)
+
+    def test_jax_state_sync_replicates(self, hvd):
+        import jax
+        import jax.numpy as jnp
+
+        s = JaxState(params={"w": jnp.arange(8.0)})
+        s.sync()
+        leaf = s.params["w"]
+        assert isinstance(leaf, jax.Array)
+        assert leaf.sharding.is_fully_replicated
+        np.testing.assert_allclose(np.asarray(leaf), np.arange(8.0))
+
+
+class TestRunWrapper:
+    def test_internal_error_restores_and_retries(self, hvd):
+        calls = []
+
+        class S(ObjectState):
+            def sync(self):
+                calls.append("sync")
+
+        state = S(step=0)
+        attempts = {"n": 0}
+
+        @elastic_run
+        def train(st):
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                st.step = 50  # uncommitted progress, must be rolled back
+                raise HorovodInternalError("peer died")
+            return st.step
+
+        assert train(state) == 0  # rolled back to initial commit
+        assert attempts["n"] == 2
+        assert calls == ["sync", "sync"]  # re-synced after restore
+
+    def test_hosts_updated_keeps_state(self, hvd):
+        state = ObjectState(step=0)
+        attempts = {"n": 0}
+
+        @elastic_run
+        def train(st):
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                st.step = 7
+                raise HostsUpdatedInterrupt()
+            return st.step
+
+        assert train(state) == 7  # progress preserved on membership change
+        assert attempts["n"] == 2
+
+    def test_commit_raises_on_pending_update(self, hvd):
+        state = ObjectState(step=0)
+        notification_manager._updated.set()
+        with pytest.raises(HostsUpdatedInterrupt):
+            state.commit()
+        # flag consumed
+        state.commit()
+
+
+class TestNotificationEndToEnd:
+    def test_driver_notifies_worker_manager(self, monkeypatch):
+        """Worker manager registers in the KV; driver pings it; the flag
+        surfaces as HostsUpdatedInterrupt."""
+        from horovod_tpu.elastic.worker import WorkerNotificationManager
+        from horovod_tpu.runner.rendezvous import RendezvousServer
+        from horovod_tpu.runner.service import BasicClient
+
+        import horovod_tpu.runner.secret as secret_mod
+
+        key = secret_mod.make_secret_key()
+        server = RendezvousServer(secret_key=key)
+        port = server.start()
+        try:
+            monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
+            monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_PORT", str(port))
+            monkeypatch.setenv("HOROVOD_SECRET_KEY", key.hex())
+            monkeypatch.setenv("HOROVOD_ELASTIC_EPOCH", "0")
+            monkeypatch.setenv("HOROVOD_PROCESS_ID", "0")
+            monkeypatch.setenv("HOROVOD_HOSTNAME", "localhost")
+            mgr = WorkerNotificationManager()
+            mgr.init()
+            try:
+                addr = server.store.get("workers.0", "0")
+                assert addr is not None
+                host, _, sport = addr.decode().partition(":")
+                out = BasicClient(host, int(sport), key).request(
+                    {"type": "hosts_updated", "epoch": 0}
+                )
+                assert out["ok"] is True
+                with pytest.raises(HostsUpdatedInterrupt):
+                    mgr.raise_if_updated()
+            finally:
+                mgr.shutdown()
+        finally:
+            server.stop()
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+@pytest.mark.slow
+class TestDriverIntegration:
+    """Real localhost gangs (§4.3's chaos style, scaled to CI)."""
+
+    def test_gang_success(self, monkeypatch):
+        for k, v in _clean_env().items():
+            monkeypatch.setenv(k, v)
+        d = ElasticDriver(
+            FakeDiscovery([HostInfo("localhost", 2)]),
+            [sys.executable, "-c", "import os; assert os.environ['HOROVOD_SIZE']=='2'"],
+            min_np=2,
+            discovery_interval=0.2,
+        )
+        try:
+            d.host_manager.refresh()
+            assert d.run() == 0
+        finally:
+            d.shutdown()
+
+    def test_worker_failure_blacklists_and_exhausts(self, monkeypatch):
+        for k, v in _clean_env().items():
+            monkeypatch.setenv(k, v)
+        d = ElasticDriver(
+            FakeDiscovery([HostInfo("localhost", 1)]),
+            [sys.executable, "-c", "raise SystemExit(5)"],
+            min_np=1,
+            discovery_interval=0.1,
+            start_timeout=0.5,
+        )
+        try:
+            d.host_manager.refresh()
+            rc = d.run()
+            assert rc != 0
+            assert d.host_manager.is_blacklisted("localhost")
+        finally:
+            d.shutdown()
+
+    def test_membership_shrink_restarts_gang(self, monkeypatch, tmp_path):
+        """World of 2 sleeps; discovery shrinks to 1; restarted world of
+        1 exits 0 — the §3.4 restart-on-change path with a live gang."""
+        for k, v in _clean_env().items():
+            monkeypatch.setenv(k, v)
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "if os.environ['HOROVOD_SIZE'] == '1':\n"
+            "    sys.exit(0)\n"
+            "time.sleep(120)\n"
+        )
+        listing = tmp_path / "hosts.txt"
+        listing.write_text("localhost:2\n")
+        d = ElasticDriver(
+            HostDiscoveryScript(f"cat {listing}"),
+            [sys.executable, str(script)],
+            min_np=1,
+            discovery_interval=0.2,
+        )
+        try:
+            d.host_manager.refresh()
+            import threading
+
+            result = {}
+            t = threading.Thread(target=lambda: result.update(rc=d.run()))
+            t.start()
+            time.sleep(1.5)  # let epoch-0 gang come up
+            listing.write_text("localhost:1\n")  # shrink membership
+            t.join(timeout=60)
+            assert not t.is_alive(), "driver did not converge"
+            assert result["rc"] == 0
+        finally:
+            d.shutdown()

@@ -35,6 +35,15 @@ class TestHosts:
         hosts = parse_hosts("a:4, b:2,c")
         assert hosts == [HostInfo("a", 4), HostInfo("b", 2), HostInfo("c", 1)]
 
+    def test_parse_ipv6(self):
+        assert HostInfo.from_string("[::1]:4") == HostInfo("::1", 4)
+        assert HostInfo.from_string("[fe80::2]") == HostInfo("fe80::2", 1)
+        assert HostInfo.from_string("fe80::2") == HostInfo("fe80::2", 1)
+        with pytest.raises(ValueError):
+            HostInfo.from_string("[::1")
+        with pytest.raises(ValueError):
+            HostInfo.from_string("[::1]x")
+
     def test_parse_hosts_rejects_dupes_and_garbage(self):
         with pytest.raises(ValueError):
             parse_hosts("a:4,a:2")
@@ -273,7 +282,12 @@ class TestBroadcastObject:
             reader = RendezvousClient("127.0.0.1", port, secret_key=key)
             import pickle
 
-            assert pickle.loads(reader.wait("broadcast", "state", 2)) == obj
+            # round counter is folded into the key so a reused name
+            # never returns a stale previous-round payload
+            assert pickle.loads(reader.wait("broadcast", "state.0", 2)) == obj
+            obj2 = {"step": 8}
+            assert broadcast_via_kv(obj2, root_rank=0, name="state") == obj2
+            assert pickle.loads(reader.wait("broadcast", "state.1", 2)) == obj2
         finally:
             server.stop()
 
