@@ -1,0 +1,86 @@
+// Adasum pairwise combiner — host-side native math.
+//
+// TPU-native rebuild of the reference's Adasum core (ref:
+// horovod/common/ops/adasum/adasum.h, the recursive
+// vector-halving-distance-doubling combiner, and
+// adasum_mpi_operations.cc — SURVEY.md §2.2). The on-device path lives
+// in horovod_tpu/ops/adasum.py (XLA collectives + MXU dots); this is
+// the CPU-buffer variant mirroring the reference's Adasum-MPI host
+// path, used for host-resident tensors (elastic state reconciliation,
+// tests, eager CPU arrays) and as the numerics oracle for the device
+// kernels.
+//
+// Combine rule (adasum.h):
+//   out = (1 - a.b / (2 a.a)) * a + (1 - a.b / (2 b.b)) * b
+// Dot products accumulate in double regardless of input precision,
+// matching the reference's accumulation discipline.
+
+#include "export.h"
+
+#include <cstring>
+#include <vector>
+
+namespace {
+
+template <typename T>
+void adasum_pair(const T* a, const T* b, T* out, long n) {
+  double dot = 0.0, asq = 0.0, bsq = 0.0;
+  for (long i = 0; i < n; ++i) {
+    double av = static_cast<double>(a[i]);
+    double bv = static_cast<double>(b[i]);
+    dot += av * bv;
+    asq += av * av;
+    bsq += bv * bv;
+  }
+  double acoef = asq > 0.0 ? 1.0 - dot / (2.0 * asq) : 1.0;
+  double bcoef = bsq > 0.0 ? 1.0 - dot / (2.0 * bsq) : 1.0;
+  for (long i = 0; i < n; ++i) {
+    out[i] = static_cast<T>(acoef * static_cast<double>(a[i]) +
+                            bcoef * static_cast<double>(b[i]));
+  }
+}
+
+// Pairwise tree over k row-major vectors of length n. Odd counts carry
+// the trailing vector up a level — the same combination order as
+// horovod_tpu/ops/adasum.py::_tree_combine, so both paths agree.
+template <typename T>
+void adasum_tree(const T* stack, long k, long n, T* out) {
+  std::vector<std::vector<T>> vals;
+  vals.reserve(k);
+  for (long i = 0; i < k; ++i) {
+    vals.emplace_back(stack + i * n, stack + (i + 1) * n);
+  }
+  while (vals.size() > 1) {
+    std::vector<std::vector<T>> nxt;
+    for (size_t i = 0; i + 1 < vals.size(); i += 2) {
+      std::vector<T> combined(n);
+      adasum_pair(vals[i].data(), vals[i + 1].data(), combined.data(), n);
+      nxt.push_back(std::move(combined));
+    }
+    if (vals.size() % 2 == 1) nxt.push_back(std::move(vals.back()));
+    vals = std::move(nxt);
+  }
+  std::memcpy(out, vals[0].data(), sizeof(T) * n);
+}
+
+}  // namespace
+
+HVD_EXPORT void hvd_adasum_pair_f32(const float* a, const float* b, float* out,
+                                    long n) {
+  adasum_pair(a, b, out, n);
+}
+
+HVD_EXPORT void hvd_adasum_pair_f64(const double* a, const double* b,
+                                    double* out, long n) {
+  adasum_pair(a, b, out, n);
+}
+
+HVD_EXPORT void hvd_adasum_tree_f32(const float* stack, long k, long n,
+                                    float* out) {
+  adasum_tree(stack, k, n, out);
+}
+
+HVD_EXPORT void hvd_adasum_tree_f64(const double* stack, long k, long n,
+                                    double* out) {
+  adasum_tree(stack, k, n, out);
+}

@@ -1,0 +1,10 @@
+// Common export macro for the horovod_tpu native runtime library.
+//
+// TPU-native rebuild of the reference's native core (ref:
+// horovod/common/*.cc — SURVEY.md §2.1/§2.7; the reference ships its
+// runtime as a C++ shared library with a C API consumed over
+// ctypes/pybind, and so do we: every entry point here is extern "C"
+// and loaded via ctypes from horovod_tpu/_native/loader.py).
+#pragma once
+
+#define HVD_EXPORT extern "C" __attribute__((visibility("default")))

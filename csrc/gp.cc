@@ -1,0 +1,146 @@
+// Gaussian-process regression core for the fusion autotuner.
+//
+// TPU-native rebuild of the reference's autotune math (ref:
+// horovod/common/optim/gaussian_process.cc +
+// optim/bayesian_optimization.cc — SURVEY.md §2.1; the reference builds
+// this on Eigen + LBFGS in C++). Same model as the Python fallback in
+// horovod_tpu/common/autotune.py::GaussianProcess and a drop-in for it:
+// RBF kernel on unit-box-normalized inputs, y standardized, noise^2 on
+// the diagonal, Cholesky solve; predictive variance clipped at 1e-12.
+// Candidate scoring (expected improvement over a sampled box) stays in
+// Python — at <=20 samples x 256 candidates the win is the O(n^3)
+// refits, which happen on the dispatch path every sample window.
+
+#include "export.h"
+
+#include <cmath>
+#include <vector>
+
+namespace {
+
+struct GP {
+  double noise;
+  double length_scale;
+  long n = 0, d = 0;
+  double y_mean = 0.0, y_std = 1.0;
+  std::vector<double> x;      // n*d row-major training inputs
+  std::vector<double> chol;   // n*n lower-triangular L
+  std::vector<double> alpha;  // K^-1 y_norm
+};
+
+// RBF kernel between rows a (len d) and b (len d).
+double kernel(const GP& gp, const double* a, const double* b) {
+  double d2 = 0.0;
+  for (long j = 0; j < gp.d; ++j) {
+    double diff = a[j] - b[j];
+    d2 += diff * diff;
+  }
+  return std::exp(-0.5 * d2 / (gp.length_scale * gp.length_scale));
+}
+
+// In-place Cholesky of the n*n matrix in gp.chol. Returns false if a
+// pivot goes non-positive (matrix not PD).
+bool cholesky(std::vector<double>& m, long n) {
+  for (long i = 0; i < n; ++i) {
+    for (long j = 0; j <= i; ++j) {
+      double sum = m[i * n + j];
+      for (long k = 0; k < j; ++k) sum -= m[i * n + k] * m[j * n + k];
+      if (i == j) {
+        if (sum <= 0.0) return false;
+        m[i * n + j] = std::sqrt(sum);
+      } else {
+        m[i * n + j] = sum / m[j * n + j];
+      }
+    }
+    for (long j = i + 1; j < n; ++j) m[i * n + j] = 0.0;
+  }
+  return true;
+}
+
+void solve_lower(const std::vector<double>& l, long n, double* b) {
+  for (long i = 0; i < n; ++i) {
+    double sum = b[i];
+    for (long k = 0; k < i; ++k) sum -= l[i * n + k] * b[k];
+    b[i] = sum / l[i * n + i];
+  }
+}
+
+void solve_upper_t(const std::vector<double>& l, long n, double* b) {
+  // Solves L^T z = b given lower-triangular L.
+  for (long i = n - 1; i >= 0; --i) {
+    double sum = b[i];
+    for (long k = i + 1; k < n; ++k) sum -= l[k * n + i] * b[k];
+    b[i] = sum / l[i * n + i];
+  }
+}
+
+}  // namespace
+
+HVD_EXPORT void* hvd_gp_create(double noise, double length_scale) {
+  auto* gp = new GP();
+  gp->noise = noise;
+  gp->length_scale = length_scale;
+  return gp;
+}
+
+HVD_EXPORT void hvd_gp_destroy(void* h) { delete static_cast<GP*>(h); }
+
+// Fit on n observations of dimension d. Returns 0 on success, 1 if the
+// kernel matrix is not positive definite.
+HVD_EXPORT int hvd_gp_fit(void* h, const double* x, const double* y, long n,
+                          long d) {
+  auto* gp = static_cast<GP*>(h);
+  gp->n = n;
+  gp->d = d;
+  gp->x.assign(x, x + n * d);
+
+  double mean = 0.0;
+  for (long i = 0; i < n; ++i) mean += y[i];
+  mean /= static_cast<double>(n);
+  double var = 0.0;
+  for (long i = 0; i < n; ++i) var += (y[i] - mean) * (y[i] - mean);
+  double std = std::sqrt(var / static_cast<double>(n));
+  if (std == 0.0) std = 1.0;
+  gp->y_mean = mean;
+  gp->y_std = std;
+
+  gp->chol.assign(n * n, 0.0);
+  for (long i = 0; i < n; ++i) {
+    for (long j = 0; j < n; ++j) {
+      gp->chol[i * n + j] = kernel(*gp, &gp->x[i * d], &gp->x[j * d]);
+    }
+    gp->chol[i * n + i] += gp->noise * gp->noise;
+  }
+  if (!cholesky(gp->chol, n)) return 1;
+
+  gp->alpha.resize(n);
+  for (long i = 0; i < n; ++i) gp->alpha[i] = (y[i] - mean) / std;
+  solve_lower(gp->chol, n, gp->alpha.data());
+  solve_upper_t(gp->chol, n, gp->alpha.data());
+  return 0;
+}
+
+// Predict mean and stddev at m query points (m*d row-major).
+HVD_EXPORT int hvd_gp_predict(void* h, const double* xq, long m, double* mu,
+                              double* sigma) {
+  auto* gp = static_cast<GP*>(h);
+  if (gp->n == 0) return 1;
+  long n = gp->n, d = gp->d;
+  std::vector<double> ks(n);
+  for (long q = 0; q < m; ++q) {
+    for (long i = 0; i < n; ++i) {
+      ks[i] = kernel(*gp, &xq[q * d], &gp->x[i * d]);
+    }
+    double mean = 0.0;
+    for (long i = 0; i < n; ++i) mean += ks[i] * gp->alpha[i];
+    // v = L^-1 ks; var = k(x,x) - |v|^2, with k(x,x) = 1 for RBF.
+    solve_lower(gp->chol, n, ks.data());
+    double vv = 0.0;
+    for (long i = 0; i < n; ++i) vv += ks[i] * ks[i];
+    double var = 1.0 - vv;
+    if (var < 1e-12) var = 1e-12;
+    mu[q] = mean * gp->y_std + gp->y_mean;
+    sigma[q] = std::sqrt(var) * gp->y_std;
+  }
+  return 0;
+}

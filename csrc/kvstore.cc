@@ -1,0 +1,401 @@
+// Native HTTP key-value rendezvous server.
+//
+// TPU-native rebuild of the reference's rendezvous plane (ref:
+// horovod/runner/http/http_server.py — the driver-hosted KV store the
+// Gloo contexts bootstrap through, SURVEY.md §2.5/§3.3 — together with
+// the C++ side that consumes it, horovod/common/gloo/gloo_context.cc;
+// the reference vendors a C++ HTTP client in third_party/HTTPRequest).
+// The reference serves this plane from Python; we serve it natively so
+// a many-hundred-worker rendezvous storm (every worker polling every
+// peer key) never contends with the driver's Python interpreter.
+//
+// Wire protocol — identical to the Python server in
+// horovod_tpu/runner/rendezvous.py, so RendezvousClient works against
+// either:
+//   GET    /kv/<scope>/<key>   -> 200 value | 404
+//   PUT    /kv/<scope>/<key>   body=value   -> 200
+//   DELETE /kv/<scope>         -> 200 (drop scope)
+//   GET    /scope/<scope>      -> 200 JSON sorted key list
+// With a secret key, every request must carry
+//   X-Horovod-Digest: hex(hmac_sha256(secret, method + path + body))
+// or it gets 403.
+
+#include "export.h"
+#include "sha256.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct KVServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::vector<uint8_t> secret;  // empty = no auth
+  std::thread accept_thread;
+  std::atomic<bool> running{false};
+  std::atomic<long> active_handlers{0};
+  std::mutex mu;
+  std::map<std::string, std::map<std::string, std::string>> data;
+};
+
+// Rendezvous payloads are addresses/topology blobs; anything near this
+// is hostile or broken. Bounding it keeps an unauthenticated client
+// from ballooning the driver's memory before HMAC rejection.
+constexpr size_t kMaxBody = 64 * 1024 * 1024;
+
+std::string to_hex(const uint8_t* d, size_t n) {
+  static const char* kHex = "0123456789abcdef";
+  std::string s(n * 2, '0');
+  for (size_t i = 0; i < n; ++i) {
+    s[2 * i] = kHex[d[i] >> 4];
+    s[2 * i + 1] = kHex[d[i] & 0xf];
+  }
+  return s;
+}
+
+bool const_time_eq(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  unsigned char diff = 0;
+  for (size_t i = 0; i < a.size(); ++i) diff |= a[i] ^ b[i];
+  return diff == 0;
+}
+
+bool authed(const KVServer& srv, const std::string& method,
+            const std::string& path, const std::string& body,
+            const std::string& digest_header) {
+  if (srv.secret.empty()) return true;
+  std::string payload = method + path + body;
+  uint8_t mac[32];
+  hvd::hmac_sha256(srv.secret.data(), srv.secret.size(),
+                   reinterpret_cast<const uint8_t*>(payload.data()),
+                   payload.size(), mac);
+  return const_time_eq(digest_header, to_hex(mac, 32));
+}
+
+void reply(int fd, int code, const std::string& body) {
+  const char* reason = code == 200   ? "OK"
+                       : code == 403 ? "Forbidden"
+                       : code == 404 ? "Not Found"
+                                     : "Bad Request";
+  char header[128];
+  int n = std::snprintf(header, sizeof(header),
+                        "HTTP/1.1 %d %s\r\nContent-Length: %zu\r\n"
+                        "Connection: close\r\n\r\n",
+                        code, reason, body.size());
+  (void)!write(fd, header, n);
+  if (!body.empty()) (void)!write(fd, body.data(), body.size());
+}
+
+// Split "/kv/scope/key" -> parts without empties.
+std::vector<std::string> split_path(const std::string& path) {
+  std::vector<std::string> parts;
+  size_t i = 0;
+  while (i < path.size()) {
+    while (i < path.size() && path[i] == '/') ++i;
+    size_t j = i;
+    while (j < path.size() && path[j] != '/') ++j;
+    if (j > i) parts.push_back(path.substr(i, j - i));
+    i = j;
+  }
+  return parts;
+}
+
+std::string json_key_list(const std::vector<std::string>& keys) {
+  // Keys here are env-style identifiers (rank addresses, host names);
+  // escape the JSON specials anyway so arbitrary keys round-trip.
+  std::string out = "[";
+  for (size_t i = 0; i < keys.size(); ++i) {
+    if (i) out += ", ";
+    out += '"';
+    for (char c : keys[i]) {
+      if (c == '"' || c == '\\') { out += '\\'; out += c; }
+      else if (static_cast<unsigned char>(c) < 0x20) {
+        char buf[8];
+        std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+        out += buf;
+      } else out += c;
+    }
+    out += '"';
+  }
+  out += "]";
+  return out;
+}
+
+void handle_connection_impl(KVServer* srv, int fd);
+
+// Detached-thread entry: count in/out so shutdown can wait for us, and
+// let no exception escape (an escaped exception in a detached thread is
+// process abort).
+void handle_connection(KVServer* srv, int fd) {
+  try {
+    handle_connection_impl(srv, fd);
+  } catch (...) {
+    close(fd);
+  }
+  srv->active_handlers.fetch_sub(1);
+}
+
+void handle_connection_impl(KVServer* srv, int fd) {
+  // Read headers (bounded), then the Content-Length body.
+  std::string buf;
+  char tmp[4096];
+  size_t header_end = std::string::npos;
+  while (buf.size() < (1 << 20)) {
+    ssize_t n = read(fd, tmp, sizeof(tmp));
+    if (n <= 0) break;
+    buf.append(tmp, n);
+    header_end = buf.find("\r\n\r\n");
+    if (header_end != std::string::npos) break;
+  }
+  if (header_end == std::string::npos) { close(fd); return; }
+
+  // Request line: METHOD SP PATH SP VERSION
+  size_t line_end = buf.find("\r\n");
+  std::string line = buf.substr(0, line_end);
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 <= sp1) { close(fd); return; }
+  std::string method = line.substr(0, sp1);
+  std::string path = line.substr(sp1 + 1, sp2 - sp1 - 1);
+
+  // Headers we care about.
+  size_t content_length = 0;
+  std::string digest;
+  size_t pos = line_end + 2;
+  while (pos < header_end) {
+    size_t eol = buf.find("\r\n", pos);
+    std::string h = buf.substr(pos, eol - pos);
+    pos = eol + 2;
+    size_t colon = h.find(':');
+    if (colon == std::string::npos) continue;
+    std::string name = h.substr(0, colon);
+    for (auto& c : name) c = std::tolower(c);
+    size_t v = colon + 1;
+    while (v < h.size() && h[v] == ' ') ++v;
+    std::string value = h.substr(v);
+    if (name == "content-length") {
+      // Hand-parse: stoul throws on garbage, and an escaped exception in
+      // a detached thread is std::terminate for the whole driver.
+      size_t parsed = 0;
+      bool ok = !value.empty();
+      for (char c : value) {
+        if (c < '0' || c > '9' || parsed > kMaxBody) { ok = false; break; }
+        parsed = parsed * 10 + static_cast<size_t>(c - '0');
+      }
+      if (!ok || parsed > kMaxBody) {
+        reply(fd, 400, "");
+        close(fd);
+        return;
+      }
+      content_length = parsed;
+    } else if (name == "x-horovod-digest") {
+      digest = value;
+    }
+  }
+
+  std::string body = buf.substr(header_end + 4);
+  while (body.size() < content_length) {
+    ssize_t n = read(fd, tmp, sizeof(tmp));
+    if (n <= 0) break;
+    body.append(tmp, n);
+  }
+  body.resize(std::min(body.size(), content_length));
+
+  if (!authed(*srv, method, path, body, digest)) {
+    reply(fd, 403, "");
+    close(fd);
+    return;
+  }
+
+  auto parts = split_path(path);
+  if (method == "GET" && parts.size() == 3 && parts[0] == "kv") {
+    std::lock_guard<std::mutex> lock(srv->mu);
+    auto scope_it = srv->data.find(parts[1]);
+    if (scope_it != srv->data.end()) {
+      auto key_it = scope_it->second.find(parts[2]);
+      if (key_it != scope_it->second.end()) {
+        reply(fd, 200, key_it->second);
+        close(fd);
+        return;
+      }
+    }
+    reply(fd, 404, "");
+  } else if (method == "GET" && parts.size() == 2 && parts[0] == "scope") {
+    std::vector<std::string> keys;
+    {
+      std::lock_guard<std::mutex> lock(srv->mu);
+      auto it = srv->data.find(parts[1]);
+      if (it != srv->data.end()) {
+        for (const auto& kv : it->second) keys.push_back(kv.first);
+      }
+    }
+    reply(fd, 200, json_key_list(keys));  // std::map is already sorted
+  } else if (method == "PUT" && parts.size() == 3 && parts[0] == "kv") {
+    {
+      std::lock_guard<std::mutex> lock(srv->mu);
+      srv->data[parts[1]][parts[2]] = body;
+    }
+    reply(fd, 200, "");
+  } else if (method == "DELETE" && parts.size() == 2 && parts[0] == "kv") {
+    {
+      std::lock_guard<std::mutex> lock(srv->mu);
+      srv->data.erase(parts[1]);
+    }
+    reply(fd, 200, "");
+  } else {
+    reply(fd, 404, "");
+  }
+  close(fd);
+}
+
+void accept_loop(KVServer* srv) {
+  while (srv->running.load()) {
+    int fd = accept(srv->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (!srv->running.load()) break;
+      continue;
+    }
+    srv->active_handlers.fetch_add(1);
+    try {
+      std::thread(handle_connection, srv, fd).detach();
+    } catch (...) {  // thread spawn failure (EAGAIN)
+      srv->active_handlers.fetch_sub(1);
+      close(fd);
+    }
+  }
+}
+
+}  // namespace
+
+// Start a server on the given port (0 = ephemeral). Returns a handle,
+// or nullptr on bind failure. out_port receives the bound port.
+HVD_EXPORT void* hvd_kv_start(int port, const uint8_t* secret,
+                              long secret_len, int* out_port) {
+  auto srv = new KVServer();
+  if (secret_len > 0) srv->secret.assign(secret, secret + secret_len);
+
+  srv->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) { delete srv; return nullptr; }
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      listen(srv->listen_fd, 128) < 0) {
+    close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  srv->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = srv->port;
+
+  srv->running.store(true);
+  srv->accept_thread = std::thread(accept_loop, srv);
+  return srv;
+}
+
+HVD_EXPORT int hvd_kv_port(void* h) { return static_cast<KVServer*>(h)->port; }
+
+HVD_EXPORT void hvd_kv_stop(void* h) {
+  auto* srv = static_cast<KVServer*>(h);
+  srv->running.store(false);
+  // Unblock accept(): shut down, then poke with a local connection in
+  // case the platform's accept ignores shutdown on listen sockets.
+  shutdown(srv->listen_fd, SHUT_RDWR);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd >= 0) {
+    sockaddr_in addr = {};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(static_cast<uint16_t>(srv->port));
+    connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    close(fd);
+  }
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  close(srv->listen_fd);
+  // Detached handler threads may still hold srv; wait them out (bounded
+  // — handlers only do in-memory work after their socket reads, so a
+  // stuck peer can pin us at most until its read() fails on close).
+  for (int i = 0; i < 50 * 60 && srv->active_handlers.load() > 0; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  delete srv;
+}
+
+// --- direct store access for the driver process (the elastic driver
+// reads/writes its own rendezvous without going through HTTP; parity
+// with server.store in the Python implementation) ---
+
+HVD_EXPORT void hvd_kv_put(void* h, const char* scope, const char* key,
+                           const uint8_t* value, long len) {
+  auto* srv = static_cast<KVServer*>(h);
+  std::lock_guard<std::mutex> lock(srv->mu);
+  srv->data[scope][key] = std::string(reinterpret_cast<const char*>(value),
+                                      static_cast<size_t>(len));
+}
+
+// Returns value length, or -1 if absent. Copies min(len, cap) bytes
+// into buf; call with cap=0 to probe the size.
+HVD_EXPORT long hvd_kv_get(void* h, const char* scope, const char* key,
+                           uint8_t* buf, long cap) {
+  auto* srv = static_cast<KVServer*>(h);
+  std::lock_guard<std::mutex> lock(srv->mu);
+  auto scope_it = srv->data.find(scope);
+  if (scope_it == srv->data.end()) return -1;
+  auto key_it = scope_it->second.find(key);
+  if (key_it == scope_it->second.end()) return -1;
+  const std::string& v = key_it->second;
+  long n = static_cast<long>(v.size());
+  if (buf && cap > 0) {
+    std::memcpy(buf, v.data(), static_cast<size_t>(std::min(n, cap)));
+  }
+  return n;
+}
+
+// Newline-joined sorted key list for a scope; same size-probe contract.
+HVD_EXPORT long hvd_kv_keys(void* h, const char* scope, uint8_t* buf,
+                            long cap) {
+  auto* srv = static_cast<KVServer*>(h);
+  std::string joined;
+  {
+    std::lock_guard<std::mutex> lock(srv->mu);
+    auto it = srv->data.find(scope);
+    if (it != srv->data.end()) {
+      for (const auto& kv : it->second) {
+        if (!joined.empty()) joined += '\n';
+        joined += kv.first;
+      }
+    }
+  }
+  long n = static_cast<long>(joined.size());
+  if (buf && cap > 0) {
+    std::memcpy(buf, joined.data(), static_cast<size_t>(std::min(n, cap)));
+  }
+  return n;
+}
+
+HVD_EXPORT void hvd_kv_drop_scope(void* h, const char* scope) {
+  auto* srv = static_cast<KVServer*>(h);
+  std::lock_guard<std::mutex> lock(srv->mu);
+  srv->data.erase(scope);
+}

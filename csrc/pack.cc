@@ -1,0 +1,36 @@
+// Batched host-buffer pack/unpack.
+//
+// TPU-native rebuild of the reference's fusion-buffer memcpy machinery
+// (ref: horovod/common/ops/collective_operations.cc
+// MemcpyInFusionBuffer/MemcpyOutFusionBuffer and the batched-D2D kernel
+// in horovod/common/ops/cuda/cuda_kernels.cu — SURVEY.md §2.2). On TPU
+// the device-side fusion copy is XLA's problem (concatenation fuses into
+// the collective); what remains native is the HOST staging copy: elastic
+// state commit/restore snapshots (horovod_tpu/elastic/state.py) and any
+// eager host-array fast path gather many small numpy buffers into one
+// contiguous block. One C call replaces k Python-level copies.
+
+#include "export.h"
+
+#include <cstdint>
+#include <cstring>
+
+HVD_EXPORT void hvd_pack(const void** srcs, const long* nbytes, long k,
+                         void* dst) {
+  char* out = static_cast<char*>(dst);
+  long off = 0;
+  for (long i = 0; i < k; ++i) {
+    std::memcpy(out + off, srcs[i], static_cast<size_t>(nbytes[i]));
+    off += nbytes[i];
+  }
+}
+
+HVD_EXPORT void hvd_unpack(const void* src, void** dsts, const long* nbytes,
+                           long k) {
+  const char* in = static_cast<const char*>(src);
+  long off = 0;
+  for (long i = 0; i < k; ++i) {
+    std::memcpy(dsts[i], in + off, static_cast<size_t>(nbytes[i]));
+    off += nbytes[i];
+  }
+}

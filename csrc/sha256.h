@@ -1,0 +1,19 @@
+// SHA-256 + HMAC-SHA256, implemented from FIPS 180-4 / RFC 2104 for the
+// native KV rendezvous server's request authentication (see kvstore.cc).
+// The Python side signs with hmac/hashlib (horovod_tpu/runner/secret.py);
+// this must produce identical digests.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace hvd {
+
+// out: 32 bytes.
+void sha256(const uint8_t* data, size_t len, uint8_t* out);
+
+// out: 32 bytes. key/msg arbitrary length.
+void hmac_sha256(const uint8_t* key, size_t key_len, const uint8_t* msg,
+                 size_t msg_len, uint8_t* out);
+
+}  // namespace hvd

@@ -1,0 +1,73 @@
+// Timeline event ring buffer.
+//
+// TPU-native rebuild of the reference's timeline writer core (ref:
+// horovod/common/timeline.cc/.h — SURVEY.md §5.1). The reference
+// buffers per-tensor lifecycle events in C++ on the background thread
+// and serializes Chrome-trace JSON off the hot path; here the Python
+// layer (horovod_tpu/common/timeline.py) formats each event once and
+// hands the string to this buffer, so the per-event cost on the
+// dispatch path is one lock + one string append instead of a Python
+// list append holding the GIL, and drain() hands everything back for
+// the final file write.
+
+#include "export.h"
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct TimelineBuffer {
+  std::mutex mu;
+  std::vector<std::string> events;
+  long total_bytes = 0;  // sum of event lengths (excl. separators)
+};
+
+}  // namespace
+
+HVD_EXPORT void* hvd_tl_create() { return new TimelineBuffer(); }
+
+HVD_EXPORT void hvd_tl_destroy(void* h) {
+  delete static_cast<TimelineBuffer*>(h);
+}
+
+HVD_EXPORT void hvd_tl_emit(void* h, const char* json) {
+  auto* tl = static_cast<TimelineBuffer*>(h);
+  std::lock_guard<std::mutex> lock(tl->mu);
+  tl->events.emplace_back(json);
+  tl->total_bytes += static_cast<long>(tl->events.back().size());
+}
+
+HVD_EXPORT long hvd_tl_count(void* h) {
+  auto* tl = static_cast<TimelineBuffer*>(h);
+  std::lock_guard<std::mutex> lock(tl->mu);
+  return static_cast<long>(tl->events.size());
+}
+
+// Bytes needed for drain(): every event plus one '\n' separator each.
+HVD_EXPORT long hvd_tl_drain_size(void* h) {
+  auto* tl = static_cast<TimelineBuffer*>(h);
+  std::lock_guard<std::mutex> lock(tl->mu);
+  return tl->total_bytes + static_cast<long>(tl->events.size());
+}
+
+// Write all buffered events into dst, newline-separated, and clear the
+// buffer. Returns bytes written, or -1 if cap is too small (buffer is
+// left intact so the caller can retry with hvd_tl_drain_size()).
+HVD_EXPORT long hvd_tl_drain(void* h, char* dst, long cap) {
+  auto* tl = static_cast<TimelineBuffer*>(h);
+  std::lock_guard<std::mutex> lock(tl->mu);
+  long need = tl->total_bytes + static_cast<long>(tl->events.size());
+  if (need > cap) return -1;
+  long off = 0;
+  for (const auto& e : tl->events) {
+    std::memcpy(dst + off, e.data(), e.size());
+    off += static_cast<long>(e.size());
+    dst[off++] = '\n';
+  }
+  tl->events.clear();
+  tl->total_bytes = 0;
+  return off;
+}

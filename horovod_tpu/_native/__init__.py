@@ -1,0 +1,12 @@
+"""Native (C++) runtime components, loaded over ctypes.
+
+The reference ships its core as a C++ shared library bound into Python
+(ref: horovod/common/basics.py loading libhorovod over ctypes [V] —
+SURVEY.md §2.4); this package is that layer for the TPU rebuild. The
+sources live in ``csrc/`` and build into ``libhvd_native.so`` on first
+use (g++ is assumed present, as cmake is for the reference). Everything
+here degrades gracefully: if the toolchain or library is unavailable,
+callers fall back to pure-Python implementations.
+"""
+
+from . import loader  # noqa: F401

@@ -1,0 +1,76 @@
+"""Build the native library on demand.
+
+Parity note: the reference compiles its native core at pip-install time
+via setup.py→CMake (SURVEY.md §2.7); this repo has no install step in
+the loop, so the equivalent moment is "first import" — we shell out to
+g++ directly (or ``make -C csrc``) and cache the result next to this
+file. Staleness is mtime-based against the csrc/ sources.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.normpath(os.path.join(_HERE, "..", "..", "csrc"))
+_LIB = os.path.join(_HERE, "libhvd_native.so")
+
+_SOURCES = [
+    "timeline.cc",
+    "adasum.cc",
+    "gp.cc",
+    "pack.cc",
+    "sha256.cc",
+    "kvstore.cc",
+]
+
+
+def _source_paths() -> List[str]:
+    return [os.path.join(_CSRC, s) for s in _SOURCES]
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    lib_mtime = os.path.getmtime(_LIB)
+    deps = _source_paths() + [
+        os.path.join(_CSRC, "export.h"),
+        os.path.join(_CSRC, "sha256.h"),
+    ]
+    return any(
+        os.path.exists(p) and os.path.getmtime(p) > lib_mtime for p in deps
+    )
+
+
+def lib_path() -> Optional[str]:
+    """Path to an up-to-date libhvd_native.so, building it if needed.
+    Returns None when the sources are missing or the build fails."""
+    if not _stale():
+        return _LIB
+    if not all(os.path.exists(p) for p in _source_paths()):
+        return _LIB if os.path.exists(_LIB) else None
+    # Build to a temp name then os.replace: concurrent builders (e.g.
+    # pytest-launched worker processes) each produce a complete .so and
+    # the last rename wins — nobody ever dlopens a half-written file.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-std=c++17", "-O3", "-fPIC", "-Wall", "-pthread",
+        "-fvisibility=hidden", "-shared",
+        *_source_paths(),
+        "-o", tmp,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=300, cwd=_CSRC
+        )
+        os.replace(tmp, _LIB)
+        return _LIB
+    except (subprocess.SubprocessError, OSError):
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return _LIB if os.path.exists(_LIB) else None

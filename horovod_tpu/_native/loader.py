@@ -1,0 +1,336 @@
+"""ctypes bindings for libhvd_native.so.
+
+The Python mirror of the reference's ``HorovodBasics`` ctypes bootstrap
+(ref: horovod/common/basics.py [V] — SURVEY.md §2.4): one place loads
+the shared library, declares every C signature, and exposes typed
+wrappers. Set ``HOROVOD_NATIVE=0`` to force the pure-Python fallbacks
+everywhere (useful for differential testing; the test suite runs both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.hvd_tl_create.restype = c.c_void_p
+    lib.hvd_tl_destroy.argtypes = [c.c_void_p]
+    lib.hvd_tl_emit.argtypes = [c.c_void_p, c.c_char_p]
+    lib.hvd_tl_count.argtypes = [c.c_void_p]
+    lib.hvd_tl_count.restype = c.c_long
+    lib.hvd_tl_drain_size.argtypes = [c.c_void_p]
+    lib.hvd_tl_drain_size.restype = c.c_long
+    lib.hvd_tl_drain.argtypes = [c.c_void_p, c.c_char_p, c.c_long]
+    lib.hvd_tl_drain.restype = c.c_long
+
+    for suffix, ptr in (("f32", c.POINTER(c.c_float)),
+                        ("f64", c.POINTER(c.c_double))):
+        pair = getattr(lib, f"hvd_adasum_pair_{suffix}")
+        pair.argtypes = [ptr, ptr, ptr, c.c_long]
+        tree = getattr(lib, f"hvd_adasum_tree_{suffix}")
+        tree.argtypes = [ptr, c.c_long, c.c_long, ptr]
+
+    dp = c.POINTER(c.c_double)
+    lib.hvd_gp_create.argtypes = [c.c_double, c.c_double]
+    lib.hvd_gp_create.restype = c.c_void_p
+    lib.hvd_gp_destroy.argtypes = [c.c_void_p]
+    lib.hvd_gp_fit.argtypes = [c.c_void_p, dp, dp, c.c_long, c.c_long]
+    lib.hvd_gp_fit.restype = c.c_int
+    lib.hvd_gp_predict.argtypes = [c.c_void_p, dp, c.c_long, dp, dp]
+    lib.hvd_gp_predict.restype = c.c_int
+
+    vp = c.POINTER(c.c_void_p)
+    lp = c.POINTER(c.c_long)
+    lib.hvd_pack.argtypes = [vp, lp, c.c_long, c.c_void_p]
+    lib.hvd_unpack.argtypes = [c.c_void_p, vp, lp, c.c_long]
+
+    u8p = c.POINTER(c.c_uint8)
+    lib.hvd_kv_start.argtypes = [c.c_int, u8p, c.c_long, c.POINTER(c.c_int)]
+    lib.hvd_kv_start.restype = c.c_void_p
+    lib.hvd_kv_port.argtypes = [c.c_void_p]
+    lib.hvd_kv_port.restype = c.c_int
+    lib.hvd_kv_stop.argtypes = [c.c_void_p]
+    lib.hvd_kv_put.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, u8p,
+                               c.c_long]
+    lib.hvd_kv_get.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, u8p,
+                               c.c_long]
+    lib.hvd_kv_get.restype = c.c_long
+    lib.hvd_kv_keys.argtypes = [c.c_void_p, c.c_char_p, u8p, c.c_long]
+    lib.hvd_kv_keys.restype = c.c_long
+    lib.hvd_kv_drop_scope.argtypes = [c.c_void_p, c.c_char_p]
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it on first call; None if disabled
+    (HOROVOD_NATIVE=0) or unbuildable."""
+    global _lib, _load_failed
+    if os.environ.get("HOROVOD_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            from . import build
+
+            path = build.lib_path()
+            if path is None:
+                _load_failed = True
+                return None
+            lib = ctypes.CDLL(path)
+            _declare(lib)
+            _lib = lib
+        except OSError:
+            _load_failed = True
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------- timeline
+
+class TimelineBuffer:
+    """Native event sink for common/timeline.py."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self._h = lib.hvd_tl_create()
+
+    def emit(self, json_str: str) -> None:
+        self._lib.hvd_tl_emit(self._h, json_str.encode())
+
+    def drain(self) -> List[str]:
+        size = self._lib.hvd_tl_drain_size(self._h)
+        if size <= 0:
+            return []
+        buf = ctypes.create_string_buffer(size)
+        n = self._lib.hvd_tl_drain(self._h, buf, size)
+        if n <= 0:
+            return []
+        text = buf.raw[:n].decode()
+        return [line for line in text.split("\n") if line]
+
+    def __len__(self) -> int:
+        return self._lib.hvd_tl_count(self._h)
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.hvd_tl_destroy(h)
+
+
+def timeline_buffer() -> TimelineBuffer:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return TimelineBuffer(lib)
+
+
+# ------------------------------------------------------------------ adasum
+
+def adasum_pair(a: np.ndarray, b: np.ndarray) -> Optional[np.ndarray]:
+    """Native Adasum combine of two host vectors; None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    dtype = np.result_type(a.dtype, b.dtype)
+    if dtype == np.float64:
+        fn, ct = lib.hvd_adasum_pair_f64, ctypes.c_double
+        dtype = np.float64
+    else:
+        fn, ct = lib.hvd_adasum_pair_f32, ctypes.c_float
+        dtype = np.float32
+    af = np.ascontiguousarray(a, dtype=dtype).ravel()
+    bf = np.ascontiguousarray(b, dtype=dtype).ravel()
+    out = np.empty_like(af)
+    p = ctypes.POINTER(ct)
+    fn(af.ctypes.data_as(p), bf.ctypes.data_as(p), out.ctypes.data_as(p),
+       af.size)
+    return out.reshape(a.shape)
+
+
+def adasum_tree(stack: np.ndarray) -> Optional[np.ndarray]:
+    """Pairwise-tree Adasum over stack[k, n]; None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if stack.dtype == np.float64:
+        fn, ct = lib.hvd_adasum_tree_f64, ctypes.c_double
+        dtype = np.float64
+    else:
+        fn, ct = lib.hvd_adasum_tree_f32, ctypes.c_float
+        dtype = np.float32
+    k = stack.shape[0]
+    flat = np.ascontiguousarray(stack, dtype=dtype).reshape(k, -1)
+    out = np.empty(flat.shape[1], dtype=dtype)
+    p = ctypes.POINTER(ct)
+    fn(flat.ctypes.data_as(p), k, flat.shape[1], out.ctypes.data_as(p))
+    return out.reshape(stack.shape[1:])
+
+
+# ---------------------------------------------------------------------- GP
+
+class NativeGaussianProcess:
+    """Drop-in for common/autotune.py::GaussianProcess (same model)."""
+
+    def __init__(self, noise: float = 0.8, length_scale: float = 0.2):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.hvd_gp_create(noise, length_scale)
+        self.noise = noise
+        self.length_scale = length_scale
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.ascontiguousarray(np.atleast_2d(x), dtype=np.float64)
+        y = np.ascontiguousarray(y, dtype=np.float64).ravel()
+        dp = ctypes.POINTER(ctypes.c_double)
+        rc = self._lib.hvd_gp_fit(
+            self._h, x.ctypes.data_as(dp), y.ctypes.data_as(dp),
+            x.shape[0], x.shape[1],
+        )
+        if rc != 0:
+            raise np.linalg.LinAlgError("kernel matrix not positive definite")
+
+    def predict(self, x: np.ndarray):
+        x = np.ascontiguousarray(np.atleast_2d(x), dtype=np.float64)
+        m = x.shape[0]
+        mu = np.empty(m, dtype=np.float64)
+        sigma = np.empty(m, dtype=np.float64)
+        dp = ctypes.POINTER(ctypes.c_double)
+        rc = self._lib.hvd_gp_predict(
+            self._h, x.ctypes.data_as(dp), m,
+            mu.ctypes.data_as(dp), sigma.ctypes.data_as(dp),
+        )
+        if rc != 0:
+            raise RuntimeError("predict before fit")
+        return mu, sigma
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.hvd_gp_destroy(h)
+
+
+# -------------------------------------------------------------------- pack
+
+def pack(arrays: List[np.ndarray]) -> Optional[np.ndarray]:
+    """Concatenate the raw bytes of host arrays into one uint8 buffer
+    with a single C call; None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    k = len(arrays)
+    total = sum(a.nbytes for a in arrays)
+    out = np.empty(total, dtype=np.uint8)
+    srcs = (ctypes.c_void_p * k)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_long * k)(*[a.nbytes for a in arrays])
+    lib.hvd_pack(srcs, sizes, k, out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def unpack(buf: np.ndarray, like: List[np.ndarray]) -> Optional[List[np.ndarray]]:
+    """Split a packed uint8 buffer back into arrays shaped/typed like
+    ``like``; None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    outs = [np.empty_like(np.ascontiguousarray(a)) for a in like]
+    k = len(outs)
+    dsts = (ctypes.c_void_p * k)(*[o.ctypes.data for o in outs])
+    sizes = (ctypes.c_long * k)(*[o.nbytes for o in outs])
+    lib.hvd_unpack(
+        np.ascontiguousarray(buf).ctypes.data_as(ctypes.c_void_p),
+        dsts, sizes, k,
+    )
+    return outs
+
+
+# ----------------------------------------------------------------- kvstore
+
+class NativeKVServer:
+    """Native rendezvous server + direct store access (the ``.store``
+    surface the elastic driver uses on the Python server)."""
+
+    def __init__(self, port: int = 0, secret_key: Optional[bytes] = None):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        secret = secret_key or b""
+        sec = (ctypes.c_uint8 * max(len(secret), 1))(*secret)
+        out_port = ctypes.c_int(0)
+        self._h = lib.hvd_kv_start(
+            port, sec, len(secret), ctypes.byref(out_port)
+        )
+        if not self._h:
+            raise OSError(f"native KV server failed to bind port {port}")
+        self.port = out_port.value
+
+    # -- KVStore-compatible surface --
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        if value:
+            buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value)
+        else:
+            buf = (ctypes.c_uint8 * 1)()
+        self._lib.hvd_kv_put(
+            self._h, scope.encode(), key.encode(), buf, len(value)
+        )
+
+    def _read(self, fn, *args) -> Optional[bytes]:
+        """Size-probe-then-copy, retried: the two C calls lock
+        separately, so a concurrent writer can change the length between
+        them. The copy call reports the length it saw under its own
+        lock — accept only a copy whose reported length fits the buffer
+        we handed it (shorter is fine: the C side copied exactly that
+        many bytes atomically)."""
+        cap = fn(self._h, *args, None, 0)
+        while True:
+            if cap < 0:
+                return None
+            if cap == 0:
+                return b""
+            buf = (ctypes.c_uint8 * cap)()
+            n = fn(self._h, *args, buf, cap)
+            if n < 0:
+                return None
+            if n <= cap:
+                return bytes(buf)[:n]
+            cap = n  # grew underneath us — retry with the larger size
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        return self._read(self._lib.hvd_kv_get, scope.encode(), key.encode())
+
+    def keys(self, scope: str) -> List[str]:
+        joined = self._read(self._lib.hvd_kv_keys, scope.encode())
+        if not joined:
+            return []
+        return joined.decode().split("\n")
+
+    def drop_scope(self, scope: str) -> None:
+        self._lib.hvd_kv_drop_scope(self._h, scope.encode())
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.hvd_kv_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass

@@ -78,11 +78,26 @@ def expected_improvement(
     return (mu - best - xi) * cdf + sigma * pdf
 
 
+def make_gaussian_process(noise: float = 0.8, length_scale: float = 0.2):
+    """Prefer the native GP core (csrc/gp.cc — the reference keeps this
+    math in C++, optim/gaussian_process.cc [V]); fall back to numpy."""
+    try:
+        from .._native import loader as _native
+
+        if _native.available():
+            return _native.NativeGaussianProcess(
+                noise=noise, length_scale=length_scale
+            )
+    except Exception:
+        pass
+    return GaussianProcess(noise=noise, length_scale=length_scale)
+
+
 class BayesianOptimizer:
     """Propose-next-candidate loop over the (threshold, cycle) box."""
 
     def __init__(self, noise: float = 0.8, seed: int = 0):
-        self._gp = GaussianProcess(noise=noise)
+        self._gp = make_gaussian_process(noise=noise)
         self._rng = np.random.default_rng(seed)
         self._xs: List[np.ndarray] = []
         self._ys: List[float] = []

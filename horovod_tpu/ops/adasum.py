@@ -90,3 +90,57 @@ def adasum_allreduce(
 
 def _is_power_of_two(n: int) -> bool:
     return n >= 1 and (n & (n - 1)) == 0
+
+
+# ---- host-side variants (ref: the reference's CPU Adasum path,
+# adasum_mpi_operations.cc [V]) — native C++ when built, numpy fallback.
+# These are the numerics oracle for the on-device path above and serve
+# host-resident tensors (elastic state reconciliation, eager numpy).
+
+def adasum_pair_host(a, b):
+    """Adasum combine of two host arrays (numpy in, numpy out)."""
+    import numpy as np
+
+    try:
+        from .._native import loader as _native
+
+        out = _native.adasum_pair(np.asarray(a), np.asarray(b))
+        if out is not None:
+            return out.astype(np.asarray(a).dtype)
+    except Exception:
+        pass
+    af = np.asarray(a, dtype=np.float64)
+    bf = np.asarray(b, dtype=np.float64)
+    dot = float((af * bf).sum())
+    asq = float((af * af).sum())
+    bsq = float((bf * bf).sum())
+    acoef = 1.0 - (dot / (2.0 * asq) if asq > 0 else 0.0)
+    bcoef = 1.0 - (dot / (2.0 * bsq) if bsq > 0 else 0.0)
+    return (acoef * af + bcoef * bf).astype(np.asarray(a).dtype)
+
+
+def adasum_tree_host(stack):
+    """Pairwise-tree Adasum over ``stack[k, ...]`` host arrays — same
+    combination order as ``_tree_combine`` (odd counts carry the last
+    element up a level)."""
+    import numpy as np
+
+    stack = np.asarray(stack)
+    try:
+        from .._native import loader as _native
+
+        out = _native.adasum_tree(stack)
+        if out is not None:
+            return out.astype(stack.dtype)
+    except Exception:
+        pass
+    vals = [stack[i] for i in range(stack.shape[0])]
+    while len(vals) > 1:
+        nxt = [
+            adasum_pair_host(vals[i], vals[i + 1])
+            for i in range(0, len(vals) - 1, 2)
+        ]
+        if len(vals) % 2 == 1:
+            nxt.append(vals[-1])
+        vals = nxt
+    return vals[0]

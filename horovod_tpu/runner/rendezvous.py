@@ -26,6 +26,7 @@ SURVEY.md §2.5).
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -123,23 +124,58 @@ def _make_handler(store: KVStore, secret_key: Optional[bytes]):
 
 
 class RendezvousServer:
-    """Driver-side rendezvous: own thread, ephemeral or fixed port."""
+    """Driver-side rendezvous: ephemeral or fixed port.
+
+    Two backends behind one interface: the native C++ server
+    (csrc/kvstore.cc — the reference's rendezvous consumers are native,
+    gloo_context.cc [V], and a many-worker polling storm shouldn't
+    contend with the driver's interpreter) and a threaded Python
+    http.server fallback. ``backend`` is "auto" (native if buildable),
+    "native", or "python"; ``HOROVOD_RENDEZVOUS_BACKEND`` overrides.
+    ``.store`` exposes the same KV surface either way (the elastic
+    driver reads it directly)."""
 
     def __init__(
-        self, port: int = 0, secret_key: Optional[bytes] = None
+        self,
+        port: int = 0,
+        secret_key: Optional[bytes] = None,
+        backend: str = "auto",
     ) -> None:
-        self.store = KVStore()
+        backend = os.environ.get("HOROVOD_RENDEZVOUS_BACKEND", backend)
         self._secret_key = secret_key
-        self._httpd = ThreadingHTTPServer(
-            ("0.0.0.0", port), _make_handler(self.store, secret_key)
-        )
+        self._native = None
+        self._httpd = None
         self._thread: Optional[threading.Thread] = None
+        self.backend = "python"
+        if backend in ("auto", "native"):
+            try:
+                from .._native import loader as _native_loader
+
+                self._native = _native_loader.NativeKVServer(
+                    port=port, secret_key=secret_key
+                )
+                self.backend = "native"
+            except Exception:
+                if backend == "native":
+                    raise
+                self._native = None
+        if self._native is not None:
+            self.store = self._native  # KVStore-compatible surface
+        else:
+            self.store = KVStore()
+            self._httpd = ThreadingHTTPServer(
+                ("0.0.0.0", port), _make_handler(self.store, secret_key)
+            )
 
     @property
     def port(self) -> int:
+        if self._native is not None:
+            return self._native.port
         return self._httpd.server_address[1]
 
     def start(self) -> int:
+        if self._native is not None:
+            return self._native.port  # native server accepts from creation
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="hvd-rendezvous", daemon=True
         )
@@ -147,6 +183,9 @@ class RendezvousServer:
         return self.port
 
     def stop(self) -> None:
+        if self._native is not None:
+            self._native.stop()
+            return
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

@@ -369,9 +369,13 @@ def test_failure_path_kills_all_and_reports(tmp_path):
         "import os, sys, time\n"
         "if os.environ['HOROVOD_RANK'] == '0':\n"
         "    sys.exit(3)\n"
-        "time.sleep(60)\n"
+        "time.sleep(300)\n"
     )
     env = dict(os.environ)
+    # Generous timeout: under full-suite load the driver's jax import
+    # alone can take tens of seconds on a loaded single-core box; the
+    # sleeping worker is SIGTERMed by the driver, so the real duration
+    # is driver startup + ~15 s, not the sleep.
     proc = subprocess.run(
         [
             sys.executable, "-m", "horovod_tpu.runner",
@@ -379,7 +383,7 @@ def test_failure_path_kills_all_and_reports(tmp_path):
             "--", sys.executable, str(script),
         ],
         env=env,
-        timeout=60,
+        timeout=240,
         capture_output=True,
     )
     assert proc.returncode == 3
