@@ -34,7 +34,18 @@ from ..common.topology import WORLD_AXIS
 
 
 def adasum_pair(a, b):
-    """Combine two same-shaped gradient tensors by the Adasum rule."""
+    """Combine two same-shaped gradient tensors by the Adasum rule.
+
+    On TPU this dispatches to the two-pass Pallas kernel
+    (ops/pallas_kernels.py — one VMEM traversal for the dots, one for
+    the weighted sum); elsewhere the jnp formulation below is both the
+    fallback and the numerics oracle the kernel is tested against."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        from .pallas_kernels import adasum_pair as _pallas_pair
+
+        return _pallas_pair(a, b)
     af = a.astype(jnp.float32)
     bf = b.astype(jnp.float32)
     dot = jnp.sum(af * bf)

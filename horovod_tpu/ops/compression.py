@@ -69,9 +69,49 @@ class BF16Compressor(Compressor):
         return tensor.astype(ctx) if ctx != tensor.dtype else tensor
 
 
+class Int8Compressor(Compressor):
+    """4x wire compression: int8 values + one float32 scale, stochastic
+    rounding (unbiased) via the Pallas quantizer (ops/pallas_kernels.py).
+
+    Beyond reference parity (the reference stops at fp16 [V]). Two
+    supported uses: (a) ``DistributedOptimizer(compression=
+    Compression.int8)`` — the optimizer detects ``quantized_wire`` and
+    routes gradients through ``traced.quantized_allreduce`` (raw int8
+    must never be summed across ranks: it wraps, and each rank's scale
+    differs); (b) manual compress/decompress around allgather/broadcast
+    payloads, where no cross-rank arithmetic touches the wire values.
+    Pass a fresh ``seed`` per call (e.g. the step counter) to keep the
+    rounding unbiased over time rather than merely per-call.
+    """
+
+    # Signals _allreduce_grads to use the quantized collective instead
+    # of compress -> psum -> decompress.
+    quantized_wire = True
+
+    @staticmethod
+    def compress(tensor, seed=0):
+        from . import pallas_kernels
+
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            values, scale = pallas_kernels.int8_quantize(tensor, seed=seed)
+            return values, (ctx, scale)
+        return tensor, (ctx, None)
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        from . import pallas_kernels
+
+        dtype, scale = ctx
+        if scale is None:
+            return tensor
+        return pallas_kernels.int8_dequantize(tensor, scale, out_dtype=dtype)
+
+
 class Compression:
-    """Namespace mirroring hvd.Compression [V]."""
+    """Namespace mirroring hvd.Compression [V] (+ TPU-native additions)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

@@ -225,3 +225,78 @@ def reducescatter(
     if postscale_factor != 1.0:
         out = out * jnp.asarray(postscale_factor, out.dtype)
     return out
+
+
+def _stochastic_round_rows(x2d, key):
+    """Per-row int8 quantization with stochastic rounding (unbiased):
+    row-wise absmax scale, floor + bernoulli(frac) up. Plain jnp — XLA
+    fuses it into one pass; the per-tensor Pallas kernel
+    (pallas_kernels.int8_quantize) covers the single-scale case."""
+    absmax = jnp.max(jnp.abs(x2d), axis=1)
+    scale = jnp.maximum(absmax, 1e-30) / 127.0
+    scaled = x2d / scale[:, None]
+    floor = jnp.floor(scaled)
+    frac = scaled - floor
+    u = jax.random.uniform(key, x2d.shape)
+    q = jnp.clip(floor + (u < frac), -128, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantized_allreduce(
+    tensor,
+    op=None,
+    axis_name: str = WORLD_AXIS,
+    seed=0,
+):
+    """Allreduce moving int8 across ICI — the quantized-collective
+    recipe of EQuARX (PAPERS.md), built from primitives the reference
+    stops short of (its wire compression ends at fp16 [V]).
+
+    Shape: quantized reduce-scatter (all_to_all of per-chunk int8 +
+    scales, dequantize-sum locally) then quantized all_gather of the
+    reduced shards. Per-device wire bytes ≈ 2·(n-1)/n · P/4 versus
+    2·(n-1)/n · P for an fp32 ring allreduce — a true ~4x at every
+    world size, with O(P) peak memory (the naive gather-everything
+    formulation would move MORE than fp32 psum beyond n=8 and
+    materialize an n·P fp32 intermediate).
+
+    Two quantization stages ⇒ error ~2 quanta worst case; stochastic
+    rounding (seeded per rank and, when the caller threads a step
+    counter in via ``seed``, per step) keeps it unbiased over time.
+    Sum/Average only: quantization commutes with neither min/max nor
+    product.
+    """
+    from .pallas_kernels import int8_quantize
+
+    op = resolve_op(op, None)
+    if op not in (Average, Sum):
+        raise ValueError("quantized_allreduce supports Sum/Average only")
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    shape, dtype = tensor.shape, tensor.dtype
+    flat = tensor.reshape(-1).astype(jnp.float32)
+    m = flat.shape[0]
+    chunk = -(-m // n)  # ceil
+    flat = jnp.pad(flat, (0, chunk * n - m))
+    chunks = flat.reshape(n, chunk)  # row j is destined for rank j
+
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), idx)
+    q, scales = _stochastic_round_rows(chunks, key)
+    # all_to_all = the scatter half of reduce-scatter: afterwards row r
+    # holds the chunk rank r quantized for us, with its scale.
+    recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    recv_scales = lax.all_to_all(
+        scales.reshape(n, 1), axis_name, split_axis=0, concat_axis=0,
+        tiled=True,
+    ).reshape(n)
+    shard = jnp.sum(recv.astype(jnp.float32) * recv_scales[:, None], axis=0)
+    if op == Average:
+        shard = shard / jnp.asarray(n, shard.dtype)
+    # Second stage: per-tensor Pallas quantizer on the reduced shard,
+    # decorrelated from stage one and from other ranks.
+    q2, s2 = int8_quantize(shard, seed=seed * 2 + 1 + idx * 7919)
+    all_q = lax.all_gather(q2, axis_name)    # [n, chunk] int8
+    all_s = lax.all_gather(s2, axis_name)    # [n] f32
+    out = (all_q.astype(jnp.float32) * all_s[:, None]).reshape(-1)[:m]
+    return out.reshape(shape).astype(dtype)

@@ -45,13 +45,37 @@ def _allreduce_grads(
     postscale_factor: float,
     process_set: Optional[ProcessSet],
     axis_name: str,
+    seed=0,
 ):
     """Compress → allreduce → decompress, leaf-wise over the grad pytree.
 
     Equivalent of the reference's `_allreduce_grad_async` + synchronize
     loop (horovod/torch/optimizer.py [V]), except the 'async' part is
     XLA's static schedule rather than handles.
+
+    Quantized-wire compressors (Compression.int8) can't go through the
+    generic compress→psum→decompress shape — summing raw int8 wraps and
+    each rank's scale differs — so they route to the quantized
+    collective, which reduces after dequantization on every hop.
     """
+    if getattr(compression, "quantized_wire", False):
+        if process_set is not None and process_set.process_set_id != 0:
+            raise NotImplementedError(
+                "Compression.int8 over a process set is not supported; "
+                "use fp16/bf16 compression or the global process set"
+            )
+
+        def one_q(g):
+            if prescale_factor != 1.0:
+                g = g * jnp.asarray(prescale_factor, g.dtype)
+            out = traced.quantized_allreduce(
+                g, op=op, axis_name=axis_name, seed=seed
+            )
+            if postscale_factor != 1.0:
+                out = out * jnp.asarray(postscale_factor, out.dtype)
+            return out
+
+        return jax.tree_util.tree_map(one_q, grads)
 
     def one(g):
         wire, ctx = compression.compress(g)
@@ -72,6 +96,7 @@ class _AccumulationState(NamedTuple):
     inner: Any
     accum: Any  # running local gradient sum
     counter: jnp.ndarray  # micro-steps since last communication
+    step: jnp.ndarray  # monotone update count — seeds stochastic rounding
 
 
 def DistributedOptimizer(
@@ -114,7 +139,7 @@ def DistributedOptimizer(
         post = postscale_factor if postscale_factor is not None else 1.0
         return op, pre, post
 
-    def communicate(grads):
+    def communicate(grads, seed):
         n = (
             process_set.size
             if process_set is not None and process_set.process_set_id != 0
@@ -122,26 +147,29 @@ def DistributedOptimizer(
         )
         eff_op, pre, post = reduce_op_factors(n)
         return _allreduce_grads(
-            grads, eff_op, compression, pre, post, process_set, axis_name
+            grads, eff_op, compression, pre, post, process_set, axis_name,
+            seed=seed,
         )
 
     def init_fn(params):
         inner = optimizer.init(params)
+        zero = jnp.zeros((), jnp.int32)
         if k == 1:
             return _AccumulationState(
-                inner=inner, accum=None, counter=jnp.zeros((), jnp.int32)
+                inner=inner, accum=None, counter=zero, step=zero
             )
         accum = jax.tree_util.tree_map(jnp.zeros_like, params)
         return _AccumulationState(
-            inner=inner, accum=accum, counter=jnp.zeros((), jnp.int32)
+            inner=inner, accum=accum, counter=zero, step=zero
         )
 
     def update_fn(grads, state: _AccumulationState, params=None):
         if k == 1:
-            reduced = communicate(grads)
+            reduced = communicate(grads, state.step)
             updates, inner = optimizer.update(reduced, state.inner, params)
             return updates, _AccumulationState(
-                inner=inner, accum=None, counter=state.counter
+                inner=inner, accum=None, counter=state.counter,
+                step=state.step + 1,
             )
 
         # Local aggregation (`backward_passes_per_step` [V]): accumulate k
@@ -162,7 +190,7 @@ def DistributedOptimizer(
                 if average_aggregated_gradients
                 else accum
             )
-            reduced = communicate(agg)
+            reduced = communicate(agg, state.step)
             updates, inner = optimizer.update(reduced, state.inner, params)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum)
             return updates, inner, zeroed, jnp.zeros((), jnp.int32)
@@ -175,7 +203,8 @@ def DistributedOptimizer(
             boundary, do_step, skip_step, operand=None
         )
         return updates, _AccumulationState(
-            inner=inner, accum=accum_out, counter=counter_out
+            inner=inner, accum=accum_out, counter=counter_out,
+            step=state.step + 1,
         )
 
     return optax.GradientTransformation(init_fn, update_fn)
