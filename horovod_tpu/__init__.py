@@ -106,6 +106,19 @@ from .optimizer import (  # noqa: F401
 from . import ops  # noqa: F401
 from .ops import traced  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.run / State, ref [V])
+from . import callbacks  # noqa: F401  (Keras-callback parity, ref [V])
+from . import executor  # noqa: F401  (RayExecutor / spark.run parity, ref [V])
+
+
+def __getattr__(name):
+    # hvd.SyncBatchNorm parity (ref [V]) without making flax a hard
+    # import-time dependency of the whole package — launcher-only hosts
+    # import horovod_tpu without any model stack.
+    if name == "SyncBatchNorm":
+        from .models.resnet import SyncBatchNorm
+
+        return SyncBatchNorm
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __version__ = "0.1.0"
 

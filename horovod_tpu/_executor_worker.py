@@ -1,0 +1,35 @@
+"""Per-rank entry point for :mod:`horovod_tpu.executor` jobs.
+
+A separate module (not imported by the package __init__) so running it
+with ``python -m`` doesn't re-execute an already-imported module — the
+pickled function must unpickle against the one true copy of its module.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def main() -> None:
+    payload_path = sys.argv[1]
+    out_dir = os.environ["HOROVOD_EXECUTOR_OUT"]
+    rank = os.environ.get("HOROVOD_RANK", "0")
+    with open(payload_path, "rb") as f:
+        fn, args, kwargs = pickle.load(f)
+    try:
+        value = fn(*args, **kwargs)
+        result = ("ok", value)
+    except BaseException as exc:  # report, don't swallow
+        result = ("error", f"{type(exc).__name__}: {exc}")
+    tmp = os.path.join(out_dir, f".result.{rank}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, os.path.join(out_dir, f"result.{rank}.pkl"))
+    if result[0] == "error":
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

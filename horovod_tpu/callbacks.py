@@ -1,0 +1,239 @@
+"""Training-loop callbacks — parity with the reference's Keras callbacks.
+
+(ref: horovod/_keras/callbacks.py + horovod/tensorflow/keras/callbacks.py
+[V] — SURVEY.md §2.4: BroadcastGlobalVariablesCallback,
+MetricAverageCallback, LearningRateWarmupCallback,
+LearningRateScheduleCallback.)
+
+The TPU rebuild has no Keras loop to hook, so each callback exists in
+two idiomatic forms:
+
+* a **callback object** with the reference's hook names
+  (``on_train_begin`` / ``on_epoch_end`` / ``on_epoch_begin``) for
+  hand-written training loops — drive them with :class:`CallbackList`;
+* where the reference mutates optimizer state imperatively (the LR
+  callbacks), a **pure optax schedule** factory — the JAX-native shape
+  of the same behavior, usable directly in ``optax.sgd(schedule)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Callback:
+    """Hook surface (subset of Keras' Callback the reference uses [V])."""
+
+    def on_train_begin(self, state=None):
+        return state
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        return state
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None,
+                     state=None):
+        return state
+
+
+class CallbackList:
+    """Drives a sequence of callbacks, threading the (immutable) train
+    state through — JAX state is values, not objects, so every hook
+    returns the possibly-replaced state."""
+
+    def __init__(self, callbacks: Sequence[Callback]):
+        self._callbacks: List[Callback] = list(callbacks)
+
+    def on_train_begin(self, state=None):
+        for cb in self._callbacks:
+            state = cb.on_train_begin(state)
+        return state
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        for cb in self._callbacks:
+            state = cb.on_epoch_begin(epoch, state)
+        return state
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None,
+                     state=None):
+        for cb in self._callbacks:
+            state = cb.on_epoch_end(epoch, logs, state)
+        return state
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast the train state from ``root_rank`` at train start
+    (ref: BroadcastGlobalVariablesCallback [V] — makes every worker
+    start from identical weights after e.g. a restore on rank 0)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state=None):
+        from .optimizer import broadcast_parameters
+
+        if state is None:
+            return state
+        return broadcast_parameters(state, root_rank=self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all workers before they are logged
+    (ref: MetricAverageCallback [V]). Works on a logs dict of scalars;
+    non-numeric entries pass through untouched."""
+
+    def __init__(self, process_set=None):
+        self.process_set = process_set
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None,
+                     state=None):
+        if not logs:
+            return state
+        from .ops import eager
+        from .ops.reduction_ops import Average
+
+        for key in list(logs.keys()):
+            value = logs[key]
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                averaged = eager.allreduce(
+                    eager.replicate(np.asarray(float(value), np.float32)),
+                    op=Average,
+                    name=f"metric.{key}",
+                    process_set=self.process_set,
+                )
+                logs[key] = float(np.asarray(averaged).reshape(-1)[0])
+        return state
+
+
+class LearningRateWarmupCallback(Callback):
+    """Warmup mirror of the reference's callback [V]: an LR multiplier
+    ramping 1/size → 1 over ``warmup_epochs``. Epoch granularity via
+    ``on_epoch_begin``; per-batch granularity (the reference's behavior)
+    via ``self.multiplier(epoch, batch=b)`` with ``steps_per_epoch``
+    set. Preferred under jit: the pure :func:`warmup_schedule`.
+    """
+
+    def __init__(
+        self,
+        initial_lr: float,
+        warmup_epochs: int = 5,
+        steps_per_epoch: Optional[int] = None,
+        momentum_correction: bool = True,  # accepted for parity
+        verbose: bool = False,
+    ):
+        from .common import basics
+
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._size = basics.size() if basics.is_initialized() else 1
+        self.current_lr = initial_lr / self._size
+
+    def multiplier(self, epoch: float, batch: Optional[int] = None) -> float:
+        """size^(progress) / size — exponential ramp from 1/size to 1,
+        the reference's gradual-warmup rule (Goyal et al.) [V]. With
+        ``batch`` and ``steps_per_epoch``, progress advances within the
+        epoch (the reference's per-batch ramp)."""
+        effective = float(epoch)
+        if batch is not None and self.steps_per_epoch:
+            effective += batch / float(self.steps_per_epoch)
+        if effective >= self.warmup_epochs:
+            return 1.0
+        progress = effective / max(self.warmup_epochs, 1e-9)
+        return math.pow(self._size, progress) / self._size
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        self.current_lr = self.initial_lr * self.multiplier(epoch)
+        if self.verbose:
+            print(
+                f"Epoch {epoch}: LearningRateWarmupCallback sets lr "
+                f"to {self.current_lr:.6g}"
+            )
+        return state
+
+
+class LearningRateScheduleCallback(Callback):
+    """Piecewise LR multiplier by epoch range (ref:
+    LearningRateScheduleCallback [V]): ``multiplier`` is a float or
+    fn(epoch)->float applied to ``initial_lr`` on
+    ``start_epoch <= epoch < end_epoch``."""
+
+    def __init__(
+        self,
+        initial_lr: float,
+        multiplier,
+        start_epoch: int = 0,
+        end_epoch: Optional[int] = None,
+        staircase: bool = True,
+    ):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        if callable(multiplier):
+            self._fn = multiplier
+        else:
+            self._fn = lambda epoch: multiplier
+        self.current_lr = initial_lr
+
+    def _active(self, epoch: float) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        e = float(int(epoch)) if self.staircase else float(epoch)
+        if self._active(e):
+            self.current_lr = self.initial_lr * float(self._fn(e))
+        return state
+
+
+# ------------------------------------------------------- optax schedules
+
+
+def warmup_schedule(
+    base_lr: float,
+    warmup_steps: int,
+    size: Optional[int] = None,
+) -> Callable:
+    """The warmup callback as a pure optax schedule: exponential ramp
+    ``base_lr/size → base_lr`` over ``warmup_steps``, then constant.
+    This is the jit-native form — feed it straight to
+    ``optax.sgd(learning_rate=...)``."""
+    import jax.numpy as jnp
+
+    from .common import basics
+
+    n = float(size if size is not None else
+              (basics.size() if basics.is_initialized() else 1))
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        progress = jnp.clip(step / max(warmup_steps, 1), 0.0, 1.0)
+        return base_lr * jnp.power(n, progress) / n
+
+    return schedule
+
+
+def piecewise_schedule(
+    base_lr: float,
+    boundaries_and_multipliers: Iterable,
+) -> Callable:
+    """LearningRateScheduleCallback as a pure schedule: a list of
+    ``(step_boundary, multiplier)`` applied in order (the classic
+    ResNet 30-60-80 decay is ``[(30*spe, 0.1), (60*spe, 0.01), ...]``)."""
+    import jax.numpy as jnp
+
+    pairs = sorted(boundaries_and_multipliers)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        mult = jnp.asarray(1.0, jnp.float32)
+        for boundary, multiplier in pairs:
+            mult = jnp.where(step >= boundary, multiplier, mult)
+        return base_lr * mult
+
+    return schedule

@@ -50,7 +50,9 @@ class SyncBatchNorm(nn.Module):
             axes = tuple(range(x.ndim - 1))
             mean = jnp.mean(xf, axis=axes)
             mean2 = jnp.mean(xf * xf, axis=axes)
-            if self.axis_name is not None:
+            # Skip the collective while flax builds shapes: init() runs
+            # outside shard_map, where the mesh axis is unbound.
+            if self.axis_name is not None and not self.is_initializing():
                 mean = lax.pmean(mean, self.axis_name)
                 mean2 = lax.pmean(mean2, self.axis_name)
             var = mean2 - mean * mean

@@ -226,14 +226,22 @@ def value_and_grad(
 ):
     """jax.value_and_grad + gradient allreduce: the DistributedGradientTape
     equivalent (ref: horovod/tensorflow/__init__.py
-    DistributedGradientTape._allreduce_grads [V], SURVEY.md §3.5)."""
+    DistributedGradientTape._allreduce_grads [V], SURVEY.md §3.5).
+
+    With ``compression=Compression.int8``, pass your step counter to the
+    wrapped function as ``hvd_step=`` (a traced scalar is fine): it seeds
+    the stochastic rounding so quantization noise varies across steps and
+    stays unbiased over time. ``DistributedOptimizer`` threads its own
+    step automatically; the tape API has no state, so the caller provides
+    it. Other compressors ignore it."""
     op = resolve_op(op, average)
     vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux, **grad_kwargs)
 
-    def wrapped(*args, **kwargs):
+    def wrapped(*args, hvd_step=0, **kwargs):
         val, grads = vg(*args, **kwargs)
         grads = _allreduce_grads(
-            grads, op, compression, 1.0, 1.0, process_set, axis_name
+            grads, op, compression, 1.0, 1.0, process_set, axis_name,
+            seed=hvd_step,
         )
         return val, grads
 
@@ -258,21 +266,44 @@ def broadcast_parameters(params, root_rank: int = 0):
     (ref: horovod/torch/functions.py broadcast_parameters /
     tensorflow broadcast_variables [V], SURVEY.md §5.4).
 
-    TPU-native semantics: parameters in a jit/pjit program live as global
-    jax.Arrays replicated over the mesh — placing the tree with a
-    replicated sharding sourced from the controller's copy IS the
-    broadcast; XLA moves the bytes over ICI. The root_rank argument is
-    kept for API parity (under a single controller there is exactly one
-    source copy)."""
+    TPU-native semantics, two cases per leaf:
+
+    * **host / replicated leaf** — placing it with a replicated sharding
+      sourced from the controller's copy IS the broadcast; XLA moves the
+      bytes over ICI (under a single controller there is exactly one
+      source copy, so root_rank is moot).
+    * **rank-major leaf** (leading dim = world, sharded over the world
+      axis — the eager convention for per-rank-divergent state): every
+      rank's row is overwritten with ``root_rank``'s, which is the
+      reference's actual semantics (rank 0 may have restored a
+      checkpoint the others don't have)."""
     from .common import basics
+    from .common.topology import WORLD_AXIS, replicated_sharding
 
     mesh = basics.mesh()
-    from .common.topology import replicated_sharding
-
+    world = int(mesh.devices.size)
     sharding = replicated_sharding(mesh)
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), params
-    )
+
+    def _rank_major(x) -> bool:
+        if not isinstance(x, jax.Array) or x.ndim == 0:
+            return False
+        if x.shape[0] != world:
+            return False
+        spec = getattr(x.sharding, "spec", None)
+        return bool(spec) and spec[0] == WORLD_AXIS
+
+    def one(x):
+        if _rank_major(x):
+            root = jax.device_put(x[root_rank], sharding)
+            # All rows = root's; re-place with the ORIGINAL rank-major
+            # sharding so per-device memory stays 1/world of the buffer
+            # and a second broadcast still recognizes the leaf.
+            return jax.device_put(
+                jnp.broadcast_to(root[None], x.shape), x.sharding
+            )
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(one, params)
 
 
 def broadcast_optimizer_state(opt_state, root_rank: int = 0):

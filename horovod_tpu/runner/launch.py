@@ -199,6 +199,23 @@ def worker_envs(
             env["HOROVOD_NUM_PROCESSES"] = str(n_proc)
             env["HOROVOD_PROCESS_ID"] = str(i)
             env.setdefault("JAX_PLATFORMS", "cpu")
+            # One device per slot, whatever the ambient XLA_FLAGS say —
+            # an inherited --xla_force_host_platform_device_count=8
+            # (e.g. from a test harness) would give every rank 8 local
+            # devices and a 8*np-device world. Caller-passed flags (via
+            # `extra`) are preserved; only the device-count token is
+            # replaced.
+            base_flags = env.get(
+                "XLA_FLAGS", os.environ.get("XLA_FLAGS", "")
+            )
+            kept = [
+                token
+                for token in base_flags.split()
+                if "xla_force_host_platform_device_count" not in token
+            ]
+            env["XLA_FLAGS"] = " ".join(
+                kept + ["--xla_force_host_platform_device_count=1"]
+            )
             blocks.append(env)
     else:
         raise ValueError(f"unknown placement {placement!r}")

@@ -1,0 +1,59 @@
+"""Examples must keep running — they are the user-facing contract
+(the reference ships its examples as de-facto integration tests via CI
+[V], SURVEY.md §4.5)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name, *args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", name), *args],
+        env=env,
+        capture_output=True,
+        timeout=timeout,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        f"{name} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_mnist_example():
+    out = _run_example(
+        "mnist.py", "--epochs", "1", "--steps-per-epoch", "3",
+        "--batch-size", "8",
+    )
+    assert "eval accuracy" in out
+
+
+@pytest.mark.slow
+def test_synthetic_benchmark_example():
+    out = _run_example(
+        "synthetic_benchmark.py", "--model", "mnist", "--batch-size", "8",
+        "--num-iters", "1", "--num-batches-per-iter", "2",
+        "--num-warmup-batches", "1",
+    )
+    assert "Total img/sec" in out
+
+
+@pytest.mark.slow
+def test_transformer_lm_example():
+    out = _run_example("transformer_lm.py", "--steps", "4")
+    assert "loss decreased" in out
+
+
+@pytest.mark.slow
+def test_elastic_example():
+    out = _run_example("elastic_train.py")
+    assert "elastic training complete" in out
