@@ -85,6 +85,7 @@ def main():
 
     @hvd.elastic.run
     def train(state):
+        loss = None  # may resume at an epoch boundary with no new batch
         while state.epoch < 2:
             while state.batch < 20:
                 world = hvd.size()
@@ -104,7 +105,7 @@ def main():
                     # Checkpoint-in-memory: a failure after this point
                     # rolls back here, not to the epoch start.
                     state.commit()
-            if hvd.rank() == 0:
+            if hvd.rank() == 0 and loss is not None:
                 print(f"epoch {state.epoch}: loss {float(loss):.4f}")
             state.batch = 0
             state.epoch += 1

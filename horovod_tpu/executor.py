@@ -88,8 +88,14 @@ class Executor:
         args: Sequence = (),
         kwargs: Optional[dict] = None,
     ) -> List[Any]:
-        """Execute ``fn(*args, **kwargs)`` on every rank; returns the
-        per-rank results ordered by rank (ref: RayExecutor.run [V])."""
+        """Execute ``fn(*args, **kwargs)`` on every launched process;
+        returns the results ordered by rank (ref: RayExecutor.run [V]).
+
+        per-slot placement launches one process per rank → one result
+        per rank; per-host placement launches one process per host
+        (driving local_size chips) → one result per host, keyed by its
+        lead rank — same per-process semantics as the reference's
+        fn-per-task model."""
         if not self._started:
             raise RuntimeError("Executor.run before start()")
         kwargs = kwargs or {}
@@ -101,12 +107,12 @@ class Executor:
                 pickle.dump((fn, tuple(args), kwargs), f)
             out_dir = os.path.join(tmp, "out")
             os.makedirs(out_dir)
-            code = self._launch(payload, out_dir)
-            # Read the per-rank results FIRST: a worker that raised
+            code, expected_ranks = self._launch(payload, out_dir)
+            # Read the per-process results FIRST: a worker that raised
             # writes its error pickle and exits nonzero, and "rank N
             # raised: ValueError ..." beats "exit code 1".
             results: List[Any] = []
-            for rank in range(self.num_workers):
+            for rank in expected_ranks:
                 path = os.path.join(out_dir, f"result.{rank}.pkl")
                 if not os.path.exists(path):
                     raise RuntimeError(
@@ -165,12 +171,14 @@ class Executor:
                 payload,
             ]
             hostnames = [b["HOROVOD_HOSTNAME"] for b in blocks]
-            return _launch.launch_processes(
+            expected_ranks = [int(b["HOROVOD_RANK"]) for b in blocks]
+            code = _launch.launch_processes(
                 blocks,
                 command,
                 hostnames,
                 start_timeout=self.start_timeout,
             )
+            return code, expected_ranks
         finally:
             server.stop()
 

@@ -317,6 +317,23 @@ class FusionManager:
             None if mask is None else np.asarray(mask, dtype=bool)
         )
 
+        # HOROVOD_HIERARCHICAL_ALLREDUCE (ref: nccl_operations.cc [V]):
+        # decompose the world psum into an intra-host stage + a
+        # cross-host stage via replica groups, letting XLA emit the
+        # ICI-local collective separately from the DCN hop. Only the
+        # unrestricted Sum/Average path qualifies.
+        hier_stages = None
+        from ..common import basics as _basics
+
+        cfg = _basics.get_config()
+        local = _basics.topology().local_size if _basics.is_initialized() else 1
+        if (
+            cfg.hierarchical_allreduce
+            and groups is None
+            and mask_arr is None
+        ):
+            hier_stages = hierarchical_stage_groups(world, local)
+
         def per_shard(x):  # x: [1, N] — this rank's slice of the buffer
             idx = lax.axis_index(WORLD_AXIS)
             if prescale != 1.0:
@@ -327,7 +344,17 @@ class FusionManager:
             else:
                 active = jnp.asarray(True)
                 contrib = x
-            if op in (Average, Sum):
+            if op in (Average, Sum) and hier_stages is not None:
+                intra_groups, inter_groups = hier_stages
+                out = lax.psum(
+                    contrib, WORLD_AXIS, axis_index_groups=intra_groups
+                )
+                out = lax.psum(
+                    out, WORLD_AXIS, axis_index_groups=inter_groups
+                )
+                if op == Average:
+                    out = out / jnp.asarray(world, out.dtype)
+            elif op in (Average, Sum):
                 out = lax.psum(contrib, WORLD_AXIS, axis_index_groups=groups)
                 if op == Average:
                     count = lax.psum(
@@ -477,6 +504,20 @@ class FusionManager:
             return out
 
         return jax.jit(self._shard_map(per_shard, mesh=mesh))
+
+
+def hierarchical_stage_groups(world: int, local: int):
+    """Replica groups for the two-level decomposition, or None when the
+    hierarchy degenerates (single host, or hosts of one chip): stage 1 =
+    one group per host (intra, ICI), stage 2 = one group per local slot
+    across hosts (inter, DCN). Summing stage 1 then stage 2 equals the
+    flat world sum."""
+    if local <= 1 or world <= local or world % local:
+        return None
+    hosts = world // local
+    intra = [list(range(h * local, (h + 1) * local)) for h in range(hosts)]
+    inter = [[i + h * local for h in range(hosts)] for i in range(local)]
+    return intra, inter
 
 
 def _singleton_mask(groups, world: int) -> np.ndarray:

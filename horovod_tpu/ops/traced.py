@@ -300,3 +300,76 @@ def quantized_allreduce(
     all_s = lax.all_gather(s2, axis_name)    # [n] f32
     out = (all_q.astype(jnp.float32) * all_s[:, None]).reshape(-1)[:m]
     return out.reshape(shape).astype(dtype)
+
+
+# Axis names for the two-level mesh built by hierarchical_mesh().
+INTRA_AXIS = "intra"  # within a host/slice: ICI
+INTER_AXIS = "inter"  # across hosts/slices: DCN
+
+
+def hierarchical_mesh(local_size: Optional[int] = None):
+    """A 2-axis (inter, intra) mesh over the world devices — the TPU
+    shape of the reference's node-hierarchy split (NCCL intra-node + MPI
+    inter-node, HOROVOD_HIERARCHICAL_ALLREDUCE in nccl_operations.cc
+    [V]): ``intra`` rides ICI within a host/slice, ``inter`` rides DCN
+    across them. ``local_size`` defaults to the topology's chips-per-host.
+    """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..common import basics
+
+    topo = basics.topology()
+    devices = np.asarray(topo.devices)
+    if local_size is None:
+        local_size = topo.local_size
+    if local_size < 1 or devices.size % local_size:
+        raise ValueError(
+            f"local_size {local_size} must divide world {devices.size}"
+        )
+    grid = devices.reshape(devices.size // local_size, local_size)
+    return Mesh(grid, (INTER_AXIS, INTRA_AXIS))
+
+
+def hierarchical_allreduce(
+    tensor,
+    op=None,
+    intra_axis: str = INTRA_AXIS,
+    inter_axis: str = INTER_AXIS,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """Two-level allreduce for use inside shard_map over a
+    :func:`hierarchical_mesh`: reduce-scatter on the intra (ICI) axis,
+    allreduce the 1/L-sized shards on the inter (DCN) axis, all-gather
+    back on intra — the reference's exact hierarchical dataflow
+    (ReduceScatter→MPI-allreduce→Allgather, nccl_operations.cc [V]),
+    which keeps the slow cross-slice hop at 1/local_size of the bytes.
+
+    The tensor is flattened and zero-padded to a multiple of the intra
+    size internally; shape is restored on return. Sum/Average only (the
+    decomposition relies on reduction associativity over partitions).
+    """
+    op = resolve_op(op, None)
+    if op not in (Average, Sum):
+        raise ValueError("hierarchical_allreduce supports Sum/Average only")
+    intra_n = lax.axis_size(intra_axis)
+    inter_n = lax.axis_size(inter_axis)
+    shape, dtype = tensor.shape, tensor.dtype
+    flat = tensor.reshape(-1)
+    m = flat.shape[0]
+    padded = -(-m // intra_n) * intra_n
+    if padded != m:
+        flat = jnp.pad(flat, (0, padded - m))
+    if prescale_factor != 1.0:
+        flat = flat * jnp.asarray(prescale_factor, flat.dtype)
+    shard = lax.psum_scatter(
+        flat, intra_axis, scatter_dimension=0, tiled=True
+    )                                       # [padded/L], summed intra
+    shard = lax.psum(shard, inter_axis)     # cross-slice hop on 1/L bytes
+    out = lax.all_gather(shard, intra_axis, tiled=True)  # [padded]
+    if op == Average:
+        out = out / jnp.asarray(intra_n * inter_n, out.dtype)
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, out.dtype)
+    return out[:m].reshape(shape).astype(dtype)

@@ -32,6 +32,17 @@ def chips_per_host(default: int = 4, env: Optional[dict] = None) -> int:
         for part in bounds.split(","):
             n *= int(part)
         return n
+    # An explicit CPU request (simulation/tests) must never touch — or
+    # wait on — a real accelerator, and answering it needs no jax at
+    # all: the CPU "chip count" is the forced host-device count.
+    if env.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import re
+
+        match = re.search(
+            r"xla_force_host_platform_device_count=(\d+)",
+            env.get("XLA_FLAGS", ""),
+        )
+        return int(match.group(1)) if match else 1
     try:
         import jax
 

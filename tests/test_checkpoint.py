@@ -1,0 +1,120 @@
+"""Checkpoint subsystem tests — the durability layer the reference
+lacks (SURVEY.md §5.4: in-memory elastic commits only)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_save_restore_roundtrip(hvd, tmp_path, rng):
+    from horovod_tpu.checkpoint import CheckpointManager
+
+    tree = {
+        "params": {"w": jnp.asarray(rng.normal(size=(4, 3)), jnp.float32)},
+        "step": jnp.asarray(7, jnp.int32),
+    }
+    with CheckpointManager(str(tmp_path / "ck")) as mgr:
+        assert mgr.save(1, tree)
+        mgr.wait_until_finished()
+        out = mgr.restore(1, like=tree)
+    np.testing.assert_allclose(
+        np.asarray(out["params"]["w"]), np.asarray(tree["params"]["w"])
+    )
+    assert int(out["step"]) == 7
+
+
+def test_latest_and_retention(hvd, tmp_path):
+    from horovod_tpu.checkpoint import CheckpointManager
+
+    tree = {"x": jnp.zeros(2)}
+    with CheckpointManager(str(tmp_path / "ck"), max_to_keep=2) as mgr:
+        for step in (1, 2, 3):
+            mgr.save(step, {"x": jnp.full(2, float(step))})
+            mgr.wait_until_finished()
+        assert mgr.latest_step() == 3
+        assert mgr.all_steps() == [2, 3]  # oldest pruned
+        out = mgr.restore(like=tree)
+    np.testing.assert_allclose(np.asarray(out["x"]), 3.0)
+
+
+def test_restore_missing_raises(hvd, tmp_path):
+    from horovod_tpu.checkpoint import CheckpointManager
+
+    with CheckpointManager(str(tmp_path / "empty")) as mgr:
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+
+
+def test_sharded_leaf_roundtrip(hvd, tmp_path, rng):
+    """A rank-major world-sharded array restores with its sharding."""
+    from horovod_tpu.checkpoint import CheckpointManager
+
+    x = hvd.shard_from_rank_fn(
+        lambda r: np.full((3,), float(r), np.float32), hvd.mesh()
+    )
+    with CheckpointManager(str(tmp_path / "ck")) as mgr:
+        mgr.save(1, {"x": x})
+        mgr.wait_until_finished()
+        out = mgr.restore(1, like={"x": x})
+    np.testing.assert_allclose(np.asarray(out["x"]), np.asarray(x))
+    assert out["x"].sharding == x.sharding
+
+
+def test_durable_state_resume(hvd, tmp_path):
+    """Full-job-restart resume: a fresh DurableJaxState picks up where
+    the dead job's last durable commit left off."""
+    from horovod_tpu.checkpoint import DurableJaxState
+
+    ckdir = str(tmp_path / "elastic_ck")
+    params = {"w": jnp.ones((2, 2), jnp.float32)}
+    state = DurableJaxState(
+        checkpoint_dir=ckdir, params=params, step=0, epoch=0
+    )
+    state.params = {"w": jnp.full((2, 2), 5.0, jnp.float32)}
+    state.step = 42
+    state.commit()
+    state.wait_until_finished()
+    state.close()
+
+    # "restarted job": new process, same directory
+    fresh = DurableJaxState(
+        checkpoint_dir=ckdir, params=params, step=0, epoch=0
+    )
+    assert fresh.resume_latest()
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]), 5.0)
+    assert fresh.step == 42
+    # in-memory rollback still works on top of the resumed state
+    fresh.step = 99
+    fresh.restore()
+    assert fresh.step == 42
+    fresh.close()
+
+
+def test_durable_state_save_interval(hvd, tmp_path):
+    from horovod_tpu.checkpoint import DurableJaxState
+
+    state = DurableJaxState(
+        checkpoint_dir=str(tmp_path / "ck"),
+        save_interval=3,
+        params={"w": jnp.zeros(2)},
+        step=0,
+    )
+    for i in range(1, 7):
+        state.step = i
+        state.commit()
+    state.wait_until_finished()
+    # 6 commits / interval 3 => exactly 2 durable checkpoints
+    assert len(state._ckpt.all_steps()) == 2
+    state.close()
+
+
+def test_durable_state_fresh_start(hvd, tmp_path):
+    from horovod_tpu.checkpoint import DurableJaxState
+
+    state = DurableJaxState(
+        checkpoint_dir=str(tmp_path / "ck"), params={"w": jnp.zeros(2)},
+        step=0,
+    )
+    assert not state.resume_latest()
+    state.close()

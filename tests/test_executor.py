@@ -34,6 +34,15 @@ def test_execute_alias_and_ray_name():
         ex.shutdown()
 
 
+def test_per_host_placement_results_per_process():
+    """per-host launches one process per host driving all local slots;
+    results come back one per process, keyed by lead rank."""
+    with Executor(num_workers=2, placement="per-host") as ex:
+        results = ex.run(os.getenv, args=("HOROVOD_RANK",))
+    # single local host → one process, rank 0, driving both slots
+    assert results == ["0"]
+
+
 def test_run_one_shot_helper():
     results = run(os.getenv, args=("HOROVOD_LOCAL_RANK",), num_proc=2)
     assert results == ["0", "0"]  # per-slot: each rank is its own host

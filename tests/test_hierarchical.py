@@ -1,0 +1,140 @@
+"""Hierarchical (two-level) allreduce tests — the ICI/DCN analog of the
+reference's NCCL-intra + MPI-inter path (HOROVOD_HIERARCHICAL_ALLREDUCE,
+nccl_operations.cc [V])."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+def test_hierarchical_mesh_shape(hvd):
+    from horovod_tpu.ops import traced
+
+    mesh = traced.hierarchical_mesh(local_size=4)
+    assert mesh.axis_names == (traced.INTER_AXIS, traced.INTRA_AXIS)
+    assert mesh.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        traced.hierarchical_mesh(local_size=3)  # 3 does not divide 8
+
+
+@pytest.mark.parametrize("local_size", [2, 4])
+@pytest.mark.parametrize("op_name", ["sum", "avg"])
+def test_hierarchical_allreduce_matches_flat(hvd, rng, local_size, op_name):
+    """rs→ar→ag over (inter, intra) must equal a flat allreduce."""
+    from horovod_tpu.ops import traced
+
+    mesh = traced.hierarchical_mesh(local_size=local_size)
+    n = 8
+    per_rank = rng.normal(size=(n, 37)).astype(np.float32)  # odd length
+    op = hvd.Sum if op_name == "sum" else hvd.Average
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P((traced.INTER_AXIS, traced.INTRA_AXIS)),
+        out_specs=P((traced.INTER_AXIS, traced.INTRA_AXIS)),
+        check_vma=False,
+    )
+    def reduce(x):
+        return traced.hierarchical_allreduce(x[0], op=op)[None]
+
+    got = np.asarray(jax.jit(reduce)(jnp.asarray(per_rank)))
+    want = per_rank.sum(axis=0)
+    if op_name == "avg":
+        want = want / n
+    for r in range(n):
+        np.testing.assert_allclose(got[r], want, rtol=1e-5, atol=1e-5)
+
+
+def test_hierarchical_allreduce_scales(hvd, rng):
+    from horovod_tpu.ops import traced
+
+    mesh = traced.hierarchical_mesh(local_size=4)
+    per_rank = rng.normal(size=(8, 16)).astype(np.float32)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P((traced.INTER_AXIS, traced.INTRA_AXIS)),
+        out_specs=P((traced.INTER_AXIS, traced.INTRA_AXIS)),
+        check_vma=False,
+    )
+    def reduce(x):
+        return traced.hierarchical_allreduce(
+            x[0], op=hvd.Sum, prescale_factor=0.5, postscale_factor=2.0
+        )[None]
+
+    got = np.asarray(jax.jit(reduce)(jnp.asarray(per_rank)))
+    np.testing.assert_allclose(
+        got[0], per_rank.sum(axis=0), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_hierarchical_allreduce_rejects_min(hvd):
+    from horovod_tpu.ops import traced
+
+    with pytest.raises(ValueError):
+        traced.hierarchical_allreduce(jnp.zeros(4), op="min")
+
+
+def test_hierarchical_stage_groups():
+    from horovod_tpu.ops.fusion import hierarchical_stage_groups
+
+    intra, inter = hierarchical_stage_groups(8, 4)
+    assert intra == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert inter == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    # every rank appears exactly once per stage
+    for stage in (intra, inter):
+        flat = sorted(r for g in stage for r in g)
+        assert flat == list(range(8))
+    # degenerate hierarchies fall back to flat
+    assert hierarchical_stage_groups(8, 1) is None
+    assert hierarchical_stage_groups(8, 8) is None
+    assert hierarchical_stage_groups(8, 3) is None
+
+
+def test_eager_hierarchical_flag_correctness(rng, monkeypatch):
+    """With HOROVOD_HIERARCHICAL_ALLREDUCE=1 and a multi-host-shaped
+    topology (local_size 4 of world 8), the eager allreduce decomposes
+    into two grouped psums and still produces the exact flat result."""
+    import dataclasses
+
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    import horovod_tpu as hvd_mod
+    from horovod_tpu.common import basics
+
+    hvd_mod.shutdown()
+    hvd_mod.init()
+    try:
+        assert hvd_mod.get_config().hierarchical_allreduce
+        # Simulate 2 hosts x 4 chips on the 8-device sim (the env
+        # contract can't fake this: the validator checks it against the
+        # real runtime, so patch the discovered topology instead).
+        topo = basics.topology()
+        patched = dataclasses.replace(topo, local_device_count=4)
+        monkeypatch.setattr(
+            basics._state, "topology", patched, raising=False
+        )
+        assert basics.topology().local_size == 4
+        per_rank = rng.normal(size=(8, 33)).astype(np.float32)
+        x = hvd_mod.shard_from_rank_fn(
+            lambda r: per_rank[r], hvd_mod.mesh()
+        )
+        out = np.asarray(jax.device_get(hvd_mod.allreduce(x, op=hvd_mod.Sum)))
+        for r in range(8):
+            np.testing.assert_allclose(
+                out[r], per_rank.sum(axis=0), rtol=1e-5, atol=1e-5
+            )
+        # Average path too
+        out = np.asarray(
+            jax.device_get(hvd_mod.allreduce(x, op=hvd_mod.Average))
+        )
+        np.testing.assert_allclose(
+            out[0], per_rank.mean(axis=0), rtol=1e-5, atol=1e-5
+        )
+    finally:
+        hvd_mod.shutdown()
