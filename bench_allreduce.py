@@ -1,0 +1,89 @@
+"""Allreduce microbenchmark — bandwidth/latency across message sizes.
+
+The harness behind the reference's headline claim (scaling efficiency of
+allreduce-dominated training, docs/benchmarks.rst + the Horovod paper
+fig. 5-6 [V]; BASELINE.md north star: allreduce scaling efficiency on an
+8→256-chip sweep). On a pod slice this sweeps the whole world; on the
+1-chip dev box it measures single-device round-trip overhead, and on the
+CPU simulation it validates the sweep logic across an 8-way mesh.
+
+Prints one JSON line per message size:
+  {"metric": "allreduce_busbw", "bytes": N, "world": W,
+   "value": GB/s, "unit": "GB/s", "lat_us": ...}
+
+Bus bandwidth uses the standard ring-allreduce convention:
+  busbw = bytes * 2*(W-1)/W / time
+(equals algobw for W=1). Env: BENCH_PLATFORM=cpu for the simulated mesh,
+BENCH_SIZES="1024,1048576" to override the sweep, BENCH_ITERS.
+"""
+
+import json
+import os
+import time
+from functools import partial
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import traced
+
+    hvd.init()
+    mesh = hvd.mesh()
+    world = hvd.size()
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    sizes_env = os.environ.get("BENCH_SIZES")
+    if sizes_env:
+        sizes = [int(s) for s in sizes_env.split(",")]
+    else:
+        sizes = [1 << p for p in range(10, 28, 2)]  # 1 KB .. 128 MB
+
+    for nbytes in sizes:
+        n = max(nbytes // 4, 1)  # float32 elements
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=P(hvd.WORLD_AXIS),
+            out_specs=P(hvd.WORLD_AXIS),
+            check_vma=False,
+        )
+        def reduce(x):
+            return traced.allreduce(x[0], op=hvd.Sum)[None]
+
+        step = jax.jit(reduce)
+        x = jnp.ones((world, n), jnp.float32)
+        out = step(x)  # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        ring_factor = 2.0 * (world - 1) / world if world > 1 else 1.0
+        busbw = nbytes * ring_factor / dt / 1e9
+        print(
+            json.dumps(
+                {
+                    "metric": "allreduce_busbw",
+                    "bytes": nbytes,
+                    "world": world,
+                    "value": round(busbw, 3),
+                    "unit": "GB/s",
+                    "lat_us": round(dt * 1e6, 1),
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

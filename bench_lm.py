@@ -1,0 +1,132 @@
+"""Language-model pretraining throughput — BERT-large / GPT-2-medium.
+
+The two tracked LM configs from BASELINE.json [V]: BERT-large with
+Adasum gradient combination (config #3) and GPT-2 medium with
+hierarchical allreduce (config #4). Prints ONE JSON line:
+  {"metric": "<model>_samples_per_sec", "value": N, "unit": "samples/s"}
+
+Env: BENCH_MODEL=bert_large|gpt2_medium (default bert_large),
+BENCH_BATCH (default 8), BENCH_SEQ (default: model max 512/1024 capped
+at 512), BENCH_ITERS (default 10), BENCH_PLATFORM=cpu + tiny model for
+the harness smoke test (BENCH_TINY=1).
+"""
+
+import json
+import os
+import time
+from functools import partial
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    model_name = os.environ.get("BENCH_MODEL", "bert_large")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+
+    hvd.init()
+    mesh = hvd.mesh()
+
+    if os.environ.get("BENCH_TINY"):
+        cfg = TransformerConfig.tiny(causal=(model_name == "gpt2_medium"))
+    elif model_name == "gpt2_medium":
+        cfg = TransformerConfig.gpt2_medium()
+    else:
+        cfg = TransformerConfig.bert_large()
+    cfg = dataclasses_replace(cfg, remat=not os.environ.get("BENCH_TINY"))
+    seq = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_len, 512))))
+
+    # The BASELINE pairing: BERT-large exercises Adasum, GPT-2 medium the
+    # hierarchical two-level reduction (BASELINE.json configs [V]).
+    if model_name == "bert_large":
+        reduce_op = hvd.Adasum
+    else:
+        reduce_op = hvd.Average
+        os.environ.setdefault("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+
+    model = Transformer(cfg)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    params = jax.jit(
+        lambda: model.init(jax.random.PRNGKey(0), tokens, train=False)
+    )()
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9), op=reduce_op
+    )
+    opt_state = opt.init(params)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    def train_step(params, opt_state, tokens, labels):
+        tokens, labels = tokens[0], labels[0]
+
+        def loss_fn(p):
+            logits = model.apply(p, tokens, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, jax.lax.pmean(loss, hvd.WORLD_AXIS)
+
+    # No donation here: fresh-initialized params contain aliased
+    # (deduplicated) zero buffers, and donating the same buffer twice is
+    # an XLA error.
+    step = jax.jit(train_step)
+    rng = np.random.default_rng(0)
+    world = hvd.size()
+    toks = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(world, batch, seq)), jnp.int32
+    )
+    labels = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(world, batch, seq)), jnp.int32
+    )
+
+    params, opt_state, loss = step(params, opt_state, toks, labels)
+    jax.block_until_ready(loss)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, toks, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    samples_per_sec = batch * world * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"{model_name}_samples_per_sec",
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/s",
+                "batch": batch,
+                "seq": seq,
+                "world": world,
+            }
+        )
+    )
+
+
+def dataclasses_replace(cfg, **kw):
+    import dataclasses
+
+    return dataclasses.replace(cfg, **kw)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Preemption handling: the TPU-native failure mode, handled first-class.
+
+The reference's elastic stack reacts to failures AFTER they break a
+collective (`HorovodInternalError` → rollback, SURVEY.md §3.4/§5.3);
+preemptible TPU VMs instead deliver an ADVANCE signal (SIGTERM from the
+infrastructure, typically ~30s of grace). This module turns that grace
+window into a durable checkpoint:
+
+    state = DurableJaxState(checkpoint_dir=..., params=..., step=0)
+    with hvd.preemption.GracefulShutdown(state):
+        train(state)   # on SIGTERM: finish persisting, then exit(143)
+
+or cooperatively:
+
+    handler = hvd.preemption.PreemptionHandler()
+    for step in range(...):
+        ...
+        if handler.should_stop():   # signal arrived: wind down in-loop
+            state.commit(); state.wait_until_finished(); break
+
+After the restart (same or re-acquired slice), ``resume_latest()`` on a
+fresh ``DurableJaxState`` continues from the persisted step — the
+slice-re-acquisition recovery the survey calls for (§5.3: "elastic on
+TPU is restart-with-different-slice").
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, Iterable, Optional
+
+_DEFAULT_SIGNALS = (signal.SIGTERM,)
+
+
+class PreemptionHandler:
+    """Latches preemption signals; query with :meth:`should_stop`.
+
+    Chains any previously-installed handler, so stacking on top of a
+    launcher's own SIGTERM handling keeps both behaviors.
+    """
+
+    def __init__(
+        self,
+        signals: Iterable[int] = _DEFAULT_SIGNALS,
+        on_preempt: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self._event = threading.Event()
+        self._on_preempt = on_preempt
+        self._previous = {}
+        for sig in signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+
+    def _handle(self, signum, frame) -> None:
+        self._event.set()
+        if self._on_preempt is not None:
+            self._on_preempt()
+        prev = self._previous.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    def should_stop(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev if prev is not None else signal.SIG_DFL)
+        self._previous.clear()
+
+
+class GracefulShutdown:
+    """Context manager: on preemption, persist the state and exit.
+
+    ``state`` needs the DurableJaxState surface (``commit()`` +
+    ``wait_until_finished()``); any object with those methods works.
+    ``exit_code`` defaults to 143 (128+SIGTERM), which launchers read as
+    "killed by infrastructure", not a software fault.
+    """
+
+    def __init__(
+        self,
+        state,
+        signals: Iterable[int] = _DEFAULT_SIGNALS,
+        exit_code: int = 143,
+    ) -> None:
+        self._state = state
+        self._signals = tuple(signals)
+        self._exit_code = exit_code
+        self._handler: Optional[PreemptionHandler] = None
+
+    def __enter__(self) -> "GracefulShutdown":
+        self._handler = PreemptionHandler(
+            signals=self._signals, on_preempt=self._drain_and_exit
+        )
+        return self
+
+    def _drain_and_exit(self) -> None:
+        try:
+            self._state.commit()
+            wait = getattr(self._state, "wait_until_finished", None)
+            if wait is not None:
+                wait()
+        finally:
+            # os._exit: a signal can arrive mid-collective; running
+            # normal interpreter teardown over wedged device state can
+            # hang past the grace window, and the checkpoint is already
+            # durable.
+            os._exit(self._exit_code)
+
+    def __exit__(self, *exc) -> None:
+        if self._handler is not None:
+            self._handler.uninstall()
+            self._handler = None

@@ -1,0 +1,120 @@
+"""Preemption handling tests: signal latching in-process, and a real
+SIGTERM to a training subprocess that must leave a resumable durable
+checkpoint (the TPU analog of the reference's kill-based elastic
+integration tests, SURVEY.md §4.3)."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_handler_latches_and_chains():
+    from horovod_tpu.preemption import PreemptionHandler
+
+    seen = []
+    prev_called = []
+    signal.signal(signal.SIGUSR1, lambda s, f: prev_called.append(s))
+    handler = PreemptionHandler(
+        signals=(signal.SIGUSR1,), on_preempt=lambda: seen.append(1)
+    )
+    try:
+        assert not handler.should_stop()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.1)
+        assert handler.should_stop()
+        assert seen == [1]
+        assert prev_called == [signal.SIGUSR1]  # chained
+    finally:
+        handler.uninstall()
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+def test_uninstall_restores():
+    from horovod_tpu.preemption import PreemptionHandler
+
+    original = signal.getsignal(signal.SIGUSR2)
+    handler = PreemptionHandler(signals=(signal.SIGUSR2,))
+    handler.uninstall()
+    assert signal.getsignal(signal.SIGUSR2) == (
+        original if original is not None else signal.SIG_DFL
+    )
+
+
+@pytest.mark.slow
+def test_sigterm_produces_resumable_checkpoint(tmp_path):
+    """Kill a training process mid-run; its GracefulShutdown must leave
+    a durable checkpoint a fresh process resumes from."""
+    ckdir = str(tmp_path / "ck")
+    script = tmp_path / "train.py"
+    script.write_text(
+        textwrap.dedent(
+            f"""
+            import sys, time
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+            from horovod_tpu.checkpoint import DurableJaxState
+            from horovod_tpu.preemption import GracefulShutdown
+
+            hvd.init()
+            state = DurableJaxState(
+                checkpoint_dir={ckdir!r},
+                params={{"w": jnp.zeros(4)}},
+                step=0,
+            )
+            with GracefulShutdown(state):
+                print("READY", flush=True)
+                while True:
+                    state.step += 1
+                    state.params = {{
+                        "w": jnp.full((4,), float(state.step))
+                    }}
+                    time.sleep(0.05)
+            """
+        )
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, str(script)],
+        env=env,
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "READY" in line
+        time.sleep(1.0)  # let some steps elapse
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 143
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # Fresh "restarted" process state resumes from the durable commit.
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.checkpoint import DurableJaxState
+
+    fresh = DurableJaxState(
+        checkpoint_dir=ckdir, params={"w": jnp.zeros(4)}, step=0
+    )
+    try:
+        assert fresh.resume_latest()
+        assert fresh.step > 0
+        np.testing.assert_allclose(
+            np.asarray(fresh.params["w"]), float(fresh.step)
+        )
+    finally:
+        fresh.close()
