@@ -5,44 +5,89 @@ north star tracks the same metric on TPU).
 
 Prints ONE JSON line:
   {"metric": "resnet50_synth_img_per_sec", "value": N, "unit": "img/s",
-   "vs_baseline": R}
+   "vs_baseline": R, "platform": "...", "mfu": M, "tflops_per_sec": T}
 
 vs_baseline compares against the canonical single-P100 fp32 ResNet-50
 throughput (~219 img/s, the tf_cnn_benchmarks number contemporaneous with
 the reference's published scaling figures — BASELINE.md [V]): the
 reference's own benchmark prints absolute img/sec per device, so the
-honest single-chip comparison is chip vs chip.
+honest single-chip comparison is chip vs chip. MFU is measured FLOP/s
+(XLA cost analysis of the compiled train step) over the chip's peak
+bf16 FLOP/s.
+
+Resilience: the default invocation is an ORCHESTRATOR that runs the
+measurement in a fresh subprocess (BENCH_INNER=1), retrying with backoff
+when the TPU backend is unavailable (the sandbox's known stuck-chip-claim
+failure mode — BENCH_r01 died on first touch with rc=1). If every TPU
+attempt fails it falls back to a small CPU run and reports it honestly
+(platform=cpu + error note), so the driver always gets a parseable line.
 
 Env knobs: BENCH_BATCH (default 256 — measured-best MXU utilization on
 the v5e-class chip; the reference harness defaults to 32, which here
 leaves ~15% throughput on the table), BENCH_ITERS, BENCH_WARMUP,
-BENCH_PLATFORM=cpu to force the host platform.
+BENCH_PLATFORM=cpu to force the host platform, BENCH_ATTEMPTS,
+BENCH_ATTEMPT_TIMEOUT (s), BENCH_PEAK_TFLOPS to override the MFU
+denominator.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
-from functools import partial
 
 P100_FP32_IMG_PER_SEC = 219.0
 
-batch = int(os.environ.get("BENCH_BATCH", "256"))
-n_iters = int(os.environ.get("BENCH_ITERS", "20"))
-n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-
-import jax  # noqa: E402
-
-if os.environ.get("BENCH_PLATFORM"):
-    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
-
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
-import optax  # noqa: E402
-
-from horovod_tpu.models import ResNet50  # noqa: E402
+# Public peak bf16 TFLOP/s per chip, keyed by the sandbox's generation
+# env var. Override with BENCH_PEAK_TFLOPS.
+PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
 
-def main():
+def _peak_tflops(platform: str):
+    if platform == "cpu":
+        return None  # no meaningful MFU denominator on the host
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        return float(os.environ["BENCH_PEAK_TFLOPS"])
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return PEAK_BF16_TFLOPS.get(gen)
+
+
+def _aot_compile(train_step, *args):
+    """AOT-compile the step ONCE and read its XLA FLOP count. Returns
+    (callable, flops) — the same compiled object is used for the timed
+    loop so the bench never pays a second trace/compile."""
+    try:
+        compiled = train_step.lower(*args).compile()
+    except Exception:
+        return train_step, None  # backend without AOT support
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        flops = None
+    return compiled, flops
+
+
+def inner_main():
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    n_iters = int(os.environ.get("BENCH_ITERS", "20"))
+    n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from functools import partial
+
+    from horovod_tpu.models import ResNet50
+
+    platform = jax.devices()[0].platform
     model = ResNet50(dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     images = jnp.asarray(
@@ -79,6 +124,10 @@ def main():
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss
 
+    train_step, flops = _aot_compile(
+        train_step, params, batch_stats, opt_state, images, labels
+    )
+
     for _ in range(n_warmup):
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels
@@ -95,17 +144,131 @@ def main():
     dt = time.perf_counter() - t0
 
     img_per_sec = batch * n_iters / dt
+    result = {
+        "metric": "resnet50_synth_img_per_sec",
+        "value": round(img_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_sec / P100_FP32_IMG_PER_SEC, 3),
+        "platform": platform,
+        "batch": batch,
+    }
+    peak = _peak_tflops(platform)
+    if flops is not None:
+        tflops = flops * n_iters / dt / 1e12
+        result["tflops_per_sec"] = round(tflops, 2)
+        if peak:
+            result["mfu"] = round(tflops / peak, 4)
+    print(json.dumps(result))
+
+
+def _spawn(env, timeout):
+    try:
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        def _txt(v):
+            return v.decode(errors="replace") if isinstance(v, bytes) else (
+                v or "")
+
+        return subprocess.CompletedProcess(
+            e.cmd, 124, _txt(e.stdout),
+            _txt(e.stderr) + f"\n[timeout after {timeout}s]",
+        )
+
+
+def _extract_json(stdout):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def orchestrate():
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "600"))
+    forced = os.environ.get("BENCH_PLATFORM")
+
+    base_env = dict(os.environ)
+    base_env["BENCH_INNER"] = "1"
+
+    if forced:
+        attempts = 1  # platform is explicit; no TPU-retry dance
+
+    last_err = ""
+    for i in range(attempts):
+        if i > 0:
+            delay = 30.0 * i
+            print(
+                f"bench: attempt {i} failed, retrying in {delay:.0f}s "
+                f"(TPU backend may be recovering a stale chip claim)",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+        proc = _spawn(base_env, timeout)
+        parsed = _extract_json(proc.stdout or "")
+        if proc.returncode == 0 and parsed is not None:
+            print(json.dumps(parsed))
+            return 0
+        last_err = (proc.stderr or "")[-1500:] or (proc.stdout or "")[-1500:]
+
+    cpu_err = ""
+    if not forced:
+        # All TPU attempts failed: fall back to a small honest CPU run
+        # so the round still records a parseable measurement. Skipped
+        # when the caller forced a platform — overriding an explicit
+        # choice would mask a hard requirement.
+        from _hermetic import hermetic_cpu_env
+
+        cpu_env = hermetic_cpu_env(base=base_env)
+        cpu_env["BENCH_PLATFORM"] = "cpu"
+        cpu_env["BENCH_BATCH"] = os.environ.get("BENCH_CPU_BATCH", "32")
+        cpu_env["BENCH_ITERS"] = os.environ.get("BENCH_CPU_ITERS", "3")
+        cpu_env["BENCH_WARMUP"] = "1"
+        proc = _spawn(cpu_env, timeout)
+        parsed = _extract_json(proc.stdout or "")
+        if proc.returncode == 0 and parsed is not None:
+            parsed["error"] = (
+                "tpu backend unavailable after "
+                f"{attempts} attempts; CPU fallback. last error: "
+                + last_err[-400:]
+            )
+            print(json.dumps(parsed))
+            return 0
+        cpu_err = (proc.stderr or "")[-400:]
+
+    # Emit a diagnostic line the driver can still parse.
     print(
         json.dumps(
             {
                 "metric": "resnet50_synth_img_per_sec",
-                "value": round(img_per_sec, 2),
+                "value": 0.0,
                 "unit": "img/s",
-                "vs_baseline": round(img_per_sec / P100_FP32_IMG_PER_SEC, 3),
+                "vs_baseline": 0.0,
+                "error": (
+                    f"all attempts failed (platform="
+                    f"{forced or 'tpu'}). last error: " + last_err[-400:]
+                    + (" | cpu fallback error: " + cpu_err
+                       if cpu_err else "")
+                ),
             }
         )
     )
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_INNER") == "1":
+        inner_main()
+    else:
+        sys.exit(orchestrate())

@@ -31,8 +31,13 @@ def main():
 
     import jax
 
-    if os.environ.get("BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    # The sandbox's sitecustomize force-selects the TPU platform even
+    # when JAX_PLATFORMS=cpu is in the env, so honor both env vars
+    # explicitly via jax.config (like the sibling examples do) — this is
+    # what keeps the example tests off the real chip.
+    plat = os.environ.get("BENCH_PLATFORM") or os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
 
     import jax.numpy as jnp
     import optax

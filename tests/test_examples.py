@@ -9,12 +9,16 @@ import sys
 import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from _hermetic import hermetic_cpu_env  # noqa: E402
 
 
-def _run_example(name, *args, timeout=420):
-    env = dict(os.environ)
+def _run_example(name, *args, timeout=600):
+    # Examples must never contend for the single real chip.
+    env = hermetic_cpu_env()
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", name), *args],
         env=env,
