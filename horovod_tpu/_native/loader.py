@@ -72,9 +72,11 @@ def _declare(lib: ctypes.CDLL) -> None:
 
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded library, building it on first call; None if disabled
-    (HOROVOD_NATIVE=0) or unbuildable."""
+    (HOROVOD_NATIVE=0; HOROVOD_TPU_NATIVE=0 is honored as an alias) or
+    unbuildable."""
     global _lib, _load_failed
-    if os.environ.get("HOROVOD_NATIVE", "1") == "0":
+    if (os.environ.get("HOROVOD_NATIVE", "1") == "0"
+            or os.environ.get("HOROVOD_TPU_NATIVE", "1") == "0"):
         return None
     with _lock:
         if _lib is not None or _load_failed:
@@ -111,15 +113,20 @@ class TimelineBuffer:
         self._lib.hvd_tl_emit(self._h, json_str.encode())
 
     def drain(self) -> List[str]:
-        size = self._lib.hvd_tl_drain_size(self._h)
-        if size <= 0:
-            return []
-        buf = ctypes.create_string_buffer(size)
-        n = self._lib.hvd_tl_drain(self._h, buf, size)
-        if n <= 0:
-            return []
-        text = buf.raw[:n].decode()
-        return [line for line in text.split("\n") if line]
+        # An emit can land between the size query and the drain, making
+        # hvd_tl_drain return -1 with the buffer intact — re-probe and
+        # retry (mirrors NativeKVServer._read) so a final shutdown drain
+        # never drops buffered events.
+        for _ in range(8):
+            size = self._lib.hvd_tl_drain_size(self._h)
+            if size <= 0:
+                return []
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.hvd_tl_drain(self._h, buf, size)
+            if n >= 0:
+                text = buf.raw[:n].decode()
+                return [line for line in text.split("\n") if line]
+        return []
 
     def __len__(self) -> int:
         return self._lib.hvd_tl_count(self._h)

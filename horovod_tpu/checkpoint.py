@@ -160,6 +160,17 @@ class DurableJaxState(JaxState):
             self._step_counter += 1
             self._ckpt.save(self._step_counter, self._durable_tree())
 
+    def persist(self) -> None:
+        """Unconditionally write the CURRENT live state to a durable
+        checkpoint — no ``save_interval`` batching, no host-update check
+        (``commit()`` does both, and either can lose the grace window:
+        with save_interval>1 the write is skipped, and
+        ``check_host_updates()`` can raise ``HostsUpdatedInterrupt``
+        before saving). :class:`~horovod_tpu.preemption.GracefulShutdown`
+        calls this, so a preempted VM always flushes its latest state."""
+        self._step_counter += 1
+        self._ckpt.save(self._step_counter, self._durable_tree(), force=True)
+
     def resume_latest(self) -> bool:
         """Load the newest durable checkpoint into this state. Returns
         False when none exists (fresh start)."""

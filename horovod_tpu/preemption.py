@@ -100,7 +100,14 @@ class GracefulShutdown:
 
     def _drain_and_exit(self) -> None:
         try:
-            self._state.commit()
+            # Prefer the unconditional durable path: commit() may batch
+            # (save_interval) or raise HostsUpdatedInterrupt before the
+            # write — either loses the grace window's whole purpose.
+            persist = getattr(self._state, "persist", None)
+            if persist is not None:
+                persist()
+            else:
+                self._state.commit()
             wait = getattr(self._state, "wait_until_finished", None)
             if wait is not None:
                 wait()

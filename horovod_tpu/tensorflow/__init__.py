@@ -1,0 +1,154 @@
+"""``import horovod_tpu.tensorflow as hvd`` — gated TensorFlow binding.
+
+Parity target: the reference's TF surface (ref:
+horovod/tensorflow/__init__.py + mpi_ops.py + gradients.py [V] —
+SURVEY.md §2.4, ~2,500 LoC). Scope decision (docs/design.md "Framework
+bindings"): this module is a *gated minimal binding* — the same
+host-bridge pattern as the torch shim (horovod_tpu/torch), delegating
+every collective to the eager XLA path. It imports only when TF is
+present; otherwise it raises immediately with this scope note rather
+than failing somewhere deep inside a user script.
+
+What is here when TF is available: init/rank/size identity, allreduce /
+allgather / broadcast (sync + _async + in-place variants where TF
+semantics allow), broadcast_variables, and DistributedGradientTape —
+the TF2 idiom the reference's docs lead with (SURVEY.md §3.5).
+Deliberately absent (would need TF to even design honestly): TF1
+Session-era DistributedOptimizer, custom-op kernels (`mpi_ops.cc`) and
+the XLA custom-call hooks (`xla_mpi_ops.cc`) — on TPU the XLA hook is
+the *whole framework* (collectives are compiler-visible), so that row
+is subsumed rather than missing.
+"""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as tf  # noqa: F401
+except Exception as _e:  # pragma: no cover - exercised only without TF
+    raise ImportError(
+        "horovod_tpu.tensorflow requires the 'tensorflow' package, which "
+        "is not installed in this environment. This binding is a gated "
+        "compatibility layer (see module docstring / docs/design.md); "
+        "the TPU-native training path is the JAX API: "
+        "`import horovod_tpu as hvd`."
+    ) from _e
+
+import numpy as np
+
+from ..common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from ..ops import eager as _eager
+from ..ops.reduction_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+)
+
+
+def _replicated_payload(tensor):
+    return _eager.replicate(np.asarray(tensor))
+
+
+class _TFHandle:
+    def __init__(self, inner, like, post=None):
+        self._inner = inner
+        self._like = like
+        self._post = post
+
+    def poll(self):
+        return self._inner.poll()
+
+    def wait(self):
+        host = np.asarray(_eager.first(self._inner.wait()))
+        if self._post is not None:
+            host = self._post(host)
+        return tf.convert_to_tensor(host, dtype=self._like.dtype)
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    process_set=None):
+    handle = _eager.allreduce_async(
+        _replicated_payload(tensor), average=average, name=name, op=op,
+        process_set=process_set,
+    )
+    return _TFHandle(handle, tensor)
+
+
+def allreduce(tensor, average=None, name=None, op=None, process_set=None):
+    return allreduce_async(
+        tensor, average=average, name=name, op=op, process_set=process_set
+    ).wait()
+
+
+def allgather_async(tensor, name=None, process_set=None):
+    handle = _eager.allgather_async(
+        _replicated_payload(tensor), name=name, process_set=process_set
+    )
+    return _TFHandle(
+        handle, tensor,
+        post=lambda host: host.reshape((-1,) + host.shape[2:]),
+    )
+
+
+def allgather(tensor, name=None, process_set=None):
+    return allgather_async(tensor, name=name, process_set=process_set).wait()
+
+
+def broadcast(tensor, root_rank, name=None, process_set=None):
+    handle = _eager.broadcast_async(
+        _replicated_payload(tensor), root_rank, name=name,
+        process_set=process_set,
+    )
+    return _TFHandle(handle, tensor).wait()
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign root's values into ``variables`` in place (ref:
+    hvd.broadcast_variables [V])."""
+    for var in variables:
+        var.assign(broadcast(var, root_rank, name=var.name))
+
+
+class DistributedGradientTape:
+    """Wrap a tf.GradientTape so gradient() allreduces the grads (ref:
+    horovod/tensorflow/__init__.py DistributedGradientTape [V])."""
+
+    def __init__(self, tape, op=None, process_set=None):
+        self._tape = tape
+        self._op = op
+        self._process_set = process_set
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    def _reduce_one(self, g):
+        if g is None:
+            return None
+        if isinstance(g, tf.IndexedSlices):
+            raise NotImplementedError(
+                "horovod_tpu.tensorflow does not reduce sparse "
+                "(IndexedSlices) gradients; densify with "
+                "tf.convert_to_tensor(g) first"
+            )
+        return allreduce(g, op=self._op, process_set=self._process_set)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        # Mirror tf.GradientTape: single source in -> single grad out.
+        if isinstance(grads, (list, tuple)):
+            reduced = [self._reduce_one(g) for g in grads]
+            return type(grads)(reduced) if isinstance(
+                grads, tuple) else reduced
+        return self._reduce_one(grads)

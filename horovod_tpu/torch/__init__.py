@@ -3,9 +3,11 @@
 Parity with the reference's largest user-facing module
 (ref: horovod/torch/__init__.py + mpi_ops.py + optimizer.py +
 functions.py [V] — SURVEY.md §2.4): torch users port their scripts by
-changing one import. Tensors are bridged zero-copy-where-possible
-(dlpack/numpy) into the eager collective path, reduced by XLA over the
-mesh, and returned as torch tensors.
+changing one import. Tensors are bridged host-side — each call does a
+``.detach().cpu().numpy()`` copy into the eager collective path, is
+reduced by XLA over the mesh, and copied back into a torch tensor. The
+round-trip is two host copies per call by design: torch (CPU) and XLA
+(TPU) do not share buffers, and honesty beats a fake zero-copy claim.
 
 The async handle protocol (`allreduce_async_` → `synchronize`) is kept:
 handles wrap the eager path's fusion-cycle handles, so Horovod's
@@ -24,17 +26,21 @@ from typing import Optional
 import numpy as np
 
 from ..common.basics import (  # noqa: F401
+    add_process_set,
     cross_rank,
     cross_size,
+    global_process_set,
     init,
     is_initialized,
     local_rank,
     local_size,
     mpi_threads_supported,
     rank,
+    remove_process_set,
     shutdown,
     size,
 )
+from ..common.process_sets import ProcessSet  # noqa: F401
 from ..ops import eager as _eager
 from ..ops.reduction_ops import (  # noqa: F401
     Adasum,
@@ -137,39 +143,74 @@ class _TorchHandle:
         return out
 
 
-def allreduce_async(tensor, average=None, name=None, op=None) -> _TorchHandle:
+def allreduce_async(
+    tensor, average=None, name=None, op=None, process_set=None
+) -> _TorchHandle:
     handle = _eager.allreduce_async(
-        _replicated_payload(tensor), average=average, name=name, op=op
+        _replicated_payload(tensor), average=average, name=name, op=op,
+        process_set=process_set,
     )
     return _TorchHandle(handle, tensor)
 
 
-def allreduce(tensor, average=None, name=None, op=None):
-    return allreduce_async(tensor, average=average, name=name, op=op).wait()
+def allreduce(tensor, average=None, name=None, op=None, process_set=None):
+    return allreduce_async(
+        tensor, average=average, name=name, op=op, process_set=process_set
+    ).wait()
 
 
-def allreduce_async_(tensor, average=None, name=None, op=None) -> _TorchHandle:
+def allreduce_async_(
+    tensor, average=None, name=None, op=None, process_set=None
+) -> _TorchHandle:
     handle = _eager.allreduce_async(
-        _replicated_payload(tensor), average=average, name=name, op=op
+        _replicated_payload(tensor), average=average, name=name, op=op,
+        process_set=process_set,
     )
     return _TorchHandle(handle, tensor, inplace_target=tensor)
 
 
-def allreduce_(tensor, average=None, name=None, op=None):
-    return allreduce_async_(tensor, average=average, name=name, op=op).wait()
+def allreduce_(tensor, average=None, name=None, op=None, process_set=None):
+    return allreduce_async_(
+        tensor, average=average, name=name, op=op, process_set=process_set
+    ).wait()
 
 
-def grouped_allreduce(tensors, average=None, name=None, op=None):
-    handles = [
+class _GroupedHandle:
+    """One handle over a group — hvd.synchronize(handle) on the grouped
+    async result must work like the reference's [V]."""
+
+    def __init__(self, handles):
+        self._handles = handles
+
+    def poll(self) -> bool:
+        return all(h.poll() for h in self._handles)
+
+    def wait(self):
+        return [h.wait() for h in self._handles]
+
+
+def grouped_allreduce_async(
+    tensors, average=None, name=None, op=None, process_set=None
+) -> _GroupedHandle:
+    return _GroupedHandle([
         allreduce_async(t, average=average, op=op,
-                        name=None if name is None else f"{name}.{i}")
+                        name=None if name is None else f"{name}.{i}",
+                        process_set=process_set)
         for i, t in enumerate(tensors)
-    ]
-    return [h.wait() for h in handles]
+    ])
 
 
-def allgather_async(tensor, name=None) -> _TorchHandle:
-    handle = _eager.allgather_async(_replicated_payload(tensor), name=name)
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      process_set=None):
+    return grouped_allreduce_async(
+        tensors, average=average, name=name, op=op, process_set=process_set
+    ).wait()
+
+
+def allgather_async(tensor, name=None, process_set=None) -> _TorchHandle:
+    handle = _eager.allgather_async(
+        _replicated_payload(tensor), name=name, process_set=process_set
+    )
     # The eager result stacks per-rank rows [world, n, ...]; Horovod's
     # torch allgather concatenates along dim 0 [V].
     return _TorchHandle(
@@ -179,39 +220,92 @@ def allgather_async(tensor, name=None) -> _TorchHandle:
     )
 
 
-def allgather(tensor, name=None):
-    return allgather_async(tensor, name=name).wait()
+def allgather(tensor, name=None, process_set=None):
+    return allgather_async(tensor, name=name, process_set=process_set).wait()
 
 
-def broadcast_async(tensor, root_rank, name=None) -> _TorchHandle:
+def broadcast_async(
+    tensor, root_rank, name=None, process_set=None
+) -> _TorchHandle:
     handle = _eager.broadcast_async(
-        _replicated_payload(tensor), root_rank, name=name
+        _replicated_payload(tensor), root_rank, name=name,
+        process_set=process_set,
     )
     return _TorchHandle(handle, tensor)
 
 
-def broadcast(tensor, root_rank, name=None):
-    return broadcast_async(tensor, root_rank, name=name).wait()
+def broadcast(tensor, root_rank, name=None, process_set=None):
+    return broadcast_async(
+        tensor, root_rank, name=name, process_set=process_set
+    ).wait()
 
 
-def broadcast_async_(tensor, root_rank, name=None) -> _TorchHandle:
+def broadcast_async_(
+    tensor, root_rank, name=None, process_set=None
+) -> _TorchHandle:
     handle = _eager.broadcast_async(
-        _replicated_payload(tensor), root_rank, name=name
+        _replicated_payload(tensor), root_rank, name=name,
+        process_set=process_set,
     )
     return _TorchHandle(handle, tensor, inplace_target=tensor)
 
 
-def broadcast_(tensor, root_rank, name=None):
-    return broadcast_async_(tensor, root_rank, name=name).wait()
+def broadcast_(tensor, root_rank, name=None, process_set=None):
+    return broadcast_async_(
+        tensor, root_rank, name=name, process_set=process_set
+    ).wait()
 
 
-def alltoall(tensor, splits=None, name=None):
+def reducescatter_async(
+    tensor, op=None, name=None, process_set=None
+) -> _TorchHandle:
+    """Reduce-scatter: this rank's shard of the world-reduced tensor,
+    split along dim 0 (ref: hvd.reducescatter, upstream v0.27+ [V]).
+    Under the single controller this process is rank 0, so the handle's
+    rank-0 row IS our shard — even and uneven (v-variant) cases both."""
+    handle = _eager.reducescatter_async(
+        _replicated_payload(tensor), op=op, name=name,
+        process_set=process_set,
+    )
+    return _TorchHandle(handle, tensor)
+
+
+def reducescatter(tensor, op=None, name=None, process_set=None):
+    return reducescatter_async(
+        tensor, op=op, name=name, process_set=process_set
+    ).wait()
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
     if splits is not None:
-        raise NotImplementedError(
-            "uneven alltoall splits are not supported by the torch shim; "
-            "use the JAX eager API"
+        # Uneven alltoall-v: this rank's 1-D `splits` says how many dim-0
+        # rows go to each peer; replicated across ranks under the single
+        # controller. Returns (output, received_splits) like the
+        # reference's torch binding [V].
+        if process_set is not None and process_set.process_set_id != 0:
+            raise NotImplementedError(
+                "alltoall with uneven splits does not support non-global "
+                "process sets in the torch shim; use the JAX eager API"
+            )
+        torch = _torch()
+        world = size()
+        host = _to_numpy(tensor)
+        splits_1d = [int(s) for s in np.asarray(_to_numpy(splits)
+                     if torch.is_tensor(splits) else splits).tolist()]
+        if sum(splits_1d) != host.shape[0]:
+            raise ValueError(
+                f"splits sum to {sum(splits_1d)} but tensor dim0 is "
+                f"{host.shape[0]}"
+            )
+        handle = _eager.alltoall_async(
+            [host] * world, splits=[splits_1d] * world, name=name
         )
-    handle = _eager.alltoall_async(_replicated_payload(tensor), name=name)
+        outputs, recv_splits = handle.wait()
+        out = _from_numpy(np.asarray(outputs[0]), tensor)
+        return out, torch.tensor(recv_splits[0], dtype=torch.int32)
+    handle = _eager.alltoall_async(
+        _replicated_payload(tensor), name=name, process_set=process_set
+    )
     return _TorchHandle(handle, tensor).wait()
 
 
@@ -332,10 +426,32 @@ class DistributedOptimizer:
             return None  # local aggregation window: skip comm + step
         self._micro = 0
         handles = []
-        for p in self._grad_tensors():
-            if self._k > 1:
-                p.grad.copy_(self._accum[id(p)])
-                self._accum[id(p)].zero_()
+        if self._k > 1:
+            # Flush the UNION of accumulated params, not just those with
+            # a grad on the boundary microbatch — a param whose final
+            # microstep produced no grad still owes its earlier sums.
+            by_id = {
+                id(p): p
+                for group in self._opt.param_groups
+                for p in group["params"]
+            }
+            reduce_params = []
+            for pid, buf in list(self._accum.items()):
+                p = by_id.get(pid)
+                # Remove the buffer either way: a param that stops
+                # getting grads must not be re-reduced with zeros (and
+                # stepped by stateful optimizers) in later cycles.
+                del self._accum[pid]
+                if p is None:
+                    continue
+                if p.grad is None:
+                    p.grad = buf
+                else:
+                    p.grad.copy_(buf)
+                reduce_params.append(p)
+        else:
+            reduce_params = list(self._grad_tensors())
+        for p in reduce_params:
             name = self._names.get(id(p), f"grad.{id(p)}")
             wire, ctx = self._compression.compress(p.grad)
             handle = allreduce_async_(

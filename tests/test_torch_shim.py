@@ -162,6 +162,128 @@ def test_distributed_optimizer_backward_passes_per_step(hvdt):
         assert torch.allclose(p, rp, atol=1e-6)
 
 
+def test_reducescatter_even(hvdt):
+    """Even case: rank 0 (this controller) gets the first dim-0 shard of
+    the world-summed tensor."""
+    world = hvdt.size()
+    x = torch.arange(4 * world, dtype=torch.float32).reshape(4 * world, 1)
+    out = hvdt.reducescatter(x, op=hvdt.Sum)
+    assert out.shape[0] == 4  # dim0 / world
+    assert torch.allclose(out, (x * world)[: out.shape[0]])
+
+
+def test_reducescatter_uneven(hvdt):
+    """Uneven dim0: rank 0 gets the (largest) first shard — v-variant
+    semantics (earlier ranks get the extra elements)."""
+    world = hvdt.size()
+    n = 4 * world + 1 if world > 1 else 3
+    x = torch.ones(n, 2)
+    out = hvdt.reducescatter(x, op=hvdt.Sum)
+    base, rem = divmod(n, world)
+    assert out.shape[0] == base + (1 if rem else 0)
+    assert torch.allclose(out, torch.full_like(out, float(world)))
+
+
+def test_alltoall_uneven_splits(hvdt):
+    """alltoall with a 1-D splits vector returns (output,
+    received_splits) — the reference's torch v-variant [V]."""
+    world = hvdt.size()
+    splits = [1] * world
+    splits[0] = 2
+    n = sum(splits)
+    x = torch.arange(n * 3, dtype=torch.float32).reshape(n, 3)
+    out, recv = hvdt.alltoall(x, splits=splits)
+    # every rank sends the same (replicated) tensor; rank 0 receives
+    # each rank's first split (2 rows each)
+    assert recv.tolist() == [2] * world
+    assert out.shape == (2 * world, 3)
+    for r in range(world):
+        assert torch.allclose(out[2 * r : 2 * r + 2], x[:2])
+
+
+def test_grouped_allreduce_async_single_handle(hvdt):
+    """hvd.synchronize(grouped_allreduce_async(...)) is the reference's
+    API shape — the grouped handle must be one waitable object."""
+    tensors = [torch.ones(2), torch.full((3,), 2.0)]
+    handle = hvd_torch.grouped_allreduce_async(tensors, op=hvdt.Sum)
+    outs = hvd_torch.synchronize(handle)
+    w = float(hvdt.size())
+    assert torch.allclose(outs[0], torch.full((2,), w))
+    assert torch.allclose(outs[1], torch.full((3,), 2.0 * w))
+
+
+def test_accum_buffer_dropped_for_inactive_param(hvdt):
+    """A param that participates in one aggregation cycle but not the
+    next must not be re-reduced with zeros (stateful optimizers would
+    still move it)."""
+    a = torch.nn.Linear(2, 1, bias=False)
+    b = torch.nn.Linear(2, 1, bias=False)
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.Adam(list(a.parameters()) + list(b.parameters()),
+                         lr=0.1),
+        backward_passes_per_step=2,
+    )
+    x = torch.ones(1, 2)
+    # cycle 1: both params get grads
+    for _ in range(2):
+        opt.zero_grad()
+        (a(x).sum() + b(x).sum()).backward()
+        opt.step()
+    frozen = next(b.parameters()).clone()
+    # cycle 2: only `a` participates
+    for _ in range(2):
+        opt.zero_grad()
+        a(x).sum().backward()
+        opt.step()
+    assert torch.equal(next(b.parameters()), frozen)
+
+
+def test_collectives_accept_process_set(hvdt):
+    """process_set threads through the torch surface (global set in the
+    1-process suite — sub-mesh correctness is covered by the eager
+    tests)."""
+    ps = hvdt.global_process_set()
+    x = torch.ones(4)
+    out = hvdt.allreduce(x, op=hvdt.Sum, process_set=ps)
+    assert torch.allclose(out, torch.full((4,), float(hvdt.size())))
+    g = hvdt.allgather(x, process_set=ps)
+    assert g.shape[0] == 4 * hvdt.size()
+    b = hvdt.broadcast(x, root_rank=0, process_set=ps)
+    assert torch.allclose(b, x)
+
+
+def test_backward_passes_flushes_accum_when_boundary_grad_is_none(hvdt):
+    """A param that accumulated grads in earlier microsteps but has
+    grad None on the boundary microstep must still be reduced and
+    stepped with its accumulated sum (regression: it was silently
+    dropped and its buffer never flushed)."""
+    torch.manual_seed(2)
+    a = torch.nn.Linear(2, 1, bias=False)
+    b = torch.nn.Linear(2, 1, bias=False)
+    ref_a = torch.nn.Linear(2, 1, bias=False)
+    ref_a.load_state_dict(a.state_dict())
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(list(a.parameters()) + list(b.parameters()), lr=0.1),
+        backward_passes_per_step=2,
+    )
+    x = torch.ones(1, 2)
+    # microstep 1: only `a` participates -> only `a` accumulates
+    opt.zero_grad()
+    a(x).sum().backward()
+    opt.step()
+    # microstep 2 (boundary): only `b` participates; `a.grad` is None
+    opt.zero_grad()
+    b(x).sum().backward()
+    opt.step()
+    # `a` must have taken a step using its microstep-1 gradient
+    ref_opt = torch.optim.SGD(ref_a.parameters(), lr=0.1)
+    ref_opt.zero_grad()
+    ref_a(x).sum().backward()
+    ref_opt.step()
+    for p, rp in zip(a.parameters(), ref_a.parameters()):
+        assert torch.allclose(p, rp, atol=1e-6)
+
+
 def test_broadcast_parameters_state_dict(hvdt):
     model = torch.nn.Linear(3, 3)
     hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
