@@ -126,6 +126,7 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
             mesh=_state.mesh,
             threshold_bytes=cfg.fusion_threshold_bytes,
             cycle_time_ms=cfg.cycle_time_ms,
+            cache_capacity=cfg.cache_capacity,
         )
         if cfg.timeline:
             from .timeline import Timeline

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -104,8 +105,17 @@ def _group_key(e: _Entry) -> Tuple:
     )
 
 
+_SUB_MESH_BOUND = 64  # active process sets are few; this is a leak guard
+
+
 class FusionManager:
-    def __init__(self, mesh: Mesh, threshold_bytes: int, cycle_time_ms: float):
+    def __init__(
+        self,
+        mesh: Mesh,
+        threshold_bytes: int,
+        cycle_time_ms: float,
+        cache_capacity: Optional[int] = None,
+    ):
         self.mesh = mesh
         self.threshold_bytes = threshold_bytes
         self.cycle_time_ms = cycle_time_ms
@@ -113,13 +123,25 @@ class FusionManager:
         self.pending: List[_Entry] = []
         self.pending_bytes = 0
         self.cycle_start: Optional[float] = None
-        self._sub_meshes: Dict[Tuple[int, ...], Mesh] = {}
+        self._sub_meshes: "OrderedDict[Tuple[int, ...], Mesh]" = OrderedDict()
         # attached by basics.init:
         self.timeline = None
         self.stall_inspector = None
         self.parameter_manager = None
-        # executor cache — the response-cache analog:
-        self._executors: Dict[Tuple, Callable] = {}
+        # Executor cache — the response-cache analog, with the
+        # reference's HOROVOD_CACHE_CAPACITY semantics enforced (ref:
+        # response_cache.cc [V]): LRU-bounded so a long eager job with
+        # varying shapes cannot leak compiled executables; capacity 0
+        # disables caching entirely.
+        if cache_capacity is None:
+            from ..common.config import Config
+
+            cache_capacity = Config.from_env().cache_capacity
+        self.cache_capacity = max(int(cache_capacity), 0)
+        self._executors: "OrderedDict[Tuple, Callable]" = OrderedDict()
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
         self.cycles = 0
 
     # ------------------------------------------------------------------ queue
@@ -230,18 +252,38 @@ class FusionManager:
         return tuple(e.process_set.ranks)
 
     def _executor(self, key: Tuple, builder: Callable) -> Callable:
+        if self.cache_capacity == 0:
+            self.cache_misses += 1
+            return builder()
         fn = self._executors.get(key)
-        if fn is None:
-            fn = builder()
-            self._executors[key] = fn
+        if fn is not None:
+            self.cache_hits += 1
+            self._executors.move_to_end(key)
+            return fn
+        self.cache_misses += 1
+        fn = builder()
+        self._executors[key] = fn
+        while len(self._executors) > self.cache_capacity:
+            self._executors.popitem(last=False)
+            self.cache_evictions += 1
         return fn
+
+    def cache_stats(self) -> Dict[str, int]:
+        return {
+            "capacity": self.cache_capacity,
+            "size": len(self._executors),
+            "hits": self.cache_hits,
+            "misses": self.cache_misses,
+            "evictions": self.cache_evictions,
+        }
 
     def _sub_mesh(self, ranks: Tuple[int, ...]) -> Mesh:
         """Sub-communicator mesh over a process set's chips
         (ref: per-set MPI/NCCL communicators in process_set.cc [V]).
         Gather-family collectives on a subset run here because XLA's
         axis_index_groups requires equal-sized groups, which a
-        set+singletons partition cannot provide."""
+        set+singletons partition cannot provide. Bounded like the
+        executor cache (a Mesh pins device references)."""
         mesh = self._sub_meshes.get(ranks)
         if mesh is None:
             flat = list(self.mesh.devices.flat)
@@ -249,6 +291,13 @@ class FusionManager:
                 np.asarray([flat[r] for r in ranks]), (WORLD_AXIS,)
             )
             self._sub_meshes[ranks] = mesh
+            # Bounded by a dedicated constant: the live count tracks the
+            # number of active process sets (small), not the response
+            # cache; coupling it to cache_capacity=0 would thrash.
+            while len(self._sub_meshes) > _SUB_MESH_BOUND:
+                self._sub_meshes.popitem(last=False)
+        else:
+            self._sub_meshes.move_to_end(ranks)
         return mesh
 
     def _shard_map(self, fn, mesh=None, out_specs=P(WORLD_AXIS)):
@@ -285,7 +334,8 @@ class FusionManager:
             # their input through unchanged.
             ranks = self._pset_ranks(e0)
             sub = self._sub_mesh(ranks)
-            key = ("adasum_pset", e0.prescale, e0.postscale, ranks)
+            key = ("adasum_pset", e0.prescale, e0.postscale, ranks,
+                   buf.shape, buf.dtype.name)
             fn = self._executor(
                 key,
                 lambda: self._build_allreduce(
@@ -295,8 +345,12 @@ class FusionManager:
             member_out = fn(jnp.take(buf, jnp.asarray(ranks), axis=0))
             out = buf.at[jnp.asarray(ranks)].set(member_out)
         else:
+            # Shape/dtype are part of the key: one executor == one
+            # compiled program, so the LRU bound really bounds compiled
+            # code (the response cache is keyed per tensor too [V]).
             key = (
-                "allreduce", int(e0.op), e0.prescale, e0.postscale, groups, mask,
+                "allreduce", int(e0.op), e0.prescale, e0.postscale, groups,
+                mask, buf.shape, buf.dtype.name,
             )
             fn = self._executor(key, lambda: self._build_allreduce(
                 e0.op, e0.prescale, e0.postscale, groups, mask))
@@ -401,7 +455,8 @@ class FusionManager:
             self.timeline.begin(e.name, e.kind.upper())
         if e.kind == "broadcast":
             groups = self._pset_groups(e)
-            key = ("broadcast", e.root_rank, groups)
+            key = ("broadcast", e.root_rank, groups,
+                   e.payload.shape, e.payload.dtype.name)
             fn = self._executor(
                 key, lambda: self._build_broadcast(e.root_rank, groups)
             )
@@ -419,7 +474,8 @@ class FusionManager:
                 else jnp.take(e.payload, jnp.asarray(ranks), axis=0)
             )
             if e.kind == "allgather":
-                key = ("allgather", ranks)
+                key = ("allgather", ranks,
+                       payload.shape, payload.dtype.name)
                 fn = self._executor(key, lambda: self._build_allgather(mesh))
             elif e.kind == "alltoall":
                 if payload.shape[1] % n_ranks != 0:
@@ -427,10 +483,13 @@ class FusionManager:
                         f"equal-split alltoall needs dim1 divisible by the "
                         f"participating rank count {n_ranks}"
                     )
-                key = ("alltoall", ranks)
+                key = ("alltoall", ranks,
+                       payload.shape, payload.dtype.name)
                 fn = self._executor(key, lambda: self._build_alltoall(mesh))
             else:
-                key = ("reducescatter", int(e.op), e.prescale, e.postscale, ranks)
+                key = ("reducescatter", int(e.op), e.prescale,
+                       e.postscale, ranks,
+                       payload.shape, payload.dtype.name)
                 fn = self._executor(
                     key,
                     lambda: self._build_reducescatter(

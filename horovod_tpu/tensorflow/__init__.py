@@ -144,8 +144,11 @@ class DistributedGradientTape:
             )
         return allreduce(g, op=self._op, process_set=self._process_set)
 
-    def gradient(self, target, sources, output_gradients=None):
-        grads = self._tape.gradient(target, sources, output_gradients)
+    def gradient(self, target, sources, output_gradients=None, **kwargs):
+        # **kwargs forwards tf.GradientTape extras (unconnected_gradients)
+        # so the wrapper stays a drop-in replacement.
+        grads = self._tape.gradient(target, sources, output_gradients,
+                                    **kwargs)
         # Mirror tf.GradientTape: single source in -> single grad out.
         if isinstance(grads, (list, tuple)):
             reduced = [self._reduce_one(g) for g in grads]

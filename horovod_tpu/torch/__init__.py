@@ -292,6 +292,11 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
         host = _to_numpy(tensor)
         splits_1d = [int(s) for s in np.asarray(_to_numpy(splits)
                      if torch.is_tensor(splits) else splits).tolist()]
+        if len(splits_1d) != world:
+            raise ValueError(
+                f"splits has {len(splits_1d)} entries but world size is "
+                f"{world}"
+            )
         if sum(splits_1d) != host.shape[0]:
             raise ValueError(
                 f"splits sum to {sum(splits_1d)} but tensor dim0 is "

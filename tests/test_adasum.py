@@ -6,10 +6,35 @@ identical inputs → identity; orthogonal inputs → sum; parallel inputs →
 average; scale invariance of the mixing coefficients.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
 
-from horovod_tpu.ops.adasum import adasum_pair, _tree_combine
+from horovod_tpu.ops.adasum import (
+    _tree_combine,
+    adasum_allreduce,
+    adasum_pair,
+    adasum_vhdd_host,
+    vhdd_wire_bytes,
+)
+
+
+def _run_distributed(stack, world):
+    """adasum_allreduce under shard_map over `world` devices; returns
+    every rank's output row."""
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:world]), ("world",)
+    )
+    fn = jax.shard_map(
+        lambda x: adasum_allreduce(x[0], axis_name="world")[None],
+        mesh=mesh,
+        in_specs=P("world"),
+        out_specs=P("world"),
+        check_vma=False,
+    )
+    return np.asarray(jax.jit(fn)(jnp.asarray(stack)))
 
 
 def test_identical_inputs_average_to_self():
@@ -66,6 +91,42 @@ def test_tree_combine_odd_count():
     out = _tree_combine(vals)
     assert out.shape == (4,)
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("world", [2, 3, 5, 6, 8])
+def test_vhdd_matches_host_oracle(world):
+    """The distributed VHDD result must equal the host pairwise-tree
+    oracle (adasum_pair_host math) on every rank — pow2 and non-pow2
+    worlds, payload not divisible by the world (exercises padding)."""
+    rng = np.random.default_rng(world)
+    stack = rng.normal(size=(world, 13)).astype(np.float32)
+    out = _run_distributed(stack, world)
+    expect = adasum_vhdd_host(stack.astype(np.float64))
+    for r in range(world):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_vhdd_identical_inputs_identity():
+    """All ranks contributing the same vector must get it back (the
+    n-way generalization of adasum(a,a)=a)."""
+    base = np.linspace(-1.0, 1.0, 16, dtype=np.float32)
+    stack = np.tile(base, (8, 1))
+    out = _run_distributed(stack, 8)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], base, rtol=1e-5, atol=1e-6)
+
+
+def test_vhdd_wire_bytes_is_2p_not_logp():
+    """The ~2P wire claim: per-rank bytes stay bounded (~2P) as the
+    world grows, vs the naive full-tensor XOR loop's log2(n)*P."""
+    P_bytes = 1 << 20
+    for n in (8, 64, 256):
+        naive = (n.bit_length() - 1) * P_bytes  # old: full tensor per stage
+        vhdd = vhdd_wire_bytes(n, P_bytes)
+        assert vhdd < 2 * P_bytes  # both sweeps sum below 2P
+        assert vhdd < naive or n <= 4
+    # non-pow2 adds one P-sized hop each way, still far under gather's n*P
+    assert vhdd_wire_bytes(5, P_bytes) <= 4 * P_bytes
 
 
 def test_bf16_inputs_keep_dtype():

@@ -67,6 +67,54 @@ def test_fusion_batches_multiple_tensors(hvd):
         np.testing.assert_allclose(np.asarray(out[0]), np.full(5, 28.0 * i))
 
 
+def test_cache_capacity_enforced(hvd):
+    """HOROVOD_CACHE_CAPACITY semantics (ref: response_cache.cc [V]):
+    the executor cache stays <= capacity via LRU eviction, an evicted
+    key recompiles as a miss, and hit/miss/eviction counters track it."""
+    fusion = hvd_mod.common.basics.state().fusion
+    fusion.cache_capacity = 2
+    fusion._executors.clear()
+    fusion.cache_hits = fusion.cache_misses = fusion.cache_evictions = 0
+
+    def reduce_of_size(n):
+        x = rank_major(lambda r: np.full((n,), float(r)))
+        return hvd.allreduce(x, op=hvd_mod.Sum)
+
+    reduce_of_size(2)  # miss
+    reduce_of_size(3)  # miss
+    reduce_of_size(2)  # hit (LRU refresh: 3 is now oldest)
+    assert fusion.cache_stats()["size"] == 2
+    assert fusion.cache_hits == 1 and fusion.cache_misses == 2
+
+    reduce_of_size(4)  # miss -> evicts size-3 executor
+    assert fusion.cache_stats()["size"] == 2
+    assert fusion.cache_evictions == 1
+
+    out = reduce_of_size(3)  # miss again: must recompile, still correct
+    assert fusion.cache_misses == 4
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(3, 28.0))
+
+    # capacity 0 disables caching entirely
+    fusion.cache_capacity = 0
+    fusion._executors.clear()
+    reduce_of_size(5)
+    reduce_of_size(5)
+    assert fusion.cache_stats()["size"] == 0
+
+
+def test_cache_capacity_env_plumbed(hvd, monkeypatch):
+    """The env var reaches the FusionManager at init."""
+    import horovod_tpu as hvd2
+
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "7")
+    hvd2.shutdown()
+    hvd2.init()
+    try:
+        assert hvd_mod.common.basics.state().fusion.cache_capacity == 7
+    finally:
+        hvd2.shutdown()
+
+
 def test_fusion_threshold_triggers_flush(hvd):
     fusion = hvd_mod.common.basics.state().fusion
     fusion.threshold_bytes = 64  # tiny: every enqueue flushes
