@@ -1,0 +1,55 @@
+"""Shared bench-harness helpers: AOT compile + XLA FLOP counting + MFU.
+
+One place owns the MFU methodology for every bench (bench.py,
+bench_lm.py): compile the jitted step ONCE ahead of time (the same
+compiled object runs the timed loop — no second trace/compile), read
+the step's FLOPs from XLA cost analysis, and divide measured FLOP/s by
+the chip's peak bf16 FLOP/s.
+"""
+
+import os
+
+# Public peak bf16 TFLOP/s per chip, keyed by the sandbox's generation
+# env var. Override with BENCH_PEAK_TFLOPS.
+PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+
+def peak_tflops(platform: str):
+    """MFU denominator for this chip; None when there isn't a meaningful
+    one (CPU)."""
+    if platform == "cpu":
+        return None
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        return float(os.environ["BENCH_PEAK_TFLOPS"])
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return PEAK_BF16_TFLOPS.get(gen)
+
+
+def aot_compile(step_fn, *args):
+    """AOT-compile a jitted fn once; returns (callable, flops_or_None).
+    Falls back to the jitted fn itself on backends without AOT."""
+    try:
+        compiled = step_fn.lower(*args).compile()
+    except Exception:
+        return step_fn, None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        flops = None
+    return compiled, flops
+
+
+def mfu_fields(flops, iters, dt, platform):
+    """The tflops_per_sec / mfu keys for a bench JSON line (empty dict
+    when FLOPs are unknown)."""
+    if flops is None or dt <= 0:
+        return {}
+    tflops = flops * iters / dt / 1e12
+    out = {"tflops_per_sec": round(tflops, 2)}
+    peak = peak_tflops(platform)
+    if peak:
+        out["mfu"] = round(tflops / peak, 4)
+    return out

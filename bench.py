@@ -38,36 +38,8 @@ import time
 
 P100_FP32_IMG_PER_SEC = 219.0
 
-# Public peak bf16 TFLOP/s per chip, keyed by the sandbox's generation
-# env var. Override with BENCH_PEAK_TFLOPS.
-PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
-
-
-def _peak_tflops(platform: str):
-    if platform == "cpu":
-        return None  # no meaningful MFU denominator on the host
-    if os.environ.get("BENCH_PEAK_TFLOPS"):
-        return float(os.environ["BENCH_PEAK_TFLOPS"])
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    return PEAK_BF16_TFLOPS.get(gen)
-
-
-def _aot_compile(train_step, *args):
-    """AOT-compile the step ONCE and read its XLA FLOP count. Returns
-    (callable, flops) — the same compiled object is used for the timed
-    loop so the bench never pays a second trace/compile."""
-    try:
-        compiled = train_step.lower(*args).compile()
-    except Exception:
-        return train_step, None  # backend without AOT support
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0)) or None
-    except Exception:
-        flops = None
-    return compiled, flops
+from _benchlib import aot_compile as _aot_compile  # noqa: E402
+from _benchlib import mfu_fields as _mfu_fields  # noqa: E402
 
 
 def inner_main():
@@ -152,12 +124,7 @@ def inner_main():
         "platform": platform,
         "batch": batch,
     }
-    peak = _peak_tflops(platform)
-    if flops is not None:
-        tflops = flops * n_iters / dt / 1e12
-        result["tflops_per_sec"] = round(tflops, 2)
-        if peak:
-            result["mfu"] = round(tflops / peak, 4)
+    result.update(_mfu_fields(flops, n_iters, dt, platform))
     print(json.dumps(result))
 
 

@@ -100,26 +100,28 @@ def main():
         rng.integers(0, cfg.vocab_size, size=(world, batch, seq)), jnp.int32
     )
 
+    from _benchlib import aot_compile, mfu_fields
+
+    step, flops = aot_compile(step, params, opt_state, toks, labels)
     params, opt_state, loss = step(params, opt_state, toks, labels)
-    jax.block_until_ready(loss)  # compile + warm
+    jax.block_until_ready(loss)  # warm (already compiled AOT)
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, loss = step(params, opt_state, toks, labels)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
     samples_per_sec = batch * world * iters / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"{model_name}_samples_per_sec",
-                "value": round(samples_per_sec, 2),
-                "unit": "samples/s",
-                "batch": batch,
-                "seq": seq,
-                "world": world,
-            }
-        )
-    )
+    result = {
+        "metric": f"{model_name}_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "batch": batch,
+        "seq": seq,
+        "world": world,
+        "platform": jax.devices()[0].platform,
+    }
+    result.update(mfu_fields(flops, iters, dt, jax.devices()[0].platform))
+    print(json.dumps(result))
 
 
 def dataclasses_replace(cfg, **kw):

@@ -125,12 +125,29 @@ def __getattr__(name):
 __version__ = "0.1.0"
 
 
-def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
-    """Runtime timeline activation (ref: hvd.start_timeline, v0.21+ [V])."""
+def start_timeline(
+    file_path: str, mark_cycles: bool = False, traced: bool = False
+) -> None:
+    """Runtime timeline activation (ref: hvd.start_timeline, v0.21+ [V]).
+
+    ``traced=False`` (default): the eager per-collective lifecycle
+    timeline (QUEUE/ALLREDUCE/... phases). ``traced=True``: an XLA
+    profiler session for jit/shard_map runs — stop_timeline() writes a
+    chrome://tracing JSON of every compiled op (collectives included,
+    with device timestamps) and keeps the TensorBoard profile dir next
+    to it. Use :func:`timeline_step` to mark step boundaries."""
     from .common import basics as _basics
-    from .common.timeline import Timeline
 
     st = _basics._require_init()
+    if traced:
+        from .common.traced_timeline import TracedTimeline
+
+        if st.traced_timeline is None:
+            st.traced_timeline = TracedTimeline(file_path)
+        st.traced_timeline.start()
+        return
+    from .common.timeline import Timeline
+
     if st.timeline is None:
         st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
         st.fusion.timeline = st.timeline
@@ -141,5 +158,20 @@ def stop_timeline() -> None:
     from .common import basics as _basics
 
     st = _basics._require_init()
+    if st.traced_timeline is not None:
+        st.traced_timeline.stop()
     if st.timeline is not None:
         st.timeline.stop()
+
+
+def timeline_step(name: str = "step", step_num=None):
+    """Context manager marking one traced training step in the profiler
+    timeline (the NVTX-range analog, nvtx_op_range.h [V]). No-op when no
+    traced timeline is active."""
+    from .common import basics as _basics
+    from .common.traced_timeline import TracedTimeline
+
+    st = _basics._require_init()
+    if st.traced_timeline is None:
+        st.traced_timeline = TracedTimeline("horovod_timeline.json")
+    return st.traced_timeline.step(name, step_num)

@@ -53,6 +53,7 @@ class _GlobalState:
         self.process_set_table: Optional[ProcessSetTable] = None
         self.fusion = None  # FusionManager, attached by ops.eager on init
         self.timeline = None  # Timeline, attached when HOROVOD_TIMELINE set
+        self.traced_timeline = None  # TracedTimeline (jax.profiler wrapper)
         self.parameter_manager = None  # autotune, attached when enabled
         self.stall_inspector = None
 
@@ -158,6 +159,8 @@ def shutdown() -> None:
             _state.fusion.flush()
         if _state.timeline is not None:
             _state.timeline.close()
+        if _state.traced_timeline is not None:
+            _state.traced_timeline.close()
         _state.initialized = False
         _state.config = None
         _state.topology = None
@@ -165,6 +168,7 @@ def shutdown() -> None:
         _state.process_set_table = None
         _state.fusion = None
         _state.timeline = None
+        _state.traced_timeline = None
         _state.parameter_manager = None
         _state.stall_inspector = None
 

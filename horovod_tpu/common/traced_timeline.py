@@ -1,0 +1,117 @@
+"""Timeline for the TRACED (jit/shard_map) path — the fast path.
+
+The reference's timeline instruments its background loop per collective
+(ref: horovod/common/timeline.cc hooks + NVTX ranges,
+nvtx_op_range.h [V] — SURVEY.md §5.1). Under jit there is no per-op
+dispatch to hook: XLA runs the whole step as one executable. The honest
+TPU equivalent is the XLA profiler itself — it records every compiled
+op (collectives included) with real device timestamps. This module
+wraps ``jax.profiler`` so the traced path gets the same user surface as
+the eager timeline:
+
+    hvd.start_timeline("/tmp/tl.json", traced=True)
+    for i in range(steps):
+        with hvd.timeline_step("train", i):   # NVTX-range analog
+            params, loss = step(params, batch)
+    hvd.stop_timeline()                        # writes chrome-trace JSON
+
+``stop()`` post-processes the profiler's ``*.trace.json.gz`` into one
+plain chrome://tracing JSON at the requested path; the raw TensorBoard
+logdir (XPlane protos) is kept next to it for users who want the full
+TB profile UI.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+from contextlib import contextmanager
+from typing import Optional
+
+
+class TracedTimeline:
+    """jax.profiler session shaped like the eager Timeline."""
+
+    def __init__(self, path: str):
+        self._path = os.path.abspath(path)
+        # TB logdir kept alongside the requested JSON for the full UI.
+        self._logdir = self._path + ".profile"
+        self._active = False
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    @property
+    def logdir(self) -> str:
+        return self._logdir
+
+    def start(self) -> None:
+        if self._active:
+            return
+        import jax
+
+        shutil.rmtree(self._logdir, ignore_errors=True)
+        os.makedirs(self._logdir, exist_ok=True)
+        jax.profiler.start_trace(self._logdir)
+        self._active = True
+
+    @contextmanager
+    def step(self, name: str = "step", step_num: Optional[int] = None):
+        """Mark one training step in the trace (the NVTX-range analog,
+        nvtx_op_range.h [V]). No-op overhead when the timeline is off."""
+        if not self._active:
+            yield
+            return
+        import jax
+
+        kwargs = {} if step_num is None else {"step_num": step_num}
+        with jax.profiler.StepTraceAnnotation(name, **kwargs):
+            yield
+
+    @contextmanager
+    def annotate(self, name: str):
+        """Free-form range annotation inside a step."""
+        if not self._active:
+            yield
+            return
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        self._export_chrome_trace()
+
+    # close() aliases stop() so GlobalState teardown treats eager and
+    # traced timelines uniformly.
+    def close(self) -> None:
+        self.stop()
+
+    def _export_chrome_trace(self) -> None:
+        """Merge the profiler's per-host trace.json.gz into one plain
+        chrome://tracing JSON at the requested path."""
+        events = []
+        pattern = os.path.join(
+            self._logdir, "plugins", "profile", "*", "*.trace.json.gz"
+        )
+        for fname in sorted(glob.glob(pattern)):
+            try:
+                with gzip.open(fname, "rt") as f:
+                    data = json.load(f)
+                events.extend(data.get("traceEvents", []))
+            except (OSError, json.JSONDecodeError):
+                continue
+        tmp = f"{self._path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        os.replace(tmp, self._path)

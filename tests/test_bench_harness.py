@@ -1,0 +1,77 @@
+"""The bench harnesses are round artifacts — their sweep/efficiency
+logic must hold without running a full benchmark (VERDICT r1 #3: a
+world-size sweep with scaling_efficiency output, pod-ready)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from bench_allreduce import (  # noqa: E402
+    ring_factor,
+    scaling_efficiency,
+    sweep_worlds,
+)
+
+
+def test_sweep_worlds_small_box():
+    assert sweep_worlds(1) == [1]
+    assert sweep_worlds(8) == [1, 2, 4, 8]
+    assert sweep_worlds(6) == [1, 2, 4, 6]
+
+
+def test_sweep_worlds_pod_starts_at_8():
+    """On a pod slice the sweep is the north star's 8→256 window."""
+    assert sweep_worlds(256) == [8, 16, 32, 64, 128, 256]
+    assert sweep_worlds(64) == [8, 16, 32, 64]
+
+
+def test_ring_factor():
+    assert ring_factor(1) == 1.0
+    assert ring_factor(2) == 1.0
+    assert abs(ring_factor(8) - 1.75) < 1e-12
+    assert abs(ring_factor(256) - 2 * 255 / 256) < 1e-12
+
+
+def test_scaling_efficiency_vs_base():
+    base, eff = scaling_efficiency({1: 10.0, 2: 9.0, 4: 8.0})
+    assert base == 1
+    assert eff[1] == 1.0
+    assert abs(eff[2] - 0.9) < 1e-12
+    assert abs(eff[4] - 0.8) < 1e-12
+
+
+def test_scaling_efficiency_empty():
+    assert scaling_efficiency({}) == (None, {})
+
+
+@pytest.mark.slow
+def test_bench_allreduce_cpu_sim_end_to_end():
+    """The sweep runs on the simulated mesh and emits both per-point
+    busbw lines and the scaling summary, parseable."""
+    from _hermetic import hermetic_cpu_env
+
+    env = hermetic_cpu_env(n_devices=8)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_SIZES"] = "4096,65536"
+    env["BENCH_ITERS"] = "3"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench_allreduce.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    busbw = [ln for ln in lines if ln["metric"] == "allreduce_busbw"]
+    scaling = [ln for ln in lines if ln["metric"] == "allreduce_scaling"]
+    assert {ln["world"] for ln in busbw} == {1, 2, 4, 8}
+    assert {ln["world"] for ln in scaling} == {1, 2, 4, 8}
+    assert all(ln["base_world"] == 1 for ln in scaling)
+    base_line = next(ln for ln in scaling if ln["world"] == 1)
+    assert base_line["value"] == 1.0

@@ -1,0 +1,52 @@
+"""Packaging contract (ref: setup.py + CMake, SURVEY.md §2.7): the
+project is pip-installable with working hvdrun/horovodrun entry points.
+The full `pip install -e . && hvdrun -np 2` transcript is exercised in
+CI-style by the runner tests; here we pin the declared contract."""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+try:
+    import tomllib
+except ImportError:  # py<3.11
+    tomllib = None
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def pyproject():
+    if tomllib is None:
+        pytest.skip("tomllib unavailable")
+    with open(os.path.join(_REPO, "pyproject.toml"), "rb") as f:
+        return tomllib.load(f)
+
+
+def test_console_scripts_declared(pyproject):
+    scripts = pyproject["project"]["scripts"]
+    assert scripts["hvdrun"] == "horovod_tpu.runner.launch:main"
+    assert scripts["horovodrun"] == "horovod_tpu.runner.launch:main"
+
+
+def test_entry_point_importable(pyproject):
+    """The declared entry point must resolve to a callable."""
+    from horovod_tpu.runner.launch import main
+
+    assert callable(main)
+
+
+def test_native_so_in_package_data(pyproject):
+    data = pyproject["tool"]["setuptools"]["package-data"]
+    assert "*.so" in data["horovod_tpu._native"]
+
+
+def test_version_coherent(pyproject):
+    import horovod_tpu
+
+    # Major.minor tracked in both places; pyproject is the release
+    # authority, module version must not be ahead of it.
+    assert pyproject["project"]["version"].split(".")[0] == (
+        horovod_tpu.__version__.split(".")[0]
+    )

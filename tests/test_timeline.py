@@ -1,0 +1,75 @@
+"""Timeline coverage for BOTH execution modes (SURVEY.md §5.1): the
+eager per-collective lifecycle writer, and the traced-path profiler
+wrapper (the round-1 gap: the fast path had zero observability)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_mod
+
+
+def _chrome_events(path):
+    with open(path) as f:
+        data = json.load(f)
+    assert "traceEvents" in data
+    return data["traceEvents"]
+
+
+def test_eager_timeline_phases(hvd, tmp_path):
+    """start_timeline → collective → stop: file is chrome-trace JSON
+    with QUEUE and ALLREDUCE phases (the verify-skill probe)."""
+    path = str(tmp_path / "tl.json")
+    hvd_mod.start_timeline(path)
+    x = np.stack([np.full((4,), float(r), np.float32) for r in range(8)])
+    hvd.allreduce(x, op=hvd_mod.Sum, name="tltensor")
+    hvd_mod.stop_timeline()
+    hvd_mod.common.basics.state().timeline.close()
+    events = _chrome_events(path)
+    names = {e.get("name") for e in events}
+    assert "QUEUE" in names
+    assert "ALLREDUCE" in names
+
+
+def test_traced_timeline_produces_chrome_trace(hvd, tmp_path):
+    """A jitted shard_map training loop under the traced timeline must
+    yield a chrome://tracing file containing the step annotation and
+    compiled-op events — per-collective visibility on the fast path."""
+    path = str(tmp_path / "traced.json")
+    mesh = hvd_mod.mesh()
+
+    @jax.jit
+    @jax.shard_map(
+        mesh=mesh, in_specs=P(hvd_mod.WORLD_AXIS), out_specs=P(),
+        check_vma=False,
+    )
+    def step(x):
+        return jax.lax.psum(x[0] @ x[0], hvd_mod.WORLD_AXIS)
+
+    x = jnp.ones((8, 16, 16), jnp.float32)
+    jax.block_until_ready(step(x))  # compile outside the profile window
+
+    hvd_mod.start_timeline(path, traced=True)
+    for i in range(2):
+        with hvd_mod.timeline_step("train", i):
+            out = step(x)
+            jax.block_until_ready(out)
+    hvd_mod.stop_timeline()
+
+    events = _chrome_events(path)
+    assert len(events) > 0
+    names = [str(e.get("name", "")) for e in events]
+    assert any("train" in n for n in names)  # step annotation
+    # XLA op-level events exist (the per-collective visibility claim)
+    assert any("psum" in n or "all-reduce" in n or "jit" in n
+               for n in names)
+
+
+def test_timeline_step_noop_without_session(hvd):
+    """timeline_step must be a cheap no-op when no traced timeline is
+    running (training loops keep the annotation unconditionally)."""
+    with hvd_mod.timeline_step("train", 0):
+        pass
