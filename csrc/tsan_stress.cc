@@ -1,0 +1,138 @@
+// ThreadSanitizer stress driver for the concurrent pieces of the native
+// runtime: the KV rendezvous server (per-connection threads behind a
+// mutex) and the timeline ring buffer (producer threads vs drain).
+//
+// Role parity: the reference gates its C++ core behind sanitizer CI
+// lanes (SURVEY.md §5.2); this binary IS that lane for csrc/ — built
+// with -fsanitize=thread by ci.sh and run to completion. Any data race
+// TSAN finds is a non-zero exit.
+//
+// Build (see ci.sh):
+//   g++ -std=c++17 -g -O1 -fsanitize=thread -pthread \
+//       timeline.cc kvstore.cc sha256.cc tsan_stress.cc -o tsan_stress
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* hvd_kv_start(int port, const uint8_t* secret, long secret_len,
+                   int* out_port);
+int hvd_kv_port(void* h);
+void hvd_kv_stop(void* h);
+void hvd_kv_put(void* h, const char* scope, const char* key,
+                const uint8_t* val, long len);
+long hvd_kv_get(void* h, const char* scope, const char* key, uint8_t* buf,
+                long cap);
+long hvd_kv_keys(void* h, const char* scope, uint8_t* buf, long cap);
+void hvd_kv_drop_scope(void* h, const char* scope);
+
+void* hvd_tl_create();
+void hvd_tl_destroy(void* h);
+void hvd_tl_emit(void* h, const char* json);
+long hvd_tl_count(void* h);
+long hvd_tl_drain_size(void* h);
+long hvd_tl_drain(void* h, char* dst, long cap);
+}
+
+namespace {
+
+constexpr int kThreads = 8;
+constexpr int kOpsPerThread = 200;
+
+void kv_worker(void* server, int tid, std::atomic<int>* errors) {
+  char key[64];
+  char scope[32];
+  uint8_t buf[256];
+  for (int i = 0; i < kOpsPerThread; ++i) {
+    std::snprintf(scope, sizeof(scope), "scope%d", i % 3);
+    std::snprintf(key, sizeof(key), "t%d.k%d", tid, i);
+    std::string val = "value-" + std::to_string(tid * 1000 + i);
+    hvd_kv_put(server, scope, key,
+               reinterpret_cast<const uint8_t*>(val.data()),
+               static_cast<long>(val.size()));
+    long n = hvd_kv_get(server, scope, key, buf, sizeof(buf));
+    if (n != static_cast<long>(val.size()) ||
+        std::memcmp(buf, val.data(), val.size()) != 0) {
+      errors->fetch_add(1);
+    }
+    if (i % 17 == 0) {
+      hvd_kv_keys(server, scope, buf, sizeof(buf));
+    }
+    if (i % 61 == 60) {
+      hvd_kv_drop_scope(server, "scope2");
+    }
+  }
+}
+
+void tl_producer(void* tl, int tid) {
+  char ev[128];
+  for (int i = 0; i < kOpsPerThread; ++i) {
+    std::snprintf(ev, sizeof(ev),
+                  "{\"name\":\"op%d.%d\",\"ph\":\"X\",\"ts\":%d}", tid, i, i);
+    hvd_tl_emit(tl, ev);
+  }
+}
+
+void tl_drainer(void* tl, std::atomic<bool>* stop) {
+  std::vector<char> buf(1 << 16);
+  while (!stop->load()) {
+    long need = hvd_tl_drain_size(tl);
+    if (need > 0 && need <= static_cast<long>(buf.size())) {
+      hvd_tl_drain(tl, buf.data(), static_cast<long>(buf.size()));
+    }
+    std::this_thread::yield();
+  }
+  hvd_tl_drain(tl, buf.data(), static_cast<long>(buf.size()));
+}
+
+}  // namespace
+
+int main() {
+  std::atomic<int> errors{0};
+
+  // --- KV server: concurrent put/get/keys/drop through the same mutex
+  // the socket handler threads use.
+  int port = 0;
+  const uint8_t secret[] = "tsan-secret";
+  void* server = hvd_kv_start(0, secret, sizeof(secret) - 1, &port);
+  if (server == nullptr) {
+    std::fprintf(stderr, "kv server failed to start\n");
+    return 2;
+  }
+  {
+    std::vector<std::thread> ts;
+    ts.reserve(kThreads);
+    for (int t = 0; t < kThreads; ++t) {
+      ts.emplace_back(kv_worker, server, t, &errors);
+    }
+    for (auto& t : ts) t.join();
+  }
+  hvd_kv_stop(server);
+
+  // --- Timeline ring buffer: producers racing a drainer.
+  void* tl = hvd_tl_create();
+  {
+    std::atomic<bool> stop{false};
+    std::thread drainer(tl_drainer, tl, &stop);
+    std::vector<std::thread> ts;
+    for (int t = 0; t < kThreads; ++t) {
+      ts.emplace_back(tl_producer, tl, t);
+    }
+    for (auto& t : ts) t.join();
+    stop.store(true);
+    drainer.join();
+  }
+  hvd_tl_destroy(tl);
+
+  if (errors.load() != 0) {
+    std::fprintf(stderr, "value mismatches: %d\n", errors.load());
+    return 1;
+  }
+  std::puts("tsan_stress: ok");
+  return 0;
+}

@@ -109,6 +109,13 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
         if _state.initialized:
             return
         cfg = config_mod.Config.from_env()
+        # Logging first so every subsystem below starts up observable
+        # (ref: logging.cc — level/timestamp read once at init [V]).
+        from . import logging as hvd_logging
+
+        log = hvd_logging.configure(
+            level=cfg.log_level, timestamp=cfg.log_timestamp
+        )
         _maybe_init_jax_distributed(cfg)
         topology = topo_mod.discover(cfg)
         _state.config = cfg
@@ -148,6 +155,16 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
             _state.parameter_manager = ParameterManager.from_config(cfg)
             _state.fusion.parameter_manager = _state.parameter_manager
         _state.initialized = True
+        log.info(
+            "initialized: world=%d local=%d platform=%s fusion=%dB "
+            "cycle=%.1fms cache=%d",
+            topology.size,
+            topology.local_size,
+            getattr(topology.devices[0], "platform", "?"),
+            cfg.fusion_threshold_bytes,
+            cfg.cycle_time_ms,
+            cfg.cache_capacity,
+        )
 
 
 def shutdown() -> None:

@@ -1,0 +1,97 @@
+"""The logging subsystem: HOROVOD_LOG_LEVEL / HOROVOD_LOG_TIMESTAMP.
+
+TPU-native stand-in for the reference's logging.cc/.h (ref:
+horovod/common/logging.cc — LOG(level) macros gated by
+HOROVOD_LOG_LEVEL with an optional timestamp prefix controlled by
+HOROVOD_LOG_TIMESTAMP [V], SURVEY.md §2.1). One module owns the
+``horovod_tpu`` logger hierarchy; every subsystem (runner, elastic
+driver, rendezvous, fusion cycles) gets its child logger here so the
+env contract configures them all at once.
+
+Level names match the reference's: trace, debug, info, warning, error,
+fatal (trace maps to a level below DEBUG; fatal to CRITICAL).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+TRACE = 5  # below logging.DEBUG, like the reference's TRACE [V]
+logging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+    "off": logging.CRITICAL + 10,
+}
+
+_ROOT = "horovod_tpu"
+_configured = False
+
+
+def parse_level(name: Optional[str]) -> int:
+    """HOROVOD_LOG_LEVEL value → numeric level; unknown names behave
+    like the reference (fall back to warning)."""
+    if not name:
+        return logging.WARNING
+    return _LEVELS.get(str(name).strip().lower(), logging.WARNING)
+
+
+def configure(
+    level: Optional[str] = None,
+    timestamp: Optional[bool] = None,
+    stream=None,
+    force: bool = False,
+) -> logging.Logger:
+    """Configure the ``horovod_tpu`` logger from the env contract.
+
+    Arguments override HOROVOD_LOG_LEVEL / HOROVOD_LOG_TIMESTAMP; called
+    with defaults it reads the environment (so init() wires the whole
+    tree with zero ceremony). Idempotent unless ``force``.
+    """
+    global _configured
+    root = logging.getLogger(_ROOT)
+    if _configured and not force:
+        return root
+    if level is None:
+        level = os.environ.get("HOROVOD_LOG_LEVEL", "warning")
+    if timestamp is None:
+        raw = os.environ.get("HOROVOD_LOG_TIMESTAMP", "1")
+        timestamp = str(raw).lower() not in ("0", "false", "no", "")
+    fmt = (
+        "[%(asctime)s] [%(levelname)s] %(name)s: %(message)s"
+        if timestamp
+        else "[%(levelname)s] %(name)s: %(message)s"
+    )
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(logging.Formatter(fmt))
+    # Replace any prior horovod handler so force-reconfig doesn't stack.
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.addHandler(handler)
+    root.setLevel(parse_level(level))
+    root.propagate = False
+    _configured = True
+    return root
+
+
+def get_logger(name: Optional[str] = None) -> logging.Logger:
+    """Child logger under the horovod_tpu hierarchy (e.g.
+    get_logger('fusion') → 'horovod_tpu.fusion'). Lazily configures the
+    tree from the environment on first use."""
+    configure()
+    if not name:
+        return logging.getLogger(_ROOT)
+    return logging.getLogger(f"{_ROOT}.{name}")
+
+
+def trace(logger: logging.Logger, msg: str, *args) -> None:
+    """LOG(TRACE) spelling (logging has no .trace method)."""
+    logger.log(TRACE, msg, *args)

@@ -1,22 +1,33 @@
-"""Stall detection for eager collectives.
+"""Stall detection: cycle-latency watchdog + cross-process heartbeats.
 
 TPU-native rebuild of horovod/common/stall_inspector.cc/.h [V]
-(SURVEY.md §2.1): the reference warns when some ranks have submitted a tensor
-and others haven't for >60s. Under a single controller, cross-rank submission
-skew cannot happen — the equivalent failure mode is a handle that is enqueued
-but never synchronized/flushed (a leak or a deadlocked consumer), so that is
-what we track: entries pending in the fusion queue past the warning age.
+(SURVEY.md §2.1). The reference warns when some ranks have submitted a
+tensor and others haven't for >60s. Under a single controller that
+exact skew cannot happen, so this inspector watches the two signals
+that CAN:
+
+1. **Cycle-latency watchdog** (intra-process): an entry enqueued but
+   never synchronized/flushed past the warning age — a leaked handle
+   or a deadlocked consumer. This is the signal `check()` always has.
+2. **Heartbeat staleness** (cross-process): in multi-process jobs
+   (runner/elastic), worker processes PUT `heartbeat/<rank>` into the
+   rendezvous KV on a timer (`runner.service.heartbeat` /
+   `read_heartbeats`); the driver feeds those timestamps in via
+   :meth:`record_heartbeat`, and `check()` warns when a rank goes
+   silent past the warning age — the true analog of the reference's
+   "some ranks are absent" report, rebuilt on the rendezvous channel
+   the TPU runner actually has.
 """
 
 from __future__ import annotations
 
-import logging
 import time
 from typing import Dict
 
 from .basics import HorovodInternalError
+from .logging import get_logger
 
-logger = logging.getLogger("horovod_tpu")
+logger = get_logger("stall")
 
 
 class StallInspector:
@@ -27,6 +38,8 @@ class StallInspector:
         self.shutdown_seconds = shutdown_seconds
         self._pending: Dict[str, float] = {}
         self._warned: set = set()
+        self._heartbeats: Dict[int, float] = {}
+        self._hb_warned: set = set()
 
     def record_enqueue(self, name: str) -> None:
         self._pending.setdefault(name, time.monotonic())
@@ -34,6 +47,29 @@ class StallInspector:
     def record_complete(self, name: str) -> None:
         self._pending.pop(name, None)
         self._warned.discard(name)
+
+    def record_heartbeat(self, rank: int, ts: float = None) -> None:
+        """Feed a worker heartbeat (driver side of signal #2). ``ts`` is
+        a unix epoch stamp (``time.time()`` — the domain
+        ``runner.rendezvous.put_heartbeat`` writes, chosen because the
+        stamps cross machines); defaults to now."""
+        self._heartbeats[int(rank)] = (
+            time.time() if ts is None else float(ts)
+        )
+        self._hb_warned.discard(int(rank))
+
+    def stale_ranks(self, now: float = None):
+        """Ranks whose last heartbeat is older than warning_seconds.
+        ``now`` is unix epoch (heartbeats cross machines; monotonic
+        clocks don't)."""
+        if not self._heartbeats:
+            return []
+        now = time.time() if now is None else now
+        return sorted(
+            r
+            for r, t in self._heartbeats.items()
+            if now - t > self.warning_seconds
+        )
 
     def check(self) -> None:
         """Called once per fusion cycle (the reference checks once per
@@ -57,4 +93,26 @@ class StallInspector:
                     "for %.0fs: %s. A consumer may be stalled.",
                     age,
                     name,
+                )
+        wall = time.time()  # heartbeats live in the epoch domain
+        for rank in self.stale_ranks(wall):
+            age = wall - self._heartbeats[rank]
+            # Shutdown escalation re-checks EVERY cycle (like the
+            # pending-entry path) — it must fire even after the
+            # one-time warning already did.
+            if (
+                self.shutdown_seconds > 0
+                and age > self.shutdown_seconds
+            ):
+                raise HorovodInternalError(
+                    f"rank {rank} heartbeat silent for {age:.0f}s "
+                    f"(> HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)"
+                )
+            if rank not in self._hb_warned:
+                self._hb_warned.add(rank)
+                logger.warning(
+                    "Rank %d has not heartbeat for %.0fs; the worker "
+                    "may be stalled or partitioned.",
+                    rank,
+                    age,
                 )

@@ -29,6 +29,9 @@ from typing import Dict, List, Optional, Sequence
 from ..runner.hosts import HostInfo, SlotInfo, assign_slots
 from ..runner.launch import _free_port, _is_local, worker_envs
 from ..runner.rendezvous import RendezvousServer
+from ..common.logging import get_logger
+
+_log = get_logger("elastic")
 from ..runner.secret import make_secret_key
 from ..runner.service import BasicClient
 from .discovery import HostDiscovery, HostManager
@@ -91,6 +94,19 @@ class ElasticDriver:
         self._assignment: Optional[SlotAssignment] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        # Cross-process stall signal (stall_inspector.cc's "ranks
+        # absent" report [V]): workers stamp heartbeat/<rank> into the
+        # rendezvous KV (elastic/worker.py); the run loop relays those
+        # stamps into this inspector, which warns/escalates on silence.
+        from ..common.config import Config
+        from ..common.stall_inspector import StallInspector
+
+        _cfg = Config.from_env()
+        self.stall_inspector = StallInspector(
+            warning_seconds=_cfg.stall_warning_seconds,
+            shutdown_seconds=_cfg.stall_shutdown_seconds,
+        )
+        self._last_hb_poll = 0.0
 
     # ---------------------------------------------------------- planning
 
@@ -123,6 +139,12 @@ class ElasticDriver:
         return self._server
 
     def _launch_gang(self, assignment: SlotAssignment) -> None:
+        _log.info(
+            "launching gang epoch=%d world=%d hosts=%s",
+            assignment.epoch,
+            assignment.world_size,
+            ",".join(sorted(set(assignment.hostnames))),
+        )
         server = self._rendezvous()
         placement = self._placement
         if placement == "auto":
@@ -238,12 +260,14 @@ class ElasticDriver:
         last_refresh = 0.0
         while not self._stop.is_set():
             now = time.monotonic()
+            self._poll_heartbeats(now)
             if now - last_refresh >= self._interval:
                 changed = self.host_manager.refresh()
                 last_refresh = now
                 if changed and self._assignment is not None:
                     # Membership changed under a live gang: tell workers
                     # (they commit + exit for re-launch), then restart.
+                    _log.info("host membership changed; restarting gang")
                     self._notify_workers("hosts_updated")
                     self._terminate_gang()
                     if not self._reset(reason="membership change"):
@@ -267,13 +291,32 @@ class ElasticDriver:
         self._terminate_gang()
         return 0
 
+    def _poll_heartbeats(self, now: float) -> None:
+        """Relay worker heartbeats from the rendezvous KV into the
+        stall inspector (rate-limited to once per discovery interval)."""
+        if self._server is None or now - self._last_hb_poll < self._interval:
+            return
+        self._last_hb_poll = now
+        from ..runner.rendezvous import read_heartbeats
+
+        try:
+            for rank, ts in read_heartbeats(self._server.store).items():
+                self.stall_inspector.record_heartbeat(rank, ts)
+            self.stall_inspector.check()
+        except Exception:
+            _log.debug("heartbeat poll failed", exc_info=True)
+
     def _reset(self, reason: str) -> bool:
         """Bump epoch and clear the assignment so the loop relaunches.
         False when the reset budget is exhausted (HOROVOD_ELASTIC
         reset_limit parity [V])."""
         self._resets += 1
         if self._reset_limit is not None and self._resets > self._reset_limit:
+            _log.error(
+                "reset limit %s exhausted (%s)", self._reset_limit, reason
+            )
             return False
+        _log.info("gang reset #%d: %s", self._resets, reason)
         self._epoch += 1
         with self._lock:
             self._assignment = None

@@ -70,11 +70,38 @@ class WorkerNotificationManager:
             hostname = os.environ.get("HOROVOD_HOSTNAME", "")
             if hostname in ("localhost", "127.0.0.1", "", socket.gethostname()):
                 hostname = "127.0.0.1"
-            from ..runner.rendezvous import RendezvousClient
+            from ..runner.rendezvous import (
+                RendezvousClient,
+                put_heartbeat,
+            )
 
-            RendezvousClient(
+            client = RendezvousClient(
                 cfg.rendezvous_addr, cfg.rendezvous_port, secret_key=secret
-            ).put(f"workers.{epoch}", process_id, f"{hostname}:{port}".encode())
+            )
+            client.put(
+                f"workers.{epoch}", process_id,
+                f"{hostname}:{port}".encode(),
+            )
+
+            # Liveness for the driver's stall inspector: stamp
+            # heartbeat/<rank> every 10s until shutdown (the rebuilt
+            # cross-process stall signal — stall_inspector.cc [V]).
+            rank = int(os.environ.get("HOROVOD_RANK", process_id))
+            stop = threading.Event()
+            self._hb_stop = stop
+
+            def _beat():
+                while not stop.is_set():
+                    try:
+                        put_heartbeat(client, rank)
+                    except Exception:
+                        pass  # rendezvous going away = job ending
+                    stop.wait(10.0)
+
+            t = threading.Thread(
+                target=_beat, name="hvd-heartbeat", daemon=True
+            )
+            t.start()
 
     def _on_hosts_updated(self, request: dict) -> dict:
         self._updated.set()
@@ -90,6 +117,9 @@ class WorkerNotificationManager:
 
     def shutdown(self) -> None:
         with self._lock:
+            if getattr(self, "_hb_stop", None) is not None:
+                self._hb_stop.set()
+                self._hb_stop = None
             if self._service is not None:
                 self._service.stop()
                 self._service = None

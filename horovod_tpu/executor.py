@@ -39,7 +39,7 @@ class Executor:
         placement: str = "auto",
         env: Optional[dict] = None,
         start_timeout: float = 600.0,
-        coordinator_port: int = 9874,
+        coordinator_port: Optional[int] = None,
         work_dir: Optional[str] = None,
     ) -> None:
         """Multi-host jobs (``hosts=``) require ``work_dir`` on a shared
@@ -152,9 +152,22 @@ class Executor:
             # must dial a routable driver name and a fixed, known
             # coordinator port (it binds on worker 0, unprobeable here).
             addr = "127.0.0.1" if all_local else socket.getfqdn()
-            coordinator_port = (
-                _launch._free_port() if all_local else self.coordinator_port
-            )
+            if all_local:
+                coordinator_port = _launch._free_port()
+            elif self.coordinator_port is not None:
+                coordinator_port = self.coordinator_port
+            else:
+                # Multi-host: the port binds on worker 0, unprobeable
+                # from here, so freeness can't be verified — but a
+                # per-job pseudo-random default keeps two concurrent
+                # multi-host jobs from colliding on one fixed number
+                # (the reference's runner derives per-job ports the
+                # same way [V]).
+                import random
+
+                coordinator_port = 9874 + random.SystemRandom().randrange(
+                    8000
+                )
             blocks = _launch.worker_envs(
                 slots,
                 placement,

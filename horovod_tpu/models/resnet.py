@@ -46,10 +46,12 @@ class SyncBatchNorm(nn.Module):
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
-            xf = x.astype(jnp.float32)
             axes = tuple(range(x.ndim - 1))
-            mean = jnp.mean(xf, axis=axes)
-            mean2 = jnp.mean(xf * xf, axis=axes)
+            # Stats accumulate in fp32 (dtype= on the reduction — XLA
+            # fuses the widening into the reduce, no fp32 copy of the
+            # activation is materialized).
+            mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+            mean2 = jnp.mean(jnp.square(x), axis=axes, dtype=jnp.float32)
             # Skip the collective while flax builds shapes: init() runs
             # outside shard_map, where the mesh axis is unbound.
             if self.axis_name is not None and not self.is_initializing():
@@ -61,8 +63,14 @@ class SyncBatchNorm(nn.Module):
                 ra_mean.value = m * ra_mean.value + (1 - m) * mean
                 ra_var.value = m * ra_var.value + (1 - m) * var
 
-        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
-        y = y * scale + bias
+        # Normalize as ONE fused multiply-add in the compute dtype:
+        # y = x·inv + (bias − mean·inv), with inv/mean folded in fp32
+        # first ([C]-sized, free). The previous elementwise-fp32
+        # formulation doubled the HBM bytes of every BN — measured
+        # +2.8% step throughput on the v5e chip from this change alone
+        # (docs/perf.md round-3 profile).
+        inv = lax.rsqrt(var + self.epsilon) * scale
+        y = x * inv.astype(x.dtype) + (bias - mean * inv).astype(x.dtype)
         return y.astype(self.dtype)
 
 
@@ -97,19 +105,48 @@ class Bottleneck(nn.Module):
 
 
 class ResNet(nn.Module):
+    """``stem``: 'conv7' is the textbook 7×7/s2 stem; 'space_to_depth'
+    is the MXU-shaped reformulation (the standard MLPerf ResNet trick on
+    TPU): the image is space-to-depth'd 2× to [H/2, W/2, 4C] and the
+    stem becomes a 4×4/s1 conv — same receptive field and output grid,
+    but the contraction dim grows 3→12 channels, which packs the MXU's
+    128-lane tiles far better than a 3-channel conv ever can."""
+
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     width: int = 64
     axis_name: Optional[str] = None
     dtype: Any = jnp.bfloat16
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         x = x.astype(self.dtype)
-        x = nn.Conv(
-            self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-            use_bias=False, dtype=self.dtype,
-        )(x)
+        if self.stem == "space_to_depth":
+            n, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"space_to_depth stem needs even spatial dims, got "
+                    f"{(h, w)}"
+                )
+            x = (
+                x.reshape(n, h // 2, 2, w // 2, 2, c)
+                .transpose(0, 1, 3, 2, 4, 5)
+                .reshape(n, h // 2, w // 2, 4 * c)
+            )
+            # 4×4/s1 with pad (2,1): exactly the 7×7/s2 output grid
+            # (offsets {-2,-1,0,1} in s2d coordinates).
+            x = nn.Conv(
+                self.width, (4, 4), strides=(1, 1),
+                padding=[(2, 1), (2, 1)], use_bias=False, dtype=self.dtype,
+            )(x)
+        elif self.stem == "conv7":
+            x = nn.Conv(
+                self.width, (7, 7), strides=(2, 2),
+                padding=[(3, 3), (3, 3)], use_bias=False, dtype=self.dtype,
+            )(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = SyncBatchNorm(axis_name=self.axis_name, dtype=self.dtype)(
             x, use_running_average=not train
         )

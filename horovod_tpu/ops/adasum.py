@@ -84,14 +84,44 @@ def adasum_allreduce(
 ):
     """Adasum-allreduce across a mesh axis, for use inside jit/shard_map
     (ref: the Adasum path selected by hvd.DistributedOptimizer(op=hvd.Adasum)
-    [V]). The full-axis path is VHDD (see module docstring); explicit
-    sub-axis groups (process sets) keep the gather+tree formulation —
-    sets are small by construction and correctness dominates there."""
-    if groups is None and process_set is not None:
-        groups = process_set.axis_index_groups(lax.axis_size(axis_name))
-    if groups is not None:
-        gathered = lax.all_gather(tensor, axis_name, axis_index_groups=groups)
-        return _tree_combine([gathered[i] for i in range(gathered.shape[0])])
+    [V]). The full-axis path is VHDD (see module docstring); process sets
+    keep the gather+tree formulation via a masked full-axis gather (XLA's
+    TPU lowering rejects unequal replica groups, so a set+singletons
+    partition can't be expressed with axis_index_groups) — sets are small
+    by construction and correctness dominates there. Non-members return
+    their input unchanged. ``groups`` (a single explicit rank list) is
+    accepted for backward compatibility and treated like a process set."""
+    ranks = None
+    if process_set is not None and process_set.process_set_id != 0:
+        ranks = list(process_set.ranks)
+    elif groups is not None:
+        member_groups = [g for g in groups if len(g) > 1]
+        if len(member_groups) > 1:
+            raise ValueError(
+                "adasum_allreduce supports one member group per call"
+            )
+        if member_groups:
+            ranks = list(member_groups[0])
+    if ranks is not None and len(ranks) == int(lax.axis_size(axis_name)):
+        ranks = None
+    if ranks is not None:
+        import numpy as np
+
+        world = int(lax.axis_size(axis_name))
+        mask = np.zeros(world, dtype=bool)
+        pos = np.zeros(world, dtype=np.int32)
+        for i, rk in enumerate(ranks):
+            mask[rk] = True
+            pos[rk] = i
+        idx = lax.axis_index(axis_name)
+        member = jnp.asarray(mask)[idx]
+        p = jnp.asarray(pos)[idx]
+        contrib = jnp.where(member, tensor, jnp.zeros_like(tensor))
+        buf = jnp.zeros((len(ranks),) + tuple(tensor.shape), tensor.dtype)
+        buf = lax.dynamic_update_slice_in_dim(buf, contrib[None], p, axis=0)
+        gathered = lax.psum(buf, axis_name)
+        out = _tree_combine([gathered[i] for i in range(len(ranks))])
+        return jnp.where(member, out, tensor)
     n = lax.axis_size(axis_name)
     if n == 1:
         return tensor
@@ -156,8 +186,24 @@ def _vhdd_allreduce(tensor, axis_name: str, n: int):
         blocks = [
             list(range(g * 2 * d, (g + 1) * 2 * d))
             for g in range(p // (2 * d))
-        ] + [[i] for i in range(p, n)]  # excess ranks isolated
-        tot = lax.psum(scal, axis_name, axis_index_groups=blocks)
+        ]
+        if excess:
+            # Unequal replica groups (2d-blocks + excess singletons) don't
+            # lower on TPU; the scalars are tiny, so all_gather them and
+            # select each rank's block sum with a static 0/1 matrix row.
+            import numpy as np
+
+            bmat = np.zeros((n, n), np.float32)
+            for g in blocks:
+                for a in g:
+                    for b in g:
+                        bmat[a, b] = 1.0
+            for i in range(p, n):
+                bmat[i, i] = 1.0
+            gathered = lax.all_gather(scal, axis_name)  # [n, 3]
+            tot = jnp.asarray(bmat)[r] @ gathered
+        else:
+            tot = lax.psum(scal, axis_name, axis_index_groups=blocks)
         dot_t, asq, bsq = tot[0], tot[1], tot[2]
         acoef = 1.0 - jnp.where(asq > 0, dot_t / (2.0 * asq), 0.0)
         bcoef = 1.0 - jnp.where(bsq > 0, dot_t / (2.0 * bsq), 0.0)

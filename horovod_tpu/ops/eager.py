@@ -143,10 +143,18 @@ def grouped_allreduce_async(
             mask=None if mask is None else np.asarray(mask, dtype=bool),
         )
         entries.append(entry)
-    # Suppress threshold-triggered flushes between group members: enqueue
-    # all, then let normal cycle logic run.
-    for entry in entries:
-        handles.append(fusion.enqueue(entry))
+    # Atomic enqueue: begin_group() defers threshold/cycle flushes until
+    # every member is queued, and the shared group_id keeps the members
+    # in one fused collective through batch splitting (group_table.cc
+    # semantics [V]; members of mixed dtype still share the cycle but
+    # fuse per-dtype, like the reference's typed fusion buffers).
+    gid = fusion.begin_group()
+    try:
+        for entry in entries:
+            entry.group_id = gid
+            handles.append(fusion.enqueue(entry))
+    finally:
+        fusion.end_group()
     return handles
 
 

@@ -43,7 +43,10 @@ from jax import shard_map
 
 from ..common.topology import WORLD_AXIS, rank_sharding
 from ..common.process_sets import ProcessSet
+from ..common.logging import get_logger
 from .reduction_ops import Average, Sum, Adasum, Min, Max, Product, ReduceOp
+
+_log = get_logger("fusion")
 
 
 @dataclasses.dataclass
@@ -62,6 +65,7 @@ class _Entry:
     extra: Any = None  # op-specific (e.g. uneven-length info)
     handle: "Handle" = None
     enqueue_t: float = 0.0
+    group_id: Optional[int] = None  # grouped_allreduce membership
 
 
 class Handle:
@@ -143,8 +147,28 @@ class FusionManager:
         self.cache_misses = 0
         self.cache_evictions = 0
         self.cycles = 0
+        self._group_depth = 0
+        self._next_group_id = 0
 
     # ------------------------------------------------------------------ queue
+
+    def begin_group(self) -> int:
+        """Start an atomic enqueue group (ref: group_table.cc — a group
+        is fused and reduced as one unit [V]): threshold/cycle flush
+        triggers are deferred until the matching end_group(), so a group
+        larger than the fusion threshold cannot be split mid-group."""
+        self._group_depth += 1
+        gid = self._next_group_id
+        self._next_group_id += 1
+        return gid
+
+    def end_group(self) -> None:
+        self._group_depth = max(self._group_depth - 1, 0)
+        if self._group_depth == 0 and (
+            self.pending_bytes >= self.threshold_bytes
+            or self._cycle_expired()
+        ):
+            self.flush()
 
     def enqueue(self, entry: _Entry) -> Handle:
         entry.enqueue_t = time.monotonic()
@@ -157,7 +181,7 @@ class FusionManager:
             self.cycle_start = entry.enqueue_t
         self.pending.append(entry)
         self.pending_bytes += int(entry.payload.nbytes)
-        if (
+        if self._group_depth == 0 and (
             self.pending_bytes >= self.threshold_bytes
             or self._cycle_expired()
         ):
@@ -214,6 +238,19 @@ class FusionManager:
                 self.timeline.end(e.name, "QUEUE")
             if self.stall_inspector is not None:
                 self.stall_inspector.record_complete(e.name)
+        if _log.isEnabledFor(10):  # DEBUG — cycle + cache stats
+            _log.debug(
+                "cycle %d: %d entries, %dB, %.2fms; cache "
+                "hits=%d misses=%d evictions=%d size=%d",
+                self.cycles,
+                len(entries),
+                flushed_bytes,
+                (time.monotonic() - t0) * 1e3,
+                self.cache_hits,
+                self.cache_misses,
+                self.cache_evictions,
+                len(self._executors),
+            )
         if self.parameter_manager is not None:
             self.parameter_manager.record(
                 bytes_=flushed_bytes, seconds=time.monotonic() - t0
@@ -225,26 +262,44 @@ class FusionManager:
     def _batches_by_threshold(self, group: List[_Entry]):
         """Split a fusable group into batches of <= threshold bytes,
         mirroring the fusion buffer's capacity (fusion_buffer_manager.cc
-        [V]). A single over-threshold entry still goes alone."""
-        batch, batch_bytes = [], 0
+        [V]). A single over-threshold entry still goes alone, and a
+        grouped_allreduce group is one indivisible unit — its members
+        always share one fused collective (group_table.cc [V])."""
+        units: List[List[_Entry]] = []
         for e in group:
-            nbytes = int(e.payload.nbytes)
+            if (
+                e.group_id is not None
+                and units
+                and units[-1][0].group_id == e.group_id
+            ):
+                units[-1].append(e)
+            else:
+                units.append([e])
+        batch, batch_bytes = [], 0
+        for unit in units:
+            nbytes = sum(int(e.payload.nbytes) for e in unit)
             if batch and batch_bytes + nbytes > self.threshold_bytes:
                 yield batch
                 batch, batch_bytes = [], 0
-            batch.append(e)
+            batch.extend(unit)
             batch_bytes += nbytes
         if batch:
             yield batch
 
     # ------------------------------------------------------------- executors
 
-    def _pset_groups(self, e: _Entry):
+    def _pset_mask(self, e: _Entry):
+        """Static [world] membership tuple for a proper-subset process
+        set, else None. Masked full-axis collectives replace
+        axis_index_groups here: XLA's TPU lowering requires equal-sized
+        replica groups, which a set+singletons partition can never be
+        (ref: per-set communicators in process_set.cc [V])."""
         if e.process_set is None or e.process_set.process_set_id == 0:
             return None
-        return tuple(
-            tuple(g) for g in e.process_set.axis_index_groups(self.world)
-        )
+        if e.process_set.size == self.world:
+            return None
+        members = set(e.process_set.ranks)
+        return tuple(r in members for r in range(self.world))
 
     def _pset_ranks(self, e: _Entry) -> Optional[Tuple[int, ...]]:
         if e.process_set is None or e.process_set.process_set_id == 0:
@@ -326,34 +381,43 @@ class FusionManager:
                     self.timeline.end(e.name, "MEMCPY_IN_FUSION_BUFFER")
                 self.timeline.begin(e.name, "ALLREDUCE")
 
-        groups = self._pset_groups(e0)
+        pset_mask = self._pset_mask(e0)
         mask = None if e0.mask is None else tuple(bool(b) for b in e0.mask)
-        if e0.op == Adasum and groups is not None:
-            # Adasum over a process set runs on the set's sub-mesh (its
-            # all-gather stage needs equal-sized groups); non-members pass
-            # their input through unchanged.
+        if e0.op == Adasum and pset_mask is not None:
+            # Adasum over a process set runs on the set's sub-mesh;
+            # non-members pass their input through unchanged. A join
+            # mask composes by zeroing the joined members' rows first
+            # (zero is Adasum's identity).
             ranks = self._pset_ranks(e0)
             sub = self._sub_mesh(ranks)
             key = ("adasum_pset", e0.prescale, e0.postscale, ranks,
-                   buf.shape, buf.dtype.name)
+                   mask, buf.shape, buf.dtype.name)
+            member_buf = jnp.take(buf, jnp.asarray(ranks), axis=0)
+            if mask is not None:
+                keep = jnp.asarray(
+                    [bool(mask[r]) for r in ranks], dtype=bool
+                )[:, None]
+                member_buf = jnp.where(
+                    keep, member_buf, jnp.zeros_like(member_buf)
+                )
             fn = self._executor(
                 key,
                 lambda: self._build_allreduce(
                     Adasum, e0.prescale, e0.postscale, None, None, mesh=sub
                 ),
             )
-            member_out = fn(jnp.take(buf, jnp.asarray(ranks), axis=0))
+            member_out = fn(member_buf)
             out = buf.at[jnp.asarray(ranks)].set(member_out)
         else:
             # Shape/dtype are part of the key: one executor == one
             # compiled program, so the LRU bound really bounds compiled
             # code (the response cache is keyed per tensor too [V]).
             key = (
-                "allreduce", int(e0.op), e0.prescale, e0.postscale, groups,
-                mask, buf.shape, buf.dtype.name,
+                "allreduce", int(e0.op), e0.prescale, e0.postscale,
+                pset_mask, mask, buf.shape, buf.dtype.name,
             )
             fn = self._executor(key, lambda: self._build_allreduce(
-                e0.op, e0.prescale, e0.postscale, groups, mask))
+                e0.op, e0.prescale, e0.postscale, pset_mask, mask))
             out = fn(buf)
         # Scatter results back out of the fusion buffer.
         offset = 0
@@ -364,12 +428,23 @@ class FusionManager:
                 self.timeline.end(e.name, "ALLREDUCE")
             e.handle._fulfill(piece)
 
-    def _build_allreduce(self, op, prescale, postscale, groups, mask, mesh=None):
+    def _build_allreduce(
+        self, op, prescale, postscale, pset_mask, mask, mesh=None
+    ):
         world = self.world if mesh is None else int(mesh.devices.size)
         op = ReduceOp(op)
         mask_arr = (
             None if mask is None else np.asarray(mask, dtype=bool)
         )
+        pset_arr = (
+            None if pset_mask is None else np.asarray(pset_mask, dtype=bool)
+        )
+        # Effective participation = joined AND in the process set; the
+        # two masks share one identity-masked full-axis collective.
+        if mask_arr is not None and pset_arr is not None:
+            active_arr = mask_arr & pset_arr
+        else:
+            active_arr = mask_arr if mask_arr is not None else pset_arr
 
         # HOROVOD_HIERARCHICAL_ALLREDUCE (ref: nccl_operations.cc [V]):
         # decompose the world psum into an intra-host stage + a
@@ -381,19 +456,16 @@ class FusionManager:
 
         cfg = _basics.get_config()
         local = _basics.topology().local_size if _basics.is_initialized() else 1
-        if (
-            cfg.hierarchical_allreduce
-            and groups is None
-            and mask_arr is None
-        ):
+        if cfg.hierarchical_allreduce and active_arr is None:
             hier_stages = hierarchical_stage_groups(world, local)
 
         def per_shard(x):  # x: [1, N] — this rank's slice of the buffer
             idx = lax.axis_index(WORLD_AXIS)
+            raw = x
             if prescale != 1.0:
                 x = x * jnp.asarray(prescale, x.dtype)
-            if mask_arr is not None:
-                active = jnp.asarray(mask_arr)[idx]
+            if active_arr is not None:
+                active = jnp.asarray(active_arr)[idx]
                 contrib = jnp.where(active, x, jnp.zeros_like(x))
             else:
                 active = jnp.asarray(True)
@@ -409,43 +481,51 @@ class FusionManager:
                 if op == Average:
                     out = out / jnp.asarray(world, out.dtype)
             elif op in (Average, Sum):
-                out = lax.psum(contrib, WORLD_AXIS, axis_index_groups=groups)
+                out = lax.psum(contrib, WORLD_AXIS)
                 if op == Average:
-                    count = lax.psum(
-                        active.astype(x.dtype), WORLD_AXIS, axis_index_groups=groups
-                    )
+                    count = lax.psum(active.astype(x.dtype), WORLD_AXIS)
                     out = out / jnp.maximum(count, 1)
             elif op == Min:
                 big = jnp.full_like(x, _max_value(x.dtype))
-                contrib = jnp.where(active, x, big) if mask_arr is not None else x
-                out = lax.pmin(contrib, WORLD_AXIS, axis_index_groups=groups)
+                contrib = (
+                    jnp.where(active, x, big)
+                    if active_arr is not None
+                    else x
+                )
+                out = lax.pmin(contrib, WORLD_AXIS)
             elif op == Max:
                 small = jnp.full_like(x, _min_value(x.dtype))
-                contrib = jnp.where(active, x, small) if mask_arr is not None else x
-                out = lax.pmax(contrib, WORLD_AXIS, axis_index_groups=groups)
+                contrib = (
+                    jnp.where(active, x, small)
+                    if active_arr is not None
+                    else x
+                )
+                out = lax.pmax(contrib, WORLD_AXIS)
             elif op == Product:
                 contrib = (
                     jnp.where(active, x, jnp.ones_like(x))
-                    if mask_arr is not None
+                    if active_arr is not None
                     else x
                 )
-                gathered = lax.all_gather(
-                    contrib, WORLD_AXIS, axis_index_groups=groups
-                )
+                gathered = lax.all_gather(contrib, WORLD_AXIS)
                 out = jnp.prod(gathered, axis=0)
             elif op == Adasum:
                 from .adasum import adasum_allreduce
 
-                out = adasum_allreduce(x, axis_name=WORLD_AXIS, groups=groups)
+                # Zero is Adasum's identity (a zero vector has no
+                # projection to remove and adds nothing), so the same
+                # contribution masking covers joined ranks here too.
+                out = adasum_allreduce(contrib, axis_name=WORLD_AXIS)
             else:
                 raise ValueError(f"unsupported op {op}")
             if postscale != 1.0:
                 out = out * jnp.asarray(postscale, out.dtype)
-            # Ranks fully outside the process set keep their input
-            # (reference: non-members don't participate at all).
-            if groups is not None:
-                in_singleton = _singleton_mask(groups, world)
-                out = jnp.where(jnp.asarray(in_singleton)[idx], x, out)
+            # Ranks outside the process set keep their input untouched
+            # (reference: non-members don't participate at all). Joined
+            # ranks (join mask) DO take the result — that's the point
+            # of join().
+            if pset_arr is not None:
+                out = jnp.where(jnp.asarray(pset_arr)[idx], out, raw)
             return out
 
         return jax.jit(self._shard_map(per_shard, mesh=mesh))
@@ -454,11 +534,11 @@ class FusionManager:
         if self.timeline is not None:
             self.timeline.begin(e.name, e.kind.upper())
         if e.kind == "broadcast":
-            groups = self._pset_groups(e)
-            key = ("broadcast", e.root_rank, groups,
+            pset_mask = self._pset_mask(e)
+            key = ("broadcast", e.root_rank, pset_mask,
                    e.payload.shape, e.payload.dtype.name)
             fn = self._executor(
-                key, lambda: self._build_broadcast(e.root_rank, groups)
+                key, lambda: self._build_broadcast(e.root_rank, pset_mask)
             )
             out = fn(e.payload)
         elif e.kind in ("allgather", "alltoall", "reducescatter"):
@@ -517,16 +597,19 @@ class FusionManager:
             self.timeline.end(e.name, e.kind.upper())
         e.handle._fulfill(out)
 
-    def _build_broadcast(self, root_rank, groups):
+    def _build_broadcast(self, root_rank, pset_mask):
+        pset_arr = (
+            None if pset_mask is None else np.asarray(pset_mask, dtype=bool)
+        )
+
         def per_shard(x):
             idx = lax.axis_index(WORLD_AXIS)
             contrib = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
-            out = lax.psum(contrib, WORLD_AXIS, axis_index_groups=groups)
+            out = lax.psum(contrib, WORLD_AXIS)
             # Non-members of the process set keep their input unchanged
             # (reference: they don't participate at all).
-            if groups is not None:
-                in_singleton = _singleton_mask(groups, self.world)
-                out = jnp.where(jnp.asarray(in_singleton)[idx], x, out)
+            if pset_arr is not None:
+                out = jnp.where(jnp.asarray(pset_arr)[idx], out, x)
             return out
 
         return jax.jit(self._shard_map(per_shard))
@@ -577,14 +660,6 @@ def hierarchical_stage_groups(world: int, local: int):
     intra = [list(range(h * local, (h + 1) * local)) for h in range(hosts)]
     inter = [[i + h * local for h in range(hosts)] for i in range(local)]
     return intra, inter
-
-
-def _singleton_mask(groups, world: int) -> np.ndarray:
-    m = np.zeros(world, dtype=bool)
-    for g in groups:
-        if len(g) == 1:
-            m[g[0]] = True
-    return m
 
 
 def _max_value(dtype):

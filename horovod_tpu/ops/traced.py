@@ -8,16 +8,24 @@ horovod/common/ops/nccl_operations.cc [V], SURVEY.md §3.2) does dynamically.
 There is deliberately no fusion buffer here: XLA's combiner pass is the
 fusion buffer.
 
-Process-set restriction maps to ``axis_index_groups``
-(ref: per-set communicators in horovod/common/process_set.cc [V]).
+Process-set restriction (ref: per-set communicators in
+horovod/common/process_set.cc [V]) is implemented with *masked full-axis
+collectives* and static ``ppermute`` routes, NOT ``axis_index_groups``:
+XLA's TPU lowering requires every replica group to have the same size,
+and a set-plus-singletons partition can never satisfy that. Masking has
+no such constraint, lowers on every backend, and costs one full-axis
+collective (ICI-cheap) instead of a sub-group one. Ranks outside the
+set contribute the reduction identity and get their own input back —
+the closest SPMD analog of "non-members don't call the op".
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..common.topology import WORLD_AXIS
@@ -25,13 +33,49 @@ from ..common.process_sets import ProcessSet
 from .reduction_ops import Average, Sum, Adasum, Min, Max, Product, resolve_op
 
 
-def _groups(process_set: Optional[ProcessSet], axis_name):
+class _SetInfo(NamedTuple):
+    """Static per-world lookup tables for a proper-subset process set."""
+
+    mask: np.ndarray  # [world] bool — rank is a member
+    pos: np.ndarray  # [world] int32 — rank's index within the set (0 outside)
+    size: int
+    ranks: Tuple[int, ...]
+
+
+def _set_info(
+    process_set: Optional[ProcessSet], axis_name
+) -> Optional[_SetInfo]:
+    """None for the global set (or a set covering the whole axis)."""
     if process_set is None or process_set.process_set_id == 0:
-        return None, None
-    world = None
-    # World size along the axis is static at trace time.
-    world = lax.axis_size(axis_name)
-    return process_set.axis_index_groups(world), process_set.size
+        return None
+    world = int(lax.axis_size(axis_name))
+    if process_set.size == world:
+        return None
+    mask = np.zeros(world, dtype=bool)
+    pos = np.zeros(world, dtype=np.int32)
+    for i, r in enumerate(process_set.ranks):
+        mask[r] = True
+        pos[r] = i
+    return _SetInfo(mask, pos, process_set.size, tuple(process_set.ranks))
+
+
+def _member(info: _SetInfo, axis_name):
+    idx = lax.axis_index(axis_name)
+    return jnp.asarray(info.mask)[idx], jnp.asarray(info.pos)[idx]
+
+
+def _masked_gather(tensor, info: _SetInfo, axis_name, member, pos):
+    """All-gather over the set's members only: each member drops its
+    tensor into its set-slot of a [k·d, ...] buffer, a full-axis psum
+    assembles them (outsiders contribute zeros). Every rank — member or
+    not — ends up holding the set's gather."""
+    d = tensor.shape[0]
+    contrib = jnp.where(member, tensor, jnp.zeros_like(tensor))
+    buf = jnp.zeros(
+        (info.size * d,) + tuple(tensor.shape[1:]), tensor.dtype
+    )
+    buf = lax.dynamic_update_slice_in_dim(buf, contrib, pos * d, axis=0)
+    return lax.psum(buf, axis_name)
 
 
 def rank(axis_name: str = WORLD_AXIS):
@@ -60,45 +104,93 @@ def allreduce(
     applied before/after the reduction, fused into the XLA program (the
     reference needs a dedicated ScaleBuffer CUDA kernel; XLA fuses the
     multiply for free, SURVEY.md §2.2 GPU context row).
+
+    With a process set, members reduce among themselves (masked
+    full-axis collective — see module docstring) and non-members return
+    their input unchanged.
     """
     op = resolve_op(op, average)
-    groups, set_size = _groups(process_set, axis_name)
-    n = set_size if set_size is not None else lax.axis_size(axis_name)
+    info = _set_info(process_set, axis_name)
+    n = info.size if info is not None else lax.axis_size(axis_name)
+    raw = tensor
 
     if op == Adasum:
         from .adasum import adasum_allreduce
 
-        if groups is not None:
-            raise NotImplementedError(
-                "traced Adasum over a process set needs equal-sized XLA "
-                "replica groups; use the eager API (hvd.allreduce with "
-                "op=Adasum), which dispatches on the set's sub-mesh"
-            )
         if prescale_factor != 1.0:
             tensor = tensor * jnp.asarray(prescale_factor, tensor.dtype)
-        out = adasum_allreduce(tensor, axis_name=axis_name)
+        if info is not None:
+            member, pos = _member(info, axis_name)
+            stacked = _masked_gather(
+                tensor[None], info, axis_name, member, pos
+            )
+            from .adasum import _tree_combine
+
+            out = _tree_combine([stacked[i] for i in range(info.size)])
+        else:
+            out = adasum_allreduce(tensor, axis_name=axis_name)
         if postscale_factor != 1.0:
             out = out * jnp.asarray(postscale_factor, out.dtype)
+        if info is not None:
+            out = jnp.where(member, out, raw)
         return out
 
     if prescale_factor != 1.0:
         tensor = tensor * jnp.asarray(prescale_factor, dtype=tensor.dtype)
+    member = None
+    if info is not None:
+        member, _ = _member(info, axis_name)
     if op in (Average, Sum):
-        out = lax.psum(tensor, axis_name, axis_index_groups=groups)
+        contrib = (
+            tensor
+            if member is None
+            else jnp.where(member, tensor, jnp.zeros_like(tensor))
+        )
+        out = lax.psum(contrib, axis_name)
         if op == Average:
             out = out / jnp.asarray(n, dtype=out.dtype)
     elif op == Min:
-        out = lax.pmin(tensor, axis_name, axis_index_groups=groups)
+        contrib = (
+            tensor
+            if member is None
+            else jnp.where(
+                member, tensor, jnp.full_like(tensor, _identity(tensor, Min))
+            )
+        )
+        out = lax.pmin(contrib, axis_name)
     elif op == Max:
-        out = lax.pmax(tensor, axis_name, axis_index_groups=groups)
+        contrib = (
+            tensor
+            if member is None
+            else jnp.where(
+                member, tensor, jnp.full_like(tensor, _identity(tensor, Max))
+            )
+        )
+        out = lax.pmax(contrib, axis_name)
     elif op == Product:
-        gathered = lax.all_gather(tensor, axis_name, axis_index_groups=groups)
+        contrib = (
+            tensor
+            if member is None
+            else jnp.where(member, tensor, jnp.ones_like(tensor))
+        )
+        gathered = lax.all_gather(contrib, axis_name)
         out = jnp.prod(gathered, axis=0)
     else:
         raise ValueError(f"unsupported reduce op {op}")
     if postscale_factor != 1.0:
         out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    if member is not None:
+        out = jnp.where(member, out, raw)
     return out
+
+
+def _identity(tensor, op):
+    """Reduction identity for masking non-members out of pmin/pmax."""
+    if jnp.issubdtype(tensor.dtype, jnp.floating):
+        fin = jnp.finfo(tensor.dtype)
+        return fin.max if op == Min else fin.min
+    iin = jnp.iinfo(tensor.dtype)
+    return iin.max if op == Min else iin.min
 
 
 def grouped_allreduce(
@@ -115,8 +207,8 @@ def grouped_allreduce(
     reduced atomically in one fused collective — is expressed by a single
     psum over the tuple; XLA emits one fused all-reduce."""
     op = resolve_op(op, average)
-    groups, set_size = _groups(process_set, axis_name)
-    n = set_size if set_size is not None else lax.axis_size(axis_name)
+    info = _set_info(process_set, axis_name)
+    n = info.size if info is not None else lax.axis_size(axis_name)
     if op == Adasum:
         return [
             allreduce(
@@ -129,21 +221,43 @@ def grouped_allreduce(
             )
             for t in tensors
         ]
+    raws = list(tensors)
     if prescale_factor != 1.0:
         tensors = [t * jnp.asarray(prescale_factor, t.dtype) for t in tensors]
+    member = None
+    if info is not None:
+        member, _ = _member(info, axis_name)
     if op in (Average, Sum):
-        outs = lax.psum(tuple(tensors), axis_name, axis_index_groups=groups)
+        contribs = tuple(
+            t if member is None else jnp.where(member, t, jnp.zeros_like(t))
+            for t in tensors
+        )
+        outs = lax.psum(contribs, axis_name)
         if op == Average:
             outs = tuple(o / jnp.asarray(n, o.dtype) for o in outs)
     elif op == Min:
-        outs = lax.pmin(tuple(tensors), axis_name, axis_index_groups=groups)
+        contribs = tuple(
+            t
+            if member is None
+            else jnp.where(member, t, jnp.full_like(t, _identity(t, Min)))
+            for t in tensors
+        )
+        outs = lax.pmin(contribs, axis_name)
     elif op == Max:
-        outs = lax.pmax(tuple(tensors), axis_name, axis_index_groups=groups)
+        contribs = tuple(
+            t
+            if member is None
+            else jnp.where(member, t, jnp.full_like(t, _identity(t, Max)))
+            for t in tensors
+        )
+        outs = lax.pmax(contribs, axis_name)
     else:
         raise ValueError(f"unsupported grouped reduce op {op}")
     outs = list(outs)
     if postscale_factor != 1.0:
         outs = [o * jnp.asarray(postscale_factor, o.dtype) for o in outs]
+    if member is not None:
+        outs = [jnp.where(member, o, r) for o, r in zip(outs, raws)]
     return outs
 
 
@@ -154,14 +268,16 @@ def allgather(
 ):
     """Concatenate each rank's tensor along axis 0 (ref: hvd.allgather /
     MPI_Allgatherv path [V]). Traced mode requires equal shapes (static
-    shapes under jit); the eager path supports uneven dim0 via padding."""
-    if process_set is not None and process_set.process_set_id != 0:
-        raise NotImplementedError(
-            "traced allgather over a process set needs equal-sized XLA "
-            "replica groups; use the eager hvd.allgather, which dispatches "
-            "on the set's sub-mesh"
-        )
-    return lax.all_gather(tensor, axis_name, axis=0, tiled=True)
+    shapes under jit); the eager path supports uneven dim0 via padding.
+
+    With a process set, the result is the concatenation of the members'
+    tensors in set order — every rank (members and outsiders alike)
+    receives it; outsiders contribute nothing."""
+    info = _set_info(process_set, axis_name)
+    if info is None:
+        return lax.all_gather(tensor, axis_name, axis=0, tiled=True)
+    member, pos = _member(info, axis_name)
+    return _masked_gather(tensor, info, axis_name, member, pos)
 
 
 def broadcast(
@@ -172,12 +288,16 @@ def broadcast(
 ):
     """Every rank receives root_rank's value (ref: hvd.broadcast /
     NCCLBroadcast [V]). Implemented as a masked psum — XLA lowers this to a
-    broadcast-from-source collective on ICI; ranks outside the process set
-    (if any) keep zeros."""
-    groups, _ = _groups(process_set, axis_name)
+    broadcast-from-source collective on ICI. With a process set, members
+    receive the root's value and outsiders keep their own input."""
+    info = _set_info(process_set, axis_name)
     idx = lax.axis_index(axis_name)
     contribution = jnp.where(idx == root_rank, tensor, jnp.zeros_like(tensor))
-    return lax.psum(contribution, axis_name, axis_index_groups=groups)
+    out = lax.psum(contribution, axis_name)
+    if info is not None:
+        member, _ = _member(info, axis_name)
+        out = jnp.where(member, out, tensor)
+    return out
 
 
 def alltoall(
@@ -187,16 +307,40 @@ def alltoall(
 ):
     """Scatter dim-0 blocks to peers, gather their blocks (ref: hvd.alltoall
     / MPI_Alltoallv [V]). Traced mode is the equal-splits case (dim0 %
-    axis size == 0); uneven splits are an eager-mode feature."""
-    if process_set is not None and process_set.process_set_id != 0:
-        raise NotImplementedError(
-            "traced alltoall over a process set needs equal-sized XLA "
-            "replica groups; use the eager hvd.alltoall, which dispatches "
-            "on the set's sub-mesh"
+    participant count == 0); uneven splits are an eager-mode feature.
+
+    With a process set, routing runs over static ``ppermute`` rings among
+    the members only — k-1 hops of one block each, the wire-optimal
+    (k-1)/k·P, with no replica-group size constraint. Non-members return
+    their input unchanged."""
+    info = _set_info(process_set, axis_name)
+    if info is None:
+        return lax.all_to_all(
+            tensor, axis_name, split_axis=0, concat_axis=0, tiled=True
         )
-    return lax.all_to_all(
-        tensor, axis_name, split_axis=0, concat_axis=0, tiled=True
-    )
+    k = info.size
+    if tensor.shape[0] % k:
+        raise ValueError(
+            f"alltoall over a {k}-rank process set needs dim0 divisible "
+            f"by {k}, got {tensor.shape[0]}"
+        )
+    d = tensor.shape[0] // k
+    member, pos = _member(info, axis_name)
+    # Block p stays home: each member keeps its own pos-th block in place.
+    own = lax.dynamic_slice_in_dim(tensor, pos * d, d, axis=0)
+    out = jnp.zeros_like(tensor)
+    out = lax.dynamic_update_slice_in_dim(out, own, pos * d, axis=0)
+    for s in range(1, k):
+        # Rotation s: the member at set-position q sends its block
+        # (q+s)%k to the member at set-position (q+s)%k; equivalently we
+        # receive, from position (pos-s)%k, that member's block `pos`.
+        perm = [(info.ranks[q], info.ranks[(q + s) % k]) for q in range(k)]
+        send_at = ((pos + s) % k) * d
+        send = lax.dynamic_slice_in_dim(tensor, send_at, d, axis=0)
+        recv = lax.ppermute(send, axis_name, perm)
+        recv_slot = ((pos - s) % k) * d
+        out = lax.dynamic_update_slice_in_dim(out, recv, recv_slot, axis=0)
+    return jnp.where(member, out, tensor)
 
 
 def reducescatter(
@@ -208,18 +352,35 @@ def reducescatter(
     axis_name: str = WORLD_AXIS,
 ):
     """Reduce then scatter dim-0 shards (ref: hvd.reducescatter, upstream
-    v0.27+ [V]). Maps directly onto the ICI-optimal psum_scatter."""
+    v0.27+ [V]). Maps directly onto the ICI-optimal psum_scatter.
+
+    With a process set, members psum the masked tensor over the full
+    axis and slice their set-position's shard (outsiders contribute
+    zeros and get the set-position-0 shard — their output, like the
+    reference's, is meaningless; its shape must still be uniform under
+    SPMD)."""
     op = resolve_op(op, None)
-    if process_set is not None and process_set.process_set_id != 0:
-        raise NotImplementedError(
-            "traced reducescatter over a process set needs equal-sized XLA "
-            "replica groups; use the eager hvd.reducescatter, which "
-            "dispatches on the set's sub-mesh"
-        )
-    n = lax.axis_size(axis_name)
+    info = _set_info(process_set, axis_name)
     if prescale_factor != 1.0:
         tensor = tensor * jnp.asarray(prescale_factor, tensor.dtype)
-    out = lax.psum_scatter(tensor, axis_name, scatter_dimension=0, tiled=True)
+    if info is None:
+        n = lax.axis_size(axis_name)
+        out = lax.psum_scatter(
+            tensor, axis_name, scatter_dimension=0, tiled=True
+        )
+    else:
+        k = info.size
+        if tensor.shape[0] % k:
+            raise ValueError(
+                f"reducescatter over a {k}-rank process set needs dim0 "
+                f"divisible by {k}, got {tensor.shape[0]}"
+            )
+        n = k
+        member, pos = _member(info, axis_name)
+        contrib = jnp.where(member, tensor, jnp.zeros_like(tensor))
+        total = lax.psum(contrib, axis_name)
+        d = tensor.shape[0] // k
+        out = lax.dynamic_slice_in_dim(total, pos * d, d, axis=0)
     if op == Average:
         out = out / jnp.asarray(n, out.dtype)
     if postscale_factor != 1.0:

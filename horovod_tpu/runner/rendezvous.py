@@ -32,6 +32,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from .secret import sign
+from ..common.logging import TRACE as _TRACE, get_logger
+
+_log = get_logger("rendezvous")
 
 
 class KVStore:
@@ -62,8 +65,11 @@ def _make_handler(store: KVStore, secret_key: Optional[bytes]):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
-        def log_message(self, fmt, *args):  # quiet
-            pass
+        def log_message(self, fmt, *args):
+            # Route through the horovod logger at trace level instead
+            # of stderr spam; %-args pass through so logging defers the
+            # formatting to the (rare) TRACE-enabled case.
+            _log.log(_TRACE, "http " + fmt, *args)
 
         def _body(self) -> bytes:
             length = int(self.headers.get("Content-Length", 0))
@@ -180,6 +186,7 @@ class RendezvousServer:
             target=self._httpd.serve_forever, name="hvd-rendezvous", daemon=True
         )
         self._thread.start()
+        _log.info("rendezvous server listening on port %d", self.port)
         return self.port
 
     def stop(self) -> None:
@@ -301,3 +308,36 @@ def broadcast_via_kv(obj, root_rank: int = 0, name: Optional[str] = None):
         "broadcast", name, timeout=cfg.gloo_timeout_seconds
     )
     return pickle.loads(payload)
+
+
+# ------------------------------------------------------------- heartbeats
+# Worker→driver liveness over the KV channel (the rebuilt signal for the
+# stall inspector's cross-process half — stall_inspector.cc reports
+# "ranks absent" [V]; here absence = heartbeat staleness).
+
+HEARTBEAT_SCOPE = "heartbeat"
+
+
+def put_heartbeat(client: "RendezvousClient", rank: int) -> None:
+    """Stamp this worker's liveness. Call on a timer (the elastic worker
+    loop does; any long-running worker can)."""
+    import time as _time
+
+    client.put(
+        HEARTBEAT_SCOPE, str(int(rank)), repr(_time.time()).encode()
+    )
+
+
+def read_heartbeats(store_or_client) -> Dict[int, float]:
+    """Driver side: {rank: unix_ts} of every heartbeat present. Accepts
+    the in-process KVStore or a RendezvousClient."""
+    out: Dict[int, float] = {}
+    for key in store_or_client.keys(HEARTBEAT_SCOPE):
+        raw = store_or_client.get(HEARTBEAT_SCOPE, key)
+        if raw is None:
+            continue
+        try:
+            out[int(key)] = float(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+    return out

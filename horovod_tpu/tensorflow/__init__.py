@@ -11,8 +11,11 @@ than failing somewhere deep inside a user script.
 
 What is here when TF is available: init/rank/size identity, allreduce /
 allgather / broadcast (sync + _async + in-place variants where TF
-semantics allow), broadcast_variables, and DistributedGradientTape —
-the TF2 idiom the reference's docs lead with (SURVEY.md §3.5).
+semantics allow), alltoall (+v), reducescatter, join,
+broadcast_variables, DistributedGradientTape (IndexedSlices gradients
+densify with a one-time warning, matching the reference's
+sparse_as_dense fallback), and a Keras ``DistributedOptimizer`` —
+the TF2 idioms the reference's docs lead with (SURVEY.md §3.5).
 Deliberately absent (would need TF to even design honestly): TF1
 Session-era DistributedOptimizer, custom-op kernels (`mpi_ops.cc`) and
 the XLA custom-call hooks (`xla_mpi_ops.cc`) — on TPU the XLA hook is
@@ -121,6 +124,61 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
         var.assign(broadcast(var, root_rank, name=var.name))
 
 
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """Scatter dim-0 blocks to peers and gather theirs (ref: hvd.alltoall
+    in horovod/tensorflow/mpi_ops.py [V]). With ``splits`` (1-D, one
+    entry per rank) returns ``(output, received_splits)`` like the
+    reference's v-variant; without, the equal-split fast path."""
+    if splits is None:
+        handle = _eager.alltoall_async(
+            _replicated_payload(tensor), name=name, process_set=process_set
+        )
+        return _TFHandle(handle, tensor).wait()
+    if process_set is not None and process_set.process_set_id != 0:
+        raise NotImplementedError(
+            "alltoall with uneven splits does not support non-global "
+            "process sets in the TF shim; use the JAX eager API"
+        )
+    host = np.asarray(tensor)
+    world = size()
+    splits_1d = [int(s) for s in np.asarray(splits).reshape(-1).tolist()]
+    if len(splits_1d) != world:
+        raise ValueError(
+            f"splits has {len(splits_1d)} entries but world size is {world}"
+        )
+    if sum(splits_1d) != host.shape[0]:
+        raise ValueError(
+            f"splits sum to {sum(splits_1d)} but tensor dim0 is "
+            f"{host.shape[0]}"
+        )
+    handle = _eager.alltoall_async(
+        [host] * world, splits=[splits_1d] * world, name=name
+    )
+    outputs, recv_splits = handle.wait()
+    return (
+        tf.convert_to_tensor(np.asarray(outputs[0]), dtype=tensor.dtype),
+        tf.convert_to_tensor(np.asarray(recv_splits[0], dtype=np.int32)),
+    )
+
+
+def reducescatter(tensor, op=None, name=None, process_set=None):
+    """This rank's shard of the world-reduced tensor, split along dim 0
+    (ref: hvd.reducescatter, upstream v0.27+ [V]). Under the single
+    controller this process is rank 0, so the rank-0 row is our shard —
+    even and uneven (v-variant) cases both."""
+    handle = _eager.reducescatter_async(
+        _replicated_payload(tensor), op=op, name=name,
+        process_set=process_set,
+    )
+    return _TFHandle(handle, tensor).wait()
+
+
+def join(joined_ranks=None) -> int:
+    """API-parity join (ref: hvd.join [V]): flush outstanding work; with
+    ``joined_ranks`` returns the last joined rank."""
+    return _eager.join(joined_ranks)
+
+
 class DistributedGradientTape:
     """Wrap a tf.GradientTape so gradient() allreduces the grads (ref:
     horovod/tensorflow/__init__.py DistributedGradientTape [V])."""
@@ -137,11 +195,12 @@ class DistributedGradientTape:
         if g is None:
             return None
         if isinstance(g, tf.IndexedSlices):
-            raise NotImplementedError(
-                "horovod_tpu.tensorflow does not reduce sparse "
-                "(IndexedSlices) gradients; densify with "
-                "tf.convert_to_tensor(g) first"
-            )
+            # The reference reduces IndexedSlices via allgather, or
+            # densifies under sparse_as_dense (horovod/tensorflow/
+            # __init__.py [V]). Embedding-layer gradients are the common
+            # source; densify-and-reduce keeps the wrapper a drop-in.
+            _warn_sparse_once()
+            g = tf.convert_to_tensor(g)
         return allreduce(g, op=self._op, process_set=self._process_set)
 
     def gradient(self, target, sources, output_gradients=None, **kwargs):
@@ -155,3 +214,60 @@ class DistributedGradientTape:
             return type(grads)(reduced) if isinstance(
                 grads, tuple) else reduced
         return self._reduce_one(grads)
+
+
+_sparse_warned = False
+
+
+def _warn_sparse_once() -> None:
+    global _sparse_warned
+    if not _sparse_warned:
+        _sparse_warned = True
+        import warnings
+
+        warnings.warn(
+            "horovod_tpu.tensorflow: densifying IndexedSlices gradient "
+            "for allreduce (the reference's sparse_as_dense behavior); "
+            "for very large embeddings prefer the JAX path",
+            stacklevel=3,
+        )
+
+
+def DistributedOptimizer(optimizer, op=None, process_set=None):
+    """Wrap a Keras optimizer so apply_gradients() allreduces gradients
+    first (ref: horovod/tensorflow/keras/__init__.py
+    DistributedOptimizer [V]). Like the reference, this builds a dynamic
+    subclass of the wrapped optimizer's own class so Keras type checks
+    and get_config round-trips keep working."""
+    base_cls = optimizer.__class__
+
+    class _DistributedKerasOptimizer(base_cls):
+        _hvd_op = op
+        _hvd_process_set = process_set
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            pairs = list(grads_and_vars)
+            reduced = []
+            for g, v in pairs:
+                if g is None:
+                    reduced.append((g, v))
+                    continue
+                if isinstance(g, tf.IndexedSlices):
+                    _warn_sparse_once()
+                    g = tf.convert_to_tensor(g)
+                reduced.append(
+                    (
+                        allreduce(
+                            g,
+                            op=self._hvd_op,
+                            process_set=self._hvd_process_set,
+                        ),
+                        v,
+                    )
+                )
+            return super().apply_gradients(reduced, *args, **kwargs)
+
+    _DistributedKerasOptimizer.__name__ = (
+        "Distributed" + base_cls.__name__
+    )
+    return _DistributedKerasOptimizer.from_config(optimizer.get_config())

@@ -479,3 +479,13 @@ class DistributedOptimizer:
 
     def load_state_dict(self, sd):
         return self._opt.load_state_dict(sd)
+
+
+def __getattr__(name):  # PEP 562 — SyncBatchNorm builds its torch base
+    # class on first access, keeping this module importable without
+    # torch until a torch-typed symbol is actually used.
+    if name == "SyncBatchNorm":
+        from . import sync_batch_norm
+
+        return sync_batch_norm.SyncBatchNorm
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

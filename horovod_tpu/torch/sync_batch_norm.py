@@ -1,0 +1,227 @@
+"""Cross-rank synchronized BatchNorm for the torch shim.
+
+TPU-native rebuild of the reference's ``hvd.SyncBatchNorm``
+(ref: horovod/torch/sync_batch_norm.py [V]): batch statistics are
+reduced across all ranks in forward, and the two gradient reductions
+of the exact BN backward are likewise cross-rank, so every replica
+normalizes — and differentiates — with global-batch statistics. Where
+the reference routes the five reductions through its allreduce ring,
+this implementation concatenates the forward stats into ONE fused
+vector per direction (sum | sumsq | count) and rides the shim's eager
+allreduce, i.e. one XLA psum over the mesh per pass instead of three.
+
+The flax ``SyncBatchNorm`` (models/resnet.py) serves JAX models; this
+module serves torch-shim users — the verdict's missing-row #7.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+def _allreduce_sum(vec):
+    """Sum a 1-D torch tensor across the mesh via the shim's eager path."""
+    from . import Sum, allreduce
+
+    return allreduce(vec, op=Sum)
+
+
+class _SyncBatchNormFunction:
+    """Holder for the autograd.Function, built lazily so importing this
+    module never drags torch in before the caller does."""
+
+    _fn = None
+
+    @classmethod
+    def get(cls):
+        if cls._fn is not None:
+            return cls._fn
+        torch = _torch()
+
+        class Fn(torch.autograd.Function):
+            @staticmethod
+            def forward(ctx, x, weight, bias, mean, invstd, count_global):
+                shape = [1, -1] + [1] * (x.dim() - 2)
+                xhat = (x - mean.reshape(shape)) * invstd.reshape(shape)
+                ctx.save_for_backward(x, weight, mean, invstd)
+                ctx.count_global = count_global
+                if weight is not None:
+                    return xhat * weight.reshape(shape) + bias.reshape(shape)
+                return xhat
+
+            @staticmethod
+            def backward(ctx, dy):
+                torch = _torch()
+                x, weight, mean, invstd = ctx.saved_tensors
+                shape = [1, -1] + [1] * (x.dim() - 2)
+                dims = [0] + list(range(2, x.dim()))
+                xhat = (x - mean.reshape(shape)) * invstd.reshape(shape)
+                sum_dy = dy.sum(dims)
+                sum_dy_xhat = (dy * xhat).sum(dims)
+                # The exact BN backward needs GLOBAL Σdy and Σdy·x̂ (ref:
+                # sync_batch_norm.py backward [V]); one fused allreduce.
+                fused = torch.cat([sum_dy, sum_dy_xhat]).detach()
+                fused_g = _allreduce_sum(fused).to(fused.dtype)
+                c = sum_dy.numel()
+                sum_dy_g = fused_g[:c]
+                sum_dy_xhat_g = fused_g[c:]
+                n = ctx.count_global
+                g = (
+                    weight.reshape(shape)
+                    if weight is not None
+                    else torch.ones_like(mean).reshape(shape)
+                )
+                dx = (
+                    invstd.reshape(shape)
+                    * g
+                    * (
+                        dy
+                        - sum_dy_g.reshape(shape) / n
+                        - xhat * sum_dy_xhat_g.reshape(shape) / n
+                    )
+                )
+                # weight/bias grads stay local — DistributedOptimizer
+                # reduces parameter grads, exactly like the reference.
+                grad_weight = sum_dy_xhat if weight is not None else None
+                grad_bias = sum_dy if weight is not None else None
+                return dx, grad_weight, grad_bias, None, None, None
+
+        cls._fn = Fn
+        return Fn
+
+
+def _sync_batch_norm_base():
+    torch = _torch()
+
+    class SyncBatchNorm(torch.nn.Module):
+        """Drop-in for torch.nn.BatchNorm1d/2d/3d that synchronizes
+        batch statistics across all horovod ranks during training
+        (ref: horovod/torch/sync_batch_norm.py [V])."""
+
+        def __init__(
+            self,
+            num_features: int,
+            eps: float = 1e-5,
+            momentum: Optional[float] = 0.1,
+            affine: bool = True,
+            track_running_stats: bool = True,
+        ):
+            super().__init__()
+            self.num_features = num_features
+            self.eps = eps
+            self.momentum = momentum
+            self.affine = affine
+            self.track_running_stats = track_running_stats
+            if affine:
+                self.weight = torch.nn.Parameter(torch.ones(num_features))
+                self.bias = torch.nn.Parameter(torch.zeros(num_features))
+            else:
+                self.register_parameter("weight", None)
+                self.register_parameter("bias", None)
+            if track_running_stats:
+                self.register_buffer(
+                    "running_mean", torch.zeros(num_features)
+                )
+                self.register_buffer("running_var", torch.ones(num_features))
+                self.register_buffer(
+                    "num_batches_tracked", torch.tensor(0, dtype=torch.long)
+                )
+            else:
+                self.register_buffer("running_mean", None)
+                self.register_buffer("running_var", None)
+                self.register_buffer("num_batches_tracked", None)
+
+        def forward(self, x):
+            if x.dim() < 2:
+                raise ValueError(
+                    f"expected at least 2D input, got {x.dim()}D"
+                )
+            if x.shape[1] != self.num_features:
+                raise ValueError(
+                    f"expected {self.num_features} channels, got "
+                    f"{x.shape[1]}"
+                )
+            if not self.training and self.track_running_stats:
+                shape = [1, -1] + [1] * (x.dim() - 2)
+                invstd = 1.0 / torch.sqrt(self.running_var + self.eps)
+                out = (x - self.running_mean.reshape(shape)) * (
+                    invstd.reshape(shape)
+                )
+                if self.affine:
+                    out = out * self.weight.reshape(shape) + (
+                        self.bias.reshape(shape)
+                    )
+                return out
+
+            dims = [0] + list(range(2, x.dim()))
+            count_local = float(x.numel() // x.shape[1])
+            local_sum = x.sum(dims)
+            local_sumsq = (x * x).sum(dims)
+            # One fused vector (sum | sumsq | count) → one allreduce —
+            # the reference performs the same sync with its
+            # sync_batch_norm allgather/allreduce pair [V].
+            fused = torch.cat(
+                [
+                    local_sum.detach(),
+                    local_sumsq.detach(),
+                    torch.tensor([count_local], dtype=local_sum.dtype),
+                ]
+            )
+            fused_g = _allreduce_sum(fused).to(fused.dtype)
+            c = self.num_features
+            n = float(fused_g[2 * c].item())
+            mean = fused_g[:c] / n
+            var = fused_g[c : 2 * c] / n - mean * mean
+            var = torch.clamp(var, min=0.0)
+            invstd = 1.0 / torch.sqrt(var + self.eps)
+
+            if self.track_running_stats:
+                self.num_batches_tracked += 1
+                m = (
+                    self.momentum
+                    if self.momentum is not None
+                    else 1.0 / float(self.num_batches_tracked)
+                )
+                unbiased = var * (n / max(n - 1.0, 1.0))
+                with torch.no_grad():
+                    self.running_mean.mul_(1 - m).add_(mean, alpha=m)
+                    self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
+
+            fn = _SyncBatchNormFunction.get()
+            return fn.apply(x, self.weight, self.bias, mean, invstd, n)
+
+        def extra_repr(self):
+            return (
+                f"{self.num_features}, eps={self.eps}, "
+                f"momentum={self.momentum}, affine={self.affine}, "
+                f"track_running_stats={self.track_running_stats}"
+            )
+
+    return SyncBatchNorm
+
+
+_cls = None
+
+
+def _get_class():
+    """The real SyncBatchNorm class, built on first access so this file
+    imports without torch. It IS a type: isinstance checks and user
+    subclassing work like the reference's class."""
+    global _cls
+    if _cls is None:
+        _cls = _sync_batch_norm_base()
+    return _cls
+
+
+def __getattr__(name):  # PEP 562: lazy module attribute
+    if name == "SyncBatchNorm":
+        return _get_class()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

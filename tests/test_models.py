@@ -106,3 +106,68 @@ def test_vit_forward():
     params = model.init(jax.random.PRNGKey(0), x, train=False)
     out = model.apply(params, x, train=False)
     assert out.shape == (2, 10)
+
+
+def test_resnet_space_to_depth_stem_matches_grid():
+    """The s2d stem (MLPerf TPU trick) must produce the exact conv7
+    output grid and train end-to-end."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.resnet import ResNet
+
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 32, 32, 3)), jnp.float32
+    )
+    shapes = {}
+    for stem in ("conv7", "space_to_depth"):
+        m = ResNet(
+            stage_sizes=(1, 1), num_classes=7, width=8,
+            dtype=jnp.float32, stem=stem,
+        )
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        y, _ = m.apply(v, x, train=True, mutable=["batch_stats"])
+        shapes[stem] = y.shape
+        assert bool(jnp.isfinite(y).all())
+    assert shapes["conv7"] == shapes["space_to_depth"] == (2, 7)
+
+
+def test_resnet_space_to_depth_equivalent_function_class():
+    """A 7x7/s2 stem conv embeds exactly into the 4x4/s1 s2d conv: with
+    the re-laid-out weights both compute the same function."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(1, 16, 16, 3)), jnp.float32)
+    w7 = jnp.asarray(rng.normal(size=(7, 7, 3, 4)), jnp.float32)
+    y_ref = jax.lax.conv_general_dilated(
+        x, w7, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    # space-to-depth input
+    n, h, w, c = x.shape
+    x2 = (
+        x.reshape(n, h // 2, 2, w // 2, 2, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(n, h // 2, w // 2, 4 * c)
+    )
+    # embed w7 into the (4,4,12,4) kernel: tap (dy,dx) lands at
+    # s2d position (ey+2, ex+2) channel (py*2+px)*c+cc with
+    # dy-3 = 2*ey+py
+    w4 = np.zeros((4, 4, 4 * c, 4), np.float32)
+    for dy in range(7):
+        for dx in range(7):
+            ey, py = divmod(dy - 3, 2)
+            ex, px = divmod(dx - 3, 2)
+            w4[ey + 2, ex + 2, (py * 2 + px) * c : (py * 2 + px + 1) * c] = (
+                np.asarray(w7[dy, dx])
+            )
+    y_s2d = jax.lax.conv_general_dilated(
+        x2, jnp.asarray(w4), window_strides=(1, 1),
+        padding=[(2, 1), (2, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_ref), np.asarray(y_s2d), rtol=1e-5, atol=1e-5
+    )

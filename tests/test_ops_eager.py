@@ -259,3 +259,31 @@ def test_executor_cache_reuse(hvd):
     n = len(fusion._executors)
     hvd.allreduce(x * 2, op=hvd_mod.Sum)
     assert len(fusion._executors) == n  # response-cache analog hit
+
+
+def test_grouped_allreduce_atomic_over_threshold(hvd):
+    """A group larger than the fusion threshold must not be split
+    mid-group (group_table.cc semantics [V]): begin_group defers the
+    threshold flush and all members complete in one cycle."""
+    fusion = hvd_mod.common.basics.state().fusion
+    fusion.threshold_bytes = 64  # each member alone crosses the threshold
+    before = fusion.cycles
+    xs = [rank_major(lambda r: np.full((64,), float(r + i))) for i in range(4)]
+    outs = hvd.grouped_allreduce(xs, op=hvd_mod.Sum)
+    assert fusion.cycles == before + 1  # one cycle for the whole group
+    for i, out in enumerate(outs):
+        expected = np.full(64, sum(r + i for r in range(8)))
+        np.testing.assert_allclose(np.asarray(out[0]), expected)
+
+
+def test_grouped_allreduce_single_fused_dispatch(hvd):
+    """Group members share ONE fused executable even when their total
+    size exceeds the threshold (the unit is indivisible in
+    _batches_by_threshold)."""
+    fusion = hvd_mod.common.basics.state().fusion
+    fusion.threshold_bytes = 64
+    misses_before = fusion.cache_misses
+    xs = [rank_major(lambda r: np.full((64,), 1.0 * r)) for _ in range(3)]
+    hvd.grouped_allreduce(xs, op=hvd_mod.Sum)
+    # one fused allreduce executor build, not three
+    assert fusion.cache_misses == misses_before + 1

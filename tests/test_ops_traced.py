@@ -179,3 +179,128 @@ def test_rank_size_in_trace(hvd):
         rank_major(lambda r: np.zeros(1)),
     )
     np.testing.assert_allclose(np.asarray(out[:, 0]), 800 + np.arange(8.0))
+
+
+# ---------------------------------------------------------------- process sets
+# The traced set family is built from masked full-axis collectives and
+# static ppermute routes — no axis_index_groups (XLA's TPU lowering
+# rejects unequal replica groups; see ops/traced.py module docstring).
+
+
+def test_allgather_process_set(hvd):
+    ps = hvd.add_process_set([1, 3, 6])
+    x = rank_major(lambda r: np.full((2, 3), float(r)))
+    out = run_spmd(
+        hvd, lambda t: traced.allgather(t, process_set=ps), x
+    )
+    expected = np.concatenate(
+        [np.full((2, 3), float(r)) for r in (1, 3, 6)]
+    )
+    assert out.shape == (8, 6, 3)
+    for r in range(8):  # members and outsiders both hold the set's gather
+        np.testing.assert_allclose(np.asarray(out[r]), expected)
+
+
+def test_alltoall_process_set(hvd):
+    ps = hvd.add_process_set([0, 2, 5, 7])
+    # member at set-position p sends block j to set-position j
+    x = rank_major(lambda r: np.array([r * 10.0 + j for j in range(8)]))
+    out = run_spmd(hvd, lambda t: traced.alltoall(t, process_set=ps), x)
+    # set order (0,2,5,7): rank 5 is position 2; its block j=2 comes from
+    # each member in set order with d=2: rank s's rows [4:6]
+    expected = np.concatenate(
+        [np.array([s * 10.0 + 4, s * 10.0 + 5]) for s in (0, 2, 5, 7)]
+    )
+    np.testing.assert_allclose(np.asarray(out[5]), expected)
+    # non-member output is the untouched input
+    np.testing.assert_allclose(
+        np.asarray(out[3]), np.array([30.0 + j for j in range(8)])
+    )
+
+
+def test_reducescatter_process_set(hvd):
+    ps = hvd.add_process_set([1, 2, 4, 6])
+    x = rank_major(lambda r: np.arange(8.0) + r)
+    out = run_spmd(
+        hvd, lambda t: traced.reducescatter(t, op=hvd_mod.Sum, process_set=ps), x
+    )
+    # reduced over members = 4*arange(8) + (1+2+4+6); member at set
+    # position p gets shard [2p, 2p+2)
+    reduced = 4 * np.arange(8.0) + 13.0
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(out[2]), reduced[2:4])  # pos 1
+    np.testing.assert_allclose(np.asarray(out[6]), reduced[6:8])  # pos 3
+
+
+def test_adasum_process_set(hvd):
+    from horovod_tpu.ops.adasum import adasum_tree_host
+
+    ps = hvd.add_process_set([0, 3, 5])
+    rng = np.random.default_rng(7)
+    vals = rng.normal(size=(8, 6)).astype(np.float32)
+    out = run_spmd(
+        hvd,
+        lambda t: traced.allreduce(t, op=hvd_mod.Adasum, process_set=ps),
+        vals,
+    )
+    expected = adasum_tree_host(np.stack([vals[0], vals[3], vals[5]]))
+    for r in (0, 3, 5):
+        np.testing.assert_allclose(
+            np.asarray(out[r]), expected, rtol=1e-5, atol=1e-6
+        )
+    np.testing.assert_allclose(np.asarray(out[4]), vals[4])
+
+
+def test_allreduce_process_set_min_max_product(hvd):
+    ps = hvd.add_process_set([2, 4, 7])
+    x = rank_major(lambda r: np.full((3,), float(r + 1)))
+    mn = run_spmd(
+        hvd, lambda t: traced.allreduce(t, op=hvd_mod.Min, process_set=ps), x
+    )
+    mx = run_spmd(
+        hvd, lambda t: traced.allreduce(t, op=hvd_mod.Max, process_set=ps), x
+    )
+    pr = run_spmd(
+        hvd,
+        lambda t: traced.allreduce(t, op=hvd_mod.Product, process_set=ps),
+        x,
+    )
+    np.testing.assert_allclose(np.asarray(mn[2]), np.full(3, 3.0))
+    np.testing.assert_allclose(np.asarray(mx[4]), np.full(3, 8.0))
+    np.testing.assert_allclose(np.asarray(pr[7]), np.full(3, 3.0 * 5.0 * 8.0))
+    # outsiders keep their input for every op
+    np.testing.assert_allclose(np.asarray(mn[0]), np.full(3, 1.0))
+    np.testing.assert_allclose(np.asarray(pr[5]), np.full(3, 6.0))
+
+
+def test_grouped_allreduce_process_set(hvd):
+    ps = hvd.add_process_set([0, 1, 4])
+    xs = [
+        rank_major(lambda r: np.full((3,), float(r))),
+        rank_major(lambda r: np.full((2,), 10.0 * r)),
+    ]
+    outs = run_spmd(
+        hvd,
+        lambda a, b: tuple(
+            traced.grouped_allreduce([a, b], op=hvd_mod.Average, process_set=ps)
+        ),
+        *xs,
+        out_specs=(P(hvd_mod.WORLD_AXIS), P(hvd_mod.WORLD_AXIS)),
+    )
+    np.testing.assert_allclose(np.asarray(outs[0][1]), np.full(3, 5.0 / 3))
+    np.testing.assert_allclose(np.asarray(outs[1][4]), np.full(2, 50.0 / 3))
+    # outsider keeps both inputs
+    np.testing.assert_allclose(np.asarray(outs[0][6]), np.full(3, 6.0))
+    np.testing.assert_allclose(np.asarray(outs[1][6]), np.full(2, 60.0))
+
+
+def test_broadcast_process_set(hvd):
+    ps = hvd.add_process_set([1, 2, 6])
+    x = rank_major(lambda r: np.full((4,), float(r)))
+    out = run_spmd(
+        hvd, lambda t: traced.broadcast(t, root_rank=2, process_set=ps), x
+    )
+    for r in (1, 2, 6):
+        np.testing.assert_allclose(np.asarray(out[r]), np.full(4, 2.0))
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(4, 0.0))
+    np.testing.assert_allclose(np.asarray(out[7]), np.full(4, 7.0))

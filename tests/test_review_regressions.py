@@ -92,19 +92,32 @@ def test_adasum_process_set_eager(hvd, rng):
     np.testing.assert_allclose(np.asarray(out[6]), x[6], rtol=1e-6)
 
 
-def test_traced_gather_family_pset_raises(hvd):
-    import jax.numpy as jnp
+def test_traced_gather_family_pset_divisibility_raises(hvd):
+    """The traced set gather family is implemented now (masked
+    full-axis collectives, round 3); what still raises is a clear
+    ValueError on non-divisible block splits — not a deep XLA error."""
+    import jax
+    from jax.sharding import PartitionSpec as P
 
     from horovod_tpu.ops import traced
 
-    ps = hvd.add_process_set([0, 1])
-    for fn in (
-        lambda: traced.allgather(jnp.ones(4), process_set=ps),
-        lambda: traced.alltoall(jnp.ones(8), process_set=ps),
-        lambda: traced.reducescatter(jnp.ones(8), process_set=ps),
-    ):
-        with pytest.raises(NotImplementedError):
-            fn()
+    ps = hvd.add_process_set([0, 1, 2])
+    mesh = hvd.mesh()
+    x = rank_major(lambda r: np.ones(8))
+
+    def run(op):
+        body = jax.shard_map(
+            lambda t: op(t[0], process_set=ps)[None],
+            mesh=mesh,
+            in_specs=P(hvd_mod.WORLD_AXIS),
+            out_specs=P(hvd_mod.WORLD_AXIS),
+            check_vma=False,
+        )
+        jax.jit(body)(x)
+
+    for op in (traced.alltoall, traced.reducescatter):
+        with pytest.raises(ValueError, match="divisible"):
+            run(op)
 
 
 def test_autotune_init_does_not_crash(monkeypatch):
@@ -172,3 +185,27 @@ def test_traced_adasum_prescale_applied(hvd, rng):
         return np.asarray(f(x))
 
     np.testing.assert_allclose(run(2.0), 2.0 * run(1.0), rtol=1e-5)
+
+
+def test_adasum_respects_join_mask(hvd, rng):
+    """Joined ranks contribute Adasum's identity (zero), so the result
+    must equal Adasum over the live ranks only (round-3 review fix:
+    the Adasum branch used the unmasked payload)."""
+    from horovod_tpu.ops.adasum import adasum_tree_host
+
+    vals = np.stack(
+        [rng.normal(size=6).astype(np.float32) for _ in range(8)]
+    )
+    with hvd.join_ranks([2, 5]):
+        out = hvd.allreduce(vals, op=hvd_mod.Adasum)
+    live = np.asarray(
+        [vals[r] if r not in (2, 5) else np.zeros(6, np.float32)
+         for r in range(8)]
+    )
+    # the VHDD order over the full axis with zeroed rows is the oracle
+    from horovod_tpu.ops.adasum import adasum_vhdd_host
+
+    expected = adasum_vhdd_host(live)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), expected, rtol=1e-4, atol=1e-5
+    )

@@ -91,15 +91,22 @@ def test_gradient_tape_single_source(hvdtf):
     np.testing.assert_allclose(g.numpy(), [4.0, 6.0])
 
 
-def test_gradient_tape_sparse_raises(hvdtf):
-    """IndexedSlices gradients fail with a clear scope message, not a
-    deep numpy conversion error."""
+def test_gradient_tape_sparse_densifies_with_warning(hvdtf):
+    """IndexedSlices gradients densify-and-reduce with a one-time
+    warning (the reference's sparse_as_dense behavior [V]) — embedding
+    gradients must not break the drop-in contract."""
+    import horovod_tpu.tensorflow as mod
+
+    mod._sparse_warned = False
     v = tf.Variable(tf.ones((4, 2)))
     with tf.GradientTape() as tape:
         loss = tf.reduce_sum(tf.gather(v, [0, 2]))
     dtape = hvdtf.DistributedGradientTape(tape)
-    with pytest.raises(NotImplementedError, match="IndexedSlices"):
-        dtape.gradient(loss, v)
+    with pytest.warns(UserWarning, match="IndexedSlices"):
+        g = dtape.gradient(loss, v)
+    expected = np.zeros((4, 2))
+    expected[0] = expected[2] = 1.0
+    np.testing.assert_allclose(np.asarray(g), expected)
 
 
 def test_gradient_tape_none_grad_passthrough(hvdtf):
@@ -113,3 +120,59 @@ def test_gradient_tape_none_grad_passthrough(hvdtf):
     grads = dtape.gradient(loss, [w, unused])
     assert grads[1] is None
     np.testing.assert_allclose(grads[0].numpy(), [3.0])
+
+
+def test_alltoall_even(hvdtf):
+    n = hvdtf.size()
+    x = tf.constant(np.arange(n, dtype=np.float32))
+    out = hvdtf.alltoall(x)
+    # rank j receives block j from every peer; the shim replicates this
+    # process's tensor to all ranks, so rank 0 gets x[0] from each
+    np.testing.assert_allclose(out.numpy(), np.full(n, x.numpy()[0]))
+
+
+def test_alltoall_uneven_splits(hvdtf):
+    n = hvdtf.size()
+    # send 1 row to rank 0 and 0 rows to everyone else
+    splits = [1] + [0] * (n - 1)
+    x = tf.constant([[7.0, 8.0]])
+    out, recv = hvdtf.alltoall(x, splits=splits)
+    # we are rank 0: every rank sent us its 1 row (identical inputs)
+    assert out.shape == (n, 2)
+    np.testing.assert_allclose(out.numpy()[0], [7.0, 8.0])
+    assert recv.numpy().tolist() == [1] * n
+
+
+def test_reducescatter(hvdtf):
+    n = hvdtf.size()
+    x = tf.constant(np.arange(2.0 * n, dtype=np.float32))
+    out = hvdtf.reducescatter(x, op=hvdtf.Sum)
+    # rank 0's shard: first 2 elements of the world sum
+    np.testing.assert_allclose(out.numpy(), np.arange(2.0) * n)
+
+
+def test_join(hvdtf):
+    assert hvdtf.join() == -1
+    assert hvdtf.join([1, 2]) == 2
+
+
+def test_keras_distributed_optimizer(hvdtf):
+    """apply_gradients allreduces first (Average over an all-same world
+    = identity): one SGD step must equal the undistributed step, the
+    reference's Keras contract (keras/__init__.py [V])."""
+    keras = tf.keras
+    v = tf.Variable([1.0, 2.0])
+    opt = hvdtf.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.5))
+    assert type(opt).__name__ == "DistributedSGD"
+    grads = [tf.constant([1.0, 1.0])]
+    opt.apply_gradients(zip(grads, [v]))
+    np.testing.assert_allclose(v.numpy(), [0.5, 1.5])
+
+
+def test_keras_distributed_optimizer_config_roundtrip(hvdtf):
+    keras = tf.keras
+    opt = hvdtf.DistributedOptimizer(
+        keras.optimizers.Adam(learning_rate=0.01)
+    )
+    cfg = opt.get_config()
+    assert abs(float(cfg["learning_rate"]) - 0.01) < 1e-9

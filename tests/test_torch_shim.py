@@ -301,3 +301,72 @@ def test_broadcast_optimizer_state(hvdt):
     # Adam state (step/exp_avg) intact and loadable
     sd = opt.state_dict()
     assert sd["state"], "optimizer state empty after broadcast"
+
+
+def test_sync_batch_norm_matches_local_bn(hvdt):
+    """Stat equivalence vs torch.nn.BatchNorm2d: with every rank seeing
+    the same replicated batch, global stats == local stats, so forward,
+    input grads, and running stats must match the single-process module
+    (ref: horovod/torch/sync_batch_norm.py [V] — the reference's own
+    equivalence contract)."""
+    torch = pytest.importorskip("torch")
+    torch.manual_seed(0)
+    x = torch.randn(4, 3, 5, 5, dtype=torch.float64)
+
+    sbn = hvdt.SyncBatchNorm(3, eps=1e-5, momentum=0.1)
+    bn = torch.nn.BatchNorm2d(3, eps=1e-5, momentum=0.1)
+    sbn.double()
+    bn.double()
+
+    xa = x.clone().requires_grad_(True)
+    xb = x.clone().requires_grad_(True)
+    ya = sbn(xa)
+    yb = bn(xb)
+    # stats ride the f32 collective path (JAX x64 off), so the
+    # equivalence tolerance is f32-level even for f64 modules
+    np.testing.assert_allclose(
+        ya.detach().numpy(), yb.detach().numpy(), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        sbn.running_mean.numpy(), bn.running_mean.numpy(), rtol=1e-5,
+        atol=1e-7,
+    )
+    # running_var's unbiased correction uses the GLOBAL element count
+    # (world×local, like torch.nn.SyncBatchNorm), not the local one —
+    # rescale the single-process value before comparing.
+    n_local = float(x.numel() // x.shape[1])
+    n_global = n_local * hvdt.size()
+    biased = (bn.running_var.numpy() - 0.9) / 0.1 * (n_local - 1) / n_local
+    expected_var = 0.9 + 0.1 * biased * n_global / (n_global - 1)
+    np.testing.assert_allclose(
+        sbn.running_var.numpy(), expected_var, rtol=1e-5, atol=1e-7
+    )
+
+    ya.sum().backward()
+    yb.sum().backward()
+    np.testing.assert_allclose(
+        xa.grad.numpy(), xb.grad.numpy(), rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        sbn.weight.grad.numpy(), bn.weight.grad.numpy(), rtol=1e-4,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        sbn.bias.grad.numpy(), bn.bias.grad.numpy(), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_sync_batch_norm_eval_uses_running_stats(hvdt):
+    torch = pytest.importorskip("torch")
+    sbn = hvdt.SyncBatchNorm(2)
+    with torch.no_grad():
+        sbn.running_mean.copy_(torch.tensor([1.0, -1.0]))
+        sbn.running_var.copy_(torch.tensor([4.0, 0.25]))
+    sbn.eval()
+    x = torch.ones(3, 2)
+    out = sbn(x)
+    expected = np.stack(
+        [np.full(3, (1.0 - 1.0) / np.sqrt(4.0 + 1e-5)),
+         np.full(3, (1.0 + 1.0) / np.sqrt(0.25 + 1e-5))], axis=1
+    )
+    np.testing.assert_allclose(out.detach().numpy(), expected, rtol=1e-5)
