@@ -43,6 +43,7 @@ from _benchlib import mfu_fields as _mfu_fields  # noqa: E402
 
 
 def inner_main():
+    model_name = os.environ.get("BENCH_MODEL", "resnet50")
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     n_iters = int(os.environ.get("BENCH_ITERS", "20"))
     n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
@@ -57,24 +58,43 @@ def inner_main():
     import optax
     from functools import partial
 
-    from horovod_tpu.models import ResNet50
+    # The reference's synthetic-benchmark model family
+    # (docs/benchmarks.rst: ResNet-50/101, Inception V3, VGG-16 [V]).
+    from horovod_tpu import models as model_zoo
+
+    image_size = 224
+    if model_name == "resnet50":
+        model = model_zoo.ResNet50(dtype=jnp.bfloat16)
+    elif model_name == "resnet101":
+        model = model_zoo.ResNet101(dtype=jnp.bfloat16)
+    elif model_name == "inception_v3":
+        model = model_zoo.InceptionV3(dtype=jnp.bfloat16)
+        image_size = 299
+    elif model_name == "vgg16":
+        model = model_zoo.VGG16(dtype=jnp.bfloat16)
+    else:
+        raise SystemExit(f"unknown BENCH_MODEL {model_name!r}")
 
     platform = jax.devices()[0].platform
-    model = ResNet50(dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     images = jnp.asarray(
-        np.random.default_rng(0).uniform(size=(batch, 224, 224, 3)),
+        np.random.default_rng(0).uniform(
+            size=(batch, image_size, image_size, 3)
+        ),
         jnp.bfloat16,
     )
     labels = jnp.zeros((batch,), jnp.int32)
     variables = jax.jit(lambda: model.init(rng, images, train=False))()
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
     opt = optax.sgd(0.01, momentum=0.9)
     opt_state = opt.init(params)
 
     # Donating the carried state lets XLA update params/opt-state in
     # place instead of allocating fresh buffers every step — the same
     # HBM-traffic discipline the fusion-buffer reuse gives the reference.
+    dropout_rng = jax.random.PRNGKey(42)
+
     @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
@@ -83,11 +103,12 @@ def inner_main():
                 images,
                 train=True,
                 mutable=["batch_stats"],
+                rngs={"dropout": dropout_rng},
             )
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels
             ).mean()
-            return loss, mutated["batch_stats"]
+            return loss, mutated.get("batch_stats", {})
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params
@@ -117,7 +138,7 @@ def inner_main():
 
     img_per_sec = batch * n_iters / dt
     result = {
-        "metric": "resnet50_synth_img_per_sec",
+        "metric": f"{model_name}_synth_img_per_sec",
         "value": round(img_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(img_per_sec / P100_FP32_IMG_PER_SEC, 3),
@@ -162,7 +183,7 @@ def _extract_json(stdout):
 
 
 def orchestrate():
-    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "4"))
     timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "600"))
     forced = os.environ.get("BENCH_PLATFORM")
 
@@ -175,7 +196,11 @@ def orchestrate():
     last_err = ""
     for i in range(attempts):
         if i > 0:
-            delay = 30.0 * i
+            # Stale chip claims take minutes to clear (measured: a
+            # killed process can wedge first-touch for ~5 min; the r02
+            # ladder of 30s+60s was too short — the driver's later run
+            # succeeded). 60/120/180s backs off ~6 min total.
+            delay = 60.0 * i
             print(
                 f"bench: attempt {i} failed, retrying in {delay:.0f}s "
                 f"(TPU backend may be recovering a stale chip claim)",
@@ -218,7 +243,8 @@ def orchestrate():
     print(
         json.dumps(
             {
-                "metric": "resnet50_synth_img_per_sec",
+                "metric": os.environ.get("BENCH_MODEL", "resnet50")
+                + "_synth_img_per_sec",
                 "value": 0.0,
                 "unit": "img/s",
                 "vs_baseline": 0.0,

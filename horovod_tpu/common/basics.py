@@ -113,9 +113,12 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
         # (ref: logging.cc — level/timestamp read once at init [V]).
         from . import logging as hvd_logging
 
-        log = hvd_logging.configure(
-            level=cfg.log_level, timestamp=cfg.log_timestamp
+        log = hvd_logging.configure_from_init(
+            cfg.log_level, cfg.log_timestamp
         )
+        from .metrics import registry as _metrics
+
+        _metrics.configure_export()  # HOROVOD_METRICS_FILE, if set
         _maybe_init_jax_distributed(cfg)
         topology = topo_mod.discover(cfg)
         _state.config = cfg

@@ -34,6 +34,7 @@ _LEVELS = {
 
 _ROOT = "horovod_tpu"
 _configured = False
+_configured_explicitly = False  # a caller passed real args (not lazy)
 
 
 def parse_level(name: Optional[str]) -> int:
@@ -56,10 +57,15 @@ def configure(
     with defaults it reads the environment (so init() wires the whole
     tree with zero ceremony). Idempotent unless ``force``.
     """
-    global _configured
+    global _configured, _configured_explicitly
+    explicit = (
+        level is not None or timestamp is not None or stream is not None
+    )
     root = logging.getLogger(_ROOT)
     if _configured and not force:
         return root
+    if explicit:
+        _configured_explicitly = True
     if level is None:
         level = os.environ.get("HOROVOD_LOG_LEVEL", "warning")
     if timestamp is None:
@@ -80,6 +86,17 @@ def configure(
     root.propagate = False
     _configured = True
     return root
+
+
+def configure_from_init(level: str, timestamp: bool) -> logging.Logger:
+    """init()'s entry point: module-level ``get_logger`` calls already
+    configured the tree lazily at import time, which would make a plain
+    ``configure(...)`` a no-op; init's Config values must win over that
+    lazy default — but never over an explicit programmatic
+    ``configure(...)`` the user made first."""
+    if _configured_explicitly:
+        return logging.getLogger(_ROOT)
+    return configure(level=level, timestamp=timestamp, force=True)
 
 
 def get_logger(name: Optional[str] = None) -> logging.Logger:

@@ -1,0 +1,101 @@
+"""Structured metrics: counters/gauges + JSON-lines export.
+
+Closes SURVEY.md §5.5's metrics half (the reference exposes its
+equivalents through the timeline + TensorBoard callbacks and buildkite
+perf jobs [V]; the rebuild's observability stack is logging.py for
+text, timeline/traced_timeline for traces, and this module for
+numbers). One process-wide registry; subsystems register or bump
+metrics by dotted name, and ``HOROVOD_METRICS_FILE`` (or an explicit
+``dump``/``start_export`` call) writes JSON lines:
+
+    {"ts": <unix>, "name": "fusion.cycles", "value": 17}
+
+The fusion manager publishes its cycle/cache counters after every
+flush; anything else (user code included) can publish through
+``metrics.gauge``/``metrics.counter``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = {}
+        self._path: Optional[str] = None
+        self._last_dump = 0.0
+
+    # -- write side ---------------------------------------------------
+
+    def counter(self, name: str, inc: float = 1.0) -> None:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0.0) + inc
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._values[name] = float(value)
+
+    def update(self, prefix: str, stats: Dict[str, float]) -> None:
+        """Publish a dict of gauges under a common prefix (the shape
+        fusion.cache_stats() and autotune samples come in)."""
+        with self._lock:
+            for k, v in stats.items():
+                self._values[f"{prefix}.{k}"] = float(v)
+
+    # -- read side ----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    # -- export -------------------------------------------------------
+
+    def configure_export(self, path: Optional[str] = None) -> None:
+        """Set (or clear) the JSON-lines sink. Defaults from
+        HOROVOD_METRICS_FILE; explicit path wins."""
+        if path is None:
+            path = os.environ.get("HOROVOD_METRICS_FILE") or None
+        self._path = path
+
+    def maybe_dump(self, min_interval: float = 1.0) -> Optional[str]:
+        """Rate-limited dump for hot paths (the fusion flush calls
+        this): at most one append per ``min_interval`` seconds, nothing
+        when no sink is configured."""
+        if not self._path:
+            return None
+        now = time.monotonic()
+        if now - self._last_dump < min_interval:
+            return None
+        self._last_dump = now
+        return self.dump()
+
+    def dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Append one line per metric to the sink; returns the path
+        written (None when no sink is configured)."""
+        path = path or self._path
+        if not path:
+            return None
+        now = time.time()
+        snap = self.snapshot()
+        with open(path, "a") as f:
+            for name in sorted(snap):
+                f.write(
+                    json.dumps(
+                        {"ts": now, "name": name, "value": snap[name]}
+                    )
+                    + "\n"
+                )
+        return path
+
+
+registry = MetricsRegistry()

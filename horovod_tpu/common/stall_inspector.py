@@ -48,6 +48,13 @@ class StallInspector:
         self._pending.pop(name, None)
         self._warned.discard(name)
 
+    def reset_heartbeats(self) -> None:
+        """Forget all liveness state — call when the worker set
+        changes (gang restart): departed ranks must not read as
+        stalled."""
+        self._heartbeats.clear()
+        self._hb_warned.clear()
+
     def record_heartbeat(self, rank: int, ts: float = None) -> None:
         """Feed a worker heartbeat (driver side of signal #2). ``ts`` is
         a unix epoch stamp (``time.time()`` — the domain

@@ -99,18 +99,40 @@ class TracedTimeline:
 
     def _export_chrome_trace(self) -> None:
         """Merge the profiler's per-host trace.json.gz into one plain
-        chrome://tracing JSON at the requested path."""
+        chrome://tracing JSON at the requested path.
+
+        Multi-host traces reuse pid numbers (each host's profiler
+        starts from the same ids), so each source file's pids are
+        remapped into a disjoint range and the host is recorded in the
+        process_name metadata — without this, chrome://tracing renders
+        every host's processes overlapped."""
         events = []
         pattern = os.path.join(
             self._logdir, "plugins", "profile", "*", "*.trace.json.gz"
         )
-        for fname in sorted(glob.glob(pattern)):
+        files = sorted(glob.glob(pattern))
+        pid_stride = 10_000
+        for host_idx, fname in enumerate(files):
             try:
                 with gzip.open(fname, "rt") as f:
                     data = json.load(f)
-                events.extend(data.get("traceEvents", []))
             except (OSError, json.JSONDecodeError):
                 continue
+            host = os.path.basename(fname).split(".")[0]
+            offset = host_idx * pid_stride
+            for ev in data.get("traceEvents", []):
+                if "pid" in ev:
+                    ev = dict(ev)
+                    ev["pid"] = int(ev["pid"]) + offset
+                    if (
+                        len(files) > 1
+                        and ev.get("ph") == "M"
+                        and ev.get("name") == "process_name"
+                    ):
+                        args = dict(ev.get("args", {}))
+                        args["name"] = f"{host}: {args.get('name', '')}"
+                        ev["args"] = args
+                events.append(ev)
         tmp = f"{self._path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"traceEvents": events}, f)

@@ -146,6 +146,13 @@ class ElasticDriver:
             ",".join(sorted(set(assignment.hostnames))),
         )
         server = self._rendezvous()
+        from ..runner.rendezvous import HEARTBEAT_SCOPE
+
+        self.stall_inspector.reset_heartbeats()
+        try:
+            server.store.drop_scope(HEARTBEAT_SCOPE)
+        except Exception:
+            pass
         placement = self._placement
         if placement == "auto":
             placement = (
@@ -260,7 +267,11 @@ class ElasticDriver:
         last_refresh = 0.0
         while not self._stop.is_set():
             now = time.monotonic()
-            self._poll_heartbeats(now)
+            if self._poll_heartbeats(now):
+                self._terminate_gang()
+                if not self._reset(reason="worker heartbeat silence"):
+                    return 1
+                continue
             if now - last_refresh >= self._interval:
                 changed = self.host_manager.refresh()
                 last_refresh = now
@@ -291,20 +302,33 @@ class ElasticDriver:
         self._terminate_gang()
         return 0
 
-    def _poll_heartbeats(self, now: float) -> None:
+    def _poll_heartbeats(self, now: float) -> bool:
         """Relay worker heartbeats from the rendezvous KV into the
-        stall inspector (rate-limited to once per discovery interval)."""
+        stall inspector (rate-limited to once per discovery interval).
+        Returns True when the inspector escalated past
+        HOROVOD_STALL_SHUTDOWN_TIME_SECONDS — the elastic-native
+        response is a gang restart, decided by the caller."""
         if self._server is None or now - self._last_hb_poll < self._interval:
-            return
+            return False
         self._last_hb_poll = now
+        from ..common.basics import HorovodInternalError
         from ..runner.rendezvous import read_heartbeats
 
         try:
-            for rank, ts in read_heartbeats(self._server.store).items():
-                self.stall_inspector.record_heartbeat(rank, ts)
-            self.stall_inspector.check()
+            heartbeats = read_heartbeats(self._server.store)
         except Exception:
             _log.debug("heartbeat poll failed", exc_info=True)
+            return False
+        for rank, ts in heartbeats.items():
+            self.stall_inspector.record_heartbeat(rank, ts)
+        try:
+            self.stall_inspector.check()
+        except HorovodInternalError as e:
+            # NOT swallowed: silence past the shutdown threshold is a
+            # worker failure; escalate to the gang-restart path.
+            _log.error("stall escalation: %s", e)
+            return True
+        return False
 
     def _reset(self, reason: str) -> bool:
         """Bump epoch and clear the assignment so the loop relaunches.

@@ -1,10 +1,14 @@
 """Model zoo mirroring the reference's benchmark/example configs
 (BASELINE.json: MNIST ConvNet, ResNet-50, BERT-large, GPT-2 medium,
-ViT-B/16; ref: examples/pytorch/pytorch_mnist.py,
+ViT-B/16; plus the reference's published-scaling models Inception V3 /
+ResNet-101 / VGG-16 — docs/benchmarks.rst [V], BASELINE.md reference
+table; ref: examples/pytorch/pytorch_mnist.py,
 examples/pytorch/pytorch_synthetic_benchmark.py [V]), implemented
 TPU-first in flax: bfloat16-friendly, static shapes, remat hooks."""
 
+from .inception import InceptionV3  # noqa: F401
 from .mnist import MNISTConvNet  # noqa: F401
-from .resnet import ResNet50  # noqa: F401
+from .resnet import ResNet50, ResNet101  # noqa: F401
 from .transformer import Transformer, TransformerConfig  # noqa: F401
+from .vgg import VGG16  # noqa: F401
 from .vit import ViT, ViTConfig  # noqa: F401

@@ -1,0 +1,184 @@
+"""Inception V3 — the reference's headline scaling model
+(ref: docs/benchmarks.rst + the README scaling figure: Inception V3 at
+~90% of linear on 128 GPUs [V]; BASELINE.md reference table row 1).
+
+TPU-first choices: NHWC, bf16 compute, BN via the shared
+``SyncBatchNorm`` (fp32 stats, fused bf16 normalize — models/resnet.py),
+branch concatenation on the trailing (lane) axis so every tower feeds
+the MXU without relayout. The factorized 7×1/1×7 and 3×1/1×3 towers are
+kept — they are MXU-friendly (long contractions) — while the aux
+classifier head is omitted (a training-regularizer, not a capability;
+the reference's benchmark path doesn't exercise it either [V]).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .resnet import SyncBatchNorm
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: Any = "SAME"
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(
+            self.features, tuple(self.kernel), strides=tuple(self.strides),
+            padding=self.padding, use_bias=False, dtype=self.dtype,
+        )(x)
+        x = SyncBatchNorm(axis_name=self.axis_name, dtype=self.dtype)(
+            x, use_running_average=not train
+        )
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, axis_name=self.axis_name, dtype=self.dtype)
+        b1 = conv(64, (1, 1))(x, train)
+        b2 = conv(64, (5, 5))(conv(48, (1, 1))(x, train), train)
+        b3 = conv(96, (3, 3))(
+            conv(96, (3, 3))(conv(64, (1, 1))(x, train), train), train
+        )
+        pool = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = conv(self.pool_features, (1, 1))(pool, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35→17."""
+
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, axis_name=self.axis_name, dtype=self.dtype)
+        b1 = conv(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = conv(96, (3, 3), strides=(2, 2), padding="VALID")(
+            conv(96, (3, 3))(conv(64, (1, 1))(x, train), train), train
+        )
+        pool = nn.max_pool(x, (3, 3), (2, 2))
+        return jnp.concatenate([b1, b2, pool], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7×7 towers."""
+
+    channels_7x7: int
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, axis_name=self.axis_name, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = conv(192, (1, 1))(x, train)
+        b2 = conv(c7, (1, 1))(x, train)
+        b2 = conv(c7, (1, 7))(b2, train)
+        b2 = conv(192, (7, 1))(b2, train)
+        b3 = conv(c7, (1, 1))(x, train)
+        b3 = conv(c7, (7, 1))(b3, train)
+        b3 = conv(c7, (1, 7))(b3, train)
+        b3 = conv(c7, (7, 1))(b3, train)
+        b3 = conv(192, (1, 7))(b3, train)
+        pool = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = conv(192, (1, 1))(pool, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17→8."""
+
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, axis_name=self.axis_name, dtype=self.dtype)
+        b1 = conv(320, (3, 3), strides=(2, 2), padding="VALID")(
+            conv(192, (1, 1))(x, train), train
+        )
+        b2 = conv(192, (1, 1))(x, train)
+        b2 = conv(192, (1, 7))(b2, train)
+        b2 = conv(192, (7, 1))(b2, train)
+        b2 = conv(192, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        pool = nn.max_pool(x, (3, 3), (2, 2))
+        return jnp.concatenate([b1, b2, pool], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded 8×8 blocks with split 1×3 / 3×1 branches."""
+
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, axis_name=self.axis_name, dtype=self.dtype)
+        b1 = conv(320, (1, 1))(x, train)
+        b2 = conv(384, (1, 1))(x, train)
+        b2 = jnp.concatenate(
+            [conv(384, (1, 3))(b2, train), conv(384, (3, 1))(b2, train)],
+            axis=-1,
+        )
+        b3 = conv(448, (1, 1))(x, train)
+        b3 = conv(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate(
+            [conv(384, (1, 3))(b3, train), conv(384, (3, 1))(b3, train)],
+            axis=-1,
+        )
+        pool = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b4 = conv(192, (1, 1))(pool, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, axis_name=self.axis_name, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem: 299 -> 35 spatial.
+        x = conv(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        # 3x InceptionA
+        x = InceptionA(32, self.axis_name, self.dtype)(x, train)
+        x = InceptionA(64, self.axis_name, self.dtype)(x, train)
+        x = InceptionA(64, self.axis_name, self.dtype)(x, train)
+        x = InceptionB(self.axis_name, self.dtype)(x, train)
+        # 4x InceptionC
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7, self.axis_name, self.dtype)(x, train)
+        x = InceptionD(self.axis_name, self.dtype)(x, train)
+        x = InceptionE(self.axis_name, self.dtype)(x, train)
+        x = InceptionE(self.axis_name, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )

@@ -1,0 +1,55 @@
+"""VGG family — the reference's hardest-scaling benchmark model
+(ref: docs/benchmarks.rst — VGG-16 reaches only ~68% of linear at 128
+GPUs because its 138M params make allreduce dominate [V]; BASELINE.md
+reference table row 3). Useful here for exactly that reason: it
+stress-tests the fusion buffer and gradient-collective path with a
+param:FLOP ratio an order worse than ResNet's.
+
+TPU-first choices: NHWC, bf16 compute with fp32 head, the classifier's
+two 4096-wide Dense layers are plain MXU matmuls (the reference's
+cuDNN-era grouping has no analog to translate).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Stage widths and conv counts for the 16-layer configuration "D"
+# (the one the reference benchmarks [V]).
+_VGG16_STAGES: Tuple[Tuple[int, int], ...] = (
+    (64, 2), (128, 2), (256, 3), (512, 3), (512, 3)
+)
+
+
+class VGG(nn.Module):
+    stages: Sequence[Tuple[int, int]] = _VGG16_STAGES
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    classifier_width: int = 4096
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for width, n_convs in self.stages:
+            for _ in range(n_convs):
+                x = nn.Conv(
+                    width, (3, 3), padding="SAME", dtype=self.dtype
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), (2, 2))
+        x = x.reshape(x.shape[0], -1)
+        for _ in range(2):
+            x = nn.Dense(self.classifier_width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+
+
+def VGG16(**kwargs) -> VGG:
+    return VGG(stages=_VGG16_STAGES, **kwargs)

@@ -251,6 +251,12 @@ class FusionManager:
                 self.cache_evictions,
                 len(self._executors),
             )
+        from ..common.metrics import registry as _metrics
+
+        _metrics.update("fusion", self.cache_stats())
+        _metrics.gauge("fusion.cycles", self.cycles)
+        _metrics.gauge("fusion.last_flush_bytes", flushed_bytes)
+        _metrics.maybe_dump()
         if self.parameter_manager is not None:
             self.parameter_manager.record(
                 bytes_=flushed_bytes, seconds=time.monotonic() - t0
@@ -390,8 +396,10 @@ class FusionManager:
             # (zero is Adasum's identity).
             ranks = self._pset_ranks(e0)
             sub = self._sub_mesh(ranks)
+            # mask deliberately NOT in the key: masking is applied to
+            # member_buf before the call, the compiled fn is identical.
             key = ("adasum_pset", e0.prescale, e0.postscale, ranks,
-                   mask, buf.shape, buf.dtype.name)
+                   buf.shape, buf.dtype.name)
             member_buf = jnp.take(buf, jnp.asarray(ranks), axis=0)
             if mask is not None:
                 keep = jnp.asarray(

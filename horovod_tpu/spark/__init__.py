@@ -66,42 +66,56 @@ class LocalStore(Store):
     """Local/NFS filesystem store (ref: LocalStore [V])."""
 
 
+def _accepts_train(module) -> bool:
+    import inspect
+
+    try:
+        return "train" in inspect.signature(type(module).__call__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 class TpuModel:
     """The servable result of ``TpuEstimator.fit`` (ref: the Estimator's
     returned ``TorchModel``/``KerasModel`` transformers [V]): holds the
-    trained params and a jitted predict."""
+    trained params plus any auxiliary variable collections (e.g.
+    batch_stats) and a jitted predict."""
 
-    def __init__(self, module, params, batch_stats=None):
+    def __init__(self, module, params, collections=None):
         import jax
 
         self.module = module
         self.params = params
-        self.batch_stats = batch_stats
+        self.collections = dict(collections or {})
+        eval_kwargs = {"train": False} if _accepts_train(module) else {}
 
-        def _apply(params, batch_stats, x):
-            variables = {"params": params}
-            if batch_stats:
-                variables["batch_stats"] = batch_stats
-                return module.apply(
-                    variables, x, train=False
-                )
-            return module.apply(variables, x)
+        def _apply(params, collections, x):
+            return module.apply(
+                {"params": params, **collections}, x, **eval_kwargs
+            )
 
         self._predict = jax.jit(_apply)
+
+    # kept for round-2 callers
+    @property
+    def batch_stats(self):
+        return self.collections.get("batch_stats")
 
     def predict(self, x):
         import numpy as _np
 
         return _np.asarray(
-            self._predict(self.params, self.batch_stats, _np.asarray(x))
+            self._predict(self.params, self.collections, _np.asarray(x))
         )
 
     def save(self, path: str) -> None:
         from ..checkpoint import CheckpointManager
 
+        tree = {"params": self.params}
+        if self.collections:
+            tree["collections"] = self.collections
         with CheckpointManager(path, async_save=False) as mgr:
-            mgr.save(0, {"params": self.params,
-                         "batch_stats": self.batch_stats or {}})
+            mgr.save(0, tree)
 
     @classmethod
     def load(cls, module, path: str):
@@ -109,8 +123,7 @@ class TpuModel:
 
         with CheckpointManager(path, async_save=False) as mgr:
             tree = mgr.restore()
-        return cls(module, tree["params"],
-                   tree.get("batch_stats") or None)
+        return cls(module, tree["params"], tree.get("collections") or {})
 
 
 class TpuEstimator:
@@ -203,22 +216,57 @@ class TpuEstimator:
             sample = np.asarray(x[0][0])
 
         rng = jax.random.PRNGKey(self.seed)
-        params = self.model.init(rng, jnp.asarray(sample))["params"]
+        model = self.model
+        train_kwargs = {"train": True} if _accepts_train(model) else {}
+        init_kwargs = {"train": False} if _accepts_train(model) else {}
+        variables = model.init(
+            {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
+            jnp.asarray(sample),
+            **init_kwargs,
+        )
+        params = variables["params"]
+        # Auxiliary collections (batch_stats etc.) thread through the
+        # step as mutable state — BN/dropout models train out of the box.
+        collections = {k: v for k, v in variables.items() if k != "params"}
+        mutable = sorted(collections)
         params = jax.device_put(params, replicated)
+        collections = jax.device_put(collections, replicated)
         opt_state = jax.device_put(opt.init(params), replicated)
         loss_fn = self.loss
-
-        model = self.model
+        dropout_rng = jax.random.fold_in(rng, 2)
 
         @jax.jit
-        def train_step(params, opt_state, xb, yb):
+        def train_step(params, collections, opt_state, xb, yb):
             def objective(p):
-                preds = model.apply({"params": p}, xb)
-                return loss_fn(preds, yb)
+                if mutable:
+                    preds, mutated = model.apply(
+                        {"params": p, **collections},
+                        xb,
+                        mutable=mutable,
+                        rngs={"dropout": dropout_rng},
+                        **train_kwargs,
+                    )
+                else:
+                    preds = model.apply(
+                        {"params": p},
+                        xb,
+                        rngs={"dropout": dropout_rng},
+                        **train_kwargs,
+                    )
+                    mutated = {}
+                return loss_fn(preds, yb), mutated
 
-            loss, grads = jax.value_and_grad(objective)(params)
+            (loss, mutated), grads = jax.value_and_grad(
+                objective, has_aux=True
+            )(params)
             updates, opt_state2 = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state2, loss
+            new_cols = {**collections, **mutated}
+            return (
+                optax.apply_updates(params, updates),
+                new_cols,
+                opt_state2,
+                loss,
+            )
 
         mgr = None
         if self.store is not None:
@@ -238,19 +286,22 @@ class TpuEstimator:
                 for xb, yb in batches:
                     xb = jax.device_put(np.asarray(xb), data_sharding)
                     yb = jax.device_put(np.asarray(yb), data_sharding)
-                    params, opt_state, loss = train_step(
-                        params, opt_state, xb, yb
+                    params, collections, opt_state, loss = train_step(
+                        params, collections, opt_state, xb, yb
                     )
                     epoch_losses.append(float(loss))
                 mean_loss = float(np.mean(epoch_losses or [np.nan]))
                 self.history.append({"epoch": epoch, "loss": mean_loss})
                 if mgr is not None and (epoch + 1) % self.checkpoint_every == 0:
-                    mgr.save(epoch, {"params": params})
+                    tree = {"params": params}
+                    if collections:
+                        tree["collections"] = collections
+                    mgr.save(epoch, tree)
         finally:
             if mgr is not None:
                 mgr.close()
 
-        return TpuModel(self.model, params)
+        return TpuModel(self.model, params, collections)
 
 
 def basics_world_axis() -> str:

@@ -149,6 +149,22 @@ def _sync_batch_norm_base():
                     f"expected {self.num_features} channels, got "
                     f"{x.shape[1]}"
                 )
+            if not self.training and not self.track_running_stats:
+                # torch semantics: no running stats -> eval normalizes
+                # with LOCAL batch statistics and performs NO collective
+                # (torch.nn.SyncBatchNorm only syncs in training).
+                dims = [0] + list(range(2, x.dim()))
+                mean = x.mean(dims)
+                var = x.var(dims, unbiased=False)
+                shape = [1, -1] + [1] * (x.dim() - 2)
+                out = (x - mean.reshape(shape)) * torch.rsqrt(
+                    var + self.eps
+                ).reshape(shape)
+                if self.affine:
+                    out = out * self.weight.reshape(shape) + (
+                        self.bias.reshape(shape)
+                    )
+                return out
             if not self.training and self.track_running_stats:
                 shape = [1, -1] + [1] * (x.dim() - 2)
                 invstd = 1.0 / torch.sqrt(self.running_var + self.eps)
@@ -172,7 +188,7 @@ def _sync_batch_norm_base():
                 [
                     local_sum.detach(),
                     local_sumsq.detach(),
-                    torch.tensor([count_local], dtype=local_sum.dtype),
+                    local_sum.new_tensor([count_local]),
                 ]
             )
             fused_g = _allreduce_sum(fused).to(fused.dtype)

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Capture the full benchmark matrix on the real TPU chip and commit-able
+# artifacts under bench_results/ (round-N tag as $1, default r03).
+#
+# The chip is exclusive and a killed process wedges its claim for
+# minutes (docs/perf.md), so: one bench at a time, no kills, generous
+# waits between. Each bench prints ONE JSON line; we tee it into its
+# artifact and fail loudly on empty output (the r02 lesson: an empty
+# artifact is worse than none).
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+tag="${1:-r03}"
+mkdir -p bench_results
+
+capture() {
+  local name="$1"; shift
+  local out="bench_results/${name}_${tag}.json"
+  echo "=== $name -> $out" >&2
+  "$@" > "$out".tmp 2> "bench_results/${name}_${tag}.err"
+  local line
+  line=$(grep -E '^\{' "$out".tmp | tail -1 || true)
+  if [ -z "$line" ]; then
+    echo "FAILED: $name produced no JSON line" >&2
+    tail -5 "bench_results/${name}_${tag}.err" >&2
+    rm -f "$out".tmp
+    return 1
+  fi
+  # multi-line benches (allreduce sweep) keep every JSON line
+  grep -E '^\{' "$out".tmp > "$out"
+  rm -f "$out".tmp
+  echo "$line" >&2
+}
+
+capture resnet50    env BENCH_INNER=1 python bench.py
+capture bert_large  env BENCH_MODEL=bert_large python bench_lm.py
+capture gpt2_medium env BENCH_MODEL=gpt2_medium python bench_lm.py
+capture allreduce   python bench_allreduce.py
+echo "matrix done" >&2

@@ -145,3 +145,48 @@ def test_heartbeat_kv_roundtrip():
         assert all(abs(time.time() - t) < 60 for t in hb.values())
     finally:
         server.stop()
+
+
+def test_metrics_registry_and_export(tmp_path):
+    from horovod_tpu.common.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("a.calls")
+    reg.counter("a.calls", 2)
+    reg.gauge("b.depth", 7)
+    reg.update("cache", {"hits": 3, "misses": 1})
+    snap = reg.snapshot()
+    assert snap["a.calls"] == 3.0
+    assert snap["b.depth"] == 7.0
+    assert snap["cache.hits"] == 3.0
+    # no sink configured → dump is a no-op
+    assert reg.dump() is None
+    path = str(tmp_path / "metrics.jsonl")
+    reg.configure_export(path)
+    assert reg.dump() == path
+    import json
+
+    lines = [json.loads(l) for l in open(path)]
+    assert {l["name"] for l in lines} >= {"a.calls", "b.depth", "cache.hits"}
+
+
+def test_fusion_publishes_metrics(hvd, monkeypatch, tmp_path):
+    """Every flush publishes cycle/cache gauges; HOROVOD_METRICS_FILE
+    exports them as JSON lines (SURVEY §5.5 metrics row)."""
+    import json
+
+    import horovod_tpu as hvd_mod
+    from horovod_tpu.common.metrics import registry
+
+    path = str(tmp_path / "m.jsonl")
+    registry.configure_export(path)
+    try:
+        x = np.stack([np.full((4,), float(r)) for r in range(8)])
+        hvd_mod.allreduce(x, op=hvd_mod.Sum)
+        hvd_mod.common.basics.state().fusion.flush()
+        snap = registry.snapshot()
+        assert snap.get("fusion.cycles", 0) >= 1
+        lines = [json.loads(l) for l in open(path)]
+        assert any(l["name"] == "fusion.cycles" for l in lines)
+    finally:
+        registry.configure_export("")  # clear sink

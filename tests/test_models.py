@@ -171,3 +171,59 @@ def test_resnet_space_to_depth_equivalent_function_class():
     np.testing.assert_allclose(
         np.asarray(y_ref), np.asarray(y_s2d), rtol=1e-5, atol=1e-5
     )
+
+
+def test_vgg16_forward_and_train_step():
+    """VGG-16 — the reference's 68%-scaling benchmark model
+    (docs/benchmarks.rst [V]): forward shape + one grad step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import VGG16
+
+    m = VGG16(num_classes=13, classifier_width=64, dtype=jnp.float32)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 32, 32, 3)), jnp.float32
+    )
+    v = m.init(jax.random.PRNGKey(0), x, train=False)
+    y = m.apply(v, x, train=False)
+    assert y.shape == (2, 13)
+    # 16 weight layers: 13 convs + 3 dense
+    n_layers = len(jax.tree_util.tree_leaves(v["params"])) // 2
+    assert n_layers == 16
+
+    def loss(p):
+        out = m.apply(
+            {"params": p}, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)}
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, jnp.zeros(2, jnp.int32)
+        ).mean()
+
+    g = jax.grad(loss)(v["params"])
+    assert all(
+        bool(jnp.isfinite(leaf).all()) for leaf in jax.tree_util.tree_leaves(g)
+    )
+
+
+def test_inception_v3_forward_shapes():
+    """Inception V3 — the reference's headline ~90%-scaling model
+    (docs/benchmarks.rst [V]): 299x299 input → 1000 logits, batch-stats
+    collection works, param count ≈ 23.8M (torchvision parity ±5%)."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import InceptionV3
+
+    m = InceptionV3(dtype=jnp.float32)
+    x = jnp.zeros((1, 299, 299, 3), jnp.float32)
+    v = jax.eval_shape(lambda: m.init(jax.random.PRNGKey(0), x, train=False))
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(v["params"])
+    )
+    assert 22.5e6 < n_params < 25.5e6, n_params
+    logits_shape = jax.eval_shape(
+        lambda vv: m.apply(vv, x, train=False), v
+    )
+    assert tuple(logits_shape.shape) == (1, 1000)

@@ -118,3 +118,38 @@ def test_fit_with_one_shot_generator(hvd):
     assert len(est.history) == 3
     # every epoch saw all 3 batches — no nan, no empty epochs
     assert all(np.isfinite(h["loss"]) for h in est.history)
+
+
+class _BNNet(nn.Module):
+    """BatchNorm + Dropout model — the stateful-collections case the
+    round-3 review flagged (batch_stats must thread through fit)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Dense(16)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.1, deterministic=not train)(x)
+        return nn.Dense(1)(x)
+
+
+def test_fit_stateful_model_with_batchnorm_and_dropout(hvd, tmp_path):
+    x, y = _data(n=128)
+    est = TpuEstimator(
+        model=_BNNet(), loss=_mse, epochs=6, batch_size=32,
+        store=LocalStore(str(tmp_path / "s")), run_id="bn",
+    )
+    model = est.fit(x, y)
+    assert all(np.isfinite(h["loss"]) for h in est.history)
+    assert est.history[-1]["loss"] < est.history[0]["loss"]
+    # batch_stats came back and predict uses running averages
+    assert model.batch_stats is not None
+    preds = model.predict(x[:4])
+    assert preds.shape == (4, 1)
+    # save/load round-trips the collections too
+    p = str(tmp_path / "served")
+    model.save(p)
+    loaded = TpuModel.load(_BNNet(), p)
+    np.testing.assert_allclose(
+        loaded.predict(x[:4]), preds, rtol=1e-6
+    )
