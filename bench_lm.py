@@ -103,6 +103,19 @@ def main():
     from _benchlib import aot_compile, mfu_fields
 
     step, flops = aot_compile(step, params, opt_state, toks, labels)
+    flops_note = None
+    if flops and cfg.flash_attention in (True, "auto"):
+        # The Pallas flash-attention kernels are custom calls — invisible
+        # to XLA cost analysis — so add their matmul FLOPs analytically:
+        # fwd 2 matmuls (QKᵀ, PV) = 4·b·s²·d, bwd ≈ 2× fwd (dq/dk/dv +
+        # blockwise recompute), halved for causal masking.
+        attn = 12.0 * batch * world * (seq**2) * cfg.d_model * cfg.num_layers
+        if cfg.causal:
+            attn /= 2.0
+        flops += attn
+        flops_note = (
+            "xla_cost_analysis + analytic flash-attention matmul flops"
+        )
     params, opt_state, loss = step(params, opt_state, toks, labels)
     jax.block_until_ready(loss)  # warm (already compiled AOT)
     t0 = time.perf_counter()
@@ -121,6 +134,8 @@ def main():
         "platform": jax.devices()[0].platform,
     }
     result.update(mfu_fields(flops, iters, dt, jax.devices()[0].platform))
+    if flops_note:
+        result["flops_note"] = flops_note
     print(json.dumps(result))
 
 

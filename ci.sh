@@ -35,6 +35,13 @@ native() {
     csrc/timeline.cc csrc/kvstore.cc csrc/sha256.cc csrc/tsan_stress.cc \
     -o "$tsan_bin"
   TSAN_OPTIONS="halt_on_error=1" "$tsan_bin"
+  step "native: AddressSanitizer stress (same driver)"
+  local asan_bin
+  asan_bin="$(mktemp -d)/asan_stress"
+  g++ -std=c++17 -g -O1 -fsanitize=address,undefined -pthread \
+    csrc/timeline.cc csrc/kvstore.cc csrc/sha256.cc csrc/tsan_stress.cc \
+    -o "$asan_bin"
+  ASAN_OPTIONS="halt_on_error=1" "$asan_bin"
 }
 
 tests() {

@@ -74,9 +74,10 @@ class MetricsRegistry:
         if not self._path:
             return None
         now = time.monotonic()
-        if now - self._last_dump < min_interval:
-            return None
-        self._last_dump = now
+        with self._lock:
+            if now - self._last_dump < min_interval:
+                return None
+            self._last_dump = now
         return self.dump()
 
     def dump(self, path: Optional[str] = None) -> Optional[str]:

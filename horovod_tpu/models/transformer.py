@@ -33,6 +33,11 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # Blockwise Pallas attention (ops/flash_attention.py): True/False,
+    # or "auto" = use it whenever no padding mask is passed (the flash
+    # path implements the causal mask itself; arbitrary padding masks
+    # stay on the dense path).
+    flash_attention: Any = "auto"
 
     @staticmethod
     def gpt2_medium() -> "TransformerConfig":
@@ -80,6 +85,28 @@ class MultiHeadAttention(nn.Module):
             (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
         )(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        use_flash = bool(cfg.flash_attention) and mask is None
+        if cfg.flash_attention and cfg.flash_attention != "auto" and (
+            mask is not None
+        ):
+            # Explicit True + padding mask: the flash kernel implements
+            # only the causal mask, so this degrades to the dense path.
+            # Loud, not silent.
+            import warnings
+
+            warnings.warn(
+                "flash_attention=True but a padding mask was passed; "
+                "falling back to dense attention (the flash path "
+                "supports the causal mask only)",
+                stacklevel=2,
+            )
+        if use_flash:
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=cfg.causal)
+            return nn.DenseGeneral(
+                cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
+            )(out)
         # scores in fp32 for softmax stability
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32

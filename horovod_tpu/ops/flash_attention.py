@@ -1,0 +1,326 @@
+"""Blockwise (flash) attention as Pallas TPU kernels, fwd + bwd.
+
+The hot op of the LM benchmark family (BASELINE.json configs #3/#4 —
+BERT-large, GPT-2 medium). The reference leans on cuDNN/torch SDPA for
+this (its CUDA kernels live outside the framework, cuda_kernels.cu is
+only scale/memcpy [V]); the TPU-native answer is a Pallas kernel pair
+implementing the FlashAttention-2 formulation:
+
+* forward: one pass over K/V blocks per Q block with the online
+  softmax (running max ``m``, running denominator ``l``), emitting the
+  output block and the per-row logsumexp. Attention probabilities are
+  never materialized in HBM — O(T) memory instead of O(T²).
+* backward: the standard two-kernel split — a dQ kernel gridded over Q
+  blocks and a dK/dV kernel gridded over K blocks — each recomputing
+  P = exp(S − lse) blockwise from the saved logsumexp (recompute beats
+  storing T² probabilities on an HBM-bound chip).
+
+Softmax statistics and accumulators run in fp32 regardless of input
+dtype (the MXU consumes bf16 operands; the VPU accumulates fp32).
+Kernels run in interpret mode off-TPU, so CPU tests exercise the same
+code path bit-for-bit (tests/test_flash_attention.py checks fwd+grads
+against the dense jnp oracle).
+
+Used by models.Transformer when ``TransformerConfig.flash_attention``
+is on (default: auto — enabled when no padding mask is passed).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    seq_k = k_ref.shape[1]
+    n_blocks = seq_k // block_k
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing
+        # (ceil((qi+1)·BQ / BK) covers exactly the unmasked columns).
+        n_blocks = jnp.minimum(
+            n_blocks, ((qi + 1) * block_q + block_k - 1) // block_k
+        )
+    d = q_ref.shape[-1]
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    seq_k = k_ref.shape[1]
+    n_blocks = seq_k // block_k
+    if causal:
+        n_blocks = jnp.minimum(
+            n_blocks, ((qi + 1) * block_q + block_k - 1) // block_k
+        )
+    d = q_ref.shape[-1]
+
+    def body(j, dq):
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, n_blocks, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    seq_q = q_ref.shape[1]
+    n_blocks = seq_q // block_q
+    start = 0
+    if causal:
+        # Q blocks strictly before this K block see none of it.
+        start = ki * block_k // block_q
+    d = k_ref.shape[-1]
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(
+            jnp.float32
+        )
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q)]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pick_block(seq: int, preferred: int) -> int:
+    b = min(preferred, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5)
+)
+def _flash_bhtd(q, k, v, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    bh, seq, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    n_q = seq // block_q
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_fwd_vjp(q, k, v, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_vjp(causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    bh, seq, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    # delta[i] = rowsum(dO ⊙ O) — plain XLA, it is one fused reduction.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )
+    n_q = seq // block_q
+    n_k = seq // block_k
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, n_k),
+        in_specs=[
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, seq), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_bhtd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Attention over [batch, seq, heads, head_dim] tensors (the model
+    layout), softmax scale 1/√d. Differentiable (custom VJP, blockwise
+    recompute). Sequence length must be divisible by the chosen block
+    sizes; blocks shrink automatically for short sequences."""
+    b, t, h, d = q.shape
+    block_q = _pick_block(t, block_q)
+    block_k = _pick_block(t, block_k)
+
+    def to_bhtd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out = _flash_bhtd(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, block_q, block_k
+    )
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

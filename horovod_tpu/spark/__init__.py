@@ -123,7 +123,11 @@ class TpuModel:
 
         with CheckpointManager(path, async_save=False) as mgr:
             tree = mgr.restore()
-        return cls(module, tree["params"], tree.get("collections") or {})
+        collections = tree.get("collections")
+        if not collections and tree.get("batch_stats"):
+            # round-2 checkpoints stored batch_stats at the top level
+            collections = {"batch_stats": tree["batch_stats"]}
+        return cls(module, tree["params"], collections or {})
 
 
 class TpuEstimator:
@@ -236,21 +240,25 @@ class TpuEstimator:
         dropout_rng = jax.random.fold_in(rng, 2)
 
         @jax.jit
-        def train_step(params, collections, opt_state, xb, yb):
+        def train_step(params, collections, opt_state, xb, yb, step):
+            # fresh dropout mask every step — a fixed key would prune
+            # the same units for the whole run
+            step_rng = jax.random.fold_in(dropout_rng, step)
+
             def objective(p):
                 if mutable:
                     preds, mutated = model.apply(
                         {"params": p, **collections},
                         xb,
                         mutable=mutable,
-                        rngs={"dropout": dropout_rng},
+                        rngs={"dropout": step_rng},
                         **train_kwargs,
                     )
                 else:
                     preds = model.apply(
                         {"params": p},
                         xb,
-                        rngs={"dropout": dropout_rng},
+                        rngs={"dropout": step_rng},
                         **train_kwargs,
                     )
                     mutated = {}
@@ -277,6 +285,7 @@ class TpuEstimator:
                 self.store.checkpoint_dir(self.run_id), async_save=False
             )
 
+        global_step = 0
         try:
             for epoch in range(self.epochs):
                 epoch_losses = []
@@ -287,8 +296,10 @@ class TpuEstimator:
                     xb = jax.device_put(np.asarray(xb), data_sharding)
                     yb = jax.device_put(np.asarray(yb), data_sharding)
                     params, collections, opt_state, loss = train_step(
-                        params, collections, opt_state, xb, yb
+                        params, collections, opt_state, xb, yb,
+                        jnp.asarray(global_step, jnp.int32),
                     )
+                    global_step += 1
                     epoch_losses.append(float(loss))
                 mean_loss = float(np.mean(epoch_losses or [np.nan]))
                 self.history.append({"epoch": epoch, "loss": mean_loss})

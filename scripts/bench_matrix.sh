@@ -32,8 +32,10 @@ capture() {
   echo "$line" >&2
 }
 
-capture resnet50    env BENCH_INNER=1 python bench.py
-capture bert_large  env BENCH_MODEL=bert_large python bench_lm.py
-capture gpt2_medium env BENCH_MODEL=gpt2_medium python bench_lm.py
-capture allreduce   python bench_allreduce.py
-echo "matrix done" >&2
+fail=0
+capture resnet50    env BENCH_INNER=1 python bench.py        || fail=1
+capture bert_large  env BENCH_MODEL=bert_large python bench_lm.py  || fail=1
+capture gpt2_medium env BENCH_MODEL=gpt2_medium python bench_lm.py || fail=1
+capture allreduce   python bench_allreduce.py                 || fail=1
+echo "matrix done (fail=$fail)" >&2
+exit $fail

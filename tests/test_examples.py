@@ -61,3 +61,9 @@ def test_transformer_lm_example():
 def test_elastic_example():
     out = _run_example("elastic_train.py")
     assert "elastic training complete" in out
+
+
+@pytest.mark.slow
+def test_estimator_example():
+    out = _run_example("estimator_train.py", "--epochs", "2")
+    assert "save/load round-trip ok" in out

@@ -227,3 +227,30 @@ def test_inception_v3_forward_shapes():
         lambda vv: m.apply(vv, x, train=False), v
     )
     assert tuple(logits_shape.shape) == (1, 1000)
+
+
+def test_transformer_flash_matches_dense_path():
+    """flash_attention='auto' must be numerically consistent with the
+    dense path (same params, same tokens)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    cfg_dense = dataclasses.replace(
+        TransformerConfig.tiny(causal=True), flash_attention=False
+    )
+    cfg_flash = dataclasses.replace(cfg_dense, flash_attention=True)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, size=(2, 32)), jnp.int32
+    )
+    params = Transformer(cfg_dense).init(
+        jax.random.PRNGKey(0), tokens, train=False
+    )
+    out_d = Transformer(cfg_dense).apply(params, tokens, train=False)
+    out_f = Transformer(cfg_flash).apply(params, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_d), rtol=2e-4, atol=2e-4
+    )
