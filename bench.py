@@ -63,10 +63,11 @@ def inner_main():
     from horovod_tpu import models as model_zoo
 
     image_size = 224
+    stem = os.environ.get("BENCH_STEM", "conv7")  # or space_to_depth
     if model_name == "resnet50":
-        model = model_zoo.ResNet50(dtype=jnp.bfloat16)
+        model = model_zoo.ResNet50(dtype=jnp.bfloat16, stem=stem)
     elif model_name == "resnet101":
-        model = model_zoo.ResNet101(dtype=jnp.bfloat16)
+        model = model_zoo.ResNet101(dtype=jnp.bfloat16, stem=stem)
     elif model_name == "inception_v3":
         model = model_zoo.InceptionV3(dtype=jnp.bfloat16)
         image_size = 299

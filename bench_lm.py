@@ -46,6 +46,10 @@ def main():
     else:
         cfg = TransformerConfig.bert_large()
     cfg = dataclasses_replace(cfg, remat=not os.environ.get("BENCH_TINY"))
+    if os.environ.get("BENCH_FLASH", "auto") in ("0", "false", "off"):
+        # escape hatch: dense attention (e.g. if the Pallas kernel
+        # misbehaves on a new libtpu)
+        cfg = dataclasses_replace(cfg, flash_attention=False)
     seq = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_len, 512))))
 
     # The BASELINE pairing: BERT-large exercises Adasum, GPT-2 medium the
@@ -104,7 +108,10 @@ def main():
 
     step, flops = aot_compile(step, params, opt_state, toks, labels)
     flops_note = None
-    if flops and cfg.flash_attention in (True, "auto"):
+    uses_pallas_flash = cfg.flash_attention is True or (
+        cfg.flash_attention == "auto" and jax.default_backend() == "tpu"
+    )
+    if flops and uses_pallas_flash:
         # The Pallas flash-attention kernels are custom calls — invisible
         # to XLA cost analysis — so add their matmul FLOPs analytically:
         # fwd 2 matmuls (QKᵀ, PV) = 4·b·s²·d, bwd ≈ 2× fwd (dq/dk/dv +

@@ -1,0 +1,83 @@
+"""TensorFlow-2-shim MNIST — the reference's canonical TF2 example,
+ported by changing one import (ref:
+examples/tensorflow2/tensorflow2_mnist.py [V]: init →
+DistributedGradientTape → broadcast_variables after first step).
+
+Synthetic MNIST-shaped data keeps the example hermetic (no downloads).
+
+Run (CPU simulation): JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/tensorflow2_mnist.py --steps 20
+"""
+
+import argparse
+import os
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def build_model():
+    return tf.keras.Sequential(
+        [
+            tf.keras.layers.Conv2D(8, 3, activation="relu"),
+            tf.keras.layers.MaxPooling2D(),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(10),
+        ]
+    )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--batch-size", type=int, default=64)
+    args = parser.parse_args()
+
+    hvd.init()
+    tf.random.set_seed(0)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(512, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(512,))
+    x += y[:, None, None, None] * 0.1
+
+    model = build_model()
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True
+    )
+    opt = tf.keras.optimizers.SGD(learning_rate=0.01 * hvd.size())
+
+    first = True
+    losses = []
+    for step in range(args.steps):
+        idx = rng.integers(0, 512, size=(args.batch_size,))
+        xb = tf.constant(x[idx])
+        yb = tf.constant(y[idx])
+        with tf.GradientTape() as tape:
+            loss = loss_obj(yb, model(xb, training=True))
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first:
+            # Broadcast AFTER the first step so optimizer slots exist —
+            # the reference's documented ordering [V].
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+            first = False
+        losses.append(float(loss))
+        if step % 10 == 0:
+            print(f"step {step}: loss {losses[-1]:.4f}")
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+    print("tf2 shim example done")
+
+
+if __name__ == "__main__":
+    main()

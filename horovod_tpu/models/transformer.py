@@ -34,9 +34,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     # Blockwise Pallas attention (ops/flash_attention.py): True/False,
-    # or "auto" = use it whenever no padding mask is passed (the flash
-    # path implements the causal mask itself; arbitrary padding masks
-    # stay on the dense path).
+    # or "auto" = use it on TPU whenever no padding mask is passed (the
+    # flash path implements the causal mask itself; arbitrary padding
+    # masks stay on the dense path, and off-TPU the interpret-mode
+    # kernel would only be overhead). True forces it on any backend.
     flash_attention: Any = "auto"
 
     @staticmethod
@@ -85,7 +86,14 @@ class MultiHeadAttention(nn.Module):
             (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
         )(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        use_flash = bool(cfg.flash_attention) and mask is None
+        if cfg.flash_attention == "auto":
+            import jax as _jax
+
+            use_flash = (
+                mask is None and _jax.default_backend() == "tpu"
+            )
+        else:
+            use_flash = bool(cfg.flash_attention) and mask is None
         if cfg.flash_attention and cfg.flash_attention != "auto" and (
             mask is not None
         ):

@@ -42,6 +42,25 @@ def _interpret() -> bool:
 _NEG_INF = -1e30
 
 
+def _causal_bound(qi, block_q, block_k, n_blocks):
+    """K-block iteration bound for causal masking: ceil((qi+1)·BQ / BK)
+    covers exactly the unmasked columns."""
+    return jnp.minimum(
+        n_blocks, ((qi + 1) * block_q + block_k - 1) // block_k
+    )
+
+
+def _apply_causal_mask(s, qi, j, block_q, block_k):
+    """Mask scores above the diagonal using global row/col indices."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_q, block_k):
     qi = pl.program_id(1)
@@ -49,11 +68,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     seq_k = k_ref.shape[1]
     n_blocks = seq_k // block_k
     if causal:
-        # Blocks strictly above the diagonal contribute nothing
-        # (ceil((qi+1)·BQ / BK) covers exactly the unmasked columns).
-        n_blocks = jnp.minimum(
-            n_blocks, ((qi + 1) * block_q + block_k - 1) // block_k
-        )
+        n_blocks = _causal_bound(qi, block_q, block_k, n_blocks)
     d = q_ref.shape[-1]
 
     def body(j, carry):
@@ -65,13 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             preferred_element_type=jnp.float32,
         )  # [BQ, BK]
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = _apply_causal_mask(s, qi, j, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -101,9 +110,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     seq_k = k_ref.shape[1]
     n_blocks = seq_k // block_k
     if causal:
-        n_blocks = jnp.minimum(
-            n_blocks, ((qi + 1) * block_q + block_k - 1) // block_k
-        )
+        n_blocks = _causal_bound(qi, block_q, block_k, n_blocks)
     d = q_ref.shape[-1]
 
     def body(j, dq):
@@ -114,13 +121,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             preferred_element_type=jnp.float32,
         )
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = _apply_causal_mask(s, qi, j, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -164,13 +165,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )  # [BQ, BK]
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = _apply_causal_mask(s, i, ki, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),

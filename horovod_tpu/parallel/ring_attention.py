@@ -9,51 +9,61 @@ around the ring with `ppermute` while accumulating attention online
 holds seq_len/sp keys — memory O(T/sp) with exact results, and each
 ppermute hop overlaps with the block's compute on ICI.
 
+Differentiation is a SECOND ring pass (custom VJP): the forward saves
+only (q, k, v, out, lse); the backward recomputes each block's
+probabilities from the logsumexp and rotates (k, v, dk, dv) together so
+every gradient block arrives back at its owner having accumulated all
+ranks' contributions. Without this, autodiff through the forward scan
+would checkpoint per-step score matrices — O(sp·T_local²) residuals,
+exactly the memory wall ring attention exists to avoid.
+
 Per-device code for use inside shard_map. Causal masking uses global
 positions derived from each block's rank of origin.
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
-    """q, k, v: [B, T_local, H, Dh] (this chip's sequence shard).
+def _ring_perm(sp):
+    return [(j, (j + 1) % sp) for j in range(sp)]
 
-    Returns [B, T_local, H, Dh] — exact softmax(QKᵀ)V over the full
-    (sp·T_local)-token sequence.
-    """
+
+def _block_scores(q, k_cur, scale, q_pos, k_pos, causal):
+    s = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q,
+            k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    return s
+
+
+def _ring_fwd_pass(q, k, v, axis_name, causal):
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     qf = q.astype(jnp.float32)
-
-    q_pos = my * t + jnp.arange(t)  # global positions of our queries
-
-    # Ring schedule: at step i we hold the block that originated on rank
-    # (my - i) mod sp; after computing we pass it to (my + 1) mod sp.
-    perm = [(j, (j + 1) % sp) for j in range(sp)]
+    q_pos = my * t + jnp.arange(t)
+    perm = _ring_perm(sp)
 
     def step(carry, i):
         k_cur, v_cur, out, m, denom = carry
         src = (my - i) % sp
         k_pos = src * t + jnp.arange(t)
-        scores = (
-            jnp.einsum(
-                "bqhd,bkhd->bhqk",
-                qf,
-                k_cur.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )
-        if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        scores = _block_scores(qf, k_cur, scale, q_pos, k_pos, causal)
         block_max = jnp.max(scores, axis=-1)  # [B,H,Tq]
         new_m = jnp.maximum(m, block_max)
         # With causal masking a whole block can be -inf; guard the exp.
@@ -71,8 +81,87 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     out0 = jnp.zeros((b, h, t, d), jnp.float32)
     m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
     denom0 = jnp.zeros((b, h, t), jnp.float32)
-    (_, _, out, _, denom), _ = lax.scan(
+    (_, _, out, m, denom), _ = lax.scan(
         step, (k, v, out0, m0, denom0), jnp.arange(sp)
     )
-    out = out / jnp.maximum(denom[..., None], 1e-30)
-    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+    denom_safe = jnp.maximum(denom, 1e-30)
+    out = out / denom_safe[..., None]
+    # lse in the same guarded convention as the flash kernels
+    lse = jnp.where(jnp.isfinite(m), m, 0.0) + jnp.log(denom_safe)
+    return (
+        jnp.einsum("bhqd->bqhd", out).astype(q.dtype),
+        lse,  # [B, H, Tq] fp32
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+    """q, k, v: [B, T_local, H, Dh] (this chip's sequence shard).
+
+    Returns [B, T_local, H, Dh] — exact softmax(QKᵀ)V over the full
+    (sp·T_local)-token sequence. Differentiable via the second-ring-pass
+    VJP (module docstring)."""
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_attention_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_attention_bwd(axis_name, causal, res, do):
+    q, k, v, out, lse = res
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    q_pos = my * t + jnp.arange(t)
+    perm = _ring_perm(sp)
+    # delta = rowsum(dO ⊙ O) per query row — [B,H,Tq]
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", dof, out.astype(jnp.float32)
+    )
+
+    def step(carry, i):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        src = (my - i) % sp
+        k_pos = src * t + jnp.arange(t)
+        s = _block_scores(qf, k_cur, scale, q_pos, k_pos, causal)
+        p = jnp.exp(s - lse[..., None])  # [B,H,Tq,Tk]; masked → 0
+        dp = jnp.einsum(
+            "bqhd,bkhd->bhqk", dof, v_cur.astype(jnp.float32)
+        )
+        ds = p * (dp - delta[..., None])
+        dq = dq + scale * jnp.einsum(
+            "bhqk,bkhd->bqhd", ds, k_cur.astype(jnp.float32)
+        )
+        dk_cur = dk_cur + scale * jnp.einsum(
+            "bhqk,bqhd->bkhd", ds, qf
+        )
+        dv_cur = dv_cur + jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        # The gradient blocks travel WITH their K/V blocks; after sp
+        # hops every block is home with all contributions on board.
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        dk_next = lax.ppermute(dk_cur, axis_name, perm)
+        dv_next = lax.ppermute(dv_cur, axis_name, perm)
+        return (k_next, v_next, dk_next, dv_next, dq), None
+
+    dk0 = jnp.zeros((b, t, h, d), jnp.float32)
+    dv0 = jnp.zeros((b, t, h, d), jnp.float32)
+    dq0 = jnp.zeros((b, t, h, d), jnp.float32)
+    (k_back, v_back, dk, dv, dq), _ = lax.scan(
+        step, (k, v, dk0, dv0, dq0), jnp.arange(sp)
+    )
+    del k_back, v_back
+    return (
+        dq.astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+    )
+
+
+ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)

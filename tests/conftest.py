@@ -46,3 +46,24 @@ def hvd():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def dense_attention_oracle(q, k, v, causal):
+    """Shared dense-attention reference for the kernel/parallel tests:
+    fp32 scores, -1e30 causal fill (matching the flash kernels'
+    finite mask constant)."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+    ).astype(q.dtype)

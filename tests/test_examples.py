@@ -67,3 +67,18 @@ def test_elastic_example():
 def test_estimator_example():
     out = _run_example("estimator_train.py", "--epochs", "2")
     assert "save/load round-trip ok" in out
+
+
+@pytest.mark.slow
+def test_torch_mnist_example():
+    pytest.importorskip("torch")
+    out = _run_example("torch_mnist.py", "--epochs", "1", "--batch-size",
+                       "128")
+    assert "torch shim example done" in out
+
+
+@pytest.mark.slow
+def test_tensorflow2_mnist_example():
+    pytest.importorskip("tensorflow")
+    out = _run_example("tensorflow2_mnist.py", "--steps", "25")
+    assert "tf2 shim example done" in out

@@ -11,19 +11,7 @@ import pytest
 from horovod_tpu.ops.flash_attention import flash_attention
 
 
-def dense_attention(q, k, v, causal):
-    d = q.shape[-1]
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) / jnp.sqrt(d).astype(jnp.float32)
-    if causal:
-        t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
-        q.dtype
-    )
+from conftest import dense_attention_oracle as dense_attention
 
 
 def _rand(shape, seed):
