@@ -108,10 +108,7 @@ def main():
 
     step, flops = aot_compile(step, params, opt_state, toks, labels)
     flops_note = None
-    uses_pallas_flash = cfg.flash_attention is True or (
-        cfg.flash_attention == "auto" and jax.default_backend() == "tpu"
-    )
-    if flops and uses_pallas_flash:
+    if flops and cfg.uses_flash():
         # The Pallas flash-attention kernels are custom calls — invisible
         # to XLA cost analysis — so add their matmul FLOPs analytically:
         # fwd 2 matmuls (QKᵀ, PV) = 4·b·s²·d, bwd ≈ 2× fwd (dq/dk/dv +

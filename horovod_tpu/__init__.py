@@ -97,6 +97,7 @@ from .ops.eager import (  # noqa: F401
 )
 from .optimizer import (  # noqa: F401
     DistributedOptimizer,
+    allgather_object,
     broadcast_object,
     broadcast_optimizer_state,
     broadcast_parameters,

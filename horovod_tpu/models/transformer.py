@@ -40,6 +40,17 @@ class TransformerConfig:
     # kernel would only be overhead). True forces it on any backend.
     flash_attention: Any = "auto"
 
+    def uses_flash(self, mask=None) -> bool:
+        """THE gating rule for the Pallas flash path — single source
+        of truth for the model and for bench_lm's FLOPs correction."""
+        if mask is not None:
+            return False
+        if self.flash_attention == "auto":
+            import jax as _jax
+
+            return _jax.default_backend() == "tpu"
+        return bool(self.flash_attention)
+
     @staticmethod
     def gpt2_medium() -> "TransformerConfig":
         """BASELINE.json config #4 (GPT-2 medium, 345M)."""
@@ -86,14 +97,7 @@ class MultiHeadAttention(nn.Module):
             (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
         )(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        if cfg.flash_attention == "auto":
-            import jax as _jax
-
-            use_flash = (
-                mask is None and _jax.default_backend() == "tpu"
-            )
-        else:
-            use_flash = bool(cfg.flash_attention) and mask is None
+        use_flash = cfg.uses_flash(mask)
         if cfg.flash_attention and cfg.flash_attention != "auto" and (
             mask is not None
         ):

@@ -325,3 +325,22 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
     from .runner.rendezvous import broadcast_via_kv  # pragma: no cover
 
     return broadcast_via_kv(obj, root_rank, name)  # pragma: no cover
+
+
+def allgather_object(obj, name: Optional[str] = None):
+    """Gather one arbitrary object per rank into a list ordered by rank
+    (ref: horovod/torch/functions.py allgather_object,
+    pickle-over-allgather [V]). Under the single controller this process
+    speaks for every rank, so the list is [obj] * size; multi-controller
+    jobs gather pickles through the rendezvous KV like broadcast_object.
+    """
+    import jax as _jax
+
+    from .common import basics
+
+    if _jax.process_count() == 1:
+        world = basics.size() if basics.is_initialized() else 1
+        return [obj] * world
+    from .runner.rendezvous import allgather_via_kv  # pragma: no cover
+
+    return allgather_via_kv(obj, name)  # pragma: no cover

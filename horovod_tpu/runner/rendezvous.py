@@ -341,3 +341,44 @@ def read_heartbeats(store_or_client) -> Dict[int, float]:
         except (ValueError, UnicodeDecodeError):
             continue
     return out
+
+
+def allgather_via_kv(obj, name: Optional[str] = None):
+    """Object allgather through the rendezvous KV — the multi-controller
+    backend of ``hvd.allgather_object`` (ref: horovod/torch/functions.py
+    allgather_object [V]). Every process publishes its pickled object
+    under its lead rank; all poll until the full set is present. Same
+    HMAC trust model as broadcast_via_kv."""
+    import pickle
+
+    from ..common import basics
+
+    cfg = basics.get_config()
+    if not cfg.rendezvous_addr or not cfg.rendezvous_port:
+        raise RuntimeError(
+            "allgather_object across processes needs the runner's "
+            "rendezvous (HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT not set)"
+        )
+    secret = (
+        bytes.fromhex(cfg.secret_key_hex) if cfg.secret_key_hex else None
+    )
+    client = RendezvousClient(
+        cfg.rendezvous_addr, cfg.rendezvous_port, secret_key=secret
+    )
+    base = "allgather_object" if name is None else name
+    count = _broadcast_counts.get(base, 0)
+    _broadcast_counts[base] = count + 1
+    scope = f"{base}.{count}"
+    topo = basics.topology()
+    client.put(scope, str(topo.rank), pickle.dumps(obj))
+    out = []
+    for r in range(topo.cross_size):
+        lead = r * topo.local_size
+        payload = client.wait(
+            scope, str(lead), timeout=cfg.gloo_timeout_seconds
+        )
+        # One entry PER RANK (size, not cross_size): each controller
+        # speaks for local_size ranks, so its payload repeats — the
+        # same contract as the single-controller [obj]*size path.
+        out.extend([pickle.loads(payload)] * topo.local_size)
+    return out

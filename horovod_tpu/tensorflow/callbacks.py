@@ -1,0 +1,174 @@
+"""Keras callbacks for the TF shim — real ``keras.callbacks.Callback``
+subclasses (ref: horovod/tensorflow/keras/callbacks.py [V]): the four
+the reference ships, adapted to the shim's collectives so
+``model.fit(callbacks=[...])`` works unchanged for a ported script.
+
+The framework-neutral twins in :mod:`horovod_tpu.callbacks` serve JAX
+training loops; these serve Keras's callback protocol (on_train_begin /
+on_epoch_end with a mutable ``logs`` dict, ``model.optimizer`` LR
+mutation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from . import allreduce, broadcast_variables
+from ..ops.reduction_ops import Average
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast model + optimizer variables from root_rank on the
+    first batch (ref: the same-named callback [V] — after a rank-0
+    restore, every worker starts identical)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_batch_end(self, batch, logs=None):
+        # After the first step the optimizer has created its slots;
+        # broadcasting then covers them too (the reference broadcasts
+        # on_batch_end of batch 0 for exactly this reason [V]).
+        if not self._done:
+            broadcast_variables(self.model.variables, self.root_rank)
+            if getattr(self.model, "optimizer", None) is not None:
+                broadcast_variables(
+                    self.model.optimizer.variables, self.root_rank
+                )
+            self._done = True
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Average epoch metrics across workers before logging (ref:
+    MetricAverageCallback [V])."""
+
+    def __init__(self, process_set=None):
+        super().__init__()
+        self.process_set = process_set
+
+    def on_epoch_end(self, epoch, logs: Optional[dict] = None):
+        if not logs:
+            return
+        for key in list(logs.keys()):
+            value = logs[key]
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                avg = allreduce(
+                    tf.constant(float(value), tf.float32),
+                    op=Average,
+                    name=f"metric.{key}",
+                    process_set=self.process_set,
+                )
+                logs[key] = float(avg.numpy())
+
+
+class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
+    """Ramp LR from lr/world to lr over warmup_epochs (ref:
+    LearningRateWarmupCallback [V] — the gradual-warmup recipe of the
+    large-batch papers the reference cites)."""
+
+    def __init__(
+        self,
+        initial_lr: float,
+        warmup_epochs: int = 5,
+        momentum_correction: bool = True,
+        steps_per_epoch: Optional[int] = None,
+        verbose: bool = False,
+    ):
+        super().__init__()
+        self.initial_lr = float(initial_lr)
+        self.warmup_epochs = int(warmup_epochs)
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._epoch = 0.0
+        self._base_momentum = None
+        self._restored = False
+
+    def _set_lr(self, lr: float) -> None:
+        opt = self.model.optimizer
+        # Keras 3 exposes .learning_rate as a Variable
+        opt.learning_rate.assign(lr)
+        if self.momentum_correction and hasattr(opt, "momentum"):
+            # The reference rescales momentum with the LR during the
+            # ramp so the effective update magnitude tracks the target
+            # schedule (horovod keras callbacks, momentum_correction
+            # [V]), restoring it when warmup ends.
+            if self._base_momentum is None:
+                try:
+                    self._base_momentum = float(opt.momentum)
+                except (TypeError, ValueError):
+                    self._base_momentum = None
+            if self._base_momentum:
+                opt.momentum = self._base_momentum * (
+                    lr / self.initial_lr
+                )
+
+    def _restore_momentum(self) -> None:
+        opt = self.model.optimizer
+        if (
+            self.momentum_correction
+            and self._base_momentum
+            and hasattr(opt, "momentum")
+        ):
+            opt.momentum = self._base_momentum
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = float(epoch)
+        if epoch >= self.warmup_epochs and not self._restored:
+            # land exactly on initial_lr when the ramp completes
+            self.model.optimizer.learning_rate.assign(self.initial_lr)
+            self._restore_momentum()
+            self._restored = True
+
+    def on_batch_begin(self, batch, logs=None):
+        if self._epoch >= self.warmup_epochs:
+            return
+        from ..common import basics
+
+        size = basics.size() if basics.is_initialized() else 1
+        if self.steps_per_epoch:
+            # +1: the ramp hits exactly initial_lr on the LAST warmup
+            # batch (the reference's epoch + (batch+1)/steps recipe [V])
+            progress = self._epoch + (batch + 1) / self.steps_per_epoch
+        else:
+            progress = self._epoch
+        frac = min(progress / max(self.warmup_epochs, 1e-9), 1.0)
+        # lr(t) = initial_lr/size + frac · (initial_lr − initial_lr/size)
+        lr = self.initial_lr / size * (1 + frac * (size - 1))
+        self._set_lr(lr)
+        if self.verbose and batch == 0:
+            print(f"warmup epoch {self._epoch}: lr={lr:.6f}")
+
+
+class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
+    """Multiply the LR by ``multiplier(epoch)`` inside [start_epoch,
+    end_epoch) (ref: LearningRateScheduleCallback [V])."""
+
+    def __init__(
+        self,
+        initial_lr: float,
+        multiplier,
+        start_epoch: int = 0,
+        end_epoch: Optional[int] = None,
+    ):
+        super().__init__()
+        self.initial_lr = float(initial_lr)
+        self.multiplier = (
+            multiplier if callable(multiplier) else (lambda e: multiplier)
+        )
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if epoch < self.start_epoch:
+            return
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return
+        self.model.optimizer.learning_rate.assign(
+            self.initial_lr * float(self.multiplier(epoch))
+        )

@@ -373,6 +373,14 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     optimizer.load_state_dict(state_dict)
 
 
+def allgather_object(obj, name: Optional[str] = None):
+    """Gather one picklable object per rank, rank-ordered list (ref:
+    horovod/torch/functions.py allgather_object [V])."""
+    from ..optimizer import allgather_object as _ao
+
+    return _ao(obj, name=name)
+
+
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
     from ..optimizer import broadcast_object as _bo
 

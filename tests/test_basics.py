@@ -93,3 +93,9 @@ def test_global_process_set(hvd):
     assert gps.process_set_id == 0
     assert gps.size == 8
     assert gps.axis_index_groups(8) is None
+
+
+def test_allgather_object(hvd):
+    out = hvd.allgather_object({"rank_payload": 42})
+    assert isinstance(out, list) and len(out) == hvd.size()
+    assert all(o == {"rank_payload": 42} for o in out)

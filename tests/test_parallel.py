@@ -24,15 +24,7 @@ def mesh_1d(name, n=8):
     return Mesh(np.asarray(jax.devices()[:n]), (name,))
 
 
-def dense_attention(q, k, v, causal):
-    scale = 1.0 / np.sqrt(q.shape[-1])
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    if causal:
-        t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    p = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+from conftest import dense_attention_oracle as dense_attention
 
 
 @pytest.mark.parametrize("causal", [False, True])

@@ -176,3 +176,35 @@ def test_keras_distributed_optimizer_config_roundtrip(hvdtf):
     )
     cfg = opt.get_config()
     assert abs(float(cfg["learning_rate"]) - 0.01) < 1e-9
+
+
+def test_keras_callbacks_fit_roundtrip(hvdtf):
+    """The four Keras callbacks ride a real model.fit (ref:
+    horovod/tensorflow/keras/callbacks.py [V])."""
+    from horovod_tpu.tensorflow import callbacks as hvd_cb
+
+    keras = tf.keras
+    model = keras.Sequential([keras.layers.Dense(4, input_shape=(3,)),
+                              keras.layers.Dense(1)])
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.4),
+                  loss="mse")
+    x = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    cbs = [
+        hvd_cb.BroadcastGlobalVariablesCallback(0),
+        hvd_cb.MetricAverageCallback(),
+        hvd_cb.LearningRateWarmupCallback(initial_lr=0.4, warmup_epochs=2,
+                                          steps_per_epoch=4),
+        hvd_cb.LearningRateScheduleCallback(initial_lr=0.4,
+                                            multiplier=lambda e: 0.5 ** e,
+                                            start_epoch=2),
+    ]
+    hist = model.fit(x, y, epochs=3, batch_size=16, verbose=0,
+                     callbacks=cbs)
+    # schedule took over after warmup: epoch 2 multiplier 0.25
+    lr = float(model.optimizer.learning_rate.numpy())
+    assert abs(lr - 0.4 * 0.25) < 1e-6
+    # metrics were averaged (world of identical replicas → unchanged
+    # but numeric), and loss decreased
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0]
