@@ -108,6 +108,7 @@ from . import ops  # noqa: F401
 from .ops import traced  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.run / State, ref [V])
 from . import callbacks  # noqa: F401  (Keras-callback parity, ref [V])
+from . import data  # noqa: F401  (DistributedSampler analog + prefetch)
 from . import executor  # noqa: F401  (RayExecutor / spark.run parity, ref [V])
 from . import checkpoint  # noqa: F401  (durable ckpt — fills ref gap, SURVEY §5.4)
 from . import preemption  # noqa: F401  (TPU preemption → durable commit)

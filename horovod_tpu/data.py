@@ -1,0 +1,142 @@
+"""Data-sharding utilities: the input-pipeline half of the porting
+recipe.
+
+The reference leans on each framework's loader plus a rank-sharding
+idiom (ref: examples use
+``torch.utils.data.distributed.DistributedSampler(dataset,
+num_replicas=hvd.size(), rank=hvd.rank())`` [V]); the TPU-native
+equivalents here serve the same three needs without assuming torch:
+
+* :class:`ShardedIndexSampler` — the DistributedSampler analog: a
+  rank's epoch-shuffled slice of ``range(n)``, padded to equal length
+  (SPMD needs identical step counts everywhere).
+* :func:`shard_array` — slice host arrays by rank (the synthetic-data
+  examples' one-liner).
+* :func:`prefetch_to_device` — overlap host→device transfer with
+  compute by keeping ``size`` batches in flight (the tf.data
+  ``prefetch`` role for plain Python iterators).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class ShardedIndexSampler:
+    """Per-rank index sampler with epoch shuffling (ref:
+    DistributedSampler semantics [V]: equal-length shards, optional
+    shuffle keyed by (seed, epoch), padding by wrap-around)."""
+
+    def __init__(
+        self,
+        n: int,
+        num_replicas: Optional[int] = None,
+        rank: Optional[int] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        from .common import basics
+
+        self.n = int(n)
+        self.num_replicas = (
+            num_replicas if num_replicas is not None else basics.size()
+        )
+        self.rank = rank if rank is not None else basics.rank()
+        if not 0 <= self.rank < self.num_replicas:
+            raise ValueError(
+                f"rank {self.rank} out of range for "
+                f"{self.num_replicas} replicas"
+            )
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.num_samples = self.n // self.num_replicas
+        else:
+            self.num_samples = -(-self.n // self.num_replicas)  # ceil
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle differently each epoch (same contract as the
+        torch sampler — call before iterating)."""
+        self.epoch = int(epoch)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            order = rng.permutation(self.n)
+        else:
+            order = np.arange(self.n)
+        total = self.num_samples * self.num_replicas
+        if self.drop_last:
+            order = order[:total]
+        else:
+            # wrap-around padding so every rank sees num_samples items;
+            # np.resize repeats the permutation as many times as needed
+            # (n < num_replicas included — a single order[:pad] slice
+            # would underfill the high ranks and deadlock SPMD loops).
+            if total > self.n:
+                order = np.resize(order, total)
+        return iter(order[self.rank :: self.num_replicas].tolist())
+
+
+def shard_array(x, num_replicas: Optional[int] = None,
+                rank: Optional[int] = None):
+    """This rank's contiguous dim-0 shard of a host array (drops the
+    ragged tail so shards are equal — SPMD shape discipline)."""
+    from .common import basics
+
+    num_replicas = (
+        num_replicas if num_replicas is not None else basics.size()
+    )
+    rank = rank if rank is not None else basics.rank()
+    x = np.asarray(x)
+    per = x.shape[0] // num_replicas
+    if per == 0:
+        raise ValueError(
+            f"cannot shard dim0={x.shape[0]} across {num_replicas} ranks"
+        )
+    return x[rank * per : (rank + 1) * per]
+
+
+def prefetch_to_device(
+    iterator: Iterable,
+    size: int = 2,
+    devices=None,
+    sharding=None,
+):
+    """Wrap a host batch iterator so device transfer runs ahead of
+    compute: ``size`` batches are put on device before the first yield
+    and one more is enqueued per step (jax device puts are async, so
+    the copy of batch t+1 overlaps the compute of batch t).
+
+    ``sharding`` (a jax.sharding.Sharding) places each pytree leaf;
+    default is the first addressable device.
+    """
+    import jax
+
+    if sharding is None:
+        dev = (devices or jax.local_devices())[0]
+        put = lambda t: jax.device_put(t, dev)  # noqa: E731
+    else:
+        put = lambda t: jax.device_put(t, sharding)  # noqa: E731
+
+    queue = collections.deque()
+    it = iter(iterator)
+
+    def enqueue(k: int) -> None:
+        for batch in itertools.islice(it, k):
+            queue.append(jax.tree_util.tree_map(put, batch))
+
+    enqueue(max(int(size), 1))
+    while queue:
+        yield queue.popleft()
+        enqueue(1)
