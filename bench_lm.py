@@ -45,7 +45,13 @@ def main():
         cfg = TransformerConfig.gpt2_medium()
     else:
         cfg = TransformerConfig.bert_large()
-    cfg = dataclasses_replace(cfg, remat=not os.environ.get("BENCH_TINY"))
+    # remat trades FLOPs for memory; at bench batch sizes the model may
+    # fit without it, making it pure recompute overhead — BENCH_REMAT=0
+    # measures that. Default stays on (the large-model-safe setting).
+    remat = not os.environ.get("BENCH_TINY") and os.environ.get(
+        "BENCH_REMAT", "1"
+    ) not in ("0", "false", "off")
+    cfg = dataclasses_replace(cfg, remat=remat)
     if os.environ.get("BENCH_FLASH", "auto") in ("0", "false", "off"):
         # escape hatch: dense attention (e.g. if the Pallas kernel
         # misbehaves on a new libtpu)

@@ -1,0 +1,123 @@
+"""KerasEstimator — the TF half of the Estimator family.
+
+Parity target: ``horovod.spark.keras.KerasEstimator`` [V] (declare a
+compiled-able Keras model + optimizer + loss, call fit, get a servable
+model back, checkpoints through the Store). Rebuilt on the TF shim:
+the optimizer is wrapped with the shim's ``DistributedOptimizer``
+(gradient allreduce), training starts with the broadcast callback so
+every worker begins identical, and epoch metrics ride
+``MetricAverageCallback``.
+
+Data enters as arrays or a ``tf.data.Dataset`` — the Petastorm/
+DataFrame slot of the reference (scope: docs/design.md "Spark / Ray
+depth").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from . import Store
+
+
+class KerasModelWrapper:
+    """Servable result of :meth:`KerasEstimator.fit` (ref: the
+    KerasModel transformer [V])."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def predict(self, x):
+        return self.model.predict(x, verbose=0)
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.model.save(path)
+
+    @classmethod
+    def load(cls, path: str, custom_objects=None) -> "KerasModelWrapper":
+        # The saved compile config references the dynamic Distributed*
+        # optimizer class, which plain tf.keras.models.load_model can't
+        # resolve; the shim's load_model injects the reconstruction
+        # factories (the reference ships hvd.keras.load_model for the
+        # same reason [V]). compile=False: serving needs no optimizer.
+        import horovod_tpu.tensorflow as hvd_tf
+
+        return cls(
+            hvd_tf.load_model(
+                path, custom_objects=custom_objects, compile=False
+            )
+        )
+
+
+class KerasEstimator:
+    def __init__(
+        self,
+        model,
+        optimizer=None,
+        loss="mse",
+        metrics=None,
+        store: Optional[Store] = None,
+        run_id: str = "run",
+        epochs: int = 1,
+        batch_size: int = 32,
+        custom_objects: Optional[dict] = None,
+        verbose: int = 0,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = metrics or []
+        self.store = store
+        self.run_id = run_id
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        # held for KerasModelWrapper.load(path, custom_objects=...) —
+        # custom layers need them at deserialization time
+        self.custom_objects = custom_objects
+        self.verbose = verbose
+        self.history = None
+
+    def fit(self, x, y=None, validation_data=None) -> KerasModelWrapper:
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd
+        from horovod_tpu.tensorflow import callbacks as hvd_cb
+
+        hvd.init()
+        opt = self.optimizer or tf.keras.optimizers.Adam()
+        opt = hvd.DistributedOptimizer(opt)
+        self.model.compile(
+            optimizer=opt, loss=self.loss, metrics=self.metrics
+        )
+        callbacks = [
+            hvd_cb.BroadcastGlobalVariablesCallback(0),
+            hvd_cb.MetricAverageCallback(),
+        ]
+        ckpt_dir = None
+        if self.store is not None:
+            ckpt_dir = self.store.checkpoint_dir(self.run_id)
+            os.makedirs(ckpt_dir, exist_ok=True)
+            os.makedirs(self.store.logs_dir(self.run_id), exist_ok=True)
+            # weights-only: the wrapped optimizer is a dynamic
+            # subclass (DistributedX) that Keras can't deserialize;
+            # weights + architecture are the servable artifact anyway
+            callbacks.append(
+                tf.keras.callbacks.ModelCheckpoint(
+                    os.path.join(
+                        ckpt_dir, "ckpt-{epoch:03d}.weights.h5"
+                    ),
+                    save_weights_only=True,
+                )
+            )
+        self.history = self.model.fit(
+            x,
+            y,
+            epochs=self.epochs,
+            batch_size=self.batch_size if y is not None else None,
+            validation_data=validation_data,
+            callbacks=callbacks,
+            verbose=self.verbose,
+        )
+        return KerasModelWrapper(self.model)

@@ -194,13 +194,7 @@ class DistributedGradientTape:
     def _reduce_one(self, g):
         if g is None:
             return None
-        if isinstance(g, tf.IndexedSlices):
-            # The reference reduces IndexedSlices via allgather, or
-            # densifies under sparse_as_dense (horovod/tensorflow/
-            # __init__.py [V]). Embedding-layer gradients are the common
-            # source; densify-and-reduce keeps the wrapper a drop-in.
-            _warn_sparse_once()
-            g = tf.convert_to_tensor(g)
+        g = _densify(g)
         return allreduce(g, op=self._op, process_set=self._process_set)
 
     def gradient(self, target, sources, output_gradients=None, **kwargs):
@@ -214,6 +208,17 @@ class DistributedGradientTape:
             return type(grads)(reduced) if isinstance(
                 grads, tuple) else reduced
         return self._reduce_one(grads)
+
+
+def _densify(g):
+    """IndexedSlices → dense with a one-time warning — the reference
+    reduces sparse grads via allgather or densifies under
+    sparse_as_dense (horovod/tensorflow/__init__.py [V]); shared by the
+    tape and the Keras optimizer paths."""
+    if isinstance(g, tf.IndexedSlices):
+        _warn_sparse_once()
+        g = tf.convert_to_tensor(g)
+    return g
 
 
 _sparse_warned = False
@@ -233,6 +238,36 @@ def _warn_sparse_once() -> None:
         )
 
 
+def load_model(path, custom_objects=None, compile=True, **kwargs):
+    """Load a model saved while compiled with this module's
+    DistributedOptimizer (ref: horovod/tensorflow/keras/__init__.py
+    load_model [V] — the reference injects the same custom objects; a
+    plain tf.keras.models.load_model cannot know the dynamic
+    Distributed* classes). The deserialized optimizer is re-wrapped, so
+    training can resume distributed."""
+    objects = dict(custom_objects or {})
+
+    def _factory(base_cls):
+        # must look like a class: Keras deserialization calls
+        # cls.from_config(config) on registered custom objects
+        class _Reconstruct:
+            @classmethod
+            def from_config(cls, config, custom_objects=None):
+                return DistributedOptimizer(base_cls.from_config(config))
+
+        return _Reconstruct
+
+    for name in dir(tf.keras.optimizers):
+        base_cls = getattr(tf.keras.optimizers, name)
+        if isinstance(base_cls, type) and issubclass(
+            base_cls, tf.keras.optimizers.Optimizer
+        ):
+            objects.setdefault(f"Distributed{name}", _factory(base_cls))
+    return tf.keras.models.load_model(
+        path, custom_objects=objects, compile=compile, **kwargs
+    )
+
+
 def DistributedOptimizer(optimizer, op=None, process_set=None):
     """Wrap a Keras optimizer so apply_gradients() allreduces gradients
     first (ref: horovod/tensorflow/keras/__init__.py
@@ -245,26 +280,32 @@ def DistributedOptimizer(optimizer, op=None, process_set=None):
         _hvd_op = op
         _hvd_process_set = process_set
 
+        def _hvd_reduce(self, g):
+            g = _densify(g)
+            # model.fit traces apply_gradients into a tf.function; the
+            # shim's collectives are host bridges, so symbolic tensors
+            # route through py_function (same host round-trip either
+            # way — this is the documented cost profile of the shim).
+            if tf.executing_eagerly():
+                return allreduce(
+                    g, op=self._hvd_op, process_set=self._hvd_process_set
+                )
+            out = tf.py_function(
+                func=lambda t: allreduce(
+                    t, op=self._hvd_op, process_set=self._hvd_process_set
+                ),
+                inp=[g],
+                Tout=g.dtype,
+            )
+            out.set_shape(g.shape)
+            return out
+
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             pairs = list(grads_and_vars)
-            reduced = []
-            for g, v in pairs:
-                if g is None:
-                    reduced.append((g, v))
-                    continue
-                if isinstance(g, tf.IndexedSlices):
-                    _warn_sparse_once()
-                    g = tf.convert_to_tensor(g)
-                reduced.append(
-                    (
-                        allreduce(
-                            g,
-                            op=self._hvd_op,
-                            process_set=self._hvd_process_set,
-                        ),
-                        v,
-                    )
-                )
+            reduced = [
+                (g if g is None else self._hvd_reduce(g), v)
+                for g, v in pairs
+            ]
             return super().apply_gradients(reduced, *args, **kwargs)
 
     _DistributedKerasOptimizer.__name__ = (

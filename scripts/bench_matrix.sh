@@ -37,5 +37,9 @@ capture resnet50    env BENCH_INNER=1 python bench.py        || fail=1
 capture bert_large  env BENCH_MODEL=bert_large python bench_lm.py  || fail=1
 capture gpt2_medium env BENCH_MODEL=gpt2_medium python bench_lm.py || fail=1
 capture allreduce   python bench_allreduce.py                 || fail=1
+# exploratory second pass: no-remat LM variants (kept as separate
+# artifacts; the defaults above stay the comparable configuration)
+capture bert_large_noremat  env BENCH_MODEL=bert_large BENCH_REMAT=0 python bench_lm.py || true
+capture gpt2_medium_noremat env BENCH_MODEL=gpt2_medium BENCH_REMAT=0 python bench_lm.py || true
 echo "matrix done (fail=$fail)" >&2
 exit $fail

@@ -1,0 +1,74 @@
+"""KerasEstimator (ref: horovod/spark/keras/estimator.py [V]):
+declare-fit-predict with Store checkpointing on the TF shim."""
+
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from horovod_tpu.spark import LocalStore  # noqa: E402
+from horovod_tpu.spark.keras import (  # noqa: E402
+    KerasEstimator,
+    KerasModelWrapper,
+)
+
+
+def _model():
+    return tf.keras.Sequential(
+        [tf.keras.layers.Dense(8, activation="relu", input_shape=(3,)),
+         tf.keras.layers.Dense(1)]
+    )
+
+
+def test_keras_estimator_fit_predict_checkpoint(hvd, tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 3)).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    est = KerasEstimator(
+        model=_model(),
+        optimizer=tf.keras.optimizers.Adam(0.05),
+        loss="mse",
+        store=LocalStore(str(tmp_path / "store")),
+        run_id="k1",
+        epochs=3,
+        batch_size=32,
+    )
+    wrapper = est.fit(x, y)
+    losses = est.history.history["loss"]
+    assert losses[-1] < losses[0]
+    preds = wrapper.predict(x[:4])
+    assert preds.shape == (4, 1)
+    ckpts = os.listdir(est.store.checkpoint_dir("k1"))
+    assert any(c.endswith(".weights.h5") for c in ckpts)
+
+    path = str(tmp_path / "served.keras")
+    wrapper.save(path)
+    loaded = KerasModelWrapper.load(path)
+    np.testing.assert_allclose(
+        loaded.predict(x[:4]), preds, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_served_artifact_loads_with_hvd_load_model(hvd, tmp_path):
+    """The serving path for compiled-with-DistributedOptimizer saves is
+    hvd.load_model — it injects the Distributed* reconstruction
+    factories exactly like the reference's keras load_model [V], and
+    the result can resume distributed training (optimizer re-wrapped)."""
+    import horovod_tpu.tensorflow as hvd_tf
+
+    x = np.random.default_rng(1).normal(size=(32, 3)).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    est = KerasEstimator(model=_model(), loss="mse", epochs=1,
+                         batch_size=16)
+    wrapper = est.fit(x, y)
+    path = str(tmp_path / "plain.keras")
+    wrapper.save(path)
+    served = hvd_tf.load_model(path)  # compile=True: optimizer rebuilt
+    assert type(served.optimizer).__name__.startswith("Distributed")
+    preds = served.predict(x[:4], verbose=0)
+    np.testing.assert_allclose(preds, wrapper.predict(x[:4]), rtol=1e-5,
+                               atol=1e-6)
+    # and it can keep TRAINING distributed after reload
+    served.fit(x, y, epochs=1, batch_size=16, verbose=0)
