@@ -326,3 +326,55 @@ class TestDriverIntegration:
             assert result["rc"] == 0
         finally:
             d.shutdown()
+
+    def test_worker_sigkill_triggers_gang_restart(self, monkeypatch,
+                                                  tmp_path):
+        """§4.3's fault injection: SIGKILL a live worker PID mid-run;
+        the driver must detect the dead gang, reset, relaunch, and the
+        job must still complete (the reference's integration tests kill
+        worker PIDs exactly like this [V])."""
+        for k, v in _clean_env().items():
+            monkeypatch.setenv(k, v)
+        flag = tmp_path / "second_epoch"
+        script = tmp_path / "w.py"
+        # epoch 0: sleep forever (to be killed); epoch 1+: exit 0
+        script.write_text(
+            "import os, sys, time, pathlib\n"
+            f"flag = pathlib.Path({str(flag)!r})\n"
+            "if int(os.environ.get('HOROVOD_ELASTIC_EPOCH', '0')) >= 1:\n"
+            "    sys.exit(0)\n"
+            "flag.write_text('up')\n"
+            "time.sleep(120)\n"
+        )
+        # Two "hosts" (both local): the failed worker's host gets
+        # blacklisted, the surviving host carries the epoch-1 gang —
+        # the reference's kill-and-survive scenario shape [V].
+        d = ElasticDriver(
+            FakeDiscovery(
+                [HostInfo("localhost", 1), HostInfo("127.0.0.1", 1)]
+            ),
+            [sys.executable, str(script)],
+            min_np=1,
+            discovery_interval=0.2,
+        )
+        try:
+            d.host_manager.refresh()
+            import signal as _signal
+            import threading
+
+            result = {}
+            t = threading.Thread(target=lambda: result.update(rc=d.run()))
+            t.start()
+            deadline = time.monotonic() + 20
+            while not flag.exists() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert flag.exists(), "epoch-0 worker never came up"
+            with d._lock:
+                procs = list(d._procs)
+            assert procs
+            procs[0].send_signal(_signal.SIGKILL)
+            t.join(timeout=60)
+            assert not t.is_alive(), "driver did not recover from SIGKILL"
+            assert result["rc"] == 0  # epoch-1 relaunch exited clean
+        finally:
+            d.shutdown()
