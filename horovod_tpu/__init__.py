@@ -85,8 +85,12 @@ from .ops.eager import (  # noqa: F401
     broadcast_async_,
     first,
     flush,
+    grouped_allgather,
+    grouped_allgather_async,
     grouped_allreduce,
     grouped_allreduce_async,
+    grouped_reducescatter,
+    grouped_reducescatter_async,
     join,
     join_ranks,
     poll,

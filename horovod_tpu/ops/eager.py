@@ -162,6 +162,80 @@ def grouped_allreduce(tensors, *args, **kwargs):
     return [h.wait() for h in grouped_allreduce_async(tensors, *args, **kwargs)]
 
 
+def grouped_allgather_async(
+    tensors: Sequence,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> List[Handle]:
+    """Atomic multi-tensor allgather (ref: hvd.grouped_allgather,
+    upstream v0.28+ [V]): all members land in one cycle — begin_group
+    defers the threshold/cycle flush until the whole list is queued."""
+    fusion = _fusion()
+    base = _auto_name("grouped_allgather", name)
+    gid = fusion.begin_group()
+    handles: List[Handle] = []
+    try:
+        for i, t in enumerate(tensors):
+            h = allgather_async(
+                t, name=f"{base}.{i}", process_set=process_set
+            )
+            if h._entry is not None:
+                h._entry.group_id = gid
+            handles.append(h)
+    except Exception:
+        # a member failed validation: the group must not partially
+        # dispatch at end_group
+        fusion.abort_group(gid)
+        raise
+    finally:
+        fusion.end_group()
+    return handles
+
+
+def grouped_allgather(tensors, *args, **kwargs):
+    return [
+        h.wait() for h in grouped_allgather_async(tensors, *args, **kwargs)
+    ]
+
+
+def grouped_reducescatter_async(
+    tensors: Sequence,
+    op: Optional[ReduceOp] = None,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> List[Handle]:
+    """Atomic multi-tensor reduce-scatter (ref: hvd.grouped_reducescatter,
+    upstream v0.28+ [V]): all members complete in one cycle. Even-shape
+    members share the group's indivisible fused unit; members taking the
+    uneven (v-variant) fallback reduce via allreduce entries that may
+    fuse separately WITHIN the same cycle."""
+    fusion = _fusion()
+    base = _auto_name("grouped_reducescatter", name)
+    gid = fusion.begin_group()
+    handles: List[Handle] = []
+    try:
+        for i, t in enumerate(tensors):
+            h = reducescatter_async(
+                t, op=op, name=f"{base}.{i}", process_set=process_set
+            )
+            if getattr(h, "_entry", None) is not None:
+                h._entry.group_id = gid
+            handles.append(h)
+    except Exception:
+        fusion.abort_group(gid)
+        raise
+    finally:
+        fusion.end_group()
+    return handles
+
+
+def grouped_reducescatter(tensors, *args, **kwargs):
+    return [
+        h.wait()
+        for h in grouped_reducescatter_async(tensors, *args, **kwargs)
+    ]
+
+
 # ----------------------------------------------------------------- allgather
 
 

@@ -162,6 +162,17 @@ class FusionManager:
         self._next_group_id += 1
         return gid
 
+    def abort_group(self, gid: int) -> None:
+        """Drop an incompletely-enqueued group (a member failed
+        validation): its entries must not dispatch at end_group."""
+        kept = [e for e in self.pending if e.group_id != gid]
+        dropped = len(self.pending) - len(kept)
+        if dropped:
+            self.pending = kept
+            self.pending_bytes = sum(
+                int(e.payload.nbytes) for e in self.pending
+            )
+
     def end_group(self) -> None:
         self._group_depth = max(self._group_depth - 1, 0)
         if self._group_depth == 0 and (

@@ -64,6 +64,12 @@ def _replicated_payload(tensor):
     return _eager.replicate(np.asarray(tensor))
 
 
+def _concat_rows(host):
+    # eager allgather returns rank-major [world, n, ...]; the TF
+    # contract concatenates along dim 0 [V]
+    return host.reshape((-1,) + host.shape[2:])
+
+
 class _TFHandle:
     def __init__(self, inner, like, post=None):
         self._inner = inner
@@ -99,10 +105,7 @@ def allgather_async(tensor, name=None, process_set=None):
     handle = _eager.allgather_async(
         _replicated_payload(tensor), name=name, process_set=process_set
     )
-    return _TFHandle(
-        handle, tensor,
-        post=lambda host: host.reshape((-1,) + host.shape[2:]),
-    )
+    return _TFHandle(handle, tensor, post=_concat_rows)
 
 
 def allgather(tensor, name=None, process_set=None):
@@ -159,6 +162,45 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
         tf.convert_to_tensor(np.asarray(outputs[0]), dtype=tensor.dtype),
         tf.convert_to_tensor(np.asarray(recv_splits[0], dtype=np.int32)),
     )
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      process_set=None):
+    """Atomic multi-tensor allreduce (ref: hvd.grouped_allreduce in
+    horovod/tensorflow/mpi_ops.py [V]): one fused collective for the
+    whole list."""
+    handles = _eager.grouped_allreduce_async(
+        [_replicated_payload(t) for t in tensors],
+        average=average, name=name, op=op, process_set=process_set,
+    )
+    return [
+        _TFHandle(h, t).wait() for h, t in zip(handles, tensors)
+    ]
+
+
+def grouped_allgather(tensors, name=None, process_set=None):
+    """Atomic multi-tensor allgather (ref: hvd.grouped_allgather,
+    upstream v0.28+ [V])."""
+    handles = _eager.grouped_allgather_async(
+        [_replicated_payload(t) for t in tensors], name=name,
+        process_set=process_set,
+    )
+    return [
+        _TFHandle(h, t, post=_concat_rows).wait()
+        for h, t in zip(handles, tensors)
+    ]
+
+
+def grouped_reducescatter(tensors, op=None, name=None, process_set=None):
+    """Atomic multi-tensor reduce-scatter (ref: hvd.grouped_reducescatter,
+    upstream v0.28+ [V])."""
+    handles = _eager.grouped_reducescatter_async(
+        [_replicated_payload(t) for t in tensors], op=op, name=name,
+        process_set=process_set,
+    )
+    return [
+        _TFHandle(h, t).wait() for h, t in zip(handles, tensors)
+    ]
 
 
 def reducescatter(tensor, op=None, name=None, process_set=None):

@@ -207,17 +207,57 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
     ).wait()
 
 
+def _gather_post(host):
+    # eager allgather returns rank-major [world, n, ...]; torch's
+    # contract concatenates along dim 0 [V]
+    return host.reshape((-1,) + host.shape[2:])
+
+
+def grouped_allgather_async(tensors, name=None, process_set=None):
+    """Atomic multi-tensor allgather (ref: hvd.grouped_allgather,
+    upstream v0.28+ [V])."""
+    handles = _eager.grouped_allgather_async(
+        [_replicated_payload(t) for t in tensors], name=name,
+        process_set=process_set,
+    )
+    return _GroupedHandle(
+        [
+            _TorchHandle(h, t, post=_gather_post)
+            for h, t in zip(handles, tensors)
+        ]
+    )
+
+
+def grouped_allgather(tensors, name=None, process_set=None):
+    return grouped_allgather_async(
+        tensors, name=name, process_set=process_set
+    ).wait()
+
+
+def grouped_reducescatter_async(tensors, op=None, name=None,
+                                process_set=None):
+    """Atomic multi-tensor reduce-scatter (ref:
+    hvd.grouped_reducescatter, upstream v0.28+ [V])."""
+    handles = _eager.grouped_reducescatter_async(
+        [_replicated_payload(t) for t in tensors], op=op, name=name,
+        process_set=process_set,
+    )
+    return _GroupedHandle(
+        [_TorchHandle(h, t) for h, t in zip(handles, tensors)]
+    )
+
+
+def grouped_reducescatter(tensors, op=None, name=None, process_set=None):
+    return grouped_reducescatter_async(
+        tensors, op=op, name=name, process_set=process_set
+    ).wait()
+
+
 def allgather_async(tensor, name=None, process_set=None) -> _TorchHandle:
     handle = _eager.allgather_async(
         _replicated_payload(tensor), name=name, process_set=process_set
     )
-    # The eager result stacks per-rank rows [world, n, ...]; Horovod's
-    # torch allgather concatenates along dim 0 [V].
-    return _TorchHandle(
-        handle,
-        tensor,
-        post=lambda host: host.reshape((-1,) + host.shape[2:]),
-    )
+    return _TorchHandle(handle, tensor, post=_gather_post)
 
 
 def allgather(tensor, name=None, process_set=None):

@@ -287,3 +287,43 @@ def test_grouped_allreduce_single_fused_dispatch(hvd):
     hvd.grouped_allreduce(xs, op=hvd_mod.Sum)
     # one fused allreduce executor build, not three
     assert fusion.cache_misses == misses_before + 1
+
+
+def test_grouped_allgather(hvd):
+    """Atomic multi-tensor allgather (ref: hvd.grouped_allgather [V])."""
+    fusion = hvd_mod.common.basics.state().fusion
+    fusion.threshold_bytes = 64
+    before = fusion.cycles
+    xs = [
+        rank_major(lambda r: np.full((2, 3), float(r + i)))
+        for i in range(3)
+    ]
+    outs = hvd.grouped_allgather(xs)
+    assert fusion.cycles == before + 1
+    for i, out in enumerate(outs):
+        got = np.asarray(out[0]).reshape(8, 2, 3)
+        for r in range(8):
+            np.testing.assert_allclose(got[r], np.full((2, 3), float(r + i)))
+
+
+def test_grouped_reducescatter(hvd):
+    xs = [rank_major(lambda r: np.arange(16.0) + r + i) for i in range(2)]
+    outs = hvd.grouped_reducescatter(xs, op=hvd_mod.Sum)
+    for i, out in enumerate(outs):
+        reduced = 8 * np.arange(16.0) + 28.0 + 8 * i
+        np.testing.assert_allclose(np.asarray(out[3]), reduced[6:8])
+
+
+def test_grouped_allgather_aborts_cleanly_on_bad_member(hvd):
+    """A member failing validation mid-group must not leave earlier
+    members enqueued (partial 'atomic' group)."""
+    fusion = hvd_mod.common.basics.state().fusion
+    good = rank_major(lambda r: np.full((2,), float(r)))
+    bad = np.zeros((3,))  # wrong leading axis
+    with pytest.raises(ValueError, match="rank-major"):
+        hvd.grouped_allgather([good, bad])
+    assert fusion.pending == []
+    assert fusion.pending_bytes == 0
+    # the queue still works after the aborted group
+    out = hvd.allreduce(good, op=hvd_mod.Sum)
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(2, 28.0))

@@ -208,3 +208,20 @@ def test_keras_callbacks_fit_roundtrip(hvdtf):
     # but numeric), and loss decreased
     losses = hist.history["loss"]
     assert losses[-1] < losses[0]
+
+
+def test_grouped_ops_tf(hvdtf):
+    n = hvdtf.size()
+    outs = hvdtf.grouped_allreduce(
+        [tf.ones((2,)), tf.fill((3,), 2.0)], op=hvdtf.Sum
+    )
+    np.testing.assert_allclose(outs[0].numpy(), np.full(2, float(n)))
+    np.testing.assert_allclose(outs[1].numpy(), np.full(3, 2.0 * n))
+
+    gathered = hvdtf.grouped_allgather([tf.constant([[1.0, 2.0]])])
+    assert gathered[0].shape == (n, 2)
+
+    rs = hvdtf.grouped_reducescatter(
+        [tf.constant(np.arange(2.0 * n, dtype=np.float32))], op=hvdtf.Sum
+    )
+    np.testing.assert_allclose(rs[0].numpy(), np.arange(2.0) * n)

@@ -370,3 +370,24 @@ def test_sync_batch_norm_eval_uses_running_stats(hvdt):
          np.full(3, (1.0 + 1.0) / np.sqrt(0.25 + 1e-5))], axis=1
     )
     np.testing.assert_allclose(out.detach().numpy(), expected, rtol=1e-5)
+
+
+def test_grouped_allgather_torch(hvdt):
+    torch = pytest.importorskip("torch")
+    xs = [torch.full((2, 3), float(i)) for i in range(3)]
+    outs = hvdt.grouped_allgather(xs)
+    n = hvdt.size()
+    for i, out in enumerate(outs):
+        assert tuple(out.shape) == (2 * n, 3)
+        np.testing.assert_allclose(out.numpy(), np.full((2 * n, 3), float(i)))
+
+
+def test_grouped_reducescatter_torch(hvdt):
+    torch = pytest.importorskip("torch")
+    n = hvdt.size()
+    xs = [torch.arange(2.0 * n) + i for i in range(2)]
+    outs = hvdt.grouped_reducescatter(xs, op=hvdt.Sum)
+    for i, out in enumerate(outs):
+        # rank 0 shard of the world sum
+        expected = (np.arange(2.0) + i) * n
+        np.testing.assert_allclose(out.numpy(), expected)
