@@ -25,6 +25,23 @@ def peak_tflops(platform: str):
     return PEAK_BF16_TFLOPS.get(gen)
 
 
+def sync(x):
+    """Reliable device sync: force a host transfer of the first leaf of
+    ``x`` and return it as a float. ``jax.block_until_ready`` proved
+    advisory on the sandbox's axon PJRT tunnel (observed: a chained
+    10-step BERT-large loop "completing" in 2.8 ms/step under
+    block_until_ready vs 152 ms/step under a value dependency, measured
+    2026-07-30) — a host transfer of a value that data-depends on the
+    whole loop is the only sync the tunnel can't fake. Call it on the
+    final loss BEFORE starting the timer too: the first transfer also
+    drains the warmup queue."""
+    import jax
+    import numpy as np
+
+    leaf = jax.tree.leaves(x)[0]
+    return float(np.asarray(leaf).ravel()[0])
+
+
 def aot_compile(step_fn, *args):
     """AOT-compile a jitted fn once; returns (callable, flops_or_None).
     Falls back to the jitted fn itself on backends without AOT."""

@@ -122,19 +122,23 @@ def inner_main():
         train_step, params, batch_stats, opt_state, images, labels
     )
 
+    from _benchlib import sync as _sync
+
+    loss = None
     for _ in range(n_warmup):
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels
         )
-    if n_warmup > 0:
-        jax.block_until_ready(loss)
+    if loss is not None:
+        # host transfer: the only trustworthy sync (see _benchlib)
+        _sync(loss)
 
     t0 = time.perf_counter()
     for _ in range(n_iters):
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels
         )
-    jax.block_until_ready(loss)
+    _sync(loss)  # loss chains through every step's params
     dt = time.perf_counter() - t0
 
     img_per_sec = batch * n_iters / dt

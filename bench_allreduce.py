@@ -76,7 +76,7 @@ def main():
 
     from horovod_tpu.common.topology import WORLD_AXIS
     from horovod_tpu.ops import traced
-    from horovod_tpu.ops.reduction_ops import Sum
+    from horovod_tpu.ops.reduction_ops import Average
 
     devices = jax.devices()
     iters = int(os.environ.get("BENCH_ITERS", "30"))
@@ -101,6 +101,12 @@ def main():
         for nbytes in sizes:
             n = max(nbytes // 4, 1)  # float32 elements
 
+            # Average (same wire bytes as Sum) keeps the chained values
+            # stationary at 1.0: the timed loop feeds each reduce the
+            # previous output, so every iteration data-depends on the
+            # last — independent same-input calls would let the final
+            # sync cover only one of them (and block_until_ready is
+            # advisory on the axon tunnel anyway; see _benchlib.sync).
             @partial(
                 jax.shard_map,
                 mesh=mesh,
@@ -109,16 +115,20 @@ def main():
                 check_vma=False,
             )
             def reduce(x):
-                return traced.allreduce(x[0], op=Sum)[None]
+                return traced.allreduce(x[0], op=Average)[None]
 
             step = jax.jit(reduce)
             x = jnp.ones((world, n), jnp.float32)
             out = step(x)  # compile + warm
-            jax.block_until_ready(out)
+            # one chained call before timing: step(out) sees a committed
+            # sharded input — a different jit cache key than the fresh
+            # jnp.ones — and must be compiled OUTSIDE the timed region
+            out = step(out)
+            float(out[0, 0])  # scalar host transfer = trustworthy sync
             t0 = time.perf_counter()
             for _ in range(iters):
-                out = step(x)
-            jax.block_until_ready(out)
+                out = step(out)
+            float(out[0, 0])
             dt = (time.perf_counter() - t0) / iters
             busbw = nbytes * ring_factor(world) / dt / 1e9
             if nbytes == scale_size:

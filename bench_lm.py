@@ -126,12 +126,14 @@ def main():
         flops_note = (
             "xla_cost_analysis + analytic flash-attention matmul flops"
         )
+    from _benchlib import sync as _sync
+
     params, opt_state, loss = step(params, opt_state, toks, labels)
-    jax.block_until_ready(loss)  # warm (already compiled AOT)
+    _sync(loss)  # warm; host transfer is the only trustworthy sync
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, loss = step(params, opt_state, toks, labels)
-    jax.block_until_ready(loss)
+    _sync(loss)  # loss chains through every step's params
     dt = time.perf_counter() - t0
     samples_per_sec = batch * world * iters / dt
     result = {
@@ -141,6 +143,7 @@ def main():
         "batch": batch,
         "seq": seq,
         "world": world,
+        "remat": remat,
         "platform": jax.devices()[0].platform,
     }
     result.update(mfu_fields(flops, iters, dt, jax.devices()[0].platform))

@@ -41,6 +41,13 @@ def _interpret() -> bool:
 
 _NEG_INF = -1e30
 
+# Mosaic requires the last two dims of every block to be (8k, 128k) or
+# the full array dims. Row statistics (lse) are per-Q-row scalars, so
+# they ride a broadcast 128-lane minor dim — the same layout the
+# official jax.experimental.pallas.ops.tpu.flash_attention uses
+# (MIN_BLOCK_SIZE trailing dim on l/m).
+_STATS_LANES = 128
+
 
 def _causal_bound(qi, block_q, block_k, n_blocks):
     """K-block iteration bound for causal masking: ceil((qi+1)·BQ / BK)
@@ -81,32 +88,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         )  # [BQ, BK]
         if causal:
             s = _apply_causal_mask(s, qi, j, block_q, block_k)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l, acc
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    # stats stay 2-D [BQ, 1] throughout — Mosaic vectorizes 2-D shapes;
+    # 1-D vectors hit lowering gaps
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to(
+        m + jnp.log(l_safe), (block_q, _STATS_LANES)
+    )
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
                scale, causal, block_q, block_k):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0][:, 0:1]  # [BQ, 1] — lanes are broadcast copies
+    # delta[i] = rowsum(dO ⊙ O), computed in-kernel: cheaper than a
+    # broadcast [seq, 128] HBM array and the O block is already small
+    delta = jnp.sum(
+        do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True
+    )
     seq_k = k_ref.shape[1]
     n_blocks = seq_k // block_k
     if causal:
@@ -122,12 +137,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         )
         if causal:
             s = _apply_causal_mask(s, qi, j, block_q, block_k)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         return dq + scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -139,7 +154,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                 dk_ref, dv_ref, *, scale, causal, block_q, block_k):
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)  # [BK, D]
@@ -158,15 +173,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(
             jnp.float32
         )
-        lse = lse_ref[0, pl.dslice(i * block_q, block_q)]
-        delta = delta_ref[0, pl.dslice(i * block_q, block_q)]
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q), :][:, 0:1]
+        delta = jnp.sum(
+            do
+            * o_ref[0, pl.dslice(i * block_q, block_q), :].astype(
+                jnp.float32
+            ),
+            axis=-1,
+            keepdims=True,
+        )
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [BQ, BK]
         if causal:
             s = _apply_causal_mask(s, i, ki, block_q, block_k)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -175,7 +197,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dk = dk + scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -222,11 +244,13 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec(
+                (1, block_q, _STATS_LANES), lambda b, i: (b, i, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, seq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq, _STATS_LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -235,17 +259,20 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
 
 def _flash_fwd_vjp(q, k, v, causal, block_q, block_k):
     o, lse = _flash_fwd(q, k, v, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    # Keep ONE lane as the residual — the broadcast 128-lane layout is a
+    # Mosaic in-kernel constraint, not something worth holding across
+    # the whole forward pass (24 BERT-large layers of (bh, seq, 128)
+    # fp32 would be ~800 MB); re-broadcast transiently in the bwd.
+    return o, (q, k, v, o, lse[..., 0])
 
 
 def _flash_bwd_vjp(causal, block_q, block_k, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse_lane = res
+    lse = jnp.broadcast_to(
+        lse_lane[..., None], (*lse_lane.shape, _STATS_LANES)
+    )
     bh, seq, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    # delta[i] = rowsum(dO ⊙ O) — plain XLA, it is one fused reduction.
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    )
     n_q = seq // block_q
     n_k = seq // block_k
     dq = pl.pallas_call(
@@ -259,13 +286,15 @@ def _flash_bwd_vjp(causal, block_q, block_k, res, do):
             pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec(
+                (1, block_q, _STATS_LANES), lambda b, i: (b, i, 0)
+            ),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, o, lse)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
@@ -277,8 +306,10 @@ def _flash_bwd_vjp(causal, block_q, block_k, res, do):
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, seq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec(
+                (1, seq, _STATS_LANES), lambda b, i: (b, 0, 0)
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -289,7 +320,7 @@ def _flash_bwd_vjp(causal, block_q, block_k, res, do):
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, o, lse)
     return dq, dk, dv
 
 

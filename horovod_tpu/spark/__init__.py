@@ -16,6 +16,10 @@ follows (see also docs/design.md "Spark / Ray depth"):
   data-parallel training over the world mesh with batch sharding (XLA
   inserts the gradient collectives), Orbax checkpoints through the
   ``Store`` abstraction.
+* ``spark.keras.KerasEstimator`` / ``spark.torch.TorchEstimator`` —
+  the framework-shim halves of the Estimator family (TF and torch),
+  each broadcasting initial state and wrapping the shim's
+  ``DistributedOptimizer``.
 * ``Store`` / ``LocalStore`` — the reference's storage abstraction
   (``horovod/spark/common/store.py`` [V]): one object owning the
   checkpoint/log/run directories, local-FS or any fsspec-style mount.
