@@ -34,11 +34,15 @@ def sync(x):
     2026-07-30) — a host transfer of a value that data-depends on the
     whole loop is the only sync the tunnel can't fake. Call it on the
     final loss BEFORE starting the timer too: the first transfer also
-    drains the warmup queue."""
+    drains the warmup queue. Only ONE scalar crosses the wire: the leaf
+    is sliced on-device first, so syncing on a 128 MB allreduce buffer
+    doesn't pay a 128 MB transfer."""
     import jax
     import numpy as np
 
     leaf = jax.tree.leaves(x)[0]
+    if hasattr(leaf, "reshape"):
+        leaf = leaf.reshape(-1)[:1]
     return float(np.asarray(leaf).ravel()[0])
 
 

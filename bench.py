@@ -73,6 +73,10 @@ def inner_main():
         image_size = 299
     elif model_name == "vgg16":
         model = model_zoo.VGG16(dtype=jnp.bfloat16)
+    elif model_name == "vit_b16":
+        # BASELINE.json config #5's model (the elastic-bench pairing);
+        # LayerNorm-based, so the batch_stats collection stays empty.
+        model = model_zoo.ViT(model_zoo.ViTConfig.b16())
     else:
         raise SystemExit(f"unknown BENCH_MODEL {model_name!r}")
 

@@ -117,6 +117,8 @@ def main():
             def reduce(x):
                 return traced.allreduce(x[0], op=Average)[None]
 
+            from _benchlib import sync as _sync
+
             step = jax.jit(reduce)
             x = jnp.ones((world, n), jnp.float32)
             out = step(x)  # compile + warm
@@ -124,11 +126,11 @@ def main():
             # sharded input — a different jit cache key than the fresh
             # jnp.ones — and must be compiled OUTSIDE the timed region
             out = step(out)
-            float(out[0, 0])  # scalar host transfer = trustworthy sync
+            _sync(out)  # scalar host transfer = trustworthy sync
             t0 = time.perf_counter()
             for _ in range(iters):
                 out = step(out)
-            float(out[0, 0])
+            _sync(out)
             dt = (time.perf_counter() - t0) / iters
             busbw = nbytes * ring_factor(world) / dt / 1e9
             if nbytes == scale_size:

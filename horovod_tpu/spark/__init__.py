@@ -187,6 +187,7 @@ class TpuEstimator:
 
         from ..common import basics
 
+        self.history = []  # fresh per fit(): re-fit must not append
         basics.init()
         mesh = basics.topology().world_mesh()
         from jax.sharding import NamedSharding, PartitionSpec as P

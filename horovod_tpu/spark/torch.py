@@ -143,6 +143,7 @@ class TorchEstimator:
                 raise ValueError("empty batch iterable")
 
         model.train()
+        self.history = []  # fresh per fit(): re-fit must not append
         for epoch in range(self.epochs):
             epoch_losses = []
             batches = self._batches(x, y) if y is not None else iter(x)
@@ -154,6 +155,10 @@ class TorchEstimator:
                 loss.backward()
                 opt.step()
                 epoch_losses.append(float(loss.detach()))
+            # a step count not divisible by backward_passes_per_step
+            # leaves a partial window — flush it so the tail batches
+            # still contribute (and windows never span epochs)
+            opt.flush()
             # metric-average across workers (ref: the Estimator's
             # metric aggregation / MetricAverageCallback semantics [V])
             mean_loss = float(

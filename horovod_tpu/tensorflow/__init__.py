@@ -215,6 +215,14 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
     return _TFHandle(handle, tensor).wait()
 
 
+def __getattr__(name):  # PEP 562 — keeps the class build off import time
+    if name == "SyncBatchNormalization":
+        from .sync_batch_norm import SyncBatchNormalization
+
+        return SyncBatchNormalization
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 def join(joined_ranks=None) -> int:
     """API-parity join (ref: hvd.join [V]): flush outstanding work; with
     ``joined_ranks`` returns the last joined rank."""

@@ -1,0 +1,204 @@
+"""Cross-rank synchronized BatchNormalization for the TF shim.
+
+TPU-native rebuild of the reference's TF ``hvd.SyncBatchNormalization``
+(ref: horovod/tensorflow/sync_batch_norm.py [V]): batch statistics are
+reduced across all ranks in forward, and the two gradient reductions of
+the exact BN backward are likewise cross-rank, so every replica
+normalizes — and differentiates — with global-batch statistics. Like
+the torch shim's SyncBatchNorm (horovod_tpu/torch/sync_batch_norm.py),
+the forward stats ride ONE fused allreduce (sum | sumsq | count) and
+the backward one more (Σdy | Σdy·x̂); the host bridge is a
+``tf.py_function``, so the layer works in eager and inside
+``tf.function``/``model.fit`` graphs alike.
+
+Keras semantics are preserved: ``momentum`` is the Keras moving-average
+decay (``moving = moving·m + batch·(1−m)``), the moving variance stores
+the biased batch variance, and eval normalizes with the moving stats —
+with every rank seeing the same batch this layer is numerically
+identical to ``keras.layers.BatchNormalization`` (the reference's own
+equivalence contract).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+
+def _host_allreduce_sum(vec):
+    """Sum a 1-D float tensor across the mesh via the shim's eager path.
+
+    Runs as a py_function so it is legal inside tf.function graphs; the
+    inner body executes eagerly on the host (the same two-copy cost the
+    shim's module docstring owns up to).
+    """
+    from . import Sum, allreduce
+
+    def _np_sum(v):
+        return np.asarray(allreduce(v.numpy(), op=Sum))
+
+    out = tf.py_function(_np_sum, [vec], Tout=vec.dtype)
+    out.set_shape(vec.shape)
+    return out
+
+
+class SyncBatchNormalization(tf.keras.layers.Layer):
+    """Drop-in for ``keras.layers.BatchNormalization`` that synchronizes
+    batch statistics across all horovod ranks during training (ref:
+    horovod/tensorflow/sync_batch_norm.py [V])."""
+
+    def __init__(
+        self,
+        axis: int = -1,
+        momentum: float = 0.99,
+        epsilon: float = 1e-3,
+        center: bool = True,
+        scale: bool = True,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.axis = axis
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.center = center
+        self.scale = scale
+
+    def build(self, input_shape):
+        ndim = len(input_shape)
+        axis = self.axis % ndim
+        self._channel_axis = axis
+        dim = int(input_shape[axis])
+        self._dim = dim
+        self._reduce_axes = [a for a in range(ndim) if a != axis]
+        # broadcast shape for per-channel vectors
+        self._bshape = [1] * ndim
+        self._bshape[axis] = dim
+        if self.scale:
+            self.gamma = self.add_weight(
+                name="gamma", shape=(dim,), initializer="ones",
+                trainable=True,
+            )
+        else:
+            self.gamma = None
+        if self.center:
+            self.beta = self.add_weight(
+                name="beta", shape=(dim,), initializer="zeros",
+                trainable=True,
+            )
+        else:
+            self.beta = None
+        self.moving_mean = self.add_weight(
+            name="moving_mean", shape=(dim,), initializer="zeros",
+            trainable=False,
+        )
+        self.moving_variance = self.add_weight(
+            name="moving_variance", shape=(dim,), initializer="ones",
+            trainable=False,
+        )
+
+    def _affine(self, xhat, dtype):
+        out = xhat
+        if self.gamma is not None:
+            out = out * tf.reshape(tf.cast(self.gamma, dtype), self._bshape)
+        if self.beta is not None:
+            out = out + tf.reshape(tf.cast(self.beta, dtype), self._bshape)
+        return out
+
+    def call(self, inputs, training=None):
+        x = tf.convert_to_tensor(inputs)
+        dtype = x.dtype
+        if not training:
+            mean = tf.reshape(
+                tf.cast(self.moving_mean, dtype), self._bshape
+            )
+            invstd = tf.reshape(
+                tf.math.rsqrt(
+                    tf.cast(self.moving_variance, dtype) + self.epsilon
+                ),
+                self._bshape,
+            )
+            return self._affine((x - mean) * invstd, dtype)
+
+        c = self._dim
+        xf = tf.cast(x, tf.float32)
+        count_local = tf.cast(tf.size(xf) / c, tf.float32)
+        local_sum = tf.reduce_sum(xf, self._reduce_axes)
+        local_sumsq = tf.reduce_sum(xf * xf, self._reduce_axes)
+        # one fused allreduce for the forward stats [V]
+        fused = tf.concat(
+            [local_sum, local_sumsq, tf.reshape(count_local, (1,))], 0
+        )
+        fused_g = _host_allreduce_sum(tf.stop_gradient(fused))
+        n = fused_g[2 * c]
+        mean = fused_g[:c] / n
+        var = tf.maximum(fused_g[c : 2 * c] / n - mean * mean, 0.0)
+
+        # Keras moving-average semantics: biased batch variance, decay m
+        m = self.momentum
+        self.moving_mean.assign(self.moving_mean * m + mean * (1.0 - m))
+        self.moving_variance.assign(
+            self.moving_variance * m + var * (1.0 - m)
+        )
+
+        invstd = tf.math.rsqrt(var + self.epsilon)
+        mean_b = tf.reshape(mean, self._bshape)
+        invstd_b = tf.reshape(invstd, self._bshape)
+        reduce_axes = self._reduce_axes
+        bshape = self._bshape
+        gamma = self.gamma
+        beta = self.beta
+
+        @tf.custom_gradient
+        def _bn_train(x32, g, b):
+            xhat = (x32 - mean_b) * invstd_b
+            out = xhat * tf.reshape(g, bshape) + tf.reshape(b, bshape)
+
+            def grad(dy):
+                sum_dy = tf.reduce_sum(dy, reduce_axes)
+                sum_dy_xhat = tf.reduce_sum(dy * xhat, reduce_axes)
+                # the exact BN backward needs GLOBAL Σdy and Σdy·x̂ [V]
+                fused_bwd = _host_allreduce_sum(
+                    tf.concat([sum_dy, sum_dy_xhat], 0)
+                )
+                sum_dy_g = fused_bwd[:c]
+                sum_dy_xhat_g = fused_bwd[c:]
+                dx = (
+                    invstd_b
+                    * tf.reshape(g, bshape)
+                    * (
+                        dy
+                        - tf.reshape(sum_dy_g, bshape) / n
+                        - xhat * tf.reshape(sum_dy_xhat_g, bshape) / n
+                    )
+                )
+                # weight/bias grads stay LOCAL Σdy·x̂ / Σdy —
+                # DistributedOptimizer / DistributedGradientTape reduces
+                # parameter grads, exactly like the reference.
+                return dx, sum_dy_xhat, sum_dy
+
+            return out, grad
+
+        # center/scale-off cases pass identity coefficients: they are
+        # plain tensors (not variables), so their returned grads vanish
+        g32 = (
+            tf.cast(gamma, tf.float32)
+            if gamma is not None
+            else tf.ones((c,), tf.float32)
+        )
+        b32 = (
+            tf.cast(beta, tf.float32)
+            if beta is not None
+            else tf.zeros((c,), tf.float32)
+        )
+        return tf.cast(_bn_train(xf, g32, b32), dtype)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(
+            axis=self.axis,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            center=self.center,
+            scale=self.scale,
+        )
+        return cfg

@@ -477,6 +477,19 @@ class DistributedOptimizer:
                 buf.add_(p.grad)
         if self._micro < self._k:
             return None  # local aggregation window: skip comm + step
+        return self._reduce_and_step(closure)
+
+    def flush(self, closure=None):
+        """Force a pending partial aggregation window to reduce + step
+        now. Owners of the training loop (e.g. spark.TorchEstimator)
+        call this at epoch/run boundaries so a step count that doesn't
+        divide backward_passes_per_step can't silently discard the tail
+        window's gradients. No-op when the window is empty."""
+        if self._micro == 0:
+            return None
+        return self._reduce_and_step(closure)
+
+    def _reduce_and_step(self, closure=None):
         self._micro = 0
         handles = []
         if self._k > 1:

@@ -42,3 +42,56 @@ def test_empty_iterable_raises(hvd):
     est = TorchEstimator(model=_net(), epochs=1)
     with pytest.raises(ValueError, match="empty batch iterable"):
         est.fit(iter([]))
+
+
+def test_flush_applies_partial_window(hvd):
+    """3 steps with backward_passes_per_step=2: the tail microbatch's
+    gradient must land via flush(), not be silently discarded (review
+    finding). Closed form with SGD lr and constant grads."""
+    import horovod_tpu.torch as hvdt
+
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = hvdt.DistributedOptimizer(
+        torch.optim.SGD([p], lr=1.0), backward_passes_per_step=2
+    )
+    for _ in range(3):
+        opt.zero_grad()
+        p.grad = torch.ones(1)
+        opt.step()
+    # boundary at step 2 applied sum of two unit grads: p = -2
+    np.testing.assert_allclose(p.detach().numpy(), [-2.0])
+    opt.flush()
+    # flush applies the dangling third grad: p = -3
+    np.testing.assert_allclose(p.detach().numpy(), [-3.0])
+    # empty window: flush is a no-op
+    opt.flush()
+    np.testing.assert_allclose(p.detach().numpy(), [-3.0])
+
+
+def test_estimator_flushes_tail_window(hvd):
+    """96 samples / batch 32 / k=2 -> 3 steps per epoch: epoch loss must
+    keep decreasing because the tail batch still contributes."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(96, 4)).astype(np.float32)
+    w = rng.normal(size=(4, 1)).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    est = TorchEstimator(
+        model=_net(),
+        loss=torch.nn.MSELoss(),
+        optimizer=lambda p: torch.optim.SGD(p, lr=1e-2),
+        epochs=8,
+        batch_size=32,
+        backward_passes_per_step=2,
+    )
+    est.fit(x, y)
+    assert est.history[-1]["loss"] < est.history[0]["loss"] * 0.5
+
+
+def test_refit_resets_history(hvd):
+    x = np.zeros((64, 4), np.float32)
+    y = np.zeros((64, 1), np.float32)
+    est = TorchEstimator(model=_net(), epochs=2, batch_size=32)
+    est.fit(x, y)
+    est.fit(x, y)
+    assert len(est.history) == 2
+    assert [h["epoch"] for h in est.history] == [0, 1]
