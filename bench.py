@@ -193,7 +193,13 @@ def _extract_json(stdout):
 
 def orchestrate():
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "4"))
-    timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "600"))
+    # A legitimate run needs ~2 min (compile + measure); only a wedged
+    # chip-claim queue ever reaches the timeout — and KILLING a claiming
+    # client is what wedges the queue further (docs/perf.md, measured
+    # 2026-07-30: each kill costs every later client ~20 min). So the
+    # timeout must outlast the queue, not race it: 1800s rides out a
+    # full wedge cycle instead of perpetuating it.
+    timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1800"))
     forced = os.environ.get("BENCH_PLATFORM")
 
     base_env = dict(os.environ)
@@ -205,11 +211,11 @@ def orchestrate():
     last_err = ""
     for i in range(attempts):
         if i > 0:
-            # Stale chip claims take minutes to clear (measured: a
-            # killed process can wedge first-touch for ~5 min; the r02
-            # ladder of 30s+60s was too short — the driver's later run
-            # succeeded). 60/120/180s backs off ~6 min total.
-            delay = 60.0 * i
+            # Stale chip claims take many minutes to clear (measured
+            # 2026-07-30: ~20 min per wedge cycle; the r02 ladder of
+            # 30s+60s was hopeless). 120/240/360s between attempts on
+            # top of the 30-min in-attempt patience.
+            delay = 120.0 * i
             print(
                 f"bench: attempt {i} failed, retrying in {delay:.0f}s "
                 f"(TPU backend may be recovering a stale chip claim)",

@@ -56,6 +56,14 @@ def main():
         # escape hatch: dense attention (e.g. if the Pallas kernel
         # misbehaves on a new libtpu)
         cfg = dataclasses_replace(cfg, flash_attention=False)
+    if os.environ.get("BENCH_FLASH_BLOCK"):
+        bq = int(os.environ["BENCH_FLASH_BLOCK"])
+        if bq < 8 or (bq & (bq - 1)) != 0:
+            raise SystemExit(
+                f"BENCH_FLASH_BLOCK={bq}: must be a power of two >= 8 "
+                "(Mosaic tiling; see ops/flash_attention.py)"
+            )
+        cfg = dataclasses_replace(cfg, flash_block_q=bq, flash_block_k=bq)
     seq = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_len, 512))))
 
     # The BASELINE pairing: BERT-large exercises Adasum, GPT-2 medium the
@@ -114,7 +122,7 @@ def main():
 
     step, flops = aot_compile(step, params, opt_state, toks, labels)
     flops_note = None
-    if flops and cfg.uses_flash():
+    if flops and cfg.uses_flash(seq=seq):
         # The Pallas flash-attention kernels are custom calls — invisible
         # to XLA cost analysis — so add their matmul FLOPs analytically:
         # fwd 2 matmuls (QKᵀ, PV) = 4·b·s²·d, bwd ≈ 2× fwd (dq/dk/dv +

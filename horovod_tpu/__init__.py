@@ -108,6 +108,9 @@ from .optimizer import (  # noqa: F401
     grad,
     value_and_grad,
 )
+from .sharded_optimizer import (  # noqa: F401
+    ShardedDistributedOptimizer,
+)
 from . import ops  # noqa: F401
 from .ops import traced  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.run / State, ref [V])

@@ -39,12 +39,27 @@ class TransformerConfig:
     # masks stay on the dense path, and off-TPU the interpret-mode
     # kernel would only be overhead). True forces it on any backend.
     flash_attention: Any = "auto"
+    # Flash kernel block sizes (tunable: bigger blocks = fewer K/V loop
+    # iterations and larger MXU matmuls, more VMEM per program). Auto-
+    # shrunk to the sequence length when it is shorter.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
 
-    def uses_flash(self, mask=None) -> bool:
+    def uses_flash(self, mask=None, seq=None) -> bool:
         """THE gating rule for the Pallas flash path — single source
-        of truth for the model and for bench_lm's FLOPs correction."""
+        of truth for the model and for bench_lm's FLOPs correction.
+        Pass ``seq`` when known: untileable lengths (e.g. ViT's 197
+        tokens — no power-of-two block divides them) take the dense
+        path rather than failing Mosaic's block constraints."""
         if mask is not None:
             return False
+        if seq is not None:
+            from ..ops.flash_attention import supports_seq
+
+            if not supports_seq(
+                seq, self.flash_block_q, self.flash_block_k
+            ):
+                return False
         if self.flash_attention == "auto":
             import jax as _jax
 
@@ -97,7 +112,7 @@ class MultiHeadAttention(nn.Module):
             (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
         )(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        use_flash = cfg.uses_flash(mask)
+        use_flash = cfg.uses_flash(mask, seq=x.shape[1])
         if cfg.flash_attention and cfg.flash_attention != "auto" and (
             mask is not None
         ):
@@ -115,7 +130,10 @@ class MultiHeadAttention(nn.Module):
         if use_flash:
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=cfg.causal)
+            out = flash_attention(
+                q, k, v, causal=cfg.causal,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            )
             return nn.DenseGeneral(
                 cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
             )(out)

@@ -218,6 +218,17 @@ def _pick_block(seq: int, preferred: int) -> int:
     return max(b, 1)
 
 
+def supports_seq(t: int, block_q: int = 128, block_k: int = 128) -> bool:
+    """Whether the kernels can tile this sequence length. Mosaic needs
+    each block's trailing dims to be (8k, 128k)-aligned or the full
+    array dim, so the auto-shrunk block must stay >= 8 or cover the
+    whole sequence. Prime-ish lengths (e.g. ViT's 14*14+1 = 197
+    tokens) fail and must take the dense path."""
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(t, block_k)
+    return (bq >= 8 or bq == t) and (bk >= 8 or bk == t)
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5)
 )

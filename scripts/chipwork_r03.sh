@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Post-capture chip work: Pallas kernel smokes + perf probes that need
+# the real TPU. Chained after capture_remaining_r03.sh (never two TPU
+# clients at once — docs/perf.md "chip-claim wedge").
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+
+# wait for the capture loop (if running) to release the chip
+while pgrep -f capture_remaining_r03.sh >/dev/null 2>&1; do sleep 60; done
+
+echo "=== pallas kernel smoke on real TPU" >&2
+python - <<'EOF' > bench_results/pallas_smoke_r03.txt 2>&1
+import numpy as np
+import jax, jax.numpy as jnp
+from horovod_tpu.ops import pallas_kernels as pk
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.normal(size=(1000, 257)).astype(np.float32))
+
+y = pk.scale_cast(x, 2.5, jnp.bfloat16)
+ref = (np.asarray(x, np.float32) * 2.5).astype(jnp.bfloat16)
+assert np.allclose(np.asarray(y, np.float32), np.asarray(ref, np.float32), rtol=1e-2), "scale_cast"
+print("scale_cast OK", y.dtype, y.shape)
+
+vals, scale = pk.int8_quantize(x, seed=7)
+deq = np.asarray(vals, np.float32) * float(scale)
+err = np.abs(deq - np.asarray(x)).max()
+assert err <= float(scale) * 1.01, ("int8 roundtrip err", err, float(scale))
+print("int8_quantize OK maxerr/scale", err / float(scale))
+
+a = jnp.asarray(rng.normal(size=(4096,)).astype(np.float32))
+b = jnp.asarray(rng.normal(size=(4096,)).astype(np.float32))
+got = np.asarray(pk.adasum_pair(a, b))
+an, bn = np.asarray(a, np.float64), np.asarray(b, np.float64)
+dot, asq, bsq = an @ bn, an @ an, bn @ bn
+oracle = (1 - dot / (2 * asq)) * an + (1 - dot / (2 * bsq)) * bn
+assert np.allclose(got, oracle, rtol=1e-4, atol=1e-5), "adasum_pair"
+print("adasum_pair OK")
+print("ALL PALLAS KERNELS PASS ON TPU")
+EOF
+tail -2 bench_results/pallas_smoke_r03.txt >&2
+
+echo "=== driver-gate entry() compile check" >&2
+python - <<'EOF' >&2
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+out = jax.jit(fn)(*args)
+print("entry() compiles+runs:", jax.tree.leaves(out)[0].shape)
+EOF
+
+echo "=== resnet space_to_depth stem probe" >&2
+BENCH_INNER=1 BENCH_STEM=space_to_depth python bench.py \
+  > bench_results/resnet50_s2d_r03.json 2> bench_results/resnet50_s2d_r03.err \
+  && rm -f bench_results/resnet50_s2d_r03.err
+cat bench_results/resnet50_s2d_r03.json >&2 || true
+
+echo "=== gpt2 full-context probe (seq 1024 = model max, flash attention)" >&2
+BENCH_MODEL=gpt2_medium BENCH_BATCH=4 BENCH_SEQ=1024 python bench_lm.py \
+  > bench_results/gpt2_seq1024_r03.json 2> bench_results/gpt2_seq1024_r03.err \
+  && rm -f bench_results/gpt2_seq1024_r03.err
+cat bench_results/gpt2_seq1024_r03.json >&2 || true
+
+echo "=== flash block-size sweep (bert, best config)" >&2
+for blk in 256 512; do
+  BENCH_MODEL=bert_large BENCH_BATCH=16 BENCH_REMAT=0 BENCH_FLASH_BLOCK=$blk \
+    python bench_lm.py > "bench_results/bert_blk${blk}_r03.json" \
+    2> "bench_results/bert_blk${blk}_r03.err" \
+    && rm -f "bench_results/bert_blk${blk}_r03.err"
+  cat "bench_results/bert_blk${blk}_r03.json" >&2 || true
+done
+
+echo "chipwork done" >&2

@@ -85,3 +85,35 @@ def test_bf16_inputs():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=2e-2
     )
+
+
+def test_untileable_seq_falls_back_to_dense():
+    """ViT's 197 tokens (prime-ish) can't tile: uses_flash must gate it
+    off so models never hand Mosaic an impossible block shape."""
+    from horovod_tpu.models.transformer import TransformerConfig
+    from horovod_tpu.ops.flash_attention import supports_seq
+
+    assert supports_seq(512) and supports_seq(128) and supports_seq(4)
+    assert not supports_seq(197)
+    cfg = TransformerConfig(flash_attention=True)
+    assert cfg.uses_flash(seq=512)
+    assert not cfg.uses_flash(seq=197)
+
+
+def test_vit_forward_with_flash_forced_on():
+    """The full ViT (seq 197) must run even with flash_attention=True —
+    the dense fallback, not a Mosaic compile error."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models.vit import ViT, ViTConfig
+
+    cfg = ViTConfig.tiny()  # seq = (32/8)^2 + 1 = 17 — also untileable
+    model = ViT(cfg)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 32, 32, 3)), jnp.float32
+    )
+    params = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = jax.jit(lambda p, x: model.apply(p, x, train=False))(params, x)
+    assert out.shape == (2, cfg.num_classes)

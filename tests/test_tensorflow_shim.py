@@ -184,6 +184,9 @@ def test_keras_callbacks_fit_roundtrip(hvdtf):
     from horovod_tpu.tensorflow import callbacks as hvd_cb
 
     keras = tf.keras
+    # seed the kernel init: at lr 0.4 an unlucky unseeded init can
+    # diverge and flip the loss-decrease assertion (observed flaky)
+    keras.utils.set_random_seed(7)
     model = keras.Sequential([keras.layers.Dense(4, input_shape=(3,)),
                               keras.layers.Dense(1)])
     model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.4),
