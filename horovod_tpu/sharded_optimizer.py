@@ -118,11 +118,23 @@ class ShardedDistributedOptimizer:
     # -- update (inside shard_map over axis_name) --------------------------
     def update(self, grads, state, params):
         n = jax.lax.axis_size(self._axis)
+        if self._world is not None and n != self._world:
+            raise ValueError(
+                f"world changed between init ({self._world}) and update "
+                f"({n}): re-run init(params) after a topology change "
+                "(elastic restarts rebuild optimizer state)"
+            )
         idx = jax.lax.axis_index(self._axis)
         # shard_map hands each rank its [1, ...] state slice
         local_state = jax.tree_util.tree_map(lambda x: x[0], state)
 
+        # 0-d leaves (scalar temperature etc.) stay replicated — exactly
+        # like init's _shard_host — so state shapes are stable step-over-
+        # step (a shape flip would force a retrace and break donation)
         def rs(g):
+            if g.ndim == 0:
+                red = jax.lax.psum(g, self._axis)
+                return red / n if self._op == Average else red
             flat = _pad_to(g.reshape(-1), n).reshape(n, -1)
             red = jax.lax.psum_scatter(
                 flat, self._axis, scatter_dimension=0, tiled=False
@@ -133,11 +145,13 @@ class ShardedDistributedOptimizer:
 
         g_sh = jax.tree_util.tree_map(rs, grads)
         p_sh = jax.tree_util.tree_map(
-            lambda p: _shard_dyn(p, n, idx), params
+            lambda p: p if p.ndim == 0 else _shard_dyn(p, n, idx), params
         )
         upd_sh, new_local = self._inner.update(g_sh, local_state, p_sh)
 
         def gather(u, p):
+            if p.ndim == 0:
+                return u.astype(u.dtype)
             full = jax.lax.all_gather(u, self._axis, axis=0).reshape(-1)
             return full[: p.size].reshape(p.shape).astype(u.dtype)
 

@@ -3,6 +3,7 @@
 [V], SURVEY.md §4.5)."""
 
 import os
+import re
 import subprocess
 import sys
 
@@ -82,3 +83,9 @@ def test_tensorflow2_mnist_example():
     pytest.importorskip("tensorflow")
     out = _run_example("tensorflow2_mnist.py", "--steps", "25")
     assert "tf2 shim example done" in out
+
+
+@pytest.mark.slow
+def test_zero1_example():
+    out = _run_example("zero1_data_parallel.py")
+    assert re.search(r"\dx smaller", out)

@@ -121,3 +121,58 @@ def test_adasum_rejected(hvd):
         hvd_pkg.ShardedDistributedOptimizer(
             optax.adam(1e-3), op=hvd_pkg.Adasum
         )
+
+
+def test_scalar_param_leaf_stable_state_shapes(hvd):
+    """0-d param leaves stay replicated: state shapes must be identical
+    step-over-step (a shape flip would retrace and break donation)."""
+    mesh = hvd_pkg.mesh()
+    params = {
+        "w": jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3)),
+        "temp": jnp.asarray(1.0),  # scalar leaf
+    }
+    opt = hvd_pkg.ShardedDistributedOptimizer(optax.adam(1e-2))
+    state = opt.init(params)
+    shapes0 = [l.shape for l in jax.tree_util.tree_leaves(state)]
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), opt.state_spec()),
+        out_specs=(P(), opt.state_spec()),
+        check_vma=False,
+    )
+    def step(p, st):
+        g = jax.tree_util.tree_map(jnp.ones_like, p)
+        u, st = opt.update(g, st, p)
+        return optax.apply_updates(p, u), st
+
+    js = jax.jit(step)
+    for _ in range(2):
+        params, state = js(params, state)
+    shapes1 = [l.shape for l in jax.tree_util.tree_leaves(state)]
+    assert shapes0 == shapes1
+    assert np.isfinite(float(params["temp"]))
+
+
+def test_world_mismatch_raises_clearly(hvd):
+    """Stale init world vs the actual mesh axis must fail loudly."""
+    from jax.sharding import Mesh
+
+    params = {"w": jnp.ones((4, 3), jnp.float32)}
+    opt = hvd_pkg.ShardedDistributedOptimizer(optax.sgd(1e-2), world=4)
+    state = opt.init(params)
+    mesh = hvd_pkg.mesh()  # 8-way axis != init's world=4
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(hvd_pkg.WORLD_AXIS)),
+        out_specs=(P(), P(hvd_pkg.WORLD_AXIS)),
+        check_vma=False,
+    )
+    def step(p, st):
+        g = jax.tree_util.tree_map(jnp.ones_like, p)
+        u, st = opt.update(g, st, p)
+        return optax.apply_updates(p, u), st
+
+    with pytest.raises(ValueError, match="world changed"):
+        jax.jit(step)(params, state)
