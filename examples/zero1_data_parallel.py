@@ -17,13 +17,15 @@ import os
 from functools import partial
 
 # Mirror the sibling examples: default to an 8-device simulated mesh
-# when the caller hasn't chosen a device count (must precede jax init).
+# when the caller hasn't chosen a device count (must precede jax init;
+# APPEND to any existing XLA_FLAGS — tests/conftest.py pattern).
 if "xla_force_host_platform_device_count" not in os.environ.get(
     "XLA_FLAGS", ""
 ) and os.environ.get("JAX_PLATFORMS") == "cpu":
-    os.environ.setdefault(
-        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
-    )
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import jax
 

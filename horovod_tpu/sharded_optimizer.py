@@ -151,7 +151,7 @@ class ShardedDistributedOptimizer:
 
         def gather(u, p):
             if p.ndim == 0:
-                return u.astype(u.dtype)
+                return u
             full = jax.lax.all_gather(u, self._axis, axis=0).reshape(-1)
             return full[: p.size].reshape(p.shape).astype(u.dtype)
 

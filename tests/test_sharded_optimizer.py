@@ -156,8 +156,6 @@ def test_scalar_param_leaf_stable_state_shapes(hvd):
 
 def test_world_mismatch_raises_clearly(hvd):
     """Stale init world vs the actual mesh axis must fail loudly."""
-    from jax.sharding import Mesh
-
     params = {"w": jnp.ones((4, 3), jnp.float32)}
     opt = hvd_pkg.ShardedDistributedOptimizer(optax.sgd(1e-2), world=4)
     state = opt.init(params)
