@@ -1,0 +1,86 @@
+"""Elastic state for the TF shim: ``TensorFlowKerasState``.
+
+Parity target: ``horovod.tensorflow.elastic.TensorFlowKerasState`` [V]
+(SURVEY.md §2.5 "Elastic worker API") — wrap a compiled Keras model
+(+ scalars like epoch/batch) so elastic training can ``commit()``
+(host snapshot of weights + optimizer variables), ``restore()`` (roll
+back to the last commit), and ``sync()`` (broadcast from the new
+rank 0 after a membership change). Use with ``hvd.elastic.run``
+exactly like ``JaxState``/``TorchState``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..elastic.state import ObjectState
+
+
+def _optimizer_variables(model) -> List:
+    opt = getattr(model, "optimizer", None)
+    if opt is None:
+        return []
+    # Keras 3: .variables is a property (list); Keras 2/TF: a method
+    variables = getattr(opt, "variables", None)
+    if callable(variables):
+        variables = variables()
+    return list(variables or [])
+
+
+class TensorFlowKerasState(ObjectState):
+    """Commit/restore/sync over a (compiled) Keras model (ref:
+    horovod/tensorflow/elastic.py TensorFlowKerasState [V])."""
+
+    def __init__(self, model, **kwargs: Any) -> None:
+        self.model = model
+        self._saved_weights: Optional[List[np.ndarray]] = None
+        self._saved_opt: Optional[List[np.ndarray]] = None
+        super().__init__(**kwargs)
+        self.save()
+
+    @staticmethod
+    def _var_key(var, index: int) -> str:
+        return getattr(var, "path", None) or getattr(
+            var, "name", f"var_{index}"
+        )
+
+    def save(self) -> None:
+        self._saved_weights = [
+            np.asarray(w) for w in self.model.get_weights()
+        ]
+        # keyed by variable path: Keras optimizers grow variables on
+        # first application (slot vars build lazily), so a positional
+        # snapshot taken at compile time wouldn't align after training
+        self._saved_opt = {
+            self._var_key(v, i): np.asarray(v)
+            for i, v in enumerate(_optimizer_variables(self.model))
+        }
+        super().save()
+
+    def restore(self) -> None:
+        if self._saved_weights is not None:
+            # set_weights copies; no defensive copy needed
+            self.model.set_weights(self._saved_weights)
+        saved = self._saved_opt or {}
+        for i, var in enumerate(_optimizer_variables(self.model)):
+            key = self._var_key(var, i)
+            if key in saved:
+                var.assign(saved[key])
+            else:
+                # slot var born after the snapshot (e.g. momentum built
+                # by the failed attempt's first step): its state at
+                # snapshot time was "not yet existing" = zeros
+                var.assign(np.zeros(var.shape, dtype=var.dtype))
+        super().restore()
+
+    def sync(self) -> None:
+        from . import broadcast_variables
+
+        broadcast_variables(self.model.weights, root_rank=0)
+        opt_vars = _optimizer_variables(self.model)
+        if opt_vars:
+            broadcast_variables(opt_vars, root_rank=0)
+        super().sync()  # scalar attributes via broadcast_object
+        self.save()

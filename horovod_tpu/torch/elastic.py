@@ -1,0 +1,81 @@
+"""Elastic state for the torch shim: ``TorchState``.
+
+Parity target: ``horovod.torch.elastic.state.TorchState`` [V]
+(SURVEY.md §2.5 "Elastic worker API") — wrap a torch module +
+optimizer (+ scalars like epoch/batch) so elastic training can
+``commit()`` (host snapshot), ``restore()`` (roll back to the last
+commit after a failure), and ``sync()`` (broadcast from the new rank 0
+after a membership change). Reuses the shim's
+``broadcast_parameters`` / ``broadcast_optimizer_state`` /
+``broadcast_object`` for the sync leg and the base ``ObjectState``
+machinery for scalar attributes; use with ``hvd.elastic.run`` exactly
+like ``JaxState``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from ..elastic.state import ObjectState
+
+
+class TorchState(ObjectState):
+    """Commit/restore/sync over a torch model + optimizer
+    (ref: horovod/torch/elastic/state.py TorchState [V])."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs: Any) -> None:
+        self.model = model
+        self.optimizer = optimizer
+        self._saved_model_state: Any = None
+        self._saved_optimizer_state: Any = None
+        super().__init__(**kwargs)
+        self.save()
+
+    @staticmethod
+    def _clone_state_dict(sd):
+        import torch
+
+        def clone(v):
+            if isinstance(v, torch.Tensor):
+                return v.detach().clone()
+            if isinstance(v, dict):
+                return {k: clone(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return type(v)(clone(x) for x in v)
+            return copy.deepcopy(v)
+
+        return clone(sd)
+
+    def save(self) -> None:
+        if self.model is not None:
+            self._saved_model_state = self._clone_state_dict(
+                self.model.state_dict()
+            )
+        if self.optimizer is not None:
+            self._saved_optimizer_state = self._clone_state_dict(
+                self.optimizer.state_dict()
+            )
+        super().save()
+
+    def restore(self) -> None:
+        # load_state_dict copies (params via copy_, optimizer via its
+        # own deepcopy), so the snapshots can be passed directly
+        if self.model is not None and self._saved_model_state is not None:
+            self.model.load_state_dict(self._saved_model_state)
+        if (
+            self.optimizer is not None
+            and self._saved_optimizer_state is not None
+        ):
+            self.optimizer.load_state_dict(self._saved_optimizer_state)
+        super().restore()
+
+    def sync(self) -> None:
+        from . import broadcast_optimizer_state, broadcast_parameters
+
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        super().sync()  # scalar attributes via broadcast_object
+        self.save()

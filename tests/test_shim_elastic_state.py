@@ -1,0 +1,152 @@
+"""Elastic State objects for the framework shims (ref:
+horovod/torch/elastic/state.py TorchState +
+horovod/tensorflow/elastic.py TensorFlowKerasState [V], SURVEY §2.5):
+commit/restore round-trips model + optimizer + scalars; sync
+broadcasts without error on the single-controller mesh."""
+
+import numpy as np
+import pytest
+
+
+def test_torch_state_commit_restore_sync(hvd):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.torch.elastic import TorchState
+
+    torch.manual_seed(0)
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    state = TorchState(model=model, optimizer=opt, epoch=0, batch=0)
+
+    w0 = model.weight.detach().clone()
+    # train a step so both weights and momentum buffers change
+    loss = model(torch.randn(8, 4)).pow(2).mean()
+    loss.backward()
+    opt.step()
+    state.epoch = 3
+    assert not torch.allclose(model.weight, w0)
+
+    # restore rolls weights, optimizer state AND scalars back
+    state.restore()
+    assert torch.allclose(model.weight, w0)
+    assert state.epoch == 0
+    assert not opt.state_dict()["state"]  # momentum rolled back too
+
+    # commit then mutate then restore -> back to the commit point
+    loss = model(torch.randn(8, 4)).pow(2).mean()
+    loss.backward()
+    opt.step()
+    state.epoch = 5
+    state.commit()
+    w_commit = model.weight.detach().clone()
+    opt.step()
+    state.epoch = 9
+    state.restore()
+    assert torch.allclose(model.weight, w_commit)
+    assert state.epoch == 5
+    # sync broadcasts from root without error and re-saves
+    state.sync()
+    assert torch.allclose(model.weight, w_commit)
+
+
+def test_tf_keras_state_commit_restore_sync(hvd):
+    tf = pytest.importorskip("tensorflow")
+    from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+    tf.keras.utils.set_random_seed(0)
+    model = tf.keras.Sequential(
+        [tf.keras.Input((4,)), tf.keras.layers.Dense(2)]
+    )
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+    x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    y = np.zeros((16, 2), np.float32)
+
+    state = TensorFlowKerasState(model, epoch=0)
+    w0 = [np.copy(w) for w in model.get_weights()]
+
+    model.fit(x, y, epochs=1, verbose=0)
+    state.epoch = 2
+    assert not np.allclose(model.get_weights()[0], w0[0])
+
+    state.restore()
+    for got, want in zip(model.get_weights(), w0):
+        np.testing.assert_allclose(got, want)
+    assert state.epoch == 0
+
+    model.fit(x, y, epochs=1, verbose=0)
+    state.epoch = 4
+    state.commit()
+    w_commit = [np.copy(w) for w in model.get_weights()]
+    model.fit(x, y, epochs=1, verbose=0)
+    state.restore()
+    for got, want in zip(model.get_weights(), w_commit):
+        np.testing.assert_allclose(got, want)
+    assert state.epoch == 4
+    state.sync()
+    for got, want in zip(model.get_weights(), w_commit):
+        np.testing.assert_allclose(got, want)
+
+
+def test_torch_state_with_elastic_run(hvd):
+    """TorchState drives hvd.elastic.run end to end: an internal error
+    rolls the model back to the last commit and retries."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.common.basics import HorovodInternalError
+    from horovod_tpu.elastic.worker import run as elastic_run
+    from horovod_tpu.torch.elastic import TorchState
+
+    torch.manual_seed(1)
+    model = torch.nn.Linear(3, 1)
+    opt = torch.optim.SGD(model.parameters(), lr=0.5)
+    state = TorchState(model=model, optimizer=opt, step=0)
+    w0 = model.weight.detach().clone()
+    attempts = {"n": 0}
+
+    @elastic_run
+    def train(st):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            # uncommitted training progress, then a peer failure
+            loss = st.model(torch.ones(4, 3)).pow(2).mean()
+            loss.backward()
+            st.optimizer.step()
+            st.step = 10
+            raise HorovodInternalError("peer died")
+        # after restore: the uncommitted step is gone
+        assert torch.allclose(st.model.weight, w0)
+        return st.step
+
+    assert train(state) == 0
+    assert attempts["n"] == 2
+
+
+def test_tf_state_snapshot_before_optimizer_build(hvd):
+    """Snapshot taken at compile time (optimizer slot vars not yet
+    built): restore after training must roll iterations back AND zero
+    the momentum slots born during the failed attempt (review
+    finding: the old positional snapshot silently kept them)."""
+    tf = pytest.importorskip("tensorflow")
+    from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+    tf.keras.utils.set_random_seed(1)
+    model = tf.keras.Sequential(
+        [tf.keras.Input((4,)), tf.keras.layers.Dense(2)]
+    )
+    model.compile(
+        optimizer=tf.keras.optimizers.SGD(0.1, momentum=0.9), loss="mse"
+    )
+    state = TensorFlowKerasState(model, epoch=0)  # pre-build snapshot
+    x = np.random.default_rng(1).normal(size=(16, 4)).astype(np.float32)
+    y = np.ones((16, 2), np.float32)
+    model.fit(x, y, epochs=2, verbose=0)  # builds + fills momentum
+
+    state.restore()
+    for v in model.optimizer.variables:
+        name = getattr(v, "path", None) or v.name
+        if "learning_rate" in name:
+            np.testing.assert_allclose(np.asarray(v), 0.1)  # snapshotted
+        else:
+            # iterations + momentum slots: rolled back / zeroed
+            np.testing.assert_allclose(
+                np.asarray(v), np.zeros(v.shape), atol=0,
+                err_msg=f"{name} not rolled back",
+            )
