@@ -220,6 +220,12 @@ def __getattr__(name):  # PEP 562 — keeps the class build off import time
         from .sync_batch_norm import SyncBatchNormalization
 
         return SyncBatchNormalization
+    if name == "elastic":
+        # hvd.elastic.run / hvd.elastic.TensorFlowKerasState from the
+        # shim namespace, matching horovod.tensorflow.elastic [V]
+        import importlib
+
+        return importlib.import_module(".elastic", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -11,11 +11,13 @@ exactly like ``JaxState``/``TorchState``.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
+import tensorflow as tf
 
-from ..elastic.state import ObjectState
+from ..elastic.state import ObjectState, State  # noqa: F401 — re-export
+from ..elastic.worker import run  # noqa: F401 — hvd.tensorflow.elastic.run
 
 
 def _optimizer_variables(model) -> List:
@@ -36,7 +38,7 @@ class TensorFlowKerasState(ObjectState):
     def __init__(self, model, **kwargs: Any) -> None:
         self.model = model
         self._saved_weights: Optional[List[np.ndarray]] = None
-        self._saved_opt: Optional[List[np.ndarray]] = None
+        self._saved_opt: Optional[Dict[str, np.ndarray]] = None
         super().__init__(**kwargs)
         self.save()
 
@@ -71,8 +73,10 @@ class TensorFlowKerasState(ObjectState):
             else:
                 # slot var born after the snapshot (e.g. momentum built
                 # by the failed attempt's first step): its state at
-                # snapshot time was "not yet existing" = zeros
-                var.assign(np.zeros(var.shape, dtype=var.dtype))
+                # snapshot time was "not yet existing" = zeros.
+                # tf.zeros handles both Keras-3 string dtypes and
+                # legacy tf.DType (np.zeros chokes on the latter)
+                var.assign(tf.zeros(var.shape, dtype=var.dtype))
         super().restore()
 
     def sync(self) -> None:

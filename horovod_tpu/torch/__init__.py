@@ -549,4 +549,10 @@ def __getattr__(name):  # PEP 562 — SyncBatchNorm builds its torch base
         from . import sync_batch_norm
 
         return sync_batch_norm.SyncBatchNorm
+    if name == "elastic":
+        # hvd.elastic.run / hvd.elastic.TorchState from the shim
+        # namespace, matching horovod.torch.elastic [V]
+        import importlib
+
+        return importlib.import_module(".elastic", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

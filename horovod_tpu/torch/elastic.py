@@ -17,7 +17,8 @@ from __future__ import annotations
 import copy
 from typing import Any
 
-from ..elastic.state import ObjectState
+from ..elastic.state import ObjectState, State  # noqa: F401 — re-export
+from ..elastic.worker import run  # noqa: F401 — hvd.torch.elastic.run
 
 
 class TorchState(ObjectState):

@@ -1,0 +1,93 @@
+"""Property-based collective tests (hypothesis): random shapes, dtypes
+and values against numpy oracles — the randomized complement to the
+closed-form op matrix (ref: the reference's test grids are exhaustive
+but fixed-value; SURVEY.md §4.1). Also checks the Adasum invariants the
+reference documents (scale behavior, agreement across ranks)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+# the hvd fixture is stable across examples (module-level init); not
+# resetting it between generated inputs is exactly what we want
+_SETTINGS = dict(
+    max_examples=10,
+    deadline=None,
+    suppress_health_check=[HealthCheck.function_scoped_fixture],
+)
+
+WORLD = 8
+
+shapes = st.lists(
+    st.integers(min_value=1, max_value=5), min_size=1, max_size=3
+).map(tuple)
+
+
+def _payload(rng_seed, shape, dtype=np.float32):
+    rng = np.random.default_rng(rng_seed)
+    return np.stack(
+        [
+            rng.normal(size=shape).astype(dtype) * (r + 1)
+            for r in range(WORLD)
+        ]
+    )
+
+
+@settings(**_SETTINGS)
+@given(shape=shapes, seed=st.integers(0, 2**16))
+def test_allreduce_sum_matches_numpy(hvd, shape, seed):
+    x = _payload(seed, shape)
+    out = np.asarray(hvd.allreduce(jnp.asarray(x), op=hvd.Sum))
+    np.testing.assert_allclose(out[0], x.sum(0), rtol=2e-5, atol=1e-5)
+    # every rank agrees
+    for r in range(1, WORLD):
+        np.testing.assert_array_equal(out[r], out[0])
+
+
+@settings(**_SETTINGS)
+@given(shape=shapes, seed=st.integers(0, 2**16),
+       root=st.integers(0, WORLD - 1))
+def test_broadcast_matches_root(hvd, shape, seed, root):
+    x = _payload(seed, shape)
+    out = np.asarray(hvd.broadcast(jnp.asarray(x), root_rank=root))
+    for r in range(WORLD):
+        np.testing.assert_array_equal(out[r], x[root])
+
+
+@settings(**_SETTINGS)
+@given(rows=st.integers(1, 4), cols=st.integers(1, 5),
+       seed=st.integers(0, 2**16))
+def test_allgather_concat_matches_numpy(hvd, rows, cols, seed):
+    x = _payload(seed, (rows, cols))
+    out = np.asarray(hvd.allgather(jnp.asarray(x)))
+    flat = out[0].reshape(WORLD * rows, cols)
+    np.testing.assert_allclose(flat, x.reshape(WORLD * rows, cols))
+
+
+@settings(**_SETTINGS)
+@given(seed=st.integers(0, 2**16), scale=st.floats(0.25, 8.0))
+def test_adasum_positive_homogeneous(hvd, seed, scale):
+    """Adasum(s·g1..s·gN) == s·Adasum(g1..gN) — the scale-invariance
+    the reference's docs claim for the combiner (positive scales)."""
+    x = _payload(seed, (6,))
+    a = np.asarray(hvd.allreduce(jnp.asarray(x), op=hvd.Adasum))[0]
+    b = np.asarray(
+        hvd.allreduce(jnp.asarray(x * scale), op=hvd.Adasum)
+    )[0]
+    np.testing.assert_allclose(b, scale * a, rtol=5e-4, atol=1e-5)
+
+
+@settings(**_SETTINGS)
+@given(seed=st.integers(0, 2**16))
+def test_reducescatter_then_allgather_is_allreduce(hvd, seed):
+    """Composition law: reduce-scatter followed by all-gather of the
+    shards reproduces the allreduce result (the two halves of the
+    ring)."""
+    x = _payload(seed, (WORLD * 2, 3))
+    rs = hvd.reducescatter(jnp.asarray(x), op=hvd.Sum)
+    gathered = np.asarray(hvd.allgather(rs))
+    full = np.asarray(hvd.allreduce(jnp.asarray(x), op=hvd.Sum))
+    np.testing.assert_allclose(
+        gathered[0].reshape(full[0].shape), full[0], rtol=2e-5, atol=1e-5
+    )

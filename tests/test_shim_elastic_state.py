@@ -150,3 +150,20 @@ def test_tf_state_snapshot_before_optimizer_build(hvd):
                 np.asarray(v), np.zeros(v.shape), atol=0,
                 err_msg=f"{name} not rolled back",
             )
+
+
+def test_shim_namespace_parity(hvd):
+    """Reference API shape: hvd.torch-style `hvd.elastic.run` +
+    `hvd.elastic.TorchState` from ONE namespace (and the TF twin)."""
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.torch as hvdt
+
+    assert callable(hvdt.elastic.run)
+    assert hvdt.elastic.TorchState is not None
+    assert hvdt.elastic.State is not None
+
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvdtf
+
+    assert callable(hvdtf.elastic.run)
+    assert hvdtf.elastic.TensorFlowKerasState is not None
