@@ -74,6 +74,7 @@ class ElasticDriver:
         start_timeout: float = 600.0,
         output_filename: Optional[str] = None,
         reset_limit: Optional[int] = None,
+        extra_env: Optional[Dict[str, str]] = None,
     ) -> None:
         self.host_manager = HostManager(discovery)
         self._command = list(command)
@@ -85,6 +86,7 @@ class ElasticDriver:
         self._start_timeout = start_timeout
         self._output_filename = output_filename
         self._reset_limit = reset_limit
+        self._extra_env = dict(extra_env or {})
         self._epoch = 0
         self._resets = 0
         self._secret = make_secret_key()
@@ -171,17 +173,14 @@ class ElasticDriver:
             _free_port(),
             self._secret.hex(),
             extra={
+                **self._extra_env,  # CLI runtime knobs (hvdrun elastic)
                 "HOROVOD_ELASTIC_EPOCH": str(assignment.epoch),
                 "HOROVOD_ELASTIC": "1",
             },
         )
         procs: List[subprocess.Popen] = []
         for block in blocks:
-            env = dict(os.environ)
-            env.update(block)
-            cwd = os.getcwd()
-            prior = env.get("PYTHONPATH")
-            env["PYTHONPATH"] = cwd if not prior else cwd + os.pathsep + prior
+            hostname = block["HOROVOD_HOSTNAME"]
             stdout = stderr = None
             if self._output_filename:
                 os.makedirs(self._output_filename, exist_ok=True)
@@ -192,11 +191,36 @@ class ElasticDriver:
                 stderr = open(
                     os.path.join(self._output_filename, tag + ".err"), "wb"
                 )
-            procs.append(
-                subprocess.Popen(
-                    self._command, env=env, stdout=stdout, stderr=stderr
+            if _is_local(hostname):
+                env = dict(os.environ)
+                env.update(block)
+                cwd = os.getcwd()
+                prior = env.get("PYTHONPATH")
+                env["PYTHONPATH"] = (
+                    cwd if not prior else cwd + os.pathsep + prior
                 )
-            )
+                procs.append(
+                    subprocess.Popen(
+                        self._command, env=env, stdout=stdout, stderr=stderr
+                    )
+                )
+            else:
+                # remote member of the gang: same ssh shape as the
+                # non-elastic launcher (launch.py [V]); the HMAC secret
+                # rides stdin, never the command line
+                from ..runner.launch import _ssh_wrap
+
+                cmd = _ssh_wrap(hostname, None, block, self._command)
+                proc = subprocess.Popen(
+                    cmd, stdin=subprocess.PIPE, stdout=stdout,
+                    stderr=stderr,
+                )
+                assert proc.stdin is not None
+                proc.stdin.write(
+                    (block.get("HOROVOD_SECRET_KEY", "") + "\n").encode()
+                )
+                proc.stdin.close()
+                procs.append(proc)
         with self._lock:
             self._procs = procs
             self._blocks = blocks

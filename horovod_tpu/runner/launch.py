@@ -94,6 +94,20 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     p.add_argument("--log-level", default=None)
     p.add_argument("--stall-timeout", type=float, default=None)
     p.add_argument("--hierarchical-allreduce", action="store_true")
+    # elastic mode (ref: horovodrun --host-discovery-script/--min-np/
+    # --max-np, horovod/runner/launch.py [V]): supervises gangs through
+    # elastic.ElasticDriver instead of a one-shot launch
+    p.add_argument("--host-discovery-script", default=None,
+                   help="executable printing 'host:slots' per line; "
+                        "presence switches hvdrun into elastic mode")
+    p.add_argument("--min-np", type=int, default=None,
+                   help="elastic: minimum world size (default: -np)")
+    p.add_argument("--max-np", type=int, default=None,
+                   help="elastic: maximum world size (default: -np)")
+    p.add_argument("--slots-per-host", type=int, default=None,
+                   help="elastic: override slots per discovered host")
+    p.add_argument("--reset-limit", type=int, default=None,
+                   help="elastic: max gang restarts before giving up")
     # accepted for script compat; the data plane is always XLA/ICI here
     p.add_argument("--gloo", action="store_true",
                    help="accepted for compatibility (no-op: TPU data "
@@ -352,11 +366,45 @@ def launch_processes(
             f.close()
 
 
+def _run_elastic(args: argparse.Namespace) -> int:
+    """Elastic mode: hand the job to ElasticDriver (ref: horovodrun's
+    elastic launch, gloo_run elastic path [V])."""
+    from ..elastic.driver import ElasticDriver
+    from ..elastic.discovery import HostDiscoveryScript
+
+    # `is None` (not `or`): --min-np 0 is an explicit value, not unset
+    min_np = args.num_proc if args.min_np is None else args.min_np
+    max_np = args.num_proc if args.max_np is None else args.max_np
+    if min_np < 1 or max_np < min_np:
+        raise SystemExit(
+            f"hvdrun: inconsistent elastic bounds min_np={min_np} "
+            f"max_np={max_np} (need 1 <= min-np <= max-np)"
+        )
+    driver = ElasticDriver(
+        discovery=HostDiscoveryScript(args.host_discovery_script),
+        command=args.command,
+        min_np=min_np,
+        max_np=max_np,
+        slots_per_host=args.slots_per_host,
+        placement=args.placement,
+        start_timeout=args.start_timeout,
+        output_filename=args.output_filename,
+        reset_limit=args.reset_limit,
+        extra_env=_runtime_env(args),
+    )
+    try:
+        return driver.run()
+    finally:
+        driver.shutdown()
+
+
 def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
     args = parse_args(argv)
     if not args.command:
         print("hvdrun: no command given", file=sys.stderr)
         return 2
+    if args.host_discovery_script:
+        return _run_elastic(args)
     hosts = _resolve_hosts(args)
     slots = assign_slots(hosts, args.num_proc)
     placement = args.placement

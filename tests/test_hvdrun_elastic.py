@@ -1,0 +1,75 @@
+"""hvdrun elastic mode: --host-discovery-script switches the CLI into
+ElasticDriver supervision (ref: horovodrun's elastic launch flags [V],
+SURVEY.md §2.5 CLI row). Live multi-process test in the style of
+tests/test_runner.py / test_elastic.py."""
+
+import os
+import sys
+
+import pytest
+
+from horovod_tpu.runner.launch import parse_args, run_commandline
+
+
+def _clean_env(monkeypatch):
+    for var in list(os.environ):
+        if var.startswith("HOROVOD_"):
+            monkeypatch.delenv(var, raising=False)
+
+
+def test_elastic_flags_parse():
+    args = parse_args(
+        [
+            "-np", "2", "--host-discovery-script", "/tmp/d.sh",
+            "--min-np", "1", "--max-np", "4", "--reset-limit", "3",
+            "--", "python", "train.py",
+        ]
+    )
+    assert args.host_discovery_script == "/tmp/d.sh"
+    assert args.min_np == 1 and args.max_np == 4
+    assert args.reset_limit == 3
+    assert args.command == ["python", "train.py"]
+
+
+@pytest.mark.slow
+def test_hvdrun_elastic_end_to_end(tmp_path, monkeypatch):
+    """Full CLI path: discovery script -> ElasticDriver gang -> worker
+    exits 0 -> hvdrun returns 0; runtime knobs reach the worker env."""
+    _clean_env(monkeypatch)
+    discovery = tmp_path / "discover.sh"
+    discovery.write_text("#!/bin/sh\necho localhost:2\n")
+    discovery.chmod(0o755)
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os, sys\n"
+        "assert os.environ.get('HOROVOD_ELASTIC') == '1'\n"
+        "assert 'HOROVOD_RANK' in os.environ\n"
+        "assert os.environ.get('HOROVOD_TIMELINE'), 'runtime knob lost'\n"
+        "sys.exit(0)\n"
+    )
+
+    rc = run_commandline(
+        [
+            "-np", "2",
+            "--host-discovery-script", str(discovery),
+            "--timeline-filename", str(tmp_path / "tl.json"),
+            "--placement", "per-slot",
+            "--", sys.executable, str(worker),
+        ]
+    )
+    assert rc == 0
+
+
+def test_inconsistent_elastic_bounds_rejected(tmp_path):
+    discovery = tmp_path / "d.sh"
+    discovery.write_text("#!/bin/sh\necho localhost:2\n")
+    discovery.chmod(0o755)
+    with pytest.raises(SystemExit, match="inconsistent elastic bounds"):
+        run_commandline(
+            [
+                "-np", "4", "--min-np", "4", "--max-np", "2",
+                "--host-discovery-script", str(discovery),
+                "--", "true",
+            ]
+        )
