@@ -408,6 +408,7 @@ def quantized_allreduce(
     op=None,
     axis_name: str = WORLD_AXIS,
     seed=0,
+    return_residual: bool = False,
 ):
     """Allreduce moving int8 across ICI — the quantized-collective
     recipe of EQuARX (PAPERS.md), built from primitives the reference
@@ -426,6 +427,13 @@ def quantized_allreduce(
     counter in via ``seed``, per step) keeps it unbiased over time.
     Sum/Average only: quantization commutes with neither min/max nor
     product.
+
+    ``return_residual=True`` additionally returns this rank's stage-1
+    quantization error (``local − dequant(quant(local))``, same shape
+    as ``tensor``) — the carry for error-feedback compression
+    (DistributedOptimizer(error_feedback=True)): adding it to the NEXT
+    step's gradient keeps the cumulative transmitted signal within a
+    constant number of quanta of the true sum instead of a random walk.
     """
     from .pallas_kernels import int8_quantize
 
@@ -460,7 +468,27 @@ def quantized_allreduce(
     all_q = lax.all_gather(q2, axis_name)    # [n, chunk] int8
     all_s = lax.all_gather(s2, axis_name)    # [n] f32
     out = (all_q.astype(jnp.float32) * all_s[:, None]).reshape(-1)[:m]
-    return out.reshape(shape).astype(dtype)
+    out = out.reshape(shape).astype(dtype)
+    if not return_residual:
+        return out
+    # Error-feedback carry, BOTH stages, in input units:
+    # * stage 1: this rank's local quantization error, elementwise;
+    # * stage 2: the reduced-shard quantization error of the chunk this
+    #   rank owns — adding it to our next-step contribution restores it
+    #   in everyone's output (x n under Average, which divides by n).
+    res_flat = (
+        chunks - q.astype(jnp.float32) * scales[:, None]
+    ).reshape(-1)
+    e2 = shard - q2.astype(jnp.float32) * s2
+    if op == Average:
+        e2 = e2 * jnp.asarray(n, jnp.float32)
+    res_flat = jax.lax.dynamic_update_slice(
+        res_flat,
+        jax.lax.dynamic_slice(res_flat, (idx * chunk,), (chunk,)) + e2,
+        (idx * chunk,),
+    )
+    residual = res_flat[:m].reshape(shape).astype(dtype)
+    return out, residual
 
 
 # Axis names for the two-level mesh built by hierarchical_mesh().

@@ -1,0 +1,176 @@
+"""Error-feedback quantized compression (EF-SGD, beyond parity; the
+reference's wire compression stops at fp16 [V]).
+
+The load-bearing property: with EF the CUMULATIVE transmitted gradient
+stays within a constant number of int8 quanta of the true cumulative
+sum for any number of steps; without it the per-step quantization
+errors random-walk. Plus plumbing tests: state threading, residual
+round-trip, and the misuse guard."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_pkg
+from horovod_tpu.ops.compression import Compression
+
+
+def test_error_feedback_requires_quantized_wire(hvd):
+    with pytest.raises(ValueError, match="quantized-wire"):
+        hvd_pkg.DistributedOptimizer(
+            optax.sgd(1e-2), error_feedback=True
+        )
+
+
+def test_residual_reconstructs_wire_value(hvd):
+    """quantized_allreduce(return_residual=True): local − residual must
+    equal dequant(quant(local)) exactly (the stage-1 wire value)."""
+    from horovod_tpu.ops import traced
+    from horovod_tpu.ops.reduction_ops import Sum
+
+    mesh = hvd_pkg.mesh()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.normal(size=(8, 64)).astype(np.float32)
+    )
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=P(hvd_pkg.WORLD_AXIS),
+        out_specs=(P(hvd_pkg.WORLD_AXIS), P(hvd_pkg.WORLD_AXIS)),
+        check_vma=False,
+    )
+    def body(t):
+        out, res = traced.quantized_allreduce(
+            t[0], op=Sum, seed=3, return_residual=True
+        )
+        return out[None], res[None]
+
+    out, res = jax.jit(body)(x)
+    res = np.asarray(res)
+    # residual = stage-1 error (<= local quantum) everywhere, plus the
+    # owned chunk's stage-2 error (<= reduced-shard quantum)
+    total = np.asarray(x).sum(0)
+    quantum2 = np.abs(total).max() / 127.0
+    for r in range(8):
+        quantum1 = np.abs(np.asarray(x[r])).max() / 127.0
+        assert np.abs(res[r]).max() <= (quantum1 + quantum2) * 1.01
+
+
+def _cumulative_error(mesh, ef: bool, steps: int, g_true):
+    """Run `steps` quantized allreduce rounds of the SAME gradient and
+    return |cumulative transmitted − cumulative true| in quanta."""
+    opt = hvd_pkg.DistributedOptimizer(
+        optax.sgd(1.0),  # update == -reduced gradient: easy bookkeeping
+        compression=Compression.int8,
+        op=hvd_pkg.Average,
+        error_feedback=ef,
+    )
+    params = {"w": jnp.zeros_like(g_true)}
+    state = opt.init(params)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(hvd_pkg.WORLD_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def step(p, st, g):
+        upd, st = opt.update({"w": g[0]}, st, p)
+        return optax.apply_updates(p, upd), st
+
+    js = jax.jit(step)
+    g_stack = jnp.broadcast_to(g_true, (8,) + g_true.shape)
+    for _ in range(steps):
+        params, state = js(params, state, g_stack)
+    # with lr=1 and identical grads per rank: -w == cumulative transmitted
+    transmitted = -np.asarray(params["w"], np.float64)
+    err = np.abs(transmitted - steps * np.asarray(g_true, np.float64))
+    quantum = float(np.abs(np.asarray(g_true)).max()) / 127.0
+    return float(err.max()) / quantum
+
+
+def test_cumulative_error_bounded_with_ef(hvd):
+    mesh = hvd_pkg.mesh()
+    rng = np.random.default_rng(1)
+    g = jnp.asarray(rng.normal(size=(96,)).astype(np.float32))
+    steps = 40
+    ef_err = _cumulative_error(mesh, True, steps, g)
+    plain_err = _cumulative_error(mesh, False, steps, g)
+    # EF: bounded by a few quanta regardless of step count (stage-1
+    # error is compensated; stage-2 stays a zero-mean random walk of
+    # bounded-variance increments). Plain: the FULL error random-walks.
+    assert ef_err < 8.0, f"EF cumulative error {ef_err} quanta"
+    # and EF must be meaningfully tighter than the uncompensated wire
+    assert ef_err < plain_err * 0.7, (ef_err, plain_err)
+
+
+def test_training_converges_with_ef(hvd):
+    mesh = hvd_pkg.mesh()
+    rng = np.random.default_rng(2)
+    w_true = jnp.asarray(rng.normal(size=(12,)).astype(np.float32))
+    opt = hvd_pkg.DistributedOptimizer(
+        optax.sgd(0.2), compression=Compression.int8, error_feedback=True
+    )
+    params = {"w": jnp.zeros((12,), jnp.float32)}
+    state = opt.init(params)
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=(P(), P()),
+        out_specs=(P(), P(), P()), check_vma=False,
+    )
+    def step(p, st):
+        def loss_fn(p):
+            return jnp.sum((p["w"] - w_true) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        upd, st = opt.update(g, st, p)
+        return optax.apply_updates(p, upd), st, loss
+
+    js = jax.jit(step)
+    losses = []
+    for _ in range(40):
+        params, state, loss = js(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 1e-3, (losses[0], losses[-1])
+
+
+def test_ef_with_tuple_pytree_and_mixed_dtypes(hvd):
+    """Review regressions: grads pytrees containing tuples must not
+    collide with the (out, residual) pairs, and the residual carry must
+    keep its init dtype across steps (lax-scan-stable state)."""
+    mesh = hvd_pkg.mesh()
+    opt = hvd_pkg.DistributedOptimizer(
+        optax.sgd(0.1), compression=Compression.int8, error_feedback=True
+    )
+    params = (
+        {"a": jnp.ones((8,), jnp.bfloat16)},
+        jnp.ones((4,), jnp.float32),
+    )
+    state = opt.init(params)
+    d0 = [l.dtype for l in jax.tree_util.tree_leaves(state.residual)]
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=(P(), P()),
+        out_specs=(P(), P()), check_vma=False,
+    )
+    def step(p, st):
+        g = jax.tree_util.tree_map(
+            lambda x: jnp.ones_like(x, dtype=jnp.float32).astype(x.dtype),
+            p,
+        )
+        upd, st = opt.update(g, st, p)
+        return optax.apply_updates(p, upd), st
+
+    js = jax.jit(step)
+    for _ in range(3):
+        params, state = js(params, state)
+    d1 = [l.dtype for l in jax.tree_util.tree_leaves(state.residual)]
+    assert d0 == d1, (d0, d1)
+    # structure preserved: still (dict, array)
+    assert isinstance(params, tuple) and isinstance(params[0], dict)
+    assert np.isfinite(np.asarray(params[1], np.float32)).all()
