@@ -66,31 +66,39 @@ def _allreduce_grads(
                 "use fp16/bf16 compression or the global process set"
             )
 
-        if residuals is not None:
-            # Error feedback (EF-SGD): carry last step's stage-1
-            # quantization error into this step's wire signal, so the
-            # cumulative transmitted gradient stays within a constant
-            # number of quanta of the truth instead of a random walk.
-            def one_q_ef(g, r):
-                if prescale_factor != 1.0:
-                    g = g * jnp.asarray(prescale_factor, g.dtype)
+        def one_q(g, r=None):
+            """One leaf through the quantized wire; with an error-
+            feedback carry ``r`` (EF-SGD), last step's quantization
+            error joins this step's wire signal and the new residual is
+            returned alongside. One body for both paths so the
+            prescale/postscale handling can't diverge."""
+            if prescale_factor != 1.0:
+                g = g * jnp.asarray(prescale_factor, g.dtype)
+            if r is None:
+                out = traced.quantized_allreduce(
+                    g, op=op, axis_name=axis_name, seed=seed
+                )
+                new_r = None
+            else:
                 out, new_r = traced.quantized_allreduce(
                     g + r.astype(g.dtype), op=op, axis_name=axis_name,
                     seed=seed, return_residual=True,
                 )
-                if postscale_factor != 1.0:
-                    out = out * jnp.asarray(postscale_factor, out.dtype)
                 # carry keeps its init dtype: a flip (e.g. bf16 params,
                 # f32 grads) would change the state pytree mid-scan
-                return out, new_r.astype(r.dtype)
+                new_r = new_r.astype(r.dtype)
+            if postscale_factor != 1.0:
+                out = out * jnp.asarray(postscale_factor, out.dtype)
+            return out, new_r
 
+        if residuals is not None:
             # flatten rather than tree_map: grads pytrees containing
             # tuples/NamedTuples would collide with the (out, residual)
             # result pairs under an isinstance(tuple) is_leaf
             g_leaves, treedef = jax.tree_util.tree_flatten(grads)
             r_leaves = treedef.flatten_up_to(residuals)
             out_pairs = [
-                one_q_ef(g, r) for g, r in zip(g_leaves, r_leaves)
+                one_q(g, r) for g, r in zip(g_leaves, r_leaves)
             ]
             reduced = jax.tree_util.tree_unflatten(
                 treedef, [t[0] for t in out_pairs]
@@ -100,17 +108,7 @@ def _allreduce_grads(
             )
             return reduced, new_residuals
 
-        def one_q(g):
-            if prescale_factor != 1.0:
-                g = g * jnp.asarray(prescale_factor, g.dtype)
-            out = traced.quantized_allreduce(
-                g, op=op, axis_name=axis_name, seed=seed
-            )
-            if postscale_factor != 1.0:
-                out = out * jnp.asarray(postscale_factor, out.dtype)
-            return out
-
-        return jax.tree_util.tree_map(one_q, grads)
+        return jax.tree_util.tree_map(lambda g: one_q(g)[0], grads)
     if residuals is not None:
         raise ValueError(
             "error_feedback requires a quantized-wire compression "

@@ -101,9 +101,9 @@ def test_cumulative_error_bounded_with_ef(hvd):
     steps = 40
     ef_err = _cumulative_error(mesh, True, steps, g)
     plain_err = _cumulative_error(mesh, False, steps, g)
-    # EF: bounded by a few quanta regardless of step count (stage-1
-    # error is compensated; stage-2 stays a zero-mean random walk of
-    # bounded-variance increments). Plain: the FULL error random-walks.
+    # EF compensates BOTH stages (traced.py return_residual), so the
+    # error is bounded by ~one round's uncompensated carry regardless
+    # of step count. Plain: the full error random-walks.
     assert ef_err < 8.0, f"EF cumulative error {ef_err} quanta"
     # and EF must be meaningfully tighter than the uncompensated wire
     assert ef_err < plain_err * 0.7, (ef_err, plain_err)

@@ -73,3 +73,67 @@ def test_inconsistent_elastic_bounds_rejected(tmp_path):
                 "--", "true",
             ]
         )
+
+
+def test_remote_gang_members_launch_over_ssh(monkeypatch):
+    """Non-local discovered hosts must get ssh-wrapped worker launches
+    with the HMAC secret on stdin (review finding: the elastic path
+    used to Popen everything locally)."""
+    from horovod_tpu.elastic import driver as driver_mod
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import HostInfo
+
+    _clean_env(monkeypatch)
+    launched = []
+
+    class FakeProc:
+        def __init__(self, cmd, **kwargs):
+            self.cmd = cmd
+            self.kwargs = kwargs
+            self.stdin = None
+            if kwargs.get("stdin") is not None:
+                import io
+
+                self.stdin = io.BytesIO()
+
+        def poll(self):
+            return 0
+
+        def wait(self, timeout=None):
+            return 0
+
+    def fake_popen(cmd, **kwargs):
+        proc = FakeProc(cmd, **kwargs)
+        launched.append(proc)
+        return proc
+
+    monkeypatch.setattr(driver_mod.subprocess, "Popen", fake_popen)
+
+    class OneShotDiscovery:
+        def find_available_hosts_and_slots(self):
+            return [
+                HostInfo("localhost", 1),
+                HostInfo("tpu-worker-7", 1),
+            ]
+
+    d = ElasticDriver(
+        OneShotDiscovery(), ["python", "train.py"], min_np=2, max_np=2
+    )
+    try:
+        d.host_manager.refresh()
+        assignment = d.compute_assignment()
+        assert assignment is not None and assignment.world_size == 2
+        d._launch_gang(assignment)
+        assert len(launched) == 2
+        local = [p for p in launched if p.cmd[0] != "ssh"]
+        remote = [p for p in launched if p.cmd[0] == "ssh"]
+        assert len(local) == 1 and len(remote) == 1
+        joined = " ".join(remote[0].cmd)
+        assert "tpu-worker-7" in joined
+        assert "HOROVOD_RANK" in joined  # env exported through ssh
+        # the secret VALUE must not ride argv (only the shell `read`
+        # stanza names the variable); it arrives via the stdin pipe
+        assert d._secret.hex() not in joined
+        assert remote[0].stdin is not None  # secret went via stdin pipe
+    finally:
+        d.stop()
