@@ -1,0 +1,100 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two long-context strategies (the first is
+``ring_attention``): instead of rotating K/V blocks around a ring,
+**exchange sequence shards for head shards** with one all_to_all, run
+ordinary full-sequence attention on each rank's subset of heads, and
+exchange back (DeepSpeed-Ulysses; the reference ships the alltoall
+primitive this rides [V: horovod/common/ops/*alltoall*] but no
+sequence parallelism at all — SURVEY.md §2.6/§5.7).
+
+Communication: 3 all_to_alls in (q, k, v) + 1 out, each moving
+(sp−1)/sp of a [B, T/sp, H, D] shard — O(B·T·H·D/sp) per rank,
+constant in sequence length per chip, vs the ring's (sp−1) hops of
+K/V blocks. Ulysses wins when heads ≥ sp and the interconnect favors
+few large transfers; ring wins when H < sp or memory for the full-
+sequence scores is the binding constraint (here scores are computed
+per head-shard over the FULL sequence: O(T²/ sp · H) total — use
+ring attention for extreme T).
+
+Use inside ``shard_map`` with the sequence axis sharded:
+
+    out = ulysses_attention(q, k, v, axis_name="sp", causal=True)
+
+q/k/v: [batch, seq_local, heads, head_dim]; heads % sp == 0.
+Differentiable (all_to_all is linear; XLA autodiffs through it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _dense_attention(q, k, v, causal: bool):
+    """fp32-softmax reference attention over [B, T, H, D] — the SAME
+    precision convention as the repo-wide test oracle
+    (tests/conftest.py dense_attention_oracle): fp32 scores, fp32
+    probability-value matmul, cast at the end."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        rows = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
+        s = jnp.where(rows[None, None] >= cols[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = False,
+    attn_fn: Optional[Callable] = None,
+) -> jax.Array:
+    """All-to-all sequence-parallel attention (module docstring).
+
+    ``attn_fn(q, k, v, causal)`` runs the full-sequence attention on
+    the head shard — defaults to the dense fp32-softmax oracle; pass
+    ``ops.flash_attention.flash_attention`` on TPU for O(T) memory in
+    the inner step too.
+    """
+    sp = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    if h % sp:
+        raise ValueError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"sequence-parallel axis size ({sp}); use ring_attention "
+            "for head-poor models"
+        )
+
+    def seq_to_heads(x):
+        # [B, T/sp, H, D] -> [B, T, H/sp, D]: ship head-group j to rank
+        # j (tiled split of the head dim, group-major) while collecting
+        # every rank's sequence shard (rank-major concat = seq order)
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        # [B, T, H/sp, D] -> [B, T/sp, H, D]: the inverse exchange
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg = seq_to_heads(q)
+    kg = seq_to_heads(k)
+    vg = seq_to_heads(v)
+    attn = attn_fn or _dense_attention
+    out = attn(qg, kg, vg, causal)
+    return heads_to_seq(out.astype(q.dtype))

@@ -1,0 +1,101 @@
+"""Ulysses all-to-all sequence parallelism (parallel/ulysses.py): the
+sharded computation must match dense full-sequence attention exactly,
+including gradients (the second SP strategy next to ring_attention —
+SURVEY.md §5.7)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_pkg
+from horovod_tpu.parallel.ulysses import _dense_attention, ulysses_attention
+from tests.conftest import dense_attention_oracle
+
+B, T, H, D = 2, 64, 8, 16
+
+
+def _qkv(seed):
+    rng = np.random.default_rng(seed)
+    return [
+        jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+        for _ in range(3)
+    ]
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_matches_dense_oracle(hvd, causal):
+    mesh = hvd_pkg.mesh()
+    q, k, v = _qkv(0)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, hvd_pkg.WORLD_AXIS), P(None, hvd_pkg.WORLD_AXIS),
+                  P(None, hvd_pkg.WORLD_AXIS)),
+        out_specs=P(None, hvd_pkg.WORLD_AXIS),
+        check_vma=False,
+    )
+    def sharded(q, k, v):
+        return ulysses_attention(
+            q, k, v, axis_name=hvd_pkg.WORLD_AXIS, causal=causal
+        )
+
+    got = np.asarray(jax.jit(sharded)(q, k, v))
+    # INDEPENDENT oracle (conftest) — not ulysses' own _dense_attention,
+    # so a shared attention-math bug cannot cancel out
+    want = np.asarray(dense_attention_oracle(q, k, v, causal))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(_dense_attention(q, k, v, causal)), want,
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_gradients_match_dense(hvd):
+    mesh = hvd_pkg.mesh()
+    q, k, v = _qkv(1)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, hvd_pkg.WORLD_AXIS),) * 3,
+        out_specs=P(),
+        check_vma=False,
+    )
+    def sharded_loss(q, k, v):
+        out = ulysses_attention(
+            q, k, v, axis_name=hvd_pkg.WORLD_AXIS, causal=True
+        )
+        return jax.lax.psum(
+            jnp.sum(out.astype(jnp.float32) ** 2), hvd_pkg.WORLD_AXIS
+        )
+
+    def dense_loss(q, k, v):
+        out = _dense_attention(q, k, v, True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g_sharded = jax.jit(jax.grad(sharded_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for gs, gd in zip(g_sharded, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(gs), np.asarray(gd), rtol=5e-4, atol=5e-4
+        )
+
+
+def test_head_poor_model_rejected(hvd):
+    mesh = hvd_pkg.mesh()
+    q = k = v = jnp.zeros((1, 8, 4, 8), jnp.float32)  # 4 heads < sp=8
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, hvd_pkg.WORLD_AXIS),) * 3,
+        out_specs=P(None, hvd_pkg.WORLD_AXIS),
+        check_vma=False,
+    )
+    def sharded(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=hvd_pkg.WORLD_AXIS)
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(sharded)(q, k, v)
