@@ -144,6 +144,7 @@ def main():
                         "value": round(busbw, 3),
                         "unit": "GB/s",
                         "lat_us": round(dt * 1e6, 1),
+                        "platform": devices[0].platform,
                     }
                 ),
                 flush=True,
@@ -161,6 +162,7 @@ def main():
                     "value": round(e, 4),
                     "unit": "ratio",
                     "busbw_gbs": round(busbw_at_scale_size[world], 3),
+                    "platform": devices[0].platform,
                 }
             ),
             flush=True,
