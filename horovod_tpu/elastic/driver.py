@@ -75,6 +75,8 @@ class ElasticDriver:
         output_filename: Optional[str] = None,
         reset_limit: Optional[int] = None,
         extra_env: Optional[Dict[str, str]] = None,
+        ssh_port: Optional[int] = None,
+        verbose: bool = False,
     ) -> None:
         self.host_manager = HostManager(discovery)
         self._command = list(command)
@@ -87,6 +89,8 @@ class ElasticDriver:
         self._output_filename = output_filename
         self._reset_limit = reset_limit
         self._extra_env = dict(extra_env or {})
+        self._ssh_port = ssh_port
+        self._verbose = verbose
         self._epoch = 0
         self._resets = 0
         self._secret = make_secret_key()
@@ -191,6 +195,15 @@ class ElasticDriver:
                 stderr = open(
                     os.path.join(self._output_filename, tag + ".err"), "wb"
                 )
+            if self._verbose:
+                import sys as _sys
+
+                print(
+                    f"[hvdrun-elastic] epoch {assignment.epoch} rank "
+                    f"{block['HOROVOD_RANK']} on {hostname}: "
+                    + " ".join(self._command),
+                    file=_sys.stderr,
+                )
             if _is_local(hostname):
                 env = dict(os.environ)
                 env.update(block)
@@ -210,7 +223,9 @@ class ElasticDriver:
                 # rides stdin, never the command line
                 from ..runner.launch import _ssh_wrap
 
-                cmd = _ssh_wrap(hostname, None, block, self._command)
+                cmd = _ssh_wrap(
+                    hostname, self._ssh_port, block, self._command
+                )
                 proc = subprocess.Popen(
                     cmd, stdin=subprocess.PIPE, stdout=stdout,
                     stderr=stderr,

@@ -343,22 +343,49 @@ def alltoall_async(
             process_set=process_set,
         )
         return fusion.enqueue(entry)
-    # Uneven: repack on host, fulfill immediately.
+    # Uneven: repack on host, fulfill immediately. With a process set,
+    # the exchange is scoped to the members (splits indexed by member
+    # position, set-size entries per member row); non-members pass
+    # their input through unchanged — the same contract as the traced
+    # set alltoall (ref: process-set Alltoallv [V]).
     rows = (
         [np.asarray(t) for t in tensor]
         if isinstance(tensor, (list, tuple))
         else [np.asarray(tensor[r]) for r in range(world)]
     )
+    if process_set is not None and process_set.process_set_id != 0:
+        members = list(process_set.ranks)
+    else:
+        members = list(range(world))
     splits = [list(map(int, s)) for s in splits]
-    outputs, recv_splits = [], []
-    offsets = [np.concatenate([[0], np.cumsum(s)]) for s in splits]
-    for dst in range(world):
+    if len(splits) < world:
+        raise ValueError(
+            f"splits must have one row per WORLD rank ({world}; "
+            f"non-member rows are ignored), got {len(splits)} rows"
+        )
+    for r in members:
+        if len(splits[r]) != len(members):
+            raise ValueError(
+                f"alltoall splits for rank {r} has {len(splits[r])} "
+                f"entries; expected one per participant "
+                f"({len(members)})"
+            )
+    outputs: list = [None] * world
+    recv_splits: list = [None] * world
+    offsets = {
+        r: np.concatenate([[0], np.cumsum(splits[r])]) for r in members
+    }
+    for j, dst in enumerate(members):
         pieces = [
-            rows[src][offsets[src][dst] : offsets[src][dst + 1]]
-            for src in range(world)
+            rows[src][offsets[src][j] : offsets[src][j + 1]]
+            for src in members
         ]
-        outputs.append(jnp.concatenate(pieces, axis=0))
-        recv_splits.append([splits[src][dst] for src in range(world)])
+        outputs[dst] = jnp.concatenate(pieces, axis=0)
+        recv_splits[dst] = [splits[src][j] for src in members]
+    for r in range(world):
+        if outputs[r] is None:  # non-member: input passes through
+            outputs[r] = jnp.asarray(rows[r])
+            recv_splits[r] = [rows[r].shape[0]]
     handle = Handle(fusion, None)
     handle._fulfill((outputs, recv_splits))
     return handle

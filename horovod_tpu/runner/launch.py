@@ -391,6 +391,8 @@ def _run_elastic(args: argparse.Namespace) -> int:
         output_filename=args.output_filename,
         reset_limit=args.reset_limit,
         extra_env=_runtime_env(args),
+        ssh_port=args.ssh_port,
+        verbose=args.verbose,
     )
     try:
         return driver.run()

@@ -39,16 +39,21 @@ except Exception as _e:  # pragma: no cover - exercised only without TF
 import numpy as np
 
 from ..common.basics import (  # noqa: F401
+    add_process_set,
     cross_rank,
     cross_size,
+    global_process_set,
     init,
     is_initialized,
     local_rank,
     local_size,
+    mpi_threads_supported,
     rank,
+    remove_process_set,
     shutdown,
     size,
 )
+from ..common.process_sets import ProcessSet  # noqa: F401
 from ..ops import eager as _eager
 from ..ops.reduction_ops import (  # noqa: F401
     Adasum,
@@ -137,17 +142,18 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
             _replicated_payload(tensor), name=name, process_set=process_set
         )
         return _TFHandle(handle, tensor).wait()
-    if process_set is not None and process_set.process_set_id != 0:
-        raise NotImplementedError(
-            "alltoall with uneven splits does not support non-global "
-            "process sets in the TF shim; use the JAX eager API"
-        )
     host = np.asarray(tensor)
     world = size()
+    participants = (
+        len(process_set.ranks)
+        if process_set is not None and process_set.process_set_id != 0
+        else world
+    )
     splits_1d = [int(s) for s in np.asarray(splits).reshape(-1).tolist()]
-    if len(splits_1d) != world:
+    if len(splits_1d) != participants:
         raise ValueError(
-            f"splits has {len(splits_1d)} entries but world size is {world}"
+            f"splits has {len(splits_1d)} entries but the exchange has "
+            f"{participants} participants"
         )
     if sum(splits_1d) != host.shape[0]:
         raise ValueError(
@@ -155,7 +161,8 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
             f"{host.shape[0]}"
         )
     handle = _eager.alltoall_async(
-        [host] * world, splits=[splits_1d] * world, name=name
+        [host] * world, splits=[splits_1d] * world, name=name,
+        process_set=process_set,
     )
     outputs, recv_splits = handle.wait()
     return (

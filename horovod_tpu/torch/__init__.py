@@ -319,23 +319,23 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
 def alltoall(tensor, splits=None, name=None, process_set=None):
     if splits is not None:
         # Uneven alltoall-v: this rank's 1-D `splits` says how many dim-0
-        # rows go to each peer; replicated across ranks under the single
-        # controller. Returns (output, received_splits) like the
-        # reference's torch binding [V].
-        if process_set is not None and process_set.process_set_id != 0:
-            raise NotImplementedError(
-                "alltoall with uneven splits does not support non-global "
-                "process sets in the torch shim; use the JAX eager API"
-            )
+        # rows go to each peer (set members when a process set is given);
+        # replicated across ranks under the single controller. Returns
+        # (output, received_splits) like the reference's torch binding [V].
         torch = _torch()
         world = size()
+        participants = (
+            len(process_set.ranks)
+            if process_set is not None and process_set.process_set_id != 0
+            else world
+        )
         host = _to_numpy(tensor)
         splits_1d = [int(s) for s in np.asarray(_to_numpy(splits)
                      if torch.is_tensor(splits) else splits).tolist()]
-        if len(splits_1d) != world:
+        if len(splits_1d) != participants:
             raise ValueError(
-                f"splits has {len(splits_1d)} entries but world size is "
-                f"{world}"
+                f"splits has {len(splits_1d)} entries but the exchange "
+                f"has {participants} participants"
             )
         if sum(splits_1d) != host.shape[0]:
             raise ValueError(
@@ -343,9 +343,13 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
                 f"{host.shape[0]}"
             )
         handle = _eager.alltoall_async(
-            [host] * world, splits=[splits_1d] * world, name=name
+            [host] * world, splits=[splits_1d] * world, name=name,
+            process_set=process_set,
         )
         outputs, recv_splits = handle.wait()
+        # single controller: this process is rank 0; with a set that
+        # excludes rank 0 the exchange happened among the members and
+        # rank 0's row passed through unchanged
         out = _from_numpy(np.asarray(outputs[0]), tensor)
         return out, torch.tensor(recv_splits[0], dtype=torch.int32)
     handle = _eager.alltoall_async(

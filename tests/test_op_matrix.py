@@ -126,3 +126,30 @@ def test_other_reduce_ops_if_supported(hvd, op_name):
         "min": vals.min(), "max": vals.max(), "product": vals.prod()
     }[op_name]
     np.testing.assert_allclose(_np(out)[0], np.full((4,), expect))
+
+
+def test_alltoall_v_over_process_set(hvd):
+    """Uneven alltoall scoped to a process set: members exchange by
+    member position, non-members pass through unchanged (ref:
+    process-set Alltoallv [V]; closed the silent-global-exchange gap)."""
+    ps = hvd.add_process_set([1, 3, 5])
+    try:
+        # every member sends 1 row to the 1st member, 2 to the 2nd,
+        # 3 to the 3rd (genuinely uneven); rows carry the sender id
+        rows = [
+            np.full((6, 2), float(r), np.float32) for r in range(WORLD)
+        ]
+        splits = [[1, 2, 3] for _ in range(WORLD)]
+        out, recv = hvd.alltoall(rows, splits=splits, process_set=ps)
+        got = [np.asarray(o) for o in out]
+        # member 3 (position 1) receives 2 rows from each of 1, 3, 5
+        np.testing.assert_allclose(
+            got[3][:, 0], [1.0, 1.0, 3.0, 3.0, 5.0, 5.0]
+        )
+        assert recv[3] == [2, 2, 2]
+        # member 5 (position 2) receives 3 rows from each member
+        assert recv[5] == [3, 3, 3] and got[5].shape == (9, 2)
+        # non-member 0 passes through unchanged
+        np.testing.assert_allclose(got[0], rows[0])
+    finally:
+        hvd.remove_process_set(ps)

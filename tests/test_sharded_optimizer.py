@@ -71,20 +71,7 @@ def test_matches_replicated_optimizer(hvd, inner):
     s_state = sharded.init(params)
     r_state = replicated.init(params)
 
-    @partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P(), sharded.state_spec(), P(hvd_pkg.WORLD_AXIS),
-                  P(hvd_pkg.WORLD_AXIS)),
-        out_specs=(P(), sharded.state_spec(), P()),
-        check_vma=False,
-    )
-    def s_step(p, st, xb, yb):
-        loss, grads = jax.value_and_grad(_loss)(p, xb[0], yb[0])
-        upd, st = sharded.update(grads, st, p)
-        return optax.apply_updates(p, upd), st, jax.lax.pmean(
-            loss, hvd_pkg.WORLD_AXIS
-        )
-
+    js = _make_sharded_step(sharded)
     @partial(
         jax.shard_map, mesh=mesh,
         in_specs=(P(), P(), P(hvd_pkg.WORLD_AXIS), P(hvd_pkg.WORLD_AXIS)),
@@ -100,7 +87,7 @@ def test_matches_replicated_optimizer(hvd, inner):
 
     sp, rp = params, params
     s_losses, r_losses = [], []
-    js, jr = jax.jit(s_step), jax.jit(r_step)
+    jr = jax.jit(r_step)
     for _ in range(10):
         sp, s_state, sl = js(sp, s_state, x, y)
         rp, r_state, rl = jr(rp, r_state, x, y)

@@ -228,3 +228,17 @@ def test_grouped_ops_tf(hvdtf):
         [tf.constant(np.arange(2.0 * n, dtype=np.float32))], op=hvdtf.Sum
     )
     np.testing.assert_allclose(rs[0].numpy(), np.arange(2.0) * n)
+
+
+def test_alltoall_v_over_process_set_tf(hvdtf):
+    """Uneven alltoall scoped to a set through the TF shim (the former
+    NotImplementedError path)."""
+    ps = hvdtf.add_process_set([0, 2, 4])
+    try:
+        x = tf.reshape(tf.range(12, dtype=tf.float32), (6, 2))
+        out, recv = hvdtf.alltoall(x, splits=[1, 2, 3], process_set=ps)
+        assert out.shape == (3, 2)
+        assert recv.numpy().tolist() == [1, 1, 1]
+        np.testing.assert_allclose(out[0].numpy(), x[0].numpy())
+    finally:
+        hvdtf.remove_process_set(ps)

@@ -391,3 +391,21 @@ def test_grouped_reducescatter_torch(hvdt):
         # rank 0 shard of the world sum
         expected = (np.arange(2.0) + i) * n
         np.testing.assert_allclose(out.numpy(), expected)
+
+
+def test_alltoall_v_over_process_set_torch(hvdt):
+    """Uneven alltoall scoped to a set through the torch shim (the
+    former NotImplementedError path)."""
+    torch = pytest.importorskip("torch")
+    ps = hvdt.add_process_set([0, 2, 4])
+    try:
+        x = torch.arange(12, dtype=torch.float32).reshape(6, 2)
+        out, recv = hvdt.alltoall(x, splits=[1, 2, 3], process_set=ps)
+        # rank 0 = first member: receives 1 row from each of 0, 2, 4
+        assert out.shape == (3, 2)
+        assert recv.tolist() == [1, 1, 1]
+        # every member replicates rank-major under the single
+        # controller, so the first row is row 0 of member 0's tensor
+        np.testing.assert_allclose(out[0].numpy(), x[0].numpy())
+    finally:
+        hvdt.remove_process_set(ps)
