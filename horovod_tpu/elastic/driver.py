@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 import signal
 import subprocess
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -196,13 +197,11 @@ class ElasticDriver:
                     os.path.join(self._output_filename, tag + ".err"), "wb"
                 )
             if self._verbose:
-                import sys as _sys
-
                 print(
                     f"[hvdrun-elastic] epoch {assignment.epoch} rank "
                     f"{block['HOROVOD_RANK']} on {hostname}: "
                     + " ".join(self._command),
-                    file=_sys.stderr,
+                    file=sys.stderr,
                 )
             if _is_local(hostname):
                 env = dict(os.environ)

@@ -357,12 +357,17 @@ def alltoall_async(
         members = list(process_set.ranks)
     else:
         members = list(range(world))
-    splits = [list(map(int, s)) for s in splits]
     if len(splits) < world:
         raise ValueError(
             f"splits must have one row per WORLD rank ({world}; "
             f"non-member rows are ignored), got {len(splits)} rows"
         )
+    # convert/validate MEMBER rows only — non-member rows really are
+    # ignored (placeholders like None are fine there)
+    splits = [
+        list(map(int, s)) if r in set(members) else None
+        for r, s in enumerate(splits)
+    ]
     for r in members:
         if len(splits[r]) != len(members):
             raise ValueError(

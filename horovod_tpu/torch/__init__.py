@@ -192,12 +192,17 @@ class _GroupedHandle:
 def grouped_allreduce_async(
     tensors, average=None, name=None, op=None, process_set=None
 ) -> _GroupedHandle:
-    return _GroupedHandle([
-        allreduce_async(t, average=average, op=op,
-                        name=None if name is None else f"{name}.{i}",
-                        process_set=process_set)
-        for i, t in enumerate(tensors)
-    ])
+    """Atomic multi-tensor allreduce (ref: hvd.grouped_allreduce /
+    group_table.cc [V]): rides the eager path's begin/end_group so the
+    whole list lands in ONE fusion cycle — per-tensor enqueues could be
+    split across cycles by a threshold flush mid-group."""
+    handles = _eager.grouped_allreduce_async(
+        [_replicated_payload(t) for t in tensors],
+        average=average, name=name, op=op, process_set=process_set,
+    )
+    return _GroupedHandle(
+        [_TorchHandle(h, t) for h, t in zip(handles, tensors)]
+    )
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,

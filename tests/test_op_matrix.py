@@ -153,3 +153,23 @@ def test_alltoall_v_over_process_set(hvd):
         np.testing.assert_allclose(got[0], rows[0])
     finally:
         hvd.remove_process_set(ps)
+
+
+def test_alltoall_v_nonmember_split_rows_are_placeholders(hvd):
+    """The documented contract: non-member splits rows are IGNORED —
+    None placeholders must work (review regression)."""
+    ps = hvd.add_process_set([0, 2, 4])
+    try:
+        rows = [
+            np.full((3, 2), float(r), np.float32) for r in range(WORLD)
+        ]
+        splits = [
+            [1, 1, 1] if r in (0, 2, 4) else None for r in range(WORLD)
+        ]
+        out, recv = hvd.alltoall(rows, splits=splits, process_set=ps)
+        np.testing.assert_allclose(
+            np.asarray(out[0])[:, 0], [0.0, 2.0, 4.0]
+        )
+        np.testing.assert_allclose(np.asarray(out[1]), rows[1])
+    finally:
+        hvd.remove_process_set(ps)

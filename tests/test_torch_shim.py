@@ -409,3 +409,31 @@ def test_alltoall_v_over_process_set_torch(hvdt):
         np.testing.assert_allclose(out[0].numpy(), x[0].numpy())
     finally:
         hvdt.remove_process_set(ps)
+
+
+def test_grouped_allreduce_atomic_over_threshold_torch(hvdt):
+    """The torch grouped path must ride the eager group machinery: a
+    group bigger than the fusion threshold completes in ONE cycle
+    (group_table.cc atomicity [V]; the old per-tensor enqueues could
+    split mid-group)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.common import basics
+
+    fusion = basics.state().fusion
+    old_threshold = fusion.threshold_bytes
+    fusion.threshold_bytes = 64  # tiny: every member crosses it
+    try:
+        cycles_before = fusion.cycles
+        outs = hvdt.grouped_allreduce(
+            [torch.ones(64) * (i + 1) for i in range(4)], op=hvdt.Sum
+        )
+        n = hvdt.size()
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(
+                out.numpy(), np.full(64, float((i + 1) * n))
+            )
+        assert fusion.cycles == cycles_before + 1, (
+            fusion.cycles, cycles_before
+        )
+    finally:
+        fusion.threshold_bytes = old_threshold
