@@ -26,7 +26,10 @@ Env knobs: BENCH_BATCH (default 256 — measured-best MXU utilization on
 the v5e-class chip; the reference harness defaults to 32, which here
 leaves ~15% throughput on the table), BENCH_ITERS, BENCH_WARMUP,
 BENCH_PLATFORM=cpu to force the host platform, BENCH_ATTEMPTS,
-BENCH_ATTEMPT_TIMEOUT (s), BENCH_PEAK_TFLOPS to override the MFU
+BENCH_ATTEMPT_TIMEOUT (s, per attempt — must outlast a chip-claim
+queue cycle), BENCH_TOTAL_BUDGET (s, whole-orchestration cap: further
+attempts start only while a full window fits, then the CPU fallback
+runs within what remains), BENCH_PEAK_TFLOPS to override the MFU
 denominator.
 """
 
@@ -208,8 +211,37 @@ def orchestrate():
     if forced:
         attempts = 1  # platform is explicit; no TPU-retry dance
 
+    # Total-time budget (BENCH_TOTAL_BUDGET, s): during a multi-hour
+    # backend outage the full ladder (4 x 30 min + backoffs) could
+    # outlive the caller's own patience and die rc=124 with NO line at
+    # all — worse than the honest platform=cpu fallback. Rules:
+    # * further attempts start only when a FULL attempt window still
+    #   fits (a truncated window would be killed mid-claim — the very
+    #   queue-wedging the 30-min timeout exists to avoid — and could
+    #   not have succeeded anyway);
+    # * the check runs BEFORE the backoff sleep, not after;
+    # * attempt 0 always runs (floored at 120s — a legitimate run
+    #   needs ~2 min), so tiny budgets still get one real try;
+    # * the CPU fallback's own timeout is capped by what's left.
+    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "4200"))
+    cpu_headroom = 420.0
+    t_start = time.monotonic()
+
+    def _remaining() -> float:
+        return total_budget - (time.monotonic() - t_start)
+
     last_err = ""
     for i in range(attempts):
+        if not forced and i > 0 and (
+            _remaining() - cpu_headroom - 120.0 * i < timeout
+        ):
+            print(
+                f"bench: {total_budget - _remaining():.0f}s spent of "
+                f"{total_budget:.0f}s budget; a full attempt window no "
+                "longer fits — moving to the honest CPU fallback",
+                file=sys.stderr,
+            )
+            break
         if i > 0:
             # Stale chip claims take many minutes to clear (measured
             # 2026-07-30: ~20 min per wedge cycle; the r02 ladder of
@@ -222,7 +254,12 @@ def orchestrate():
                 file=sys.stderr,
             )
             time.sleep(delay)
-        proc = _spawn(base_env, timeout)
+        attempt_timeout = timeout
+        if not forced and i == 0:
+            attempt_timeout = min(
+                timeout, max(total_budget - cpu_headroom, 120.0)
+            )
+        proc = _spawn(base_env, attempt_timeout)
         parsed = _extract_json(proc.stdout or "")
         if proc.returncode == 0 and parsed is not None:
             print(json.dumps(parsed))
@@ -242,7 +279,9 @@ def orchestrate():
         cpu_env["BENCH_BATCH"] = os.environ.get("BENCH_CPU_BATCH", "32")
         cpu_env["BENCH_ITERS"] = os.environ.get("BENCH_CPU_ITERS", "3")
         cpu_env["BENCH_WARMUP"] = "1"
-        proc = _spawn(cpu_env, timeout)
+        # cap by what's left of the budget, but always leave enough to
+        # actually emit a line (~5 min compile+run at the small batch)
+        proc = _spawn(cpu_env, min(timeout, max(_remaining(), 300.0)))
         parsed = _extract_json(proc.stdout or "")
         if proc.returncode == 0 and parsed is not None:
             parsed["error"] = (
