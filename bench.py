@@ -222,7 +222,10 @@ def orchestrate():
     # * the check runs BEFORE the backoff sleep, not after;
     # * attempt 0 always runs (floored at 120s — a legitimate run
     #   needs ~2 min), so tiny budgets still get one real try;
-    # * the CPU fallback's own timeout is capped by what's left.
+    # * the CPU fallback's own timeout is capped by what's left but
+    #   floored at 300s so a line always gets out — consequently a
+    #   budget below ~420s can be EXCEEDED by up to that floor sum;
+    #   size any outer watchdog to BENCH_TOTAL_BUDGET + 600s.
     total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "4200"))
     cpu_headroom = 420.0
     t_start = time.monotonic()
@@ -232,8 +235,9 @@ def orchestrate():
 
     last_err = ""
     for i in range(attempts):
+        delay = 120.0 * i  # backoff for THIS attempt (0 for the first)
         if not forced and i > 0 and (
-            _remaining() - cpu_headroom - 120.0 * i < timeout
+            _remaining() - cpu_headroom - delay < timeout
         ):
             print(
                 f"bench: {total_budget - _remaining():.0f}s spent of "
@@ -247,7 +251,6 @@ def orchestrate():
             # 2026-07-30: ~20 min per wedge cycle; the r02 ladder of
             # 30s+60s was hopeless). 120/240/360s between attempts on
             # top of the 30-min in-attempt patience.
-            delay = 120.0 * i
             print(
                 f"bench: attempt {i} failed, retrying in {delay:.0f}s "
                 f"(TPU backend may be recovering a stale chip claim)",

@@ -63,8 +63,24 @@ void kv_worker(void* server, int tid, std::atomic<int>* errors) {
     if (i % 17 == 0) {
       hvd_kv_keys(server, scope, buf, sizeof(buf));
     }
+    // Exercise drop_scope against concurrent put/get/keys — but on a
+    // scope whose values nobody verifies: dropping scope0-2 mid-flight
+    // would make another thread's put/get check fail by DESIGN (the
+    // drop legally races the pair), which is a driver bug, not a
+    // kvstore race (observed as a rare "value mismatches: 1").
+    if (i % 13 == 0) {
+      std::snprintf(key, sizeof(key), "s%d.k%d", tid, i);
+      hvd_kv_put(server, "scratch", key,
+                 reinterpret_cast<const uint8_t*>(val.data()),
+                 static_cast<long>(val.size()));
+      // UNVERIFIED reads on the droppable scope: keeps TSAN coverage
+      // of get()/keys() racing drop_scope() without a value check
+      // that the race legally breaks.
+      hvd_kv_get(server, "scratch", key, buf, sizeof(buf));
+      hvd_kv_keys(server, "scratch", buf, sizeof(buf));
+    }
     if (i % 61 == 60) {
-      hvd_kv_drop_scope(server, "scope2");
+      hvd_kv_drop_scope(server, "scratch");
     }
   }
 }
