@@ -51,6 +51,146 @@ def test_scaling_efficiency_empty():
     assert scaling_efficiency({}) == (None, {})
 
 
+class TestStaleArtifactFallback:
+    """BENCH_r03 regression (rc=124): the orchestrator must ALWAYS emit
+    a parseable line inside its budget, preferring a committed real-TPU
+    artifact over a CPU number when the backend is down."""
+
+    METRIC = "resnet50_synth_img_per_sec"
+
+    def _write(self, d, name, payload):
+        (d / name).write_text(json.dumps(payload) + "\n")
+
+    def _tpu_line(self, value=100.0, metric=None):
+        return {
+            "metric": metric or self.METRIC,
+            "value": value,
+            "unit": "img/s",
+            "vs_baseline": 1.0,
+            "platform": "tpu",
+        }
+
+    def test_picks_most_recent_tpu_artifact(self, tmp_path, monkeypatch):
+        import bench
+
+        self._write(tmp_path, "old_r01.json", self._tpu_line(1.0))
+        self._write(tmp_path, "new_r03.json", self._tpu_line(2.0))
+        os.utime(tmp_path / "old_r01.json", (1000, 1000))
+        monkeypatch.setenv("BENCH_RESULTS_DIR", str(tmp_path))
+        parsed, path, _ = bench._stale_artifact(self.METRIC)
+        assert parsed["value"] == 2.0
+        assert path.endswith("new_r03.json")
+
+    def test_skips_sim_cpu_zero_and_stale_artifacts(
+        self, tmp_path, monkeypatch
+    ):
+        import bench
+
+        self._write(tmp_path, "sim_thing.json", self._tpu_line(5.0))
+        cpu = self._tpu_line(6.0)
+        cpu["platform"] = "cpu"
+        self._write(tmp_path, "cpu_fallback.json", cpu)
+        self._write(tmp_path, "failed.json", self._tpu_line(0.0))
+        self._write(tmp_path, "other_metric.json",
+                    self._tpu_line(7.0, metric="bert_large_samples_per_sec"))
+        # a prior outage's reprint must never be re-laundered with a
+        # fresh captured_at
+        reprint = self._tpu_line(8.0)
+        reprint["stale"] = True
+        self._write(tmp_path, "reprint_r04.json", reprint)
+        monkeypatch.setenv("BENCH_RESULTS_DIR", str(tmp_path))
+        assert bench._stale_artifact(self.METRIC) is None
+
+    def test_prefers_embedded_captured_at_over_mtime(
+        self, tmp_path, monkeypatch
+    ):
+        """mtime is checkout time after a fresh clone; the measurement's
+        own stamp wins."""
+        import bench
+
+        newer = self._tpu_line(1.0)
+        newer["captured_at"] = "2026-07-30T06:00:00Z"
+        older = self._tpu_line(2.0)
+        older["captured_at"] = "2026-07-29T06:00:00Z"
+        self._write(tmp_path, "a.json", newer)
+        self._write(tmp_path, "b.json", older)
+        os.utime(tmp_path / "a.json", (1000, 1000))  # mtime says a is old
+        # an UNSTAMPED artifact with a fresh mtime (= checkout time on a
+        # clone) must lose to ANY stamped one
+        self._write(tmp_path, "unstamped.json", self._tpu_line(3.0))
+        monkeypatch.setenv("BENCH_RESULTS_DIR", str(tmp_path))
+        parsed, _, when = bench._stale_artifact(self.METRIC)
+        assert parsed["value"] == 1.0
+        assert when == "2026-07-30T06:00:00Z"
+
+    def _run_orchestrator(self, tmp_path, extra_env):
+        env = dict(os.environ)
+        env.update(
+            {
+                "BENCH_RESULTS_DIR": str(tmp_path),
+                "BENCH_FAIL_INNER": "1",  # every spawn dies instantly
+                "BENCH_ATTEMPTS": "1",
+                "BENCH_ATTEMPT_TIMEOUT": "30",
+                "BENCH_TOTAL_BUDGET": "60",
+                "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            }
+        )
+        env.update(extra_env)
+        return subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+
+    def test_config_mismatch_never_substituted(self, tmp_path, monkeypatch):
+        """A space_to_depth-stem or odd-batch probe shares the metric
+        name; an outage reprint must not swap configs silently."""
+        import bench
+
+        s2d = self._tpu_line(9999.0)
+        s2d["stem"] = "space_to_depth"
+        s2d["captured_at"] = "2026-07-30T09:00:00Z"
+        self._write(tmp_path, "resnet50_s2d_r03.json", s2d)
+        big_batch = self._tpu_line(8888.0)
+        big_batch["batch"] = 1024
+        self._write(tmp_path, "resnet50_b1024.json", big_batch)
+        default = self._tpu_line(2577.0)
+        default["captured_at"] = "2026-07-30T05:00:00Z"
+        default["batch"] = 256
+        self._write(tmp_path, "resnet50_r03.json", default)
+        monkeypatch.setenv("BENCH_RESULTS_DIR", str(tmp_path))
+        cfg = {"batch": (256, 256), "stem": ("conv7", "conv7")}
+        parsed, _, _ = bench._stale_artifact(self.METRIC, config=cfg)
+        assert parsed["value"] == 2577.0
+
+    def test_orchestrator_reprints_stale_tpu_line(self, tmp_path):
+        self._write(tmp_path, "resnet50_r03.json", self._tpu_line(2577.0))
+        proc = self._run_orchestrator(tmp_path, {})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert line["value"] == 2577.0
+        assert line["platform"] == "tpu"
+        assert line["stale"] is True
+        assert "captured_at" in line and "source" in line
+
+    def test_orchestrator_diagnostic_line_when_nothing_left(self, tmp_path):
+        """No stale artifact + CPU fallback also fails: still ONE
+        parseable line (value 0, error populated), nonzero rc."""
+        proc = self._run_orchestrator(tmp_path, {"BENCH_STALE": "0"})
+        assert proc.returncode == 1
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert line["value"] == 0.0
+        assert "error" in line
+
+    def test_budget_default_inside_driver_timeout(self):
+        """The r3 postmortem contract: the DEFAULT total budget plus
+        fallback floors must fit `timeout 1200 python bench.py`."""
+        import bench  # noqa: F401 — import keeps the constant honest
+
+        src = open(os.path.join(_REPO, "bench.py")).read()
+        assert '"BENCH_TOTAL_BUDGET", "900"' in src
+        assert '"BENCH_ATTEMPT_TIMEOUT", "600"' in src
+
+
 @pytest.mark.slow
 def test_bench_allreduce_cpu_sim_end_to_end():
     """The sweep runs on the simulated mesh and emits both per-point
@@ -75,3 +215,6 @@ def test_bench_allreduce_cpu_sim_end_to_end():
     assert all(ln["base_world"] == 1 for ln in scaling)
     base_line = next(ln for ln in scaling if ln["world"] == 1)
     assert base_line["value"] == 1.0
+    # CPU-sim quarantine: every non-TPU scaling line carries the
+    # logic-validation-only note (VERDICT r3 weak #8)
+    assert all("logic-validation only" in ln["note"] for ln in scaling)
