@@ -54,6 +54,32 @@ class ProcessSet:
         return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
 
 
+def warn_nonmember_controller(op_name: str, process_set) -> None:
+    """Warn when a framework-shim collective is called with a process
+    set that EXCLUDES rank 0 (ADVICE r3): under the single-controller
+    model the shim caller is rank 0, so its tensor passes through
+    unchanged — the reference errors for non-member callers, and
+    silent pass-through can mask misuse. The contract is documented in
+    docs/api.md ("Process sets under the single controller")."""
+    if (
+        process_set is not None
+        and process_set.process_set_id != 0
+        and 0 not in process_set.ranks
+    ):
+        import warnings
+
+        warnings.warn(
+            f"{op_name} over a process set that excludes rank 0: under "
+            "the single-controller model this caller IS rank 0, so its "
+            "tensor passes through unchanged (the exchange still "
+            "happens among the members' rows). The reference errors "
+            "for non-member callers — if you relied on that, check "
+            "process_set.ranks before calling. See docs/api.md "
+            "'Process sets under the single controller'.",
+            stacklevel=3,
+        )
+
+
 class ProcessSetTable:
     """Registry mapping ids → ProcessSet, id 0 = global
     (ref: ProcessSetTable in horovod/common/process_set.h [V])."""

@@ -357,24 +357,41 @@ def alltoall_async(
         members = list(process_set.ranks)
     else:
         members = list(range(world))
-    if len(splits) < world:
+    if len(splits) != world:
         raise ValueError(
-            f"splits must have one row per WORLD rank ({world}; "
+            f"splits must have exactly one row per WORLD rank ({world}; "
             f"non-member rows are ignored), got {len(splits)} rows"
         )
+
     # convert/validate MEMBER rows only — non-member rows really are
     # ignored (placeholders like None are fine there)
+    def _member_row(r, s):
+        try:
+            row = [int(v) for v in s]
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"alltoall splits row for member rank {r} must be a "
+                f"sequence of ints, got {s!r}"
+            ) from None
+        if len(row) != len(members):
+            raise ValueError(
+                f"alltoall splits for rank {r} has {len(row)} "
+                f"entries; expected one per participant ({len(members)})"
+            )
+        if sum(row) != rows[r].shape[0]:
+            # numpy slicing clamps out-of-range offsets silently, which
+            # would truncate data while recv_splits claims otherwise
+            raise ValueError(
+                f"alltoall splits for rank {r} sum to {sum(row)} but "
+                f"that rank's tensor dim0 is {rows[r].shape[0]}"
+            )
+        return row
+
+    member_set = set(members)
     splits = [
-        list(map(int, s)) if r in set(members) else None
+        _member_row(r, s) if r in member_set else None
         for r, s in enumerate(splits)
     ]
-    for r in members:
-        if len(splits[r]) != len(members):
-            raise ValueError(
-                f"alltoall splits for rank {r} has {len(splits[r])} "
-                f"entries; expected one per participant "
-                f"({len(members)})"
-            )
     outputs: list = [None] * world
     recv_splits: list = [None] * world
     offsets = {

@@ -43,10 +43,27 @@ _NEG_INF = -1e30
 
 # Mosaic requires the last two dims of every block to be (8k, 128k) or
 # the full array dims. Row statistics (lse) are per-Q-row scalars, so
-# they ride a broadcast 128-lane minor dim — the same layout the
-# official jax.experimental.pallas.ops.tpu.flash_attention uses
-# (MIN_BLOCK_SIZE trailing dim on l/m).
+# inside the kernels they ride a broadcast lane minor dim — the same
+# layout the official jax.experimental.pallas.ops.tpu.flash_attention
+# uses (MIN_BLOCK_SIZE trailing dim on l/m). ACROSS kernels, though,
+# the lse lives width-1 (minor dim 1 = the full array dim, which
+# Mosaic's block rule also accepts): materializing the broadcast as a
+# (bh, seq, 128) HBM array made bwd lse traffic and the dkv kernel's
+# VMEM footprint 128x larger than needed (ADVICE r3).
+# HOROVOD_FLASH_LSE_BROADCAST=1 restores the broadcast interchange
+# layout (escape hatch while the width-1 layout awaits real-TPU
+# validation; interpret-mode tests cover both).
 _STATS_LANES = 128
+
+
+def _interchange_lanes() -> int:
+    import os
+
+    return (
+        _STATS_LANES
+        if os.environ.get("HOROVOD_FLASH_LSE_BROADCAST")
+        else 1
+    )
 
 
 def _causal_bound(qi, block_q, block_k, n_blocks):
@@ -106,8 +123,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # lane width comes from the out spec: 128 broadcast lanes or the
+    # compact width-1 interchange layout (module docstring)
     lse_ref[0] = jnp.broadcast_to(
-        m + jnp.log(l_safe), (block_q, _STATS_LANES)
+        m + jnp.log(l_safe), (block_q, lse_ref.shape[-1])
     )
 
 
@@ -241,6 +260,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
     bh, seq, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_q = seq // block_q
+    lanes = _interchange_lanes()
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
@@ -256,12 +276,12 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec(
-                (1, block_q, _STATS_LANES), lambda b, i: (b, i, 0)
+                (1, block_q, lanes), lambda b, i: (b, i, 0)
             ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, seq, _STATS_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq, lanes), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -279,9 +299,16 @@ def _flash_fwd_vjp(q, k, v, causal, block_q, block_k):
 
 def _flash_bwd_vjp(causal, block_q, block_k, res, do):
     q, k, v, o, lse_lane = res
-    lse = jnp.broadcast_to(
-        lse_lane[..., None], (*lse_lane.shape, _STATS_LANES)
-    )
+    lanes = _interchange_lanes()
+    if lanes == 1:
+        # compact interchange: (bh, seq, 1) — the kernels' [:, 0:1]
+        # slices read it unchanged, at 1/128th the HBM traffic and
+        # dkv VMEM of the broadcast layout
+        lse = lse_lane[..., None]
+    else:
+        lse = jnp.broadcast_to(
+            lse_lane[..., None], (*lse_lane.shape, lanes)
+        )
     bh, seq, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_q = seq // block_q
@@ -299,7 +326,7 @@ def _flash_bwd_vjp(causal, block_q, block_k, res, do):
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec(
-                (1, block_q, _STATS_LANES), lambda b, i: (b, i, 0)
+                (1, block_q, lanes), lambda b, i: (b, i, 0)
             ),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -319,7 +346,7 @@ def _flash_bwd_vjp(causal, block_q, block_k, res, do):
             pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec(
-                (1, seq, _STATS_LANES), lambda b, i: (b, 0, 0)
+                (1, seq, lanes), lambda b, i: (b, 0, 0)
             ),
         ],
         out_specs=[

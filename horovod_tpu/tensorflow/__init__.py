@@ -53,7 +53,10 @@ from ..common.basics import (  # noqa: F401
     shutdown,
     size,
 )
-from ..common.process_sets import ProcessSet  # noqa: F401
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet,
+    warn_nonmember_controller as _warn_nonmember_controller,
+)
 from ..ops import eager as _eager
 from ..ops.reduction_ops import (  # noqa: F401
     Adasum,
@@ -93,6 +96,7 @@ class _TFHandle:
 
 def allreduce_async(tensor, average=None, name=None, op=None,
                     process_set=None):
+    _warn_nonmember_controller("allreduce", process_set)
     handle = _eager.allreduce_async(
         _replicated_payload(tensor), average=average, name=name, op=op,
         process_set=process_set,
@@ -107,6 +111,7 @@ def allreduce(tensor, average=None, name=None, op=None, process_set=None):
 
 
 def allgather_async(tensor, name=None, process_set=None):
+    _warn_nonmember_controller("allgather", process_set)
     handle = _eager.allgather_async(
         _replicated_payload(tensor), name=name, process_set=process_set
     )
@@ -118,6 +123,7 @@ def allgather(tensor, name=None, process_set=None):
 
 
 def broadcast(tensor, root_rank, name=None, process_set=None):
+    _warn_nonmember_controller("broadcast", process_set)
     handle = _eager.broadcast_async(
         _replicated_payload(tensor), root_rank, name=name,
         process_set=process_set,
@@ -137,6 +143,7 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
     in horovod/tensorflow/mpi_ops.py [V]). With ``splits`` (1-D, one
     entry per rank) returns ``(output, received_splits)`` like the
     reference's v-variant; without, the equal-split fast path."""
+    _warn_nonmember_controller("alltoall", process_set)
     if splits is None:
         handle = _eager.alltoall_async(
             _replicated_payload(tensor), name=name, process_set=process_set
@@ -176,6 +183,7 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
     """Atomic multi-tensor allreduce (ref: hvd.grouped_allreduce in
     horovod/tensorflow/mpi_ops.py [V]): one fused collective for the
     whole list."""
+    _warn_nonmember_controller("grouped_allreduce", process_set)
     handles = _eager.grouped_allreduce_async(
         [_replicated_payload(t) for t in tensors],
         average=average, name=name, op=op, process_set=process_set,
@@ -188,6 +196,7 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
 def grouped_allgather(tensors, name=None, process_set=None):
     """Atomic multi-tensor allgather (ref: hvd.grouped_allgather,
     upstream v0.28+ [V])."""
+    _warn_nonmember_controller("grouped_allgather", process_set)
     handles = _eager.grouped_allgather_async(
         [_replicated_payload(t) for t in tensors], name=name,
         process_set=process_set,
@@ -201,6 +210,7 @@ def grouped_allgather(tensors, name=None, process_set=None):
 def grouped_reducescatter(tensors, op=None, name=None, process_set=None):
     """Atomic multi-tensor reduce-scatter (ref: hvd.grouped_reducescatter,
     upstream v0.28+ [V])."""
+    _warn_nonmember_controller("grouped_reducescatter", process_set)
     handles = _eager.grouped_reducescatter_async(
         [_replicated_payload(t) for t in tensors], op=op, name=name,
         process_set=process_set,
@@ -215,6 +225,7 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
     (ref: hvd.reducescatter, upstream v0.27+ [V]). Under the single
     controller this process is rank 0, so the rank-0 row is our shard —
     even and uneven (v-variant) cases both."""
+    _warn_nonmember_controller("reducescatter", process_set)
     handle = _eager.reducescatter_async(
         _replicated_payload(tensor), op=op, name=name,
         process_set=process_set,

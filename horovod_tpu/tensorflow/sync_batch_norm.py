@@ -106,19 +106,37 @@ class SyncBatchNormalization(tf.keras.layers.Layer):
 
     def call(self, inputs, training=None):
         x = tf.convert_to_tensor(inputs)
-        dtype = x.dtype
+        if tf.is_tensor(training):
+            # Symbolic training flag (legacy Keras passes a placeholder
+            # inside tf.function graphs): `not training` would raise
+            # OperatorNotAllowedInGraphError, so build both branches and
+            # select like keras.layers.BatchNormalization's smart_cond.
+            # Stateful ops (the moving-average assigns, the py_function
+            # allreduce) execute only in the taken branch.
+            return tf.cond(
+                tf.cast(training, tf.bool),
+                lambda: self._train_call(x),
+                lambda: self._infer_call(x),
+            )
         if not training:
-            mean = tf.reshape(
-                tf.cast(self.moving_mean, dtype), self._bshape
-            )
-            invstd = tf.reshape(
-                tf.math.rsqrt(
-                    tf.cast(self.moving_variance, dtype) + self.epsilon
-                ),
-                self._bshape,
-            )
-            return self._affine((x - mean) * invstd, dtype)
+            return self._infer_call(x)
+        return self._train_call(x)
 
+    def _infer_call(self, x):
+        dtype = x.dtype
+        mean = tf.reshape(
+            tf.cast(self.moving_mean, dtype), self._bshape
+        )
+        invstd = tf.reshape(
+            tf.math.rsqrt(
+                tf.cast(self.moving_variance, dtype) + self.epsilon
+            ),
+            self._bshape,
+        )
+        return self._affine((x - mean) * invstd, dtype)
+
+    def _train_call(self, x):
+        dtype = x.dtype
         c = self._dim
         xf = tf.cast(x, tf.float32)
         count_local = tf.cast(tf.size(xf) / c, tf.float32)

@@ -40,7 +40,10 @@ from ..common.basics import (  # noqa: F401
     shutdown,
     size,
 )
-from ..common.process_sets import ProcessSet  # noqa: F401
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet,
+    warn_nonmember_controller as _warn_nonmember_controller,
+)
 from ..ops import eager as _eager
 from ..ops.reduction_ops import (  # noqa: F401
     Adasum,
@@ -146,6 +149,7 @@ class _TorchHandle:
 def allreduce_async(
     tensor, average=None, name=None, op=None, process_set=None
 ) -> _TorchHandle:
+    _warn_nonmember_controller("allreduce", process_set)
     handle = _eager.allreduce_async(
         _replicated_payload(tensor), average=average, name=name, op=op,
         process_set=process_set,
@@ -162,6 +166,7 @@ def allreduce(tensor, average=None, name=None, op=None, process_set=None):
 def allreduce_async_(
     tensor, average=None, name=None, op=None, process_set=None
 ) -> _TorchHandle:
+    _warn_nonmember_controller("allreduce_", process_set)
     handle = _eager.allreduce_async(
         _replicated_payload(tensor), average=average, name=name, op=op,
         process_set=process_set,
@@ -196,6 +201,7 @@ def grouped_allreduce_async(
     group_table.cc [V]): rides the eager path's begin/end_group so the
     whole list lands in ONE fusion cycle — per-tensor enqueues could be
     split across cycles by a threshold flush mid-group."""
+    _warn_nonmember_controller("grouped_allreduce", process_set)
     handles = _eager.grouped_allreduce_async(
         [_replicated_payload(t) for t in tensors],
         average=average, name=name, op=op, process_set=process_set,
@@ -221,6 +227,7 @@ def _gather_post(host):
 def grouped_allgather_async(tensors, name=None, process_set=None):
     """Atomic multi-tensor allgather (ref: hvd.grouped_allgather,
     upstream v0.28+ [V])."""
+    _warn_nonmember_controller("grouped_allgather", process_set)
     handles = _eager.grouped_allgather_async(
         [_replicated_payload(t) for t in tensors], name=name,
         process_set=process_set,
@@ -243,6 +250,7 @@ def grouped_reducescatter_async(tensors, op=None, name=None,
                                 process_set=None):
     """Atomic multi-tensor reduce-scatter (ref:
     hvd.grouped_reducescatter, upstream v0.28+ [V])."""
+    _warn_nonmember_controller("grouped_reducescatter", process_set)
     handles = _eager.grouped_reducescatter_async(
         [_replicated_payload(t) for t in tensors], op=op, name=name,
         process_set=process_set,
@@ -259,6 +267,7 @@ def grouped_reducescatter(tensors, op=None, name=None, process_set=None):
 
 
 def allgather_async(tensor, name=None, process_set=None) -> _TorchHandle:
+    _warn_nonmember_controller("allgather", process_set)
     handle = _eager.allgather_async(
         _replicated_payload(tensor), name=name, process_set=process_set
     )
@@ -272,6 +281,7 @@ def allgather(tensor, name=None, process_set=None):
 def broadcast_async(
     tensor, root_rank, name=None, process_set=None
 ) -> _TorchHandle:
+    _warn_nonmember_controller("broadcast", process_set)
     handle = _eager.broadcast_async(
         _replicated_payload(tensor), root_rank, name=name,
         process_set=process_set,
@@ -288,6 +298,7 @@ def broadcast(tensor, root_rank, name=None, process_set=None):
 def broadcast_async_(
     tensor, root_rank, name=None, process_set=None
 ) -> _TorchHandle:
+    _warn_nonmember_controller("broadcast_", process_set)
     handle = _eager.broadcast_async(
         _replicated_payload(tensor), root_rank, name=name,
         process_set=process_set,
@@ -308,6 +319,7 @@ def reducescatter_async(
     split along dim 0 (ref: hvd.reducescatter, upstream v0.27+ [V]).
     Under the single controller this process is rank 0, so the handle's
     rank-0 row IS our shard — even and uneven (v-variant) cases both."""
+    _warn_nonmember_controller("reducescatter", process_set)
     handle = _eager.reducescatter_async(
         _replicated_payload(tensor), op=op, name=name,
         process_set=process_set,
@@ -322,6 +334,7 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):
+    _warn_nonmember_controller("alltoall", process_set)
     if splits is not None:
         # Uneven alltoall-v: this rank's 1-D `splits` says how many dim-0
         # rows go to each peer (set members when a process set is given);

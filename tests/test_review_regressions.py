@@ -209,3 +209,63 @@ def test_adasum_respects_join_mask(hvd, rng):
     np.testing.assert_allclose(
         np.asarray(out[0]), expected, rtol=1e-4, atol=1e-5
     )
+
+
+# ---- ADVICE r3 regressions -------------------------------------------------
+
+
+def test_alltoall_member_splits_row_none_is_clear_error(hvd):
+    """A member rank whose splits row is None must get a ValueError
+    naming the rank, not a TypeError from len(None) (ADVICE r3)."""
+    ps = hvd.add_process_set([0, 2])
+    try:
+        x = rank_major(lambda r: np.arange(4) + r)
+        splits = [[1, 3], None, None, None, None, None, None, None]
+        with pytest.raises(ValueError, match="member rank 2"):
+            hvd.alltoall(x, splits=splits, process_set=ps)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_alltoall_rejects_extra_splits_rows(hvd):
+    """len(splits) > world was silently accepted (ADVICE r3)."""
+    x = rank_major(lambda r: np.arange(8) + r)
+    splits = [[1] * 8] * 9  # 9 rows on an 8-rank world
+    with pytest.raises(ValueError, match="exactly one row per WORLD"):
+        hvd.alltoall(x, splits=splits)
+
+
+def test_shim_alltoall_warns_when_set_excludes_rank0(hvd):
+    """Single-controller pass-through for a non-member controller is a
+    documented contract, but it must be LOUD (ADVICE r3)."""
+    import warnings as _w
+
+    torch = pytest.importorskip("torch")
+    from horovod_tpu import torch as hvdt
+
+    ps = hvd.add_process_set([1, 2])
+    try:
+        with _w.catch_warnings(record=True) as got:
+            _w.simplefilter("always")
+            hvdt.alltoall(torch.arange(8, dtype=torch.float32))
+            assert not any(
+                "excludes rank 0" in str(w.message) for w in got
+            ), "global alltoall must not warn"
+        with _w.catch_warnings(record=True) as got:
+            _w.simplefilter("always")
+            out, recv = hvdt.alltoall(
+                torch.arange(6, dtype=torch.float32).reshape(6, 1),
+                splits=[3, 3],
+                process_set=ps,
+            )
+            assert any(
+                "excludes rank 0" in str(w.message) for w in got
+            ), "non-member controller must warn"
+            # pass-through contract: input unchanged, recv = full dim0
+            np.testing.assert_array_equal(
+                out.numpy(),
+                np.arange(6, dtype=np.float32).reshape(6, 1),
+            )
+            assert recv.tolist() == [6]
+    finally:
+        hvd.remove_process_set(ps)

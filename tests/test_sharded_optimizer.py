@@ -221,3 +221,55 @@ def test_sharded_state_checkpoints_roundtrip(hvd, tmp_path):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-6
         )
+
+
+class TestNonElementwiseGuard:
+    """VERDICT r3 #5: the init-time differential probe must reject
+    norm-coupled inner transforms and accept elementwise ones."""
+
+    @pytest.mark.parametrize(
+        "make",
+        [
+            lambda: optax.clip_by_global_norm(1.0),
+            lambda: optax.chain(
+                optax.clip_by_global_norm(1.0), optax.sgd(0.1)
+            ),
+            lambda: optax.adaptive_grad_clip(0.01),
+            # Adam's step-1 update is scale-invariant: only a
+            # multi-step probe catches clip composed with it
+            lambda: optax.chain(
+                optax.clip_by_global_norm(1.0), optax.adam(1e-3)
+            ),
+            # shape-gated coupling: factored second moment engages only
+            # for dims >= 128, and shards are flattened 1-D
+            lambda: optax.adafactor(1e-3),
+        ],
+        ids=["clip_global_norm", "clip_then_sgd", "adaptive_grad_clip",
+             "clip_then_adam", "adafactor"],
+    )
+    def test_rejects_norm_based_transforms(self, make):
+        with pytest.raises(ValueError, match="not elementwise"):
+            hvd_pkg.ShardedDistributedOptimizer(make())
+
+    @pytest.mark.parametrize(
+        "make",
+        [
+            lambda: optax.sgd(0.1, momentum=0.9),
+            lambda: optax.adam(1e-3),
+            lambda: optax.adamw(1e-3, weight_decay=1e-2),
+            lambda: optax.rmsprop(1e-3),
+            lambda: optax.chain(
+                optax.clip(1.0),  # per-element clip IS elementwise
+                optax.sgd(0.1),
+            ),
+        ],
+        ids=["sgd_momentum", "adam", "adamw", "rmsprop", "clip_elementwise"],
+    )
+    def test_accepts_elementwise_transforms(self, make):
+        hvd_pkg.ShardedDistributedOptimizer(make())  # must not raise
+
+    def test_probe_escape_hatch(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SHARDED_OPT_PROBE", "0")
+        hvd_pkg.ShardedDistributedOptimizer(
+            optax.clip_by_global_norm(1.0)
+        )  # caller accepted the risk; construction proceeds

@@ -127,3 +127,35 @@ def test_scale_center_off(hvd_mesh):
     np.testing.assert_allclose(
         out.numpy().mean(0), np.zeros(3), atol=1e-5
     )
+
+
+def test_symbolic_training_flag_in_graph(hvd_mesh):
+    """Legacy Keras paths pass a symbolic `training` tensor inside
+    tf.function; `not training` would raise
+    OperatorNotAllowedInGraphError (ADVICE r3). Both branch values must
+    match the corresponding python-bool calls."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 6, 3)).astype(np.float32)
+    sbn = hvd_tf.SyncBatchNormalization(momentum=0.9)
+    sbn.build(x.shape)
+    xa = tf.constant(x)
+
+    # seed the moving stats so train/infer outputs differ
+    sbn(xa, training=True)
+
+    @tf.function
+    def run(flag):
+        return sbn(xa, training=flag)
+
+    got_infer = run(tf.constant(False))
+    want_infer = sbn(xa, training=False)
+    np.testing.assert_allclose(
+        got_infer.numpy(), want_infer.numpy(), rtol=1e-5, atol=1e-5
+    )
+
+    moving_before = sbn.moving_mean.numpy().copy()
+    got_train = run(tf.constant(True))
+    # the symbolic-True branch must behave as training: batch stats
+    # normalize the output and the moving average advances
+    assert not np.allclose(got_train.numpy(), want_infer.numpy())
+    assert not np.allclose(sbn.moving_mean.numpy(), moving_before)
