@@ -59,11 +59,8 @@ _STATS_LANES = 128
 def _interchange_lanes() -> int:
     import os
 
-    return (
-        _STATS_LANES
-        if os.environ.get("HOROVOD_FLASH_LSE_BROADCAST")
-        else 1
-    )
+    flag = os.environ.get("HOROVOD_FLASH_LSE_BROADCAST", "")
+    return _STATS_LANES if flag not in ("", "0", "false", "off") else 1
 
 
 def _causal_bound(qi, block_q, block_k, n_blocks):

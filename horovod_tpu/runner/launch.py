@@ -54,15 +54,108 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _load_config_file(path: str, parser: argparse.ArgumentParser) -> dict:
+    """Parse a hvdrun params YAML (ref: horovodrun --config-file,
+    upstream runner/launch.py [V]) into argparse defaults.
+
+    Format: a mapping whose keys are the long option names (dashes or
+    underscores both accepted); one level of nesting joins section and
+    key with a dash, so
+
+        num-proc: 8
+        cycle-time-ms: 3.5
+        fusion:
+          threshold-mb: 32
+        autotune: true
+
+    sets --num-proc/--cycle-time-ms/--fusion-threshold-mb/--autotune.
+    Precedence (documented contract): explicit CLI flags > config file
+    > built-in defaults — the file is applied via parser defaults, so a
+    flag given on the command line always wins. Unknown keys fail fast.
+    """
+    import yaml
+
+    try:
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+    except OSError as e:
+        raise SystemExit(f"--config-file {path}: {e}") from None
+    except yaml.YAMLError as e:
+        raise SystemExit(f"--config-file {path}: invalid YAML: {e}") from None
+    if not isinstance(data, dict):
+        raise SystemExit(
+            f"--config-file {path}: expected a YAML mapping, got "
+            f"{type(data).__name__}"
+        )
+    flat: dict = {}
+    for k, v in data.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                flat[f"{k}-{k2}"] = v2
+        else:
+            flat[k] = v
+    by_dest = {a.dest: a for a in parser._actions}
+    out = {}
+    for k, v in flat.items():
+        dest = str(k).replace("-", "_")
+        if dest in ("help", "command", "config_file") or dest not in by_dest:
+            raise SystemExit(
+                f"--config-file {path}: unknown parameter {k!r} "
+                "(keys are hvdrun's long option names)"
+            )
+        action = by_dest[dest]
+        if isinstance(action, argparse._StoreTrueAction):
+            v = bool(v)
+        elif action.type is not None and v is not None:
+            v = action.type(v)
+        out[dest] = v
+    return out
+
+
 def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     """Flag surface mirrors horovodrun's (launch.py [V]); flags that
     configure the runtime translate into HOROVOD_* env for workers, same
     as the reference."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Pre-scan so config-file values can satisfy the -np requirement.
+    # The scan walks hvdrun's OWN flags only: it stops at "--" or at the
+    # first positional (where the REMAINDER command begins), skipping
+    # each value-taking flag's argument, so a --config-file belonging to
+    # the launched program is never misread as ours.
+    no_value_flags = {
+        "--verbose", "--timeline-mark-cycles", "--autotune",
+        "--hierarchical-allreduce", "--gloo", "--mpi", "-h", "--help",
+    }
+    config_path = None
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--":
+            break
+        if a.startswith("--config-file="):
+            config_path = a.split("=", 1)[1]
+            i += 1
+        elif a == "--config-file":
+            if i + 1 < len(argv):
+                config_path = argv[i + 1]
+            i += 2
+        elif a.startswith("-"):
+            i += 1 if (a in no_value_flags or "=" in a) else 2
+        else:
+            break  # first positional = start of the launched command
     p = argparse.ArgumentParser(
         prog="hvdrun",
         description="Launch a horovod_tpu job across hosts/chips.",
+        # abbreviations would desync the exact-string pre-scan above
+        # (e.g. --config would reach argparse but not the scan)
+        allow_abbrev=False,
     )
-    p.add_argument("-np", "--num-proc", type=int, required=True,
+    p.add_argument("--config-file", default=None,
+                   help="params YAML; CLI flags override its values "
+                        "(keys = long option names, one nesting level "
+                        "joins with a dash)")
+    p.add_argument("-np", "--num-proc", type=int,
+                   required=config_path is None,
                    help="total number of ranks (chips)")
     p.add_argument("-H", "--hosts", default=None,
                    help="comma-separated host:slots list")
@@ -116,7 +209,12 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                    help="accepted for compatibility (no-op)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program and args to launch on every worker")
+    if config_path is not None:
+        p.set_defaults(**_load_config_file(config_path, p))
     args = p.parse_args(argv)
+    if args.num_proc is None:
+        p.error("-np/--num-proc is required (on the CLI or in "
+                "--config-file)")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
     return args

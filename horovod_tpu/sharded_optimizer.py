@@ -91,15 +91,11 @@ def _probe_nonelementwise(inner: optax.GradientTransformation) -> bool:
     matches or cannot be probed (an inner transform that rejects the
     probe shapes is left to the docstring contract).
     """
-    import numpy as _np
-
-    import numpy as _np_det
-
     # The (128, 128) leaf exists for SHAPE-GATED couplings: adafactor
     # factors its second moment only when both dims >= 128, and the
     # sharded path always flattens to 1-D (where it falls back to
     # unfactored RMS) — a tiny-leaf probe would let it through.
-    _det = _np_det.linspace(-1.0, 1.0, 128 * 128, dtype=_np_det.float32)
+    _det = np.linspace(-1.0, 1.0, 128 * 128, dtype=np.float32)
     params = {
         "w": jnp.asarray([1.0, -2.0, 3.0, -4.0], jnp.float32),
         "b": jnp.asarray([0.5, 0.25], jnp.float32),
@@ -169,9 +165,9 @@ def _probe_nonelementwise(inner: optax.GradientTransformation) -> bool:
         leaves_f = jax.tree_util.tree_leaves(full_u)
         leaves_s = jax.tree_util.tree_leaves(shard_u)
         if any(
-            not _np.allclose(
-                _np.asarray(a, _np.float32).reshape(-1),
-                _np.asarray(b, _np.float32).reshape(-1),
+            not np.allclose(
+                np.asarray(a, np.float32).reshape(-1),
+                np.asarray(b, np.float32).reshape(-1),
                 rtol=1e-5,
                 atol=1e-6,
             )

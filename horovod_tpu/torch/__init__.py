@@ -3,11 +3,16 @@
 Parity with the reference's largest user-facing module
 (ref: horovod/torch/__init__.py + mpi_ops.py + optimizer.py +
 functions.py [V] — SURVEY.md §2.4): torch users port their scripts by
-changing one import. Tensors are bridged host-side — each call does a
-``.detach().cpu().numpy()`` copy into the eager collective path, is
-reduced by XLA over the mesh, and copied back into a torch tensor. The
-round-trip is two host copies per call by design: torch (CPU) and XLA
-(TPU) do not share buffers, and honesty beats a fake zero-copy claim.
+changing one import. Tensors are bridged host-side — each call views
+the torch storage (``.detach().cpu().numpy()`` is zero-copy for CPU
+tensors), transfers once into the eager collective path, is reduced by
+XLA over the mesh, and comes back via **dlpack** when the result lives
+on a CPU jax device (``torch.from_dlpack`` shares the XLA buffer — no
+copy; VERDICT r3 #6, the role of the reference's zero-copy
+``adapter_v2.cc`` [V]). On a TPU backend the return is one
+device-to-host transfer + ``torch.from_numpy`` without an extra host
+copy. Worst case one host copy each way; never the old
+numpy→copy→from_numpy double round-trip.
 
 The async handle protocol (`allreduce_async_` → `synchronize`) is kept:
 handles wrap the eager path's fusion-cycle handles, so Horovod's
@@ -105,9 +110,37 @@ def _from_numpy(array: np.ndarray, like):
     contig = np.ascontiguousarray(array)
     if contig.shape != array.shape:  # ascontiguousarray promotes 0-d to (1,)
         contig = contig.reshape(array.shape)
-    return torch.from_numpy(contig.copy()).to(
+    if not contig.flags.writeable:
+        # torch.from_numpy refuses read-only views (e.g. a CPU-backend
+        # jax array's __array__); only then is a defensive copy needed
+        contig = contig.copy()
+    return torch.from_numpy(contig).to(
         dtype=like.dtype, device=like.device
     )
+
+
+def _jax_to_torch(jax_row, like):
+    """Result bridge with a dlpack zero-copy fast path (VERDICT r3 #6;
+    the role of the reference's zero-copy adapter layer,
+    horovod/torch/adapter_v2.cc [V]).
+
+    When the collective result lives on a CPU jax device and the caller
+    wants a CPU torch tensor, ``torch.from_dlpack`` shares the XLA
+    buffer — no host copy at all on the way out (the buffer is a fresh
+    per-call result, so aliasing it to the returned tensor is safe).
+    Any failure (TPU-resident result, exotic dtype, dlpack version
+    skew) falls back to the documented one-copy numpy path.
+    """
+    torch = _torch()
+    try:
+        if like.device.type == "cpu" and list(
+            d.platform for d in jax_row.devices()
+        ) == ["cpu"]:
+            out = torch.from_dlpack(jax_row)
+            return out.to(dtype=like.dtype)  # no-op when dtypes match
+    except Exception:
+        pass
+    return _from_numpy(np.asarray(jax_row), like)
 
 
 def _replicated_payload(tensor):
@@ -132,14 +165,17 @@ class _TorchHandle:
 
     def wait(self):
         result = self._inner.wait()
-        host = np.asarray(_eager.first(result))
+        row = _eager.first(result)
         if self._post is not None:
-            host = self._post(host)
-        elif host.size == int(np.prod(self._like.shape)):
-            # 0-dim torch scalars round-trip as shape-(1,) payloads;
-            # restore the caller's shape before any in-place copy.
-            host = host.reshape(tuple(self._like.shape))
-        out = _from_numpy(host, self._like)
+            out = _from_numpy(self._post(np.asarray(row)), self._like)
+        else:
+            out = _jax_to_torch(row, self._like)
+            if out.numel() == int(np.prod(self._like.shape)) and tuple(
+                out.shape
+            ) != tuple(self._like.shape):
+                # 0-dim torch scalars round-trip as shape-(1,) payloads;
+                # restore the caller's shape before any in-place copy.
+                out = out.reshape(tuple(self._like.shape))
         if self._target is not None:
             self._target.copy_(out)
             return self._target
@@ -368,7 +404,18 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
         # single controller: this process is rank 0; with a set that
         # excludes rank 0 the exchange happened among the members and
         # rank 0's row passed through unchanged
-        out = _from_numpy(np.asarray(outputs[0]), tensor)
+        if (
+            process_set is not None
+            and process_set.process_set_id != 0
+            and 0 not in process_set.ranks
+        ):
+            # Identity pass-through: the eager path may hand back a
+            # zero-copy view of the caller's own input storage, so the
+            # dlpack fast path would alias output to input (mutating
+            # one would corrupt the other). Force a real copy here.
+            out = _from_numpy(np.array(outputs[0], copy=True), tensor)
+        else:
+            out = _jax_to_torch(outputs[0], tensor)
         return out, torch.tensor(recv_splits[0], dtype=torch.int32)
     handle = _eager.alltoall_async(
         _replicated_payload(tensor), name=name, process_set=process_set

@@ -387,3 +387,84 @@ def test_failure_path_kills_all_and_reports(tmp_path):
         capture_output=True,
     )
     assert proc.returncode == 3
+
+
+class TestConfigFile:
+    """hvdrun --config-file params YAML (ref: horovodrun --config-file,
+    upstream runner/launch.py [V]). Precedence: CLI > file > defaults."""
+
+    def _write(self, tmp_path, text):
+        f = tmp_path / "params.yaml"
+        f.write_text(text)
+        return str(f)
+
+    def test_yaml_values_with_nesting(self, tmp_path):
+        path = self._write(
+            tmp_path,
+            "num-proc: 8\n"
+            "placement: per-slot\n"
+            "fusion:\n"
+            "  threshold-mb: 32\n"
+            "cycle-time-ms: 3.5\n"
+            "autotune: true\n",
+        )
+        args = parse_args(
+            ["--config-file", path, "--", "python", "train.py"]
+        )
+        assert args.num_proc == 8
+        assert args.placement == "per-slot"
+        assert args.fusion_threshold_mb == 32.0
+        assert args.cycle_time_ms == 3.5
+        assert args.autotune is True
+        assert args.command == ["python", "train.py"]
+        env = _runtime_env(args)
+        assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+
+    def test_cli_overrides_config_file(self, tmp_path):
+        path = self._write(
+            tmp_path, "num-proc: 8\ncycle-time-ms: 3.5\n"
+        )
+        args = parse_args(
+            ["--config-file", path, "-np", "2", "--", "x"]
+        )
+        assert args.num_proc == 2      # CLI wins
+        assert args.cycle_time_ms == 3.5  # file still applies
+
+    def test_underscore_keys_and_string_coercion(self, tmp_path):
+        path = self._write(
+            tmp_path, "num_proc: '4'\nstart_timeout: '30'\n"
+        )
+        args = parse_args(["--config-file", path, "--", "x"])
+        assert args.num_proc == 4
+        assert args.start_timeout == 30.0
+
+    def test_unknown_key_fails_fast(self, tmp_path):
+        path = self._write(tmp_path, "num-proc: 4\nnot-a-flag: 1\n")
+        with pytest.raises(SystemExit):
+            parse_args(["--config-file", path, "--", "x"])
+
+    def test_np_still_required_without_config(self):
+        with pytest.raises(SystemExit):
+            parse_args(["--cycle-time-ms", "3.5", "--", "x"])
+
+    def test_command_not_scanned_for_config_flag(self, tmp_path):
+        """--config-file appearing only inside the launched command must
+        not be treated as hvdrun's own flag."""
+        args = parse_args(
+            ["-np", "2", "--", "python", "t.py", "--config-file", "u.yaml"]
+        )
+        assert args.config_file is None
+        assert args.command == [
+            "python", "t.py", "--config-file", "u.yaml"
+        ]
+
+    def test_command_config_flag_without_separator(self, tmp_path):
+        """Same, without the `--` separator: the pre-scan must stop at
+        the first positional (start of the command)."""
+        args = parse_args(
+            ["-np", "2", "python", "t.py", "--config-file", "u.yaml"]
+        )
+        assert args.config_file is None
+        assert args.command == [
+            "python", "t.py", "--config-file", "u.yaml"
+        ]
