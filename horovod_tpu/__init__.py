@@ -93,6 +93,7 @@ from .ops.eager import (  # noqa: F401
     grouped_reducescatter_async,
     join,
     join_ranks,
+    my_row,
     poll,
     reducescatter,
     reducescatter_async,

@@ -54,6 +54,23 @@ class ProcessSet:
         return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
 
 
+def member_tables(world: int, ranks):
+    """(member_mask[world], member_position[world]) numpy lookup tables
+    for masked full-axis collectives over a process set — the one shared
+    construction behind the fusion executors and adasum_allreduce's
+    gather+tree path (XLA's TPU lowering rejects unequal replica groups,
+    so subset collectives are expressed as full-axis programs indexed by
+    these tables)."""
+    import numpy as np
+
+    member = np.zeros(world, dtype=bool)
+    pos = np.zeros(world, dtype=np.int32)
+    for i, rk in enumerate(ranks):
+        member[rk] = True
+        pos[rk] = i
+    return member, pos
+
+
 def warn_nonmember_controller(op_name: str, process_set) -> None:
     """Warn when a framework-shim collective is called with a process
     set that EXCLUDES rank 0 (ADVICE r3): under the single-controller

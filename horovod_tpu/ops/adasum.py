@@ -105,14 +105,10 @@ def adasum_allreduce(
     if ranks is not None and len(ranks) == int(lax.axis_size(axis_name)):
         ranks = None
     if ranks is not None:
-        import numpy as np
+        from ..common.process_sets import member_tables
 
         world = int(lax.axis_size(axis_name))
-        mask = np.zeros(world, dtype=bool)
-        pos = np.zeros(world, dtype=np.int32)
-        for i, rk in enumerate(ranks):
-            mask[rk] = True
-            pos[rk] = i
+        mask, pos = member_tables(world, ranks)
         idx = lax.axis_index(axis_name)
         member = jnp.asarray(mask)[idx]
         p = jnp.asarray(pos)[idx]

@@ -73,6 +73,34 @@ def first(result) -> jax.Array:
     return result[0]
 
 
+def my_row(result) -> np.ndarray:
+    """THIS process's row of a rank-major result — the multi-process-safe
+    read (each process gets what its rank received, like the reference's
+    per-process return value [V]).
+
+    Under multi-controller JAX every process must run the SAME program
+    on a global array, so ``result[hvd.rank()]`` — a different index per
+    process — is divergent and silently returns garbage. This reads the
+    locally-addressable shard instead: no cross-process computation at
+    all. Single-process (controller) callers get rank 0's row, same as
+    ``first``.
+    """
+    r = basics.rank()
+    shards = getattr(result, "addressable_shards", None)
+    if shards:
+        for s in shards:
+            idx = s.index[0] if s.index else slice(None)
+            if not isinstance(idx, slice):
+                continue
+            start = idx.start or 0
+            # an open slice means the row dim is replicated on this
+            # shard — it covers every row
+            stop = idx.stop if idx.stop is not None else result.shape[0]
+            if start <= r < stop:
+                return np.asarray(s.data)[r - start]
+    return np.asarray(result[r])
+
+
 # ----------------------------------------------------------------- allreduce
 
 

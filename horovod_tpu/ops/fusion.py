@@ -109,7 +109,6 @@ def _group_key(e: _Entry) -> Tuple:
     )
 
 
-_SUB_MESH_BOUND = 64  # active process sets are few; this is a leak guard
 
 
 class FusionManager:
@@ -127,7 +126,6 @@ class FusionManager:
         self.pending: List[_Entry] = []
         self.pending_bytes = 0
         self.cycle_start: Optional[float] = None
-        self._sub_meshes: "OrderedDict[Tuple[int, ...], Mesh]" = OrderedDict()
         # attached by basics.init:
         self.timeline = None
         self.stall_inspector = None
@@ -349,33 +347,10 @@ class FusionManager:
             "evictions": self.cache_evictions,
         }
 
-    def _sub_mesh(self, ranks: Tuple[int, ...]) -> Mesh:
-        """Sub-communicator mesh over a process set's chips
-        (ref: per-set MPI/NCCL communicators in process_set.cc [V]).
-        Gather-family collectives on a subset run here because XLA's
-        axis_index_groups requires equal-sized groups, which a
-        set+singletons partition cannot provide. Bounded like the
-        executor cache (a Mesh pins device references)."""
-        mesh = self._sub_meshes.get(ranks)
-        if mesh is None:
-            flat = list(self.mesh.devices.flat)
-            mesh = Mesh(
-                np.asarray([flat[r] for r in ranks]), (WORLD_AXIS,)
-            )
-            self._sub_meshes[ranks] = mesh
-            # Bounded by a dedicated constant: the live count tracks the
-            # number of active process sets (small), not the response
-            # cache; coupling it to cache_capacity=0 would thrash.
-            while len(self._sub_meshes) > _SUB_MESH_BOUND:
-                self._sub_meshes.popitem(last=False)
-        else:
-            self._sub_meshes.move_to_end(ranks)
-        return mesh
-
-    def _shard_map(self, fn, mesh=None, out_specs=P(WORLD_AXIS)):
+    def _shard_map(self, fn, out_specs=P(WORLD_AXIS)):
         return shard_map(
             fn,
-            mesh=self.mesh if mesh is None else mesh,
+            mesh=self.mesh,
             in_specs=P(WORLD_AXIS),
             out_specs=out_specs,
             check_vma=False,
@@ -401,32 +376,42 @@ class FusionManager:
         pset_mask = self._pset_mask(e0)
         mask = None if e0.mask is None else tuple(bool(b) for b in e0.mask)
         if e0.op == Adasum and pset_mask is not None:
-            # Adasum over a process set runs on the set's sub-mesh;
-            # non-members pass their input through unchanged. A join
-            # mask composes by zeroing the joined members' rows first
-            # (zero is Adasum's identity).
+            # Adasum over a process set rides adasum_allreduce's masked
+            # full-axis formulation (gather members + in-jit tree
+            # combine); non-members pass their input through unchanged.
+            # A join mask composes by zeroing the joined members'
+            # contributions (zero is Adasum's identity). Full-axis is
+            # the MULTI-PROCESS-safe shape: a sub-mesh launch would be
+            # a computation the non-member processes never join, and
+            # the surrounding take/scatter on the global buffer would
+            # diverge across processes (found by the 3-process parity
+            # suite, tests/test_multiprocess_ops.py).
             ranks = self._pset_ranks(e0)
-            sub = self._sub_mesh(ranks)
-            # mask deliberately NOT in the key: masking is applied to
-            # member_buf before the call, the compiled fn is identical.
+            # mask deliberately NOT in the key: joined MEMBERS' rows are
+            # zeroed on the global buffer before the call (zero is
+            # Adasum's identity; a uniform op every process executes
+            # identically) so one compiled program serves every join
+            # pattern. Joined NON-members keep their rows — their
+            # pass-through must return the original input.
             key = ("adasum_pset", e0.prescale, e0.postscale, ranks,
                    buf.shape, buf.dtype.name)
-            member_buf = jnp.take(buf, jnp.asarray(ranks), axis=0)
+            buf_in = buf
             if mask is not None:
+                member_set = set(ranks)
                 keep = jnp.asarray(
-                    [bool(mask[r]) for r in ranks], dtype=bool
+                    [
+                        not (r in member_set and not mask[r])
+                        for r in range(self.world)
+                    ]
                 )[:, None]
-                member_buf = jnp.where(
-                    keep, member_buf, jnp.zeros_like(member_buf)
-                )
+                buf_in = jnp.where(keep, buf, jnp.zeros_like(buf))
             fn = self._executor(
                 key,
-                lambda: self._build_allreduce(
-                    Adasum, e0.prescale, e0.postscale, None, None, mesh=sub
+                lambda: self._build_adasum_pset(
+                    e0.prescale, e0.postscale, ranks
                 ),
             )
-            member_out = fn(member_buf)
-            out = buf.at[jnp.asarray(ranks)].set(member_out)
+            out = fn(buf_in)
         else:
             # Shape/dtype are part of the key: one executor == one
             # compiled program, so the LRU bound really bounds compiled
@@ -447,10 +432,8 @@ class FusionManager:
                 self.timeline.end(e.name, "ALLREDUCE")
             e.handle._fulfill(piece)
 
-    def _build_allreduce(
-        self, op, prescale, postscale, pset_mask, mask, mesh=None
-    ):
-        world = self.world if mesh is None else int(mesh.devices.size)
+    def _build_allreduce(self, op, prescale, postscale, pset_mask, mask):
+        world = self.world
         op = ReduceOp(op)
         mask_arr = (
             None if mask is None else np.asarray(mask, dtype=bool)
@@ -547,7 +530,7 @@ class FusionManager:
                 out = jnp.where(jnp.asarray(pset_arr)[idx], out, raw)
             return out
 
-        return jax.jit(self._shard_map(per_shard, mesh=mesh))
+        return jax.jit(self._shard_map(per_shard))
 
     def _execute_single(self, e: _Entry) -> None:
         if self.timeline is not None:
@@ -561,21 +544,20 @@ class FusionManager:
             )
             out = fn(e.payload)
         elif e.kind in ("allgather", "alltoall", "reducescatter"):
-            # Gather-family ops on a process set run on the set's sub-mesh
-            # (XLA needs equal-sized replica groups); non-member output
-            # rows are zeros — they receive nothing.
+            # Gather-family ops on a process set run as MASKED FULL-AXIS
+            # collectives (XLA needs equal-sized replica groups, and a
+            # sub-mesh launch would diverge across processes in
+            # multi-controller mode — tests/test_multiprocess_ops.py);
+            # non-member output rows are zeros — they receive nothing.
             ranks = self._pset_ranks(e)
-            mesh = self.mesh if ranks is None else self._sub_mesh(ranks)
             n_ranks = self.world if ranks is None else len(ranks)
-            payload = (
-                e.payload
-                if ranks is None
-                else jnp.take(e.payload, jnp.asarray(ranks), axis=0)
-            )
+            payload = e.payload
             if e.kind == "allgather":
                 key = ("allgather", ranks,
                        payload.shape, payload.dtype.name)
-                fn = self._executor(key, lambda: self._build_allgather(mesh))
+                fn = self._executor(
+                    key, lambda: self._build_allgather(ranks)
+                )
             elif e.kind == "alltoall":
                 if payload.shape[1] % n_ranks != 0:
                     raise ValueError(
@@ -584,7 +566,9 @@ class FusionManager:
                     )
                 key = ("alltoall", ranks,
                        payload.shape, payload.dtype.name)
-                fn = self._executor(key, lambda: self._build_alltoall(mesh))
+                fn = self._executor(
+                    key, lambda: self._build_alltoall(ranks)
+                )
             else:
                 key = ("reducescatter", int(e.op), e.prescale,
                        e.postscale, ranks,
@@ -592,7 +576,7 @@ class FusionManager:
                 fn = self._executor(
                     key,
                     lambda: self._build_reducescatter(
-                        e.op, e.prescale, e.postscale, mesh
+                        e.op, e.prescale, e.postscale, ranks
                     ),
                 )
             out = fn(payload)
@@ -603,13 +587,6 @@ class FusionManager:
                 srcs = range(self.world) if ranks is None else ranks
                 pieces = [out[:, i, : lengths[s]] for i, s in enumerate(srcs)]
                 out = jnp.concatenate(pieces, axis=1)
-            if ranks is not None:
-                full_shape = (self.world,) + tuple(out.shape[1:])
-                out = (
-                    jnp.zeros(full_shape, out.dtype)
-                    .at[jnp.asarray(ranks)]
-                    .set(out)
-                )
         else:
             raise ValueError(f"unknown kind {e.kind}")
         if self.timeline is not None:
@@ -633,38 +610,124 @@ class FusionManager:
 
         return jax.jit(self._shard_map(per_shard))
 
-    def _build_allgather(self, mesh):
+    def _member_tables(self, ranks):
+        from ..common.process_sets import member_tables
+
+        return member_tables(self.world, ranks)
+
+    def _build_allgather(self, ranks=None):
+        ranks_t = None if ranks is None else tuple(ranks)
+        member = None
+        if ranks_t is not None:
+            member, _ = self._member_tables(ranks_t)
+
         def per_shard(x):  # [1, n, ...] → [1, n_ranks, n, ...]
-            g = lax.all_gather(x[0], WORLD_AXIS)  # [n_ranks, n, ...]
-            return g[None]
+            g = lax.all_gather(x[0], WORLD_AXIS)  # [world, n, ...]
+            if ranks_t is None:
+                return g[None]
+            mg = g[jnp.asarray(ranks_t)]  # static member selection
+            is_m = jnp.asarray(member)[lax.axis_index(WORLD_AXIS)]
+            return jnp.where(is_m, mg, jnp.zeros_like(mg))[None]
 
-        return jax.jit(self._shard_map(per_shard, mesh=mesh))
+        return jax.jit(self._shard_map(per_shard))
 
-    def _build_alltoall(self, mesh):
-        def per_shard(x):  # [1, n, ...]; n % n_ranks == 0
-            return lax.all_to_all(
-                x, WORLD_AXIS, split_axis=1, concat_axis=1, tiled=True
-            )
+    def _build_alltoall(self, ranks=None):
+        if ranks is None:
+            def per_shard(x):  # [1, n, ...]; n % world == 0
+                return lax.all_to_all(
+                    x, WORLD_AXIS, split_axis=1, concat_axis=1, tiled=True
+                )
+        else:
+            ranks_t = tuple(ranks)
+            n_ranks = len(ranks_t)
+            member, pos = self._member_tables(ranks_t)
 
-        return jax.jit(self._shard_map(per_shard, mesh=mesh))
+            def per_shard(x):  # [1, n, ...]; n % n_ranks == 0
+                # Masked full-axis formulation: gather every row, select
+                # the member block addressed to this rank's member
+                # position. More wire than a member-only exchange, but
+                # expressible with equal replica groups AND launched
+                # identically by every process.
+                row = x[0]
+                k = row.shape[0] // n_ranks
+                g = lax.all_gather(row, WORLD_AXIS)  # [world, n, ...]
+                mg = g[jnp.asarray(ranks_t)]         # [n_ranks, n, ...]
+                blocks = mg.reshape(
+                    (n_ranks, n_ranks, k) + row.shape[1:]
+                )
+                idx = lax.axis_index(WORLD_AXIS)
+                mine = lax.dynamic_index_in_dim(
+                    blocks, jnp.asarray(pos)[idx], axis=1, keepdims=False
+                )  # [n_ranks, k, ...]
+                mine = mine.reshape((n_ranks * k,) + row.shape[1:])
+                is_m = jnp.asarray(member)[idx]
+                return jnp.where(is_m, mine, jnp.zeros_like(mine))[None]
 
-    def _build_reducescatter(self, op, prescale, postscale, mesh):
+        return jax.jit(self._shard_map(per_shard))
+
+    def _build_reducescatter(self, op, prescale, postscale, ranks=None):
         op = ReduceOp(op)
-        n_ranks = int(mesh.devices.size)
+        if ranks is None:
+            n_ranks = self.world
 
-        def per_shard(x):  # [1, n, ...]; n % n_ranks == 0
+            def per_shard(x):  # [1, n, ...]; n % n_ranks == 0
+                if prescale != 1.0:
+                    x = x * jnp.asarray(prescale, x.dtype)
+                out = lax.psum_scatter(
+                    x, WORLD_AXIS, scatter_dimension=1, tiled=True
+                )
+                if op == Average:
+                    out = out / jnp.asarray(n_ranks, out.dtype)
+                if postscale != 1.0:
+                    out = out * jnp.asarray(postscale, out.dtype)
+                return out
+        else:
+            ranks_t = tuple(ranks)
+            n_ranks = len(ranks_t)
+            member, pos = self._member_tables(ranks_t)
+
+            def per_shard(x):  # [1, n, ...]; n % n_ranks == 0
+                if prescale != 1.0:
+                    x = x * jnp.asarray(prescale, x.dtype)
+                idx = lax.axis_index(WORLD_AXIS)
+                is_m = jnp.asarray(member)[idx]
+                contrib = jnp.where(is_m, x, jnp.zeros_like(x))
+                total = lax.psum(contrib, WORLD_AXIS)  # member sum
+                k = x.shape[1] // n_ranks
+                mine = lax.dynamic_slice_in_dim(
+                    total, jnp.asarray(pos)[idx] * k, k, axis=1
+                )
+                if op == Average:
+                    mine = mine / jnp.asarray(n_ranks, mine.dtype)
+                if postscale != 1.0:
+                    mine = mine * jnp.asarray(postscale, mine.dtype)
+                return jnp.where(is_m, mine, jnp.zeros_like(mine))
+
+        return jax.jit(self._shard_map(per_shard))
+
+    def _build_adasum_pset(self, prescale, postscale, ranks):
+        """Adasum over a process set as a masked full-axis program
+        (adasum_allreduce's gather+tree formulation); non-members keep
+        their input. Join masking happens on the buffer BEFORE the call
+        (see the call site) so the compiled program is mask-independent."""
+        from .adasum import adasum_allreduce
+
+        ranks_l = list(ranks)
+        member, _ = self._member_tables(ranks_l)
+
+        def per_shard(x):  # [1, N]
+            idx = lax.axis_index(WORLD_AXIS)
+            raw = x
             if prescale != 1.0:
                 x = x * jnp.asarray(prescale, x.dtype)
-            out = lax.psum_scatter(
-                x, WORLD_AXIS, scatter_dimension=1, tiled=True
-            )
-            if op == Average:
-                out = out / jnp.asarray(n_ranks, out.dtype)
+            out = adasum_allreduce(
+                x[0], WORLD_AXIS, groups=[ranks_l]
+            )[None]
             if postscale != 1.0:
                 out = out * jnp.asarray(postscale, out.dtype)
-            return out
+            return jnp.where(jnp.asarray(member)[idx], out, raw)
 
-        return jax.jit(self._shard_map(per_shard, mesh=mesh))
+        return jax.jit(self._shard_map(per_shard))
 
 
 def hierarchical_stage_groups(world: int, local: int):

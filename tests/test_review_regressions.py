@@ -269,3 +269,29 @@ def test_shim_alltoall_warns_when_set_excludes_rank0(hvd):
             assert recv.tolist() == [6]
     finally:
         hvd.remove_process_set(ps)
+
+
+def test_adasum_pset_join_mask_composition(hvd, rng):
+    """Join masking composes with an Adasum process set via buffer
+    pre-zeroing (one compiled program per shape, mask-independent):
+    joined MEMBERS contribute zero (Adasum identity) but take the
+    result; joined NON-members keep their original input."""
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    try:
+        x = rank_major(lambda r: rng.normal(size=5))
+        with hvd_mod.join_ranks([1, 6]):  # 1 = member, 6 = non-member
+            out = hvd.allreduce(x, op=hvd_mod.Adasum, process_set=ps)
+        # same op with rank 1's row zeroed, no join: must match exactly
+        x_zeroed = x.copy()
+        x_zeroed[1] = 0.0
+        want = hvd.allreduce(x_zeroed, op=hvd_mod.Adasum, process_set=ps)
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.asarray(want[0]), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[1]), np.asarray(want[1]), rtol=1e-5, atol=1e-6
+        )
+        # joined non-member: original input, not zeros
+        np.testing.assert_allclose(np.asarray(out[6]), x[6], rtol=1e-6)
+    finally:
+        hvd.remove_process_set(ps)
