@@ -210,5 +210,177 @@ def run(
         return ex.run(fn, args=args, kwargs=kwargs)
 
 
-#: Reference-name alias (ray scripts: ``RayExecutor(settings, np).start()``)
-RayExecutor = Executor
+def _ray_or_none():
+    try:
+        import ray
+
+        return ray
+    except ImportError:
+        return None
+
+
+class RayExecutor(Executor):
+    """Executor with a REAL ray backend when ray is importable (ref:
+    horovod/ray/runner.py ``RayExecutor``: a placement group with one
+    CPU bundle per worker, remote tasks carrying the env contract [V]).
+
+    Ray mode lifecycle: ``start()`` connects/initializes ray and
+    reserves a placement group (``placement_group_strategy``, default
+    PACK — the reference's colocation default); ``run(fn)`` dispatches
+    one remote task per rank pinned to its bundle. The rank-0 task's
+    node hosts the ``jax.distributed`` coordinator; its address travels
+    through a tiny ray actor, and every task receives the same
+    ``HOROVOD_*`` env contract the local runner would export, so
+    ``hvd.init()`` inside ``fn`` works identically in both modes.
+
+    Without ray installed (``use_ray=None`` auto-detects) every call
+    transparently falls back to the local runner — the documented
+    degraded mode the non-ray tests exercise.
+    """
+
+    def __init__(
+        self,
+        *args,
+        use_ray: Optional[bool] = None,
+        placement_group_strategy: str = "PACK",
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        if use_ray is None:
+            use_ray = _ray_or_none() is not None
+        if use_ray and _ray_or_none() is None:
+            raise RuntimeError(
+                "use_ray=True but the 'ray' package is not importable"
+            )
+        self.use_ray = use_ray
+        self.placement_group_strategy = placement_group_strategy
+        self._pg = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if not self.use_ray:
+            return super().start()
+        ray = _ray_or_none()
+        from ray.util.placement_group import placement_group
+
+        if not ray.is_initialized():
+            ray.init(ignore_reinit_error=True)
+        self._pg = placement_group(
+            [{"CPU": 1}] * self.num_workers,
+            strategy=self.placement_group_strategy,
+        )
+        ray.get(self._pg.ready(), timeout=self.start_timeout)
+        self._started = True
+
+    def shutdown(self) -> None:
+        if not self.use_ray:
+            return super().shutdown()
+        if self._pg is not None:
+            from ray.util.placement_group import remove_placement_group
+
+            remove_placement_group(self._pg)
+            self._pg = None
+        self._started = False
+
+    # -- dispatch ------------------------------------------------------
+
+    def run(
+        self,
+        fn: Callable,
+        args: Sequence = (),
+        kwargs: Optional[dict] = None,
+    ) -> List[Any]:
+        if not self.use_ray:
+            return super().run(fn, args=args, kwargs=kwargs)
+        if not self._started:
+            raise RuntimeError("RayExecutor.run before start()")
+        ray = _ray_or_none()
+        from ray.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
+        n = self.num_workers
+        if self.coordinator_port is not None:
+            coord_port = self.coordinator_port
+        else:
+            import random
+
+            coord_port = 9874 + random.SystemRandom().randrange(8000)
+
+        @ray.remote
+        class _CoordInfo:
+            def __init__(self):
+                self._addr = None
+
+            def set(self, addr):
+                self._addr = addr
+
+            def get(self):
+                return self._addr
+
+        @ray.remote
+        def _worker(rank, world, payload, extra_env, port, coord):
+            import os
+            import pickle as _pickle
+            import time
+
+            import ray as _ray
+
+            env = dict(extra_env)
+            ip = _ray.util.get_node_ip_address()
+            env.update(
+                {
+                    "HOROVOD_HOSTNAME": ip,
+                    "HOROVOD_RANK": str(rank),
+                    "HOROVOD_SIZE": str(world),
+                    "HOROVOD_LOCAL_RANK": "0",
+                    "HOROVOD_LOCAL_SIZE": "1",
+                    "HOROVOD_CROSS_RANK": str(rank),
+                    "HOROVOD_CROSS_SIZE": str(world),
+                    "HOROVOD_NUM_PROCESSES": str(world),
+                    "HOROVOD_PROCESS_ID": str(rank),
+                    "HOROVOD_CONTROLLER": "tpu",
+                }
+            )
+            if rank == 0:
+                _ray.get(coord.set.remote(f"{ip}:{port}"))
+            addr = None
+            deadline = time.monotonic() + 300.0
+            while addr is None and time.monotonic() < deadline:
+                addr = _ray.get(coord.get.remote())
+                if addr is None:
+                    time.sleep(0.2)
+            if addr is None:
+                raise RuntimeError(
+                    "coordinator address never published by rank 0"
+                )
+            if world > 1:
+                host, p = addr.rsplit(":", 1)
+                env["HOROVOD_COORDINATOR_ADDR"] = host
+                env["HOROVOD_COORDINATOR_PORT"] = p
+            os.environ.update(env)
+            f, a, kw = _pickle.loads(payload)
+            return f(*a, **kw)
+
+        coord = _CoordInfo.options(num_cpus=0).remote()
+        try:
+            futures = [
+                _worker.options(
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=self._pg,
+                        placement_group_bundle_index=rank,
+                    )
+                ).remote(rank, n, payload, self.env, coord_port, coord)
+                for rank in range(n)
+            ]
+            # No timeout here: start_timeout bounds STARTUP (the
+            # placement-group wait in start()); the job itself may
+            # legitimately run for hours — same contract as the base
+            # Executor, whose start_timeout only gates process launch.
+            return ray.get(futures)
+        finally:
+            ray.kill(coord)  # one actor per run() would otherwise leak
+
+    execute = run

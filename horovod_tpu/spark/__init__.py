@@ -212,10 +212,40 @@ class TpuEstimator:
 
         opt = self.optimizer or optax.adam(1e-3)
 
+        dataset = None
         if y is not None:
             x = np.asarray(x)
             y = np.asarray(y)
             sample = x[: self.batch_size]
+        elif hasattr(x, "set_epoch") and hasattr(x, "__len__"):
+            # Re-iterable sharded dataset (data.ShardedFileDataset — the
+            # Petastorm-reader slot [V]): stream it lazily, do NOT
+            # materialize; fit advances its epoch for per-epoch shuffles.
+            dataset = x
+            # The sharding decision below must use the batch size the
+            # DATASET produces, not the estimator default — a mismatch
+            # would pass the divisibility check and then fail device_put
+            # mid-epoch (or silently lose data parallelism).
+            ds_batch = getattr(dataset, "batch_size", None)
+            if ds_batch is not None and int(ds_batch) != self.batch_size:
+                from ..common.logging import get_logger
+
+                get_logger("spark").info(
+                    "using the dataset's batch_size=%d (estimator "
+                    "batch_size=%d is ignored for dataset input)",
+                    int(ds_batch), self.batch_size,
+                )
+                self.batch_size = int(ds_batch)
+            first = next(iter(dataset), None)
+            if first is None:
+                raise ValueError("empty dataset")
+            if not (isinstance(first, tuple) and len(first) == 2):
+                raise ValueError(
+                    "fit() needs labeled batches: the dataset yields "
+                    "bare feature arrays (written without y?); "
+                    "write_shards(path, x, y) produces the (x, y) form"
+                )
+            sample = np.asarray(first[0])
         else:
             # Materialize the batch source: a one-shot generator must
             # survive the shape peek below AND re-iterate every epoch.
@@ -294,6 +324,8 @@ class TpuEstimator:
         try:
             for epoch in range(self.epochs):
                 epoch_losses = []
+                if dataset is not None:
+                    dataset.set_epoch(epoch)
                 batches = (
                     self._batches(x, y) if y is not None else iter(x)
                 )

@@ -30,6 +30,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running multi-process integration test"
     )
+    config.addinivalue_line(
+        "markers", "ray: needs the real ray package (optional integration)"
+    )
 
 
 @pytest.fixture

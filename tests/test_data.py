@@ -78,3 +78,90 @@ def test_sampler_fewer_items_than_replicas(hvd):
         assert 0 <= idx[0] < 3
         lens.add(len(idx))
     assert lens == {1}
+
+
+class TestShardedFileDataset:
+    """Petastorm-reader slot (VERDICT r4 #9): directory of .npz shards
+    -> per-rank lazy batch iterable with sampler semantics."""
+
+    def _write(self, tmp_path, n=100, d=3, rows_per_shard=16, labels=True):
+        from horovod_tpu.data import write_shards
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = np.arange(n, dtype=np.int32)
+        k = write_shards(
+            str(tmp_path), x, y if labels else None,
+            rows_per_shard=rows_per_shard,
+        )
+        assert k == (n + rows_per_shard - 1) // rows_per_shard
+        return x, y
+
+    def test_roundtrip_single_rank_covers_all_rows(self, hvd, tmp_path):
+        from horovod_tpu.data import ShardedFileDataset
+
+        x, y = self._write(tmp_path)
+        ds = ShardedFileDataset(
+            str(tmp_path), batch_size=10, num_replicas=1, rank=0,
+            shuffle=False,
+        )
+        assert len(ds) == 10
+        seen_x, seen_y = [], []
+        for xb, yb in ds:
+            assert xb.shape == (10, 3) and yb.shape == (10,)
+            seen_x.append(xb)
+            seen_y.append(yb)
+        got = np.concatenate(seen_x)[np.argsort(np.concatenate(seen_y))]
+        np.testing.assert_allclose(got, x)
+
+    def test_ranks_are_disjoint_and_cover(self, hvd, tmp_path):
+        from horovod_tpu.data import ShardedFileDataset
+
+        _, _ = self._write(tmp_path, n=96, rows_per_shard=10)
+        rows = []
+        for r in range(4):
+            ds = ShardedFileDataset(
+                str(tmp_path), batch_size=8, num_replicas=4, rank=r,
+                shuffle=True, seed=3,
+            )
+            mine = [int(v) for _, yb in ds for v in yb]
+            assert len(mine) == 24  # equal step counts (SPMD)
+            rows.append(set(mine))
+        assert set().union(*rows) == set(range(96))
+        # disjoint modulo wrap-around padding (96 % 4 == 0 -> exact)
+        for a in range(4):
+            for b in range(a + 1, 4):
+                assert not (rows[a] & rows[b])
+
+    def test_epoch_shuffling_changes_order(self, hvd, tmp_path):
+        from horovod_tpu.data import ShardedFileDataset
+
+        self._write(tmp_path)
+        ds = ShardedFileDataset(
+            str(tmp_path), batch_size=10, num_replicas=1, rank=0,
+            shuffle=True, seed=0,
+        )
+        ds.set_epoch(0)
+        e0 = [int(v) for _, yb in ds for v in yb]
+        ds.set_epoch(1)
+        e1 = [int(v) for _, yb in ds for v in yb]
+        assert e0 != e1 and sorted(e0) == sorted(e1)
+
+    def test_labelless_directory_yields_bare_x(self, hvd, tmp_path):
+        from horovod_tpu.data import ShardedFileDataset
+
+        x, _ = self._write(tmp_path, labels=False)
+        ds = ShardedFileDataset(
+            str(tmp_path), batch_size=25, num_replicas=1, rank=0,
+            shuffle=False,
+        )
+        assert ds.has_labels is False
+        batches = list(ds)
+        assert all(isinstance(b, np.ndarray) for b in batches)
+        np.testing.assert_allclose(np.concatenate(batches), x)
+
+    def test_empty_dir_raises(self, hvd, tmp_path):
+        from horovod_tpu.data import ShardedFileDataset
+
+        with pytest.raises(ValueError, match="no .npz"):
+            ShardedFileDataset(str(tmp_path), batch_size=4)
