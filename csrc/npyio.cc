@@ -1,0 +1,179 @@
+// mmap'd .npy shard reader — the native data-loader half.
+//
+// TPU-native stand-in for the reference's native data plumbing (ref:
+// horovod/spark's petastorm reader + the torch DataLoader C workers the
+// examples lean on [V] — SURVEY.md §2.5): the Python layer
+// (horovod_tpu/data.py ShardedFileDataset) decides WHICH rows each rank
+// reads; this layer makes reading them cheap. A shard is mapped once
+// (MAP_SHARED, page cache does the buffering) and a shuffled batch's
+// rows are gathered with one C call instead of k Python-level copies.
+//
+// Parser scope (deliberately minimal): C-order little-endian .npy,
+// format versions 1.0/2.0, any dtype — the row stride is derived from
+// (file size − data offset) / rows, so descr never needs decoding; a
+// Fortran-order file is rejected (row gather would be wrong).
+
+#include "export.h"
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Npy {
+  void* map = nullptr;
+  size_t map_len = 0;
+  const char* data = nullptr;  // first row
+  long rows = 0;
+  long row_bytes = 0;
+};
+
+// Parse "'shape': (123, 4, 5)" out of the header dict; returns the
+// FIRST dimension (row count) or -1. A 0-d / empty-shape file has no
+// row axis and is rejected.
+long parse_rows(const char* hdr, size_t n) {
+  const char* key = static_cast<const char*>(
+      memmem(hdr, n, "'shape'", 7));
+  if (!key) return -1;
+  const char* p = static_cast<const char*>(
+      memchr(key, '(', n - (key - hdr)));
+  if (!p) return -1;
+  ++p;
+  while (p < hdr + n && *p == ' ') ++p;
+  if (p >= hdr + n || *p < '0' || *p > '9') return -1;
+  return strtol(p, nullptr, 10);
+}
+
+bool fortran_order(const char* hdr, size_t n) {
+  const char* key = static_cast<const char*>(
+      memmem(hdr, n, "'fortran_order'", 15));
+  if (!key) return true;  // can't verify: reject
+  const char* rest = key + 15;
+  size_t left = n - (rest - hdr);
+  const char* t = static_cast<const char*>(memmem(rest, left, "True", 4));
+  const char* f = static_cast<const char*>(memmem(rest, left, "False", 5));
+  if (!f) return true;
+  return t != nullptr && t < f;
+}
+
+}  // namespace
+
+extern "C" {
+
+HVD_EXPORT void* hvd_npy_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 10) {
+    ::close(fd);
+    return nullptr;
+  }
+  size_t len = static_cast<size_t>(st.st_size);
+  void* map = ::mmap(nullptr, len, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);  // mapping keeps the file alive
+  if (map == MAP_FAILED) return nullptr;
+  const unsigned char* b = static_cast<const unsigned char*>(map);
+  auto fail = [&]() -> void* {
+    ::munmap(map, len);
+    return nullptr;
+  };
+  if (memcmp(b, "\x93NUMPY", 6) != 0) return fail();
+  int major = b[6];
+  size_t hdr_off, hdr_len;
+  if (major == 1) {
+    if (len < 10) return fail();
+    hdr_len = static_cast<size_t>(b[8]) | (static_cast<size_t>(b[9]) << 8);
+    hdr_off = 10;
+  } else if (major == 2 || major == 3) {
+    if (len < 12) return fail();
+    hdr_len = static_cast<size_t>(b[8]) |
+              (static_cast<size_t>(b[9]) << 8) |
+              (static_cast<size_t>(b[10]) << 16) |
+              (static_cast<size_t>(b[11]) << 24);
+    hdr_off = 12;
+  } else {
+    return fail();
+  }
+  if (hdr_off + hdr_len > len) return fail();
+  const char* hdr = reinterpret_cast<const char*>(b + hdr_off);
+  if (fortran_order(hdr, hdr_len)) return fail();
+  long rows = parse_rows(hdr, hdr_len);
+  if (rows <= 0) return fail();
+  size_t data_off = hdr_off + hdr_len;
+  size_t payload = len - data_off;
+  if (payload % static_cast<size_t>(rows) != 0) return fail();
+  Npy* h = new Npy;
+  h->map = map;
+  h->map_len = len;
+  h->data = reinterpret_cast<const char*>(b + data_off);
+  h->rows = rows;
+  h->row_bytes = static_cast<long>(payload / static_cast<size_t>(rows));
+  return h;
+}
+
+HVD_EXPORT long hvd_npy_rows(void* handle) {
+  return static_cast<Npy*>(handle)->rows;
+}
+
+HVD_EXPORT long hvd_npy_row_bytes(void* handle) {
+  return static_cast<Npy*>(handle)->row_bytes;
+}
+
+// Gather rows idx[0..k) into dst (k * row_bytes bytes). Out-of-range
+// indices are clamped-checked: returns the number of rows copied (== k
+// on success), stopping at the first bad index rather than reading
+// beyond the mapping.
+HVD_EXPORT long hvd_npy_gather(void* handle, const long* idx, long k,
+                               void* dst) {
+  const Npy* h = static_cast<const Npy*>(handle);
+  char* out = static_cast<char*>(dst);
+  for (long i = 0; i < k; ++i) {
+    if (idx[i] < 0 || idx[i] >= h->rows) return i;
+    std::memcpy(out + i * h->row_bytes,
+                h->data + idx[i] * h->row_bytes,
+                static_cast<size_t>(h->row_bytes));
+  }
+  return k;
+}
+
+HVD_EXPORT void hvd_npy_close(void* handle) {
+  Npy* h = static_cast<Npy*>(handle);
+  ::munmap(h->map, h->map_len);
+  delete h;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Scattered gather across MANY mapped shards in one call: row i of dst
+// comes from handles[hsel[i]] at row local[i]. All handles must share
+// one row stride (the caller validates dtype/trailing shape); returns
+// the number of rows copied (== k on success), stopping at the first
+// out-of-range index. This is the batch-level entry point: one C call
+// replaces a Python loop over touched files.
+HVD_EXPORT long hvd_npy_gather_scattered(void** handles, const long* hsel,
+                                         const long* local, long k,
+                                         void* dst) {
+  if (k <= 0) return 0;
+  char* out = static_cast<char*>(dst);
+  const long rb = static_cast<const Npy*>(handles[hsel[0]])->row_bytes;
+  for (long i = 0; i < k; ++i) {
+    const Npy* h = static_cast<const Npy*>(handles[hsel[i]]);
+    if (h->row_bytes != rb || local[i] < 0 || local[i] >= h->rows) {
+      return i;
+    }
+    std::memcpy(out + i * rb, h->data + local[i] * rb,
+                static_cast<size_t>(rb));
+  }
+  return k;
+}
+
+}  // extern "C"

@@ -25,6 +25,7 @@ _SOURCES = [
     "pack.cc",
     "sha256.cc",
     "kvstore.cc",
+    "npyio.cc",
 ]
 
 

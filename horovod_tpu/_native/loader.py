@@ -54,6 +54,19 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_pack.argtypes = [vp, lp, c.c_long, c.c_void_p]
     lib.hvd_unpack.argtypes = [c.c_void_p, vp, lp, c.c_long]
 
+    lib.hvd_npy_open.argtypes = [c.c_char_p]
+    lib.hvd_npy_open.restype = c.c_void_p
+    lib.hvd_npy_rows.argtypes = [c.c_void_p]
+    lib.hvd_npy_rows.restype = c.c_long
+    lib.hvd_npy_row_bytes.argtypes = [c.c_void_p]
+    lib.hvd_npy_row_bytes.restype = c.c_long
+    lib.hvd_npy_gather.argtypes = [c.c_void_p, lp, c.c_long, c.c_void_p]
+    lib.hvd_npy_gather.restype = c.c_long
+    lib.hvd_npy_gather_scattered.argtypes = [vp, lp, lp, c.c_long,
+                                             c.c_void_p]
+    lib.hvd_npy_gather_scattered.restype = c.c_long
+    lib.hvd_npy_close.argtypes = [c.c_void_p]
+
     u8p = c.POINTER(c.c_uint8)
     lib.hvd_kv_start.argtypes = [c.c_int, u8p, c.c_long, c.POINTER(c.c_int)]
     lib.hvd_kv_start.restype = c.c_void_p
@@ -341,3 +354,106 @@ class NativeKVServer:
             self.stop()
         except Exception:
             pass
+
+
+# -------------------------------------------------------------------- npy IO
+
+class NpyReader:
+    """mmap'd row-gather view of a C-order .npy file (csrc/npyio.cc) —
+    the native data-loader half behind ``data.ShardedFileDataset``'s
+    uncompressed fast path. ``None`` from :func:`npy_reader` means no
+    native library (or an unsupported file); callers fall back to
+    ``np.load(mmap_mode='r')`` fancy indexing."""
+
+    _native_gather = True  # data.ShardedFileDataset dispatch marker
+
+    def __init__(self, lib, handle, path: str):
+        # Validate BEFORE taking ownership of the handle: if anything
+        # here raises (numpy rejecting a descr the C parser skipped,
+        # stride disagreement), self._h is never set, __del__ is a
+        # no-op, and npy_reader closes the handle exactly once.
+        mm = np.load(path, mmap_mode="r")
+        shape, dtype = mm.shape, mm.dtype
+        del mm
+        row_bytes = int(np.prod(shape[1:], dtype=np.int64)) * dtype.itemsize
+        if (
+            lib.hvd_npy_rows(handle) != shape[0]
+            or lib.hvd_npy_row_bytes(handle) != row_bytes
+        ):
+            raise ValueError(f"native/numpy header disagreement: {path}")
+        self.shape = shape
+        self.dtype = dtype
+        self._lib = lib
+        self._h = handle
+
+    def take(self, idx: np.ndarray) -> np.ndarray:
+        """Rows ``idx`` as one contiguous array (single C gather)."""
+        idx = np.ascontiguousarray(idx, dtype=np.int64)
+        out = np.empty((len(idx),) + self.shape[1:], self.dtype)
+        copied = self._lib.hvd_npy_gather(
+            self._h,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            len(idx),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        if copied != len(idx):
+            raise IndexError(
+                f"row index {int(idx[copied])} out of range "
+                f"[0, {self.shape[0]})"
+            )
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_npy_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def npy_reader(path: str) -> Optional[NpyReader]:
+    """Open ``path`` with the native reader; None when the library is
+    unavailable or the file is unsupported (compressed, Fortran-order,
+    0-d)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    handle = lib.hvd_npy_open(os.fsencode(path))
+    if not handle:
+        return None
+    try:
+        return NpyReader(lib, handle, path)
+    except Exception:
+        lib.hvd_npy_close(handle)  # __init__ raised before taking ownership
+        return None
+
+
+def npy_gather_scattered(readers, hsel: np.ndarray, local: np.ndarray,
+                         out: np.ndarray) -> bool:
+    """One C call gathering out[i] = readers[hsel[i]].row(local[i])
+    across many mapped shards (csrc/npyio.cc). All readers must share
+    the row stride (caller-validated). False when unavailable."""
+    lib = get_lib()
+    if lib is None or not readers:
+        return False
+    handles = (ctypes.c_void_p * len(readers))(*[r._h for r in readers])
+    hsel = np.ascontiguousarray(hsel, dtype=np.int64)
+    local = np.ascontiguousarray(local, dtype=np.int64)
+    lp = ctypes.POINTER(ctypes.c_long)
+    copied = lib.hvd_npy_gather_scattered(
+        handles,
+        hsel.ctypes.data_as(lp),
+        local.ctypes.data_as(lp),
+        len(local),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if copied != len(local):
+        raise IndexError(
+            f"scattered gather stopped at position {int(copied)} "
+            "(row index out of range or stride mismatch)"
+        )
+    return True

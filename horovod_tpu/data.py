@@ -142,13 +142,19 @@ def prefetch_to_device(
         enqueue(1)
 
 
-def write_shards(path, x, y=None, rows_per_shard: int = 4096) -> int:
+def write_shards(
+    path, x, y=None, rows_per_shard: int = 4096, compressed: bool = True
+) -> int:
     """Materialize arrays as a shard directory readable by
     :class:`ShardedFileDataset` — the writer half of the reference's
     Store/Petastorm data-materialization step (ref:
-    horovod/spark/common/util.py prepare_data → parquet row groups [V];
-    here: ``shard_NNNNN.npz`` files with ``x`` and optional ``y``).
-    Returns the number of shards written."""
+    horovod/spark/common/util.py prepare_data → parquet row groups [V]).
+
+    ``compressed=True`` (default) writes ``shard_NNNNN.npz`` (zip
+    container); ``compressed=False`` writes raw ``shard_NNNNN.x.npy``
+    (+ ``.y.npy``) pairs — larger on disk but readable by the NATIVE
+    mmap row-gather (csrc/npyio.cc), the fast path for shuffled access
+    to datasets bigger than memory. Returns the number of shards."""
     import os
 
     os.makedirs(path, exist_ok=True)
@@ -163,11 +169,17 @@ def write_shards(path, x, y=None, rows_per_shard: int = 4096) -> int:
     k = 0
     for start in range(0, n, rows_per_shard):
         sl = slice(start, start + rows_per_shard)
-        fname = os.path.join(path, f"shard_{k:05d}.npz")
-        if y is None:
-            np.savez(fname, x=x[sl])
+        if compressed:
+            fname = os.path.join(path, f"shard_{k:05d}.npz")
+            if y is None:
+                np.savez(fname, x=x[sl])
+            else:
+                np.savez(fname, x=x[sl], y=y[sl])
         else:
-            np.savez(fname, x=x[sl], y=y[sl])
+            stem = os.path.join(path, f"shard_{k:05d}")
+            np.save(stem + ".x.npy", x[sl])
+            if y is not None:
+                np.save(stem + ".y.npy", y[sl])
         k += 1
     return k
 
@@ -224,18 +236,32 @@ class ShardedFileDataset:
         self.path = path
         self.batch_size = int(batch_size)
         files = sorted(glob.glob(os.path.join(path, "*.npz")))
+        self._fmt = "npz"
         if not files:
-            raise ValueError(f"no .npz shard files under {path!r}")
+            # uncompressed pairs: the native mmap-gather format
+            files = sorted(glob.glob(os.path.join(path, "*.x.npy")))
+            self._fmt = "npy"
+        if not files:
+            raise ValueError(
+                f"no .npz or .x.npy shard files under {path!r}"
+            )
         self.files = files
         self.has_labels = True
         counts = []
         for f in files:
-            shape, _ = _npz_member_shape(f, "x")
-            counts.append(shape[0])
-            try:
-                _npz_member_shape(f, "y")
-            except KeyError:
-                self.has_labels = False
+            if self._fmt == "npz":
+                shape, _ = _npz_member_shape(f, "x")
+                counts.append(shape[0])
+                try:
+                    _npz_member_shape(f, "y")
+                except KeyError:
+                    self.has_labels = False
+            else:
+                mm = np.load(f, mmap_mode="r")
+                counts.append(mm.shape[0])
+                del mm
+                if not os.path.exists(f[: -len(".x.npy")] + ".y.npy"):
+                    self.has_labels = False
         self._offsets = np.concatenate([[0], np.cumsum(counts)])
         self.n = int(self._offsets[-1])
         self._sampler = ShardedIndexSampler(
@@ -259,44 +285,112 @@ class ShardedFileDataset:
         step needs one static shape)."""
         return self._sampler.num_samples // self.batch_size
 
+    def _open_column(self, path: str):
+        """One shard column: the native mmap row-gather when available
+        (csrc/npyio.cc), else a numpy memmap (same semantics, Python
+        fancy-index)."""
+        from ._native import loader as _native
+
+        reader = _native.npy_reader(path)
+        if reader is not None:
+            return reader
+        return np.load(path, mmap_mode="r")
+
     def _load(self, file_i: int) -> dict:
         entry = self._cache.get(file_i)
         if entry is None:
-            with np.load(self.files[file_i]) as z:
-                entry = {k: z[k] for k in (
-                    ("x", "y") if self.has_labels else ("x",)
-                )}
+            cols = ("x", "y") if self.has_labels else ("x",)
+            if self._fmt == "npz":
+                with np.load(self.files[file_i]) as z:
+                    entry = {k: z[k] for k in cols}
+            else:
+                stem = self.files[file_i][: -len(".x.npy")]
+                entry = {
+                    k: self._open_column(f"{stem}.{k}.npy") for k in cols
+                }
             self._cache[file_i] = entry
-            while len(self._cache) > self._cache_files:
-                self._cache.popitem(last=False)
+            if self._fmt == "npz":
+                # npz entries are fully-loaded ARRAYS — bound the memory.
+                # npy entries are mmap handles (pages live in the OS
+                # cache, not here); evicting them would re-parse headers
+                # on every shuffled batch, so they all stay open.
+                while len(self._cache) > self._cache_files:
+                    self._cache.popitem(last=False)
         else:
             self._cache.move_to_end(file_i)
         return entry
+
+    @staticmethod
+    def _take(col, idx: np.ndarray) -> np.ndarray:
+        if getattr(col, "_native_gather", False):
+            return col.take(idx)  # one C call (csrc/npyio.cc)
+        return np.asarray(col[idx])  # ndarray / memmap fancy index
+
+    def _native_rows(self, global_idx: np.ndarray, file_is: np.ndarray):
+        """Whole-batch scattered gather in ONE C call per column
+        (csrc/npyio.cc hvd_npy_gather_scattered); None when the native
+        library is off or the shards aren't uniform native readers."""
+        from ._native import loader as _native
+
+        if _native.get_lib() is None:
+            return None
+        touched = np.unique(file_is)
+        entries = [self._load(int(fi)) for fi in touched]  # refs keep
+        # evicted readers alive for the duration of the gather
+        pos = np.zeros(int(touched[-1]) + 1, np.int64)
+        pos[touched] = np.arange(len(touched))
+        hsel = pos[file_is]
+        local = (global_idx - self._offsets[file_is]).astype(np.int64)
+        outs = []
+        for col in ("x", "y") if self.has_labels else ("x",):
+            readers = [e[col] for e in entries]
+            if not all(
+                getattr(r, "_native_gather", False) for r in readers
+            ):
+                return None
+            if len({(r.dtype, r.shape[1:]) for r in readers}) != 1:
+                return None  # non-uniform shards: generic path
+            out = np.empty(
+                (len(global_idx),) + readers[0].shape[1:],
+                readers[0].dtype,
+            )
+            if not _native.npy_gather_scattered(readers, hsel, local, out):
+                return None
+            outs.append(out)
+        return tuple(outs) if self.has_labels else outs[0]
 
     def _rows(self, global_idx: np.ndarray):
         file_is = (
             np.searchsorted(self._offsets, global_idx, side="right") - 1
         )
+        if self._fmt == "npy":
+            fast = self._native_rows(global_idx, file_is)
+            if fast is not None:
+                return fast
         # Group the batch's rows BY FILE: a shuffled batch touches many
         # shards, and loading per-row would decompress a whole .npz per
-        # row and thrash the small LRU. One load + one fancy-index per
-        # touched file, then restore batch order.
+        # row and thrash the small LRU. One gather per touched file,
+        # written back into batch order with a vectorized fancy store.
         order = np.argsort(file_is, kind="stable")
-        xs = np.empty(len(global_idx), dtype=object)
-        ys = np.empty(len(global_idx), dtype=object) if self.has_labels else None
+        x_out = y_out = None
         for fi in np.unique(file_is):
             sel = order[file_is[order] == fi]
             local = (global_idx[sel] - self._offsets[fi]).astype(np.int64)
             entry = self._load(int(fi))
-            fx = entry["x"][local]
-            for j, s in enumerate(sel):
-                xs[s] = fx[j]
+            fx = self._take(entry["x"], local)
+            if x_out is None:
+                x_out = np.empty(
+                    (len(global_idx),) + fx.shape[1:], fx.dtype
+                )
+            x_out[sel] = fx
             if self.has_labels:
-                fy = entry["y"][local]
-                for j, s in enumerate(sel):
-                    ys[s] = fy[j]
-        x = np.stack(list(xs))
-        return (x, np.stack(list(ys))) if self.has_labels else x
+                fy = self._take(entry["y"], local)
+                if y_out is None:
+                    y_out = np.empty(
+                        (len(global_idx),) + fy.shape[1:], fy.dtype
+                    )
+                y_out[sel] = fy
+        return (x_out, y_out) if self.has_labels else x_out
 
     def __iter__(self):
         idx = np.fromiter(iter(self._sampler), dtype=np.int64)

@@ -28,6 +28,17 @@ from .runner.rendezvous import RendezvousServer
 from .runner.secret import make_secret_key
 
 
+def _default_coordinator_port() -> int:
+    """Per-job pseudo-random coordinator port: the port binds on worker
+    0's host, unprobeable from the driver, so freeness can't be
+    verified — but a random default keeps two concurrent multi-host
+    jobs from colliding on one fixed number (the reference's runner
+    derives per-job ports the same way [V])."""
+    import random
+
+    return 9874 + random.SystemRandom().randrange(8000)
+
+
 class Executor:
     """Run functions across a horovod_tpu worker set
     (ref: RayExecutor's start/run/shutdown lifecycle [V])."""
@@ -157,17 +168,7 @@ class Executor:
             elif self.coordinator_port is not None:
                 coordinator_port = self.coordinator_port
             else:
-                # Multi-host: the port binds on worker 0, unprobeable
-                # from here, so freeness can't be verified — but a
-                # per-job pseudo-random default keeps two concurrent
-                # multi-host jobs from colliding on one fixed number
-                # (the reference's runner derives per-job ports the
-                # same way [V]).
-                import random
-
-                coordinator_port = 9874 + random.SystemRandom().randrange(
-                    8000
-                )
+                coordinator_port = _default_coordinator_port()
             blocks = _launch.worker_envs(
                 slots,
                 placement,
@@ -300,71 +301,80 @@ class RayExecutor(Executor):
             PlacementGroupSchedulingStrategy,
         )
 
-        payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
         n = self.num_workers
-        if self.coordinator_port is not None:
-            coord_port = self.coordinator_port
-        else:
-            import random
-
-            coord_port = 9874 + random.SystemRandom().randrange(8000)
+        coord_port = self.coordinator_port or _default_coordinator_port()
 
         @ray.remote
         class _CoordInfo:
-            def __init__(self):
-                self._addr = None
+            """Rank→node-IP registry: once all ranks have registered,
+            every worker derives the REAL host topology (local rank =
+            order among same-node ranks) — colocated PACK bundles must
+            not masquerade as separate single-rank hosts."""
 
-            def set(self, addr):
-                self._addr = addr
+            def __init__(self, world):
+                self._world = world
+                self._ips = {}
 
-            def get(self):
-                return self._addr
+            def register(self, rank, ip):
+                self._ips[rank] = ip
 
+            def topology(self):
+                if len(self._ips) < self._world:
+                    return None
+                return dict(self._ips)
+
+        # fn/args ride the task submission itself: ray cloudpickles
+        # them, so closures and locally-defined functions work (plain
+        # pickle.dumps would reject any fn defined inside a function).
         @ray.remote
-        def _worker(rank, world, payload, extra_env, port, coord):
+        def _worker(rank, world, fn, args, kwargs, extra_env, port,
+                    coord):
             import os
-            import pickle as _pickle
             import time
 
             import ray as _ray
 
-            env = dict(extra_env)
             ip = _ray.util.get_node_ip_address()
+            _ray.get(coord.register.remote(rank, ip))
+            topo = None
+            deadline = time.monotonic() + 300.0
+            while topo is None and time.monotonic() < deadline:
+                topo = _ray.get(coord.topology.remote())
+                if topo is None:
+                    time.sleep(0.2)
+            if topo is None:
+                raise RuntimeError(
+                    "worker topology never completed (some rank failed "
+                    "to register)"
+                )
+            local_peers = sorted(
+                r for r, host in topo.items() if host == ip
+            )
+            hosts = sorted(set(topo.values()), key=lambda h: min(
+                r for r, hh in topo.items() if hh == h
+            ))
+            env = dict(extra_env)
             env.update(
                 {
                     "HOROVOD_HOSTNAME": ip,
                     "HOROVOD_RANK": str(rank),
                     "HOROVOD_SIZE": str(world),
-                    "HOROVOD_LOCAL_RANK": "0",
-                    "HOROVOD_LOCAL_SIZE": "1",
-                    "HOROVOD_CROSS_RANK": str(rank),
-                    "HOROVOD_CROSS_SIZE": str(world),
+                    "HOROVOD_LOCAL_RANK": str(local_peers.index(rank)),
+                    "HOROVOD_LOCAL_SIZE": str(len(local_peers)),
+                    "HOROVOD_CROSS_RANK": str(hosts.index(ip)),
+                    "HOROVOD_CROSS_SIZE": str(len(hosts)),
                     "HOROVOD_NUM_PROCESSES": str(world),
                     "HOROVOD_PROCESS_ID": str(rank),
                     "HOROVOD_CONTROLLER": "tpu",
                 }
             )
-            if rank == 0:
-                _ray.get(coord.set.remote(f"{ip}:{port}"))
-            addr = None
-            deadline = time.monotonic() + 300.0
-            while addr is None and time.monotonic() < deadline:
-                addr = _ray.get(coord.get.remote())
-                if addr is None:
-                    time.sleep(0.2)
-            if addr is None:
-                raise RuntimeError(
-                    "coordinator address never published by rank 0"
-                )
             if world > 1:
-                host, p = addr.rsplit(":", 1)
-                env["HOROVOD_COORDINATOR_ADDR"] = host
-                env["HOROVOD_COORDINATOR_PORT"] = p
+                env["HOROVOD_COORDINATOR_ADDR"] = topo[0]
+                env["HOROVOD_COORDINATOR_PORT"] = str(port)
             os.environ.update(env)
-            f, a, kw = _pickle.loads(payload)
-            return f(*a, **kw)
+            return fn(*args, **kwargs)
 
-        coord = _CoordInfo.options(num_cpus=0).remote()
+        coord = _CoordInfo.options(num_cpus=0).remote(n)
         try:
             futures = [
                 _worker.options(
@@ -372,7 +382,8 @@ class RayExecutor(Executor):
                         placement_group=self._pg,
                         placement_group_bundle_index=rank,
                     )
-                ).remote(rank, n, payload, self.env, coord_port, coord)
+                ).remote(rank, n, fn, tuple(args), dict(kwargs or {}),
+                         self.env, coord_port, coord)
                 for rank in range(n)
             ]
             # No timeout here: start_timeout bounds STARTUP (the

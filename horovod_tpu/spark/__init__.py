@@ -168,13 +168,14 @@ class TpuEstimator:
         self.seed = seed
         self.history: list = []
 
-    def _batches(self, x, y):
+    def _batches(self, x, y, batch_size=None):
+        bs = batch_size or self.batch_size
         n = x.shape[0]
         # drop the ragged tail so every jitted step sees one static shape
         # (XLA semantics: shapes are compile-time)
-        steps = n // self.batch_size
+        steps = n // bs
         for i in range(steps):
-            sl = slice(i * self.batch_size, (i + 1) * self.batch_size)
+            sl = slice(i * bs, (i + 1) * bs)
             yield x[sl], y[sl]
 
     def fit(self, x, y=None) -> TpuModel:
@@ -193,49 +194,35 @@ class TpuEstimator:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         world = basics.topology().size
-        # Batch rides the data axis when it divides evenly; otherwise it
-        # replicates (correct, just not parallel) — a loud log beats a
-        # shape error mid-epoch.
-        if self.batch_size % world == 0:
-            data_sharding = NamedSharding(mesh, P(basics_world_axis()))
-        else:
-            from ..common.logging import get_logger
-
-            get_logger("spark").warning(
-                "batch_size %d not divisible by world %d; replicating "
-                "batches (no data parallelism)",
-                self.batch_size,
-                world,
-            )
-            data_sharding = NamedSharding(mesh, P())
         replicated = NamedSharding(mesh, P())
-
         opt = self.optimizer or optax.adam(1e-3)
 
+        # Resolve the input FIRST: the sharding decision below must see
+        # the batch size the batches will actually have. For dataset
+        # input that is the DATASET's batch size (a stale estimator
+        # value would pass the divisibility check and then fail
+        # device_put mid-epoch, or silently lose data parallelism).
         dataset = None
+        batch_size = self.batch_size  # effective; self stays unmutated
         if y is not None:
             x = np.asarray(x)
             y = np.asarray(y)
-            sample = x[: self.batch_size]
+            sample = x[:batch_size]
         elif hasattr(x, "set_epoch") and hasattr(x, "__len__"):
             # Re-iterable sharded dataset (data.ShardedFileDataset — the
             # Petastorm-reader slot [V]): stream it lazily, do NOT
             # materialize; fit advances its epoch for per-epoch shuffles.
             dataset = x
-            # The sharding decision below must use the batch size the
-            # DATASET produces, not the estimator default — a mismatch
-            # would pass the divisibility check and then fail device_put
-            # mid-epoch (or silently lose data parallelism).
             ds_batch = getattr(dataset, "batch_size", None)
-            if ds_batch is not None and int(ds_batch) != self.batch_size:
+            if ds_batch is not None and int(ds_batch) != batch_size:
                 from ..common.logging import get_logger
 
                 get_logger("spark").info(
                     "using the dataset's batch_size=%d (estimator "
-                    "batch_size=%d is ignored for dataset input)",
-                    int(ds_batch), self.batch_size,
+                    "batch_size=%d does not apply to dataset input)",
+                    int(ds_batch), batch_size,
                 )
-                self.batch_size = int(ds_batch)
+                batch_size = int(ds_batch)
             first = next(iter(dataset), None)
             if first is None:
                 raise ValueError("empty dataset")
@@ -253,6 +240,23 @@ class TpuEstimator:
             if not x:
                 raise ValueError("empty batch iterable")
             sample = np.asarray(x[0][0])
+            batch_size = int(sample.shape[0])
+
+        # Batch rides the data axis when it divides evenly; otherwise it
+        # replicates (correct, just not parallel) — a loud log beats a
+        # shape error mid-epoch.
+        if batch_size % world == 0:
+            data_sharding = NamedSharding(mesh, P(basics_world_axis()))
+        else:
+            from ..common.logging import get_logger
+
+            get_logger("spark").warning(
+                "batch_size %d not divisible by world %d; replicating "
+                "batches (no data parallelism)",
+                batch_size,
+                world,
+            )
+            data_sharding = NamedSharding(mesh, P())
 
         rng = jax.random.PRNGKey(self.seed)
         model = self.model
@@ -327,7 +331,9 @@ class TpuEstimator:
                 if dataset is not None:
                     dataset.set_epoch(epoch)
                 batches = (
-                    self._batches(x, y) if y is not None else iter(x)
+                    self._batches(x, y, batch_size)
+                    if y is not None
+                    else iter(x)
                 )
                 for xb, yb in batches:
                     xb = jax.device_put(np.asarray(xb), data_sharding)

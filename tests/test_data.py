@@ -165,3 +165,59 @@ class TestShardedFileDataset:
 
         with pytest.raises(ValueError, match="no .npz"):
             ShardedFileDataset(str(tmp_path), batch_size=4)
+
+    @pytest.mark.parametrize("native", ["1", "0"], ids=["native", "python"])
+    def test_uncompressed_npy_format_roundtrip(
+        self, hvd, tmp_path, monkeypatch, native
+    ):
+        """compressed=False writes .x.npy/.y.npy pairs served by the
+        native mmap row-gather (csrc/npyio.cc) or the memmap fallback —
+        both must agree with the npz path bit-for-bit."""
+        from horovod_tpu.data import ShardedFileDataset, write_shards
+
+        monkeypatch.setenv("HOROVOD_NATIVE", native)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(90, 5)).astype(np.float32)
+        y = np.arange(90, dtype=np.int64)
+        write_shards(
+            str(tmp_path), x, y, rows_per_shard=17, compressed=False
+        )
+        ds = ShardedFileDataset(
+            str(tmp_path), batch_size=9, num_replicas=1, rank=0,
+            shuffle=True, seed=5,
+        )
+        assert ds._fmt == "npy"
+        seen_x, seen_y = [], []
+        for xb, yb in ds:
+            seen_x.append(xb)
+            seen_y.append(yb)
+        order = np.argsort(np.concatenate(seen_y))
+        np.testing.assert_allclose(np.concatenate(seen_x)[order], x)
+
+    def test_native_gather_matches_numpy(self, tmp_path):
+        """Differential: the C row-gather equals numpy fancy indexing
+        (same discipline as the other csrc twins, test_native.py)."""
+        from horovod_tpu._native import loader
+
+        x = np.random.default_rng(2).normal(size=(64, 3, 2)).astype(
+            np.float32
+        )
+        p = str(tmp_path / "a.npy")
+        np.save(p, x)
+        r = loader.npy_reader(p)
+        if r is None:
+            pytest.skip("native library unavailable")
+        idx = np.array([63, 0, 17, 17, 5], dtype=np.int64)
+        np.testing.assert_array_equal(r.take(idx), x[idx])
+        with pytest.raises(IndexError):
+            r.take(np.array([64]))
+        r.close()
+
+    def test_native_reader_rejects_fortran_order(self, tmp_path):
+        from horovod_tpu._native import loader
+
+        if loader.get_lib() is None:
+            pytest.skip("native library unavailable")
+        p = str(tmp_path / "f.npy")
+        np.save(p, np.asfortranarray(np.ones((8, 4), np.float32)))
+        assert loader.npy_reader(p) is None  # falls back to memmap path
