@@ -13,6 +13,19 @@ import os
 # env var. Override with BENCH_PEAK_TFLOPS.
 PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
+# Public peak HBM bandwidth (GB/s) per generation — the roofline
+# denominator. Override with BENCH_PEAK_HBM_GBS.
+PEAK_HBM_GBS = {"v4": 1228.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1638.0}
+
+
+def peak_hbm_gbs(platform: str):
+    if platform == "cpu":
+        return None
+    if os.environ.get("BENCH_PEAK_HBM_GBS"):
+        return float(os.environ["BENCH_PEAK_HBM_GBS"])
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return PEAK_HBM_GBS.get(gen)
+
 
 def peak_tflops(platform: str):
     """MFU denominator for this chip; None when there isn't a meaningful
@@ -48,7 +61,9 @@ def sync(x):
 
 def aot_compile(step_fn, *args):
     """AOT-compile a jitted fn once; returns (callable, flops_or_None).
-    Falls back to the jitted fn itself on backends without AOT."""
+    Falls back to the jitted fn itself on backends without AOT. The
+    step's XLA-estimated HBM traffic (the roofline numerator) is read
+    separately with :func:`bytes_accessed`."""
     try:
         compiled = step_fn.lower(*args).compile()
     except Exception:
@@ -63,9 +78,27 @@ def aot_compile(step_fn, *args):
     return compiled, flops
 
 
-def mfu_fields(flops, iters, dt, platform):
+def bytes_accessed(compiled):
+    """XLA's 'bytes accessed' estimate for a compiled step, or None
+    (its own failure domain — a missing bytes field must never cost
+    the FLOPs number)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("bytes accessed", 0.0)) or None
+    except Exception:
+        return None
+
+
+def mfu_fields(flops, iters, dt, platform, step_bytes=None):
     """The tflops_per_sec / mfu keys for a bench JSON line (empty dict
-    when FLOPs are unknown)."""
+    when FLOPs are unknown). ``step_bytes`` (from
+    :func:`bytes_accessed` on the SAME compiled step) adds the
+    roofline side: XLA's bytes estimate over the measured step time vs
+    the chip's HBM peak — an mbu near 1.0 with mfu well below 1.0 is
+    the quantified bandwidth-bound argument VERDICT r3 asked for
+    (XLA assumes perfect fusion, so read mbu as a lower bound)."""
     if flops is None or dt <= 0:
         return {}
     tflops = flops * iters / dt / 1e12
@@ -73,4 +106,10 @@ def mfu_fields(flops, iters, dt, platform):
     peak = peak_tflops(platform)
     if peak:
         out["mfu"] = round(tflops / peak, 4)
+    if step_bytes and platform != "cpu":
+        gbs = step_bytes * iters / dt / 1e9
+        out["hbm_gb_per_sec"] = round(gbs, 1)
+        peak_bw = peak_hbm_gbs(platform)
+        if peak_bw:
+            out["mbu"] = round(gbs / peak_bw, 4)
     return out

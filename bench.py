@@ -142,6 +142,9 @@ def inner_main():
     train_step, flops = _aot_compile(
         train_step, params, batch_stats, opt_state, images, labels
     )
+    from _benchlib import bytes_accessed as _bytes_accessed
+
+    step_bytes = _bytes_accessed(train_step)
 
     from _benchlib import sync as _sync
 
@@ -182,7 +185,9 @@ def inner_main():
         # config provenance: the stale-artifact fallback must not
         # substitute a stem-variant probe for the default config
         result["stem"] = stem
-    result.update(_mfu_fields(flops, n_iters, dt, platform))
+    result.update(
+        _mfu_fields(flops, n_iters, dt, platform, step_bytes=step_bytes)
+    )
     print(json.dumps(result))
 
 

@@ -118,9 +118,10 @@ def main():
         rng.integers(0, cfg.vocab_size, size=(world, batch, seq)), jnp.int32
     )
 
-    from _benchlib import aot_compile, mfu_fields
+    from _benchlib import aot_compile, bytes_accessed, mfu_fields
 
     step, flops = aot_compile(step, params, opt_state, toks, labels)
+    step_bytes = bytes_accessed(step)
     flops_note = None
     if flops and cfg.uses_flash(seq=seq):
         # The Pallas flash-attention kernels are custom calls — invisible
@@ -154,7 +155,8 @@ def main():
         "remat": remat,
         "platform": jax.devices()[0].platform,
     }
-    result.update(mfu_fields(flops, iters, dt, jax.devices()[0].platform))
+    result.update(mfu_fields(flops, iters, dt, jax.devices()[0].platform,
+                             step_bytes=step_bytes))
     if flops_note:
         result["flops_note"] = flops_note
     print(json.dumps(result))

@@ -80,3 +80,114 @@ class TorchState(ObjectState):
             broadcast_optimizer_state(self.optimizer, root_rank=0)
         super().sync()  # scalar attributes via broadcast_object
         self.save()
+
+
+class ElasticSampler:
+    """Distributed sampler that supports mid-epoch membership changes
+    (ref: horovod/torch/elastic/sampler.py ElasticSampler [V]).
+
+    Contract (same as the reference): iterate your rank's shard;
+    ``record_batch`` after each step marks those samples processed; on a
+    host change call ``sampler.sync()`` — it UNIONS every rank's
+    processed set (allgather, the reference's sampler state handler
+    semantics) and re-shards the remainder over the new world, so no
+    sample is dropped or repeated within the epoch. NOTE:
+    ``TorchState.sync`` alone is NOT enough — its broadcast would
+    overwrite survivors' progress with rank 0's; call the sampler's own
+    ``sync()`` after it. ``state_dict``/``load_state_dict`` ride an
+    elastic State object so commits capture progress; ``set_epoch``
+    reshuffles and clears the processed set.
+
+    Duck-typed to torch's Sampler protocol (``__iter__``/``__len__``) —
+    usable as ``DataLoader(..., sampler=ElasticSampler(ds))`` without
+    importing torch here.
+    """
+
+    def __init__(self, dataset, shuffle: bool = True, seed: int = 0,
+                 num_replicas=None, rank=None):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: set = set()
+        # explicit overrides pin the identity (tests / manual sharding);
+        # None = re-read from the runtime on every reset (the elastic
+        # membership-change behavior)
+        self._fixed_replicas = num_replicas
+        self._fixed_rank = rank
+        self.reset()
+
+    # -- lifecycle -----------------------------------------------------
+    def reset(self) -> None:
+        """Re-shard the unprocessed remainder over the CURRENT world
+        (rank/size re-read — this is the membership-change hook)."""
+        from ..common import basics
+        import numpy as np
+
+        self.num_replicas = (
+            self._fixed_replicas
+            if self._fixed_replicas is not None
+            else basics.size()
+        )
+        self.rank = (
+            self._fixed_rank if self._fixed_rank is not None else basics.rank()
+        )
+        n = len(self.dataset)
+        remaining = np.array(
+            sorted(set(range(n)) - self.processed_indices), dtype=np.int64
+        )
+        if self.shuffle and len(remaining):
+            rng = np.random.default_rng((self.seed, self.epoch))
+            remaining = remaining[rng.permutation(len(remaining))]
+        # equal shards via wrap-around padding (SPMD step-count parity,
+        # same discipline as data.ShardedIndexSampler)
+        per = -(-len(remaining) // self.num_replicas) if len(remaining) else 0
+        total = per * self.num_replicas
+        if total > len(remaining) and len(remaining):
+            remaining = np.resize(remaining, total)
+        self.indices = remaining[self.rank :: self.num_replicas].tolist()
+        self.num_samples = len(self.indices)
+
+    def sync(self) -> None:
+        """Union every rank's processed set, then re-shard the
+        remainder over the CURRENT world — the membership-change hook
+        (ref: the sampler state-sync handler unions processed indices
+        across workers [V]; a plain broadcast would drop the progress
+        of every rank but the root)."""
+        from . import allgather_object
+
+        for other in allgather_object(sorted(self.processed_indices)):
+            self.processed_indices.update(int(i) for i in other)
+        self.reset()
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+        self.processed_indices = set()
+        self.reset()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark the samples of batch ``batch_idx`` (into this rank's
+        current index list) as processed."""
+        sl = self.indices[
+            batch_idx * batch_size : (batch_idx + 1) * batch_size
+        ]
+        self.processed_indices.update(int(i) for i in sl)
+
+    # -- elastic State integration ------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "processed_indices": sorted(self.processed_indices),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.epoch = int(sd["epoch"])
+        self.processed_indices = set(sd["processed_indices"])
+        self.reset()
+
+    # -- sampler protocol ---------------------------------------------
+    def __iter__(self):
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -167,3 +167,76 @@ def test_shim_namespace_parity(hvd):
 
     assert callable(hvdtf.elastic.run)
     assert hvdtf.elastic.TensorFlowKerasState is not None
+
+
+class TestElasticSampler:
+    """ref: horovod/torch/elastic/sampler.py [V] — mid-epoch re-shard of
+    the unprocessed remainder, no drops, no repeats."""
+
+    @staticmethod
+    def _sampler(n=40, world=4, rank=0, **kw):
+        from horovod_tpu.torch.elastic import ElasticSampler
+
+        return ElasticSampler(
+            list(range(n)), num_replicas=world, rank=rank, **kw
+        )
+
+    def test_covers_all_and_equal_shards(self, hvd):
+        shards = [
+            self._sampler(n=40, world=4, rank=r, shuffle=False).indices
+            for r in range(4)
+        ]
+        assert all(len(sh) == 10 for sh in shards)
+        assert set().union(*map(set, shards)) == set(range(40))
+
+    def test_record_and_reshard_no_repeat_no_drop(self, hvd):
+        samplers = [
+            self._sampler(n=64, world=4, rank=r, shuffle=True, seed=3)
+            for r in range(4)
+        ]
+        # every rank processes its first two batches of 4
+        processed = set()
+        for s in samplers:
+            s.record_batch(0, 4)
+            s.record_batch(1, 4)
+            processed |= s.processed_indices
+        # membership change 4 -> 2: the union travels via
+        # sampler.sync() (allgather semantics; under the single
+        # controller allgather_object returns the caller's own set, so
+        # we seed each survivor with its pre-change local view plus the
+        # union — multi-process coverage of allgather_object itself
+        # lives in tests/test_multiprocess_ops.py's op family)
+        survivors = []
+        for r in range(2):
+            s = self._sampler(n=64, world=2, rank=r, shuffle=True, seed=3)
+            s.processed_indices = set(processed)
+            s.sync()
+            survivors.append(s)
+        remaining = set(range(64)) - processed
+        got = set(survivors[0].indices) | set(survivors[1].indices)
+        assert got == remaining
+        # nothing processed is repeated
+        for s in survivors:
+            assert not (set(s.indices) & processed)
+        # equal step counts (wrap-around padding)
+        assert len(survivors[0]) == len(survivors[1])
+
+    def test_set_epoch_clears_progress_and_reshuffles(self, hvd):
+        s = self._sampler(n=32, world=2, rank=0, shuffle=True, seed=0)
+        s.record_batch(0, 4)
+        e0 = list(s.indices)
+        s.set_epoch(1)
+        assert s.processed_indices == set()
+        assert s.indices != e0  # different epoch permutation
+
+    def test_state_dict_roundtrip(self, hvd):
+        s = self._sampler(n=32, world=2, rank=1)
+        s.set_epoch(2)
+        s.record_batch(0, 4)
+        sd = s.state_dict()
+        s2 = self._sampler(n=32, world=2, rank=1)
+        s2.load_state_dict(sd)
+        assert s2.epoch == 2
+        assert s2.processed_indices == s.processed_indices
+        s.reset()  # same post-restore view: both exclude the processed set
+        assert s2.indices == s.indices
