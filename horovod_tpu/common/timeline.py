@@ -56,7 +56,14 @@ class Timeline:
         self._active = True
 
     def stop(self) -> None:
+        """Deactivate AND flush the file: the reference writes the
+        timeline incrementally, so after hvd.stop_timeline() the user
+        can open the trace immediately — waiting for shutdown() to
+        materialize it would silently diverge (timeline.cc [V]).
+        start() may still resume recording; close() re-writes with any
+        further events."""
         self._active = False
+        self._write()
 
     @property
     def active(self) -> bool:
@@ -141,13 +148,19 @@ class Timeline:
                     }
                 )
 
-    def close(self) -> None:
+    def _write(self) -> None:
         with self._lock:
             if self._native is not None:
-                events = [json.loads(s) for s in self._native.drain()]
-            else:
-                events = self._events
+                # drain() empties the ring; keep drained events so a
+                # later write (stop → close) still has the full trace
+                self._events.extend(
+                    json.loads(s) for s in self._native.drain()
+                )
+            events = self._events
             tmp = f"{self._path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump({"traceEvents": events}, f)
             os.replace(tmp, self._path)
+
+    def close(self) -> None:
+        self._write()

@@ -1,0 +1,487 @@
+"""``import horovod_tpu.mxnet as hvd`` — the MXNet binding surface.
+
+Parity with the reference's MXNet module (ref: horovod/mxnet/__init__.py
++ mpi_ops.py + functions.py [V] — SURVEY.md §2.4/§2.5): Gluon scripts
+port by changing one import. The bridge is the same host-side design as
+the torch shim: each NDArray crosses to numpy once (``.asnumpy()``),
+rides the eager collective path (so tensor fusion, process sets, the
+join mask, and the timeline all apply), and the XLA-reduced result
+comes back through ``mx.nd.array``.
+
+Duck-typing contract: mxnet itself is imported lazily and only for
+constructing result arrays, so the module imports (and the op surface
+runs) with any NDArray-shaped object exposing ``.asnumpy()``/``.shape``
+/``.dtype`` and a module registered as ``mxnet`` providing
+``nd.array``. MXNet reached EOL upstream; this shim keeps script
+compatibility without making the framework depend on it (the earlier
+out-of-scope decision in docs/design.md is superseded by this gated
+surface).
+
+Divergences (documented, same one-controller model as the torch shim):
+- ``priority`` is accepted and ignored — the reference uses it to order
+  MXNet-engine async ops (horovod/mxnet/mpi_ops.py [V]); here dispatch
+  order is the fusion cycle's enqueue order.
+- ops are synchronous: the reference returns immediately and lets the
+  MXNet engine chain dependencies; there is no engine to chain here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..common.basics import (  # noqa: F401
+    add_process_set,
+    cross_rank,
+    cross_size,
+    global_process_set,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    remove_process_set,
+    shutdown,
+    size,
+)
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet,
+    warn_nonmember_controller as _warn_nonmember_controller,
+)
+from ..ops import eager as _eager
+from ..ops.reduction_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+)
+
+
+def start_timeline(file_path, mark_cycles: bool = False) -> None:
+    import horovod_tpu as _hvd
+
+    _hvd.start_timeline(file_path, mark_cycles=mark_cycles)
+
+
+def stop_timeline() -> None:
+    import horovod_tpu as _hvd
+
+    _hvd.stop_timeline()
+
+
+def _mx():
+    import mxnet
+
+    return mxnet
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    return tensor.asnumpy()
+
+
+def _from_numpy(array: np.ndarray, like):
+    """numpy → NDArray on the caller's context, preserving dtype."""
+    mx = _mx()
+    shape = tuple(np.shape(array))
+    arr = np.ascontiguousarray(array)  # promotes 0-d to (1,)
+    kwargs = {}
+    ctx = getattr(like, "context", None)
+    if ctx is not None:
+        kwargs["ctx"] = ctx
+    dtype = getattr(like, "dtype", None)
+    if dtype is not None:
+        kwargs["dtype"] = dtype
+    out = mx.nd.array(arr, **kwargs)
+    if tuple(out.shape) != shape:
+        out = out.reshape(shape)
+    return out
+
+
+def _replicated_payload(tensor):
+    """Single-controller payload: every rank contributes this process's
+    tensor (same data model as the torch shim)."""
+    return _eager.replicate(_to_numpy(tensor))
+
+
+def _finish(result, like):
+    row = np.asarray(_eager.first(result))
+    like_shape = tuple(getattr(like, "shape", row.shape))
+    if row.size == int(np.prod(like_shape)) and row.shape != like_shape:
+        # 0-d scalars ride the fusion path as shape-(1,) payloads;
+        # restore the caller's shape (same guard as the torch shim)
+        row = row.reshape(like_shape)
+    return _from_numpy(row, like)
+
+
+def _copy_into(target, value_nd):
+    target[:] = value_nd
+    return target
+
+
+# --------------------------------------------------------------- collectives
+
+
+def allreduce(tensor, average=None, name=None, priority=0, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set: Optional[ProcessSet] = None):
+    """hvd.allreduce for NDArrays (ref: horovod/mxnet/mpi_ops.py
+    allreduce [V]). `priority` accepted for compatibility (see module
+    docstring)."""
+    del priority
+    _warn_nonmember_controller("allreduce", process_set)
+    handle = _eager.allreduce_async(
+        _replicated_payload(tensor), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    return _finish(handle.wait(), tensor)
+
+
+def allreduce_(tensor, average=None, name=None, priority=0, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set: Optional[ProcessSet] = None):
+    """In-place spelling: writes the reduction back into `tensor` [V]."""
+    out = allreduce(tensor, average=average, name=name, priority=priority,
+                    op=op, prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set=process_set)
+    return _copy_into(tensor, out)
+
+
+def grouped_allreduce(tensors, average=None, name=None, priority=0, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set: Optional[ProcessSet] = None):
+    """Atomic grouped allreduce (ref: grouped_allreduce [V]) — the group
+    rides the fusion engine's indivisible-group machinery."""
+    del priority
+    _warn_nonmember_controller("grouped_allreduce", process_set)
+    handles = _eager.grouped_allreduce_async(
+        [_replicated_payload(t) for t in tensors],
+        average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    return [_finish(h.wait(), t) for h, t in zip(handles, tensors)]
+
+
+def grouped_allreduce_(tensors, **kwargs):
+    outs = grouped_allreduce(tensors, **kwargs)
+    return [_copy_into(t, o) for t, o in zip(tensors, outs)]
+
+
+def allgather(tensor, name=None, priority=0,
+              process_set: Optional[ProcessSet] = None):
+    """Concatenates along axis 0 across ranks (ref: allgather [V])."""
+    del priority
+    _warn_nonmember_controller("allgather", process_set)
+    handle = _eager.allgather_async(
+        _replicated_payload(tensor), name=name, process_set=process_set,
+    )
+    # eager allgather yields rank-major [world, n, ...]; the NDArray
+    # contract concatenates along dim 0 (same post step as the torch shim)
+    host = np.asarray(_eager.first(handle.wait()))
+    return _from_numpy(host.reshape((-1,) + host.shape[2:]), tensor)
+
+
+def broadcast(tensor, root_rank, name=None, priority=0,
+              process_set: Optional[ProcessSet] = None):
+    """hvd.broadcast (ref: broadcast [V])."""
+    del priority
+    _warn_nonmember_controller("broadcast", process_set)
+    handle = _eager.broadcast_async(
+        _replicated_payload(tensor), root_rank=root_rank, name=name,
+        process_set=process_set,
+    )
+    return _finish(handle.wait(), tensor)
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0,
+               process_set: Optional[ProcessSet] = None):
+    out = broadcast(tensor, root_rank, name=name, priority=priority,
+                    process_set=process_set)
+    return _copy_into(tensor, out)
+
+
+def alltoall(tensor, splits=None, name=None, priority=0,
+             process_set: Optional[ProcessSet] = None):
+    """hvd.alltoall with optional uneven 1-D `splits` (this rank's dim-0
+    row counts per peer); returns (output, received_splits) when splits
+    are given, like the reference (ref: alltoall [V]). Same replicated
+    single-controller model as the torch shim's alltoall."""
+    del priority
+    _warn_nonmember_controller("alltoall", process_set)
+    host = _to_numpy(tensor)
+    if splits is not None:
+        world = size()
+        participants = (
+            len(process_set.ranks)
+            if process_set is not None and process_set.process_set_id != 0
+            else world
+        )
+        splits_1d = [int(s) for s in np.asarray(
+            splits.asnumpy() if hasattr(splits, "asnumpy") else splits
+        ).reshape(-1).tolist()]
+        if len(splits_1d) != participants:
+            raise ValueError(
+                f"splits has {len(splits_1d)} entries but the exchange "
+                f"has {participants} participants"
+            )
+        if sum(splits_1d) != host.shape[0]:
+            raise ValueError(
+                f"splits sum to {sum(splits_1d)} but tensor dim0 is "
+                f"{host.shape[0]}"
+            )
+        handle = _eager.alltoall_async(
+            [host] * world, splits=[splits_1d] * world, name=name,
+            process_set=process_set,
+        )
+        outputs, recv_splits = handle.wait()
+        out = _from_numpy(np.array(outputs[0], copy=True), tensor)
+        mx = _mx()
+        return out, mx.nd.array(
+            np.asarray(recv_splits[0], dtype=np.int32), dtype="int32"
+        )
+    handle = _eager.alltoall_async(
+        _eager.replicate(host), name=name, process_set=process_set,
+    )
+    return _finish(handle.wait(), tensor)
+
+
+def reducescatter(tensor, name=None, priority=0, op=None,
+                  process_set: Optional[ProcessSet] = None):
+    """hvd.reducescatter (ref: reducescatter [V])."""
+    del priority
+    _warn_nonmember_controller("reducescatter", process_set)
+    handle = _eager.reducescatter_async(
+        _replicated_payload(tensor), name=name, op=op,
+        process_set=process_set,
+    )
+    return _finish(handle.wait(), tensor)
+
+
+# ---------------------------------------------------------------- functions
+
+
+def broadcast_parameters(params, root_rank: int = 0, prefix: str = "") -> None:
+    """Broadcast a Gluon ``ParameterDict`` / plain dict of NDArrays from
+    `root_rank` in place (ref: horovod/mxnet/functions.py
+    broadcast_parameters [V]). Gluon Parameters are recognized by their
+    ``list_data()``/``set_data()`` methods; plain NDArrays by
+    ``asnumpy``. Keys are sorted so every rank walks the same order."""
+    if params is None:
+        return
+    items = sorted(params.items()) if hasattr(params, "items") else sorted(
+        enumerate(params)
+    )
+    for key, p in items:
+        name = f"{prefix}{key}"
+        if hasattr(p, "list_data") and hasattr(p, "set_data"):
+            # gluon Parameter: broadcast the master copy, set_data fans
+            # it out to every context
+            data = p.list_data()[0]
+            out = broadcast(data, root_rank, name=f"bp.{name}")
+            p.set_data(out)
+        elif hasattr(p, "asnumpy"):
+            broadcast_(p, root_rank, name=f"bp.{name}")
+        elif p is None:
+            continue
+        else:
+            raise ValueError(
+                f"broadcast_parameters: unsupported value for {name!r}: "
+                f"{type(p).__name__}"
+            )
+
+
+# --------------------------------------------------------------- optimizers
+
+
+class _DistOptMixin:
+    """The Horovod half of DistributedOptimizer: allreduce each gradient
+    before delegating update/update_multi_precision (ref:
+    horovod/mxnet/__init__.py DistributedOptimizer [V]). Combined with
+    ``mx.optimizer.Optimizer`` as a base when real mxnet is importable
+    (so isinstance checks in gluon.Trainer / Module.init_optimizer
+    accept it, like the reference's subclass), and used standalone for
+    duck-typed optimizers."""
+
+    def _hvd_init(self, optimizer, gradient_predivide_factor, num_groups,
+                  op, process_set):
+        op = Average if op is None else op
+        if float(gradient_predivide_factor) != 1.0 and op is not Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average "
+                "(ref parity)")
+        self._optimizer = optimizer
+        self._op = op
+        self._predivide = float(gradient_predivide_factor)
+        self._num_groups = int(num_groups)
+        self._process_set = process_set
+
+    def __getattr__(self, item):
+        inner = self.__dict__.get("_optimizer")
+        if inner is None:  # not yet _hvd_init'd (base __init__ probes)
+            raise AttributeError(item)
+        return getattr(inner, item)
+
+    def __setattr__(self, name, value):
+        # Real-mxnet callers poke public knobs straight onto the
+        # optimizer object (Trainer sets rescale_grad per step); mirror
+        # them onto the wrapped optimizer, whose update() consumes them.
+        object.__setattr__(self, name, value)
+        inner = self.__dict__.get("_optimizer")
+        if inner is not None and not name.startswith("_"):
+            try:
+                setattr(inner, name, value)
+            except Exception:
+                pass
+
+    def _reduce(self, grads, names):
+        if self._predivide != 1.0:  # only reachable with op=Average
+            pre = 1.0 / self._predivide
+            post = self._predivide
+        else:
+            pre, post = 1.0, 1.0
+        grads = list(grads)
+        # num_groups > 0: split into that many fusion groups, like the
+        # reference's grouped allreduce batching [V]; each group is one
+        # atomic grouped_allreduce (0 = everything in one group)
+        n_groups = max(1, min(self._num_groups, len(grads))) \
+            if self._num_groups > 0 else 1
+        out = []
+        for chunk_idx in range(n_groups):
+            chunk = grads[chunk_idx::n_groups]
+            if not chunk:
+                continue
+            reduced = grouped_allreduce(
+                chunk, op=self._op,
+                name=names[chunk_idx] if chunk_idx < len(names) else None,
+                prescale_factor=pre, postscale_factor=post,
+                process_set=self._process_set,
+            )
+            out.append((chunk, reduced))
+        for chunk, reduced in out:
+            for g, r in zip(chunk, reduced):
+                _copy_into(g, r)
+
+    @staticmethod
+    def _listify(index, weight, grad, state):
+        if isinstance(index, (tuple, list)):
+            return list(index), list(weight), list(grad), state
+        return [index], [weight], [grad], state
+
+    def update(self, index, weight, grad, state):
+        idx, w, g, st = self._listify(index, weight, grad, state)
+        self._reduce(g, [f"grad.{i}" for i in idx])
+        return self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        idx, w, g, st = self._listify(index, weight, grad, state)
+        self._reduce(g, [f"grad.{i}" for i in idx])
+        return self._optimizer.update_multi_precision(
+            index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+def DistributedOptimizer(optimizer, gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0, op=None,
+                         process_set: Optional[ProcessSet] = None):
+    """Factory (same call shape as the reference's class [V]): returns
+    an ``mx.optimizer.Optimizer`` subclass instance when `optimizer` is
+    a real mxnet Optimizer — so gluon.Trainer/Module isinstance checks
+    pass — and a duck-typed wrapper otherwise."""
+    if op is not None and op not in (Average, Sum, Adasum):
+        raise ValueError(
+            "DistributedOptimizer supports Average, Sum and Adasum")
+    bases = (_DistOptMixin,)
+    try:
+        import mxnet as mx
+
+        real_base = getattr(getattr(mx, "optimizer", None), "Optimizer",
+                            None)
+        if real_base is not None and isinstance(optimizer, real_base):
+            bases = (_DistOptMixin, real_base)
+    except Exception:
+        pass
+
+    cls = type("DistributedOptimizer", bases, {})
+    # Deliberately do NOT run Optimizer.__init__: its kwarg defaults
+    # (lr/wd/rescale_grad...) would land as instance attributes on the
+    # wrapper and permanently shadow __getattr__ delegation to the
+    # wrapped optimizer's real values (the reference subclass skips it
+    # for the same reason [V]). isinstance checks only need the bases.
+    inst = cls.__new__(cls)
+    inst._hvd_init(optimizer, gradient_predivide_factor, num_groups, op,
+                   process_set)
+    return inst
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       compression=None, gradient_predivide_factor=1.0,
+                       process_set: Optional[ProcessSet] = None):
+    """Gluon Trainer whose ``_allreduce_grads`` reduces over the mesh
+    (ref: horovod/mxnet/__init__.py DistributedTrainer [V]).
+
+    Implemented as a factory: the subclass of ``mx.gluon.Trainer`` is
+    built at call time, so importing this module never requires mxnet.
+    Like the reference, the loss scale is folded into the trainer's
+    rescale_grad so ``trainer.step(batch_size)`` keeps its Gluon
+    meaning per worker.
+    """
+    del compression  # fp16 wire compression: the fused path casts bf16
+    mx = _mx()
+    pset = process_set
+
+    class _DistributedTrainer(mx.gluon.Trainer):
+        def __init__(self):
+            # optimizer_params forwards UNCHANGED: gluon.Trainer asserts
+            # it is None when `optimizer` is an Optimizer instance, and
+            # the reference forwards it verbatim too [V]
+            super().__init__(
+                params, optimizer, optimizer_params,
+                kvstore=None,
+            )
+            # The reference rescales because its wire op is a Sum; this
+            # shim reduces with Average, so Gluon's own rescale_grad
+            # semantics (divide by step's batch_size) are already
+            # per-worker-correct and _scale is left untouched [V].
+            self._hvd_predivide = float(gradient_predivide_factor)
+
+        def _allreduce_grads(self):
+            grads, names = [], []
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for g in param.list_grad():
+                        grads.append(g)
+                        names.append(f"grad.{i}")
+            if not grads:
+                return
+            if self._hvd_predivide != 1.0:
+                pre = 1.0 / self._hvd_predivide
+                post = self._hvd_predivide
+            else:
+                pre, post = 1.0, 1.0
+            reduced = grouped_allreduce(
+                grads, op=Average, name=names[0],
+                prescale_factor=pre, postscale_factor=post,
+                process_set=pset,
+            )
+            for g, r in zip(grads, reduced):
+                _copy_into(g, r)
+
+    return _DistributedTrainer()

@@ -125,14 +125,19 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     no_value_flags = {
         "--verbose", "--timeline-mark-cycles", "--autotune",
         "--hierarchical-allreduce", "--gloo", "--mpi", "-h", "--help",
+        "-cb", "--check-build",
     }
+    check_build = False
     config_path = None
     i = 0
     while i < len(argv):
         a = argv[i]
         if a == "--":
             break
-        if a.startswith("--config-file="):
+        if a in ("-cb", "--check-build"):
+            check_build = True
+            i += 1
+        elif a.startswith("--config-file="):
             config_path = a.split("=", 1)[1]
             i += 1
         elif a == "--config-file":
@@ -150,12 +155,15 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
         # (e.g. --config would reach argparse but not the scan)
         allow_abbrev=False,
     )
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print the framework/controller/op build summary "
+                        "and exit (ref: horovodrun --check-build [V])")
     p.add_argument("--config-file", default=None,
                    help="params YAML; CLI flags override its values "
                         "(keys = long option names, one nesting level "
                         "joins with a dash)")
     p.add_argument("-np", "--num-proc", type=int,
-                   required=config_path is None,
+                   required=config_path is None and not check_build,
                    help="total number of ranks (chips)")
     p.add_argument("-H", "--hosts", default=None,
                    help="comma-separated host:slots list")
@@ -212,7 +220,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     if config_path is not None:
         p.set_defaults(**_load_config_file(config_path, p))
     args = p.parse_args(argv)
-    if args.num_proc is None:
+    if args.num_proc is None and not args.check_build:
         p.error("-np/--num-proc is required (on the CLI or in "
                 "--config-file)")
     if args.command and args.command[0] == "--":
@@ -498,8 +506,57 @@ def _run_elastic(args: argparse.Namespace) -> int:
         driver.shutdown()
 
 
+def _check_build() -> int:
+    """Print the build summary (ref: horovodrun --check-build, which
+    renders Available Frameworks / Controllers / Tensor Operations from
+    the compiled-in feature set [V]). Here the feature set is determined
+    at runtime: framework rows probe the shim imports, controller and
+    op rows come from the basics predicates — the data plane is always
+    XLA collectives over ICI, so the op column reports [X] XLA and [ ]
+    for every GPU-era transport the reference could compile in."""
+    from horovod_tpu.common import basics
+
+    def _probe(modname):
+        try:
+            __import__(modname)
+            return True
+        except Exception:
+            return False
+
+    def box(flag):
+        return "[X]" if flag else "[ ]"
+
+    lines = [
+        "Horovod-TPU v" + getattr(
+            __import__("horovod_tpu"), "__version__", "?"),
+        "",
+        "Available Frameworks:",
+        f"    {box(True)} JAX / Flax",
+        f"    {box(_probe('torch'))} PyTorch (host bridge)",
+        f"    {box(_probe('tensorflow'))} TensorFlow (host bridge)",
+        f"    {box(_probe('mxnet'))} MXNet (host bridge)",
+        "",
+        "Available Controllers:",
+        f"    {box(basics.mpi_built())} MPI",
+        f"    {box(basics.gloo_built())} Gloo",
+        f"    {box(True)} jax.distributed (TPU coordination service)",
+        "",
+        "Available Tensor Operations:",
+        f"    {box(basics.nccl_built())} NCCL",
+        f"    {box(basics.ddl_built())} DDL",
+        f"    {box(basics.ccl_built())} CCL",
+        f"    {box(basics.mpi_built())} MPI",
+        f"    {box(basics.gloo_built())} Gloo",
+        f"    {box(basics.xla_built())} XLA collectives (ICI/DCN)",
+    ]
+    print("\n".join(lines))
+    return 0
+
+
 def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
     args = parse_args(argv)
+    if args.check_build:
+        return _check_build()
     if not args.command:
         print("hvdrun: no command given", file=sys.stderr)
         return 2

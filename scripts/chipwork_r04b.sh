@@ -1,0 +1,143 @@
+#!/usr/bin/env bash
+# Round-4 chip work, part b. chipwork_r04.sh's lse smoke used the CPU
+# test tolerance (2e-3) against an fp32 dense oracle; on the chip the
+# MXU's default-precision matmul carries bf16-epsilon (~7.8e-3) input
+# rounding, so BOTH layouts "failed" with identical ~6.6e-3 maxerr —
+# i.e. they agree with each other exactly and differ from the oracle by
+# rounding. That misread exported BENCH_FLASH=0 and would have run every
+# LM bench with dense attention. This part re-validates with an
+# on-chip-calibrated gate (cross-layout agreement tight at 1e-5, oracle
+# agreement at 2e-2 like tests/test_flash_attention.py:85's bf16 case)
+# and then runs the remaining captures from the r04 plan.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+R=r04
+
+# 0. let the in-flight resnet50 capture (launched by part a) finish,
+#    then finalize its artifact the way cap() would have
+while pgrep -f "python bench.py" >/dev/null 2>&1; do sleep 30; done
+if [ -f bench_results/resnet50_${R}.json.tmp ]; then
+  if grep -qE '^\{' bench_results/resnet50_${R}.json.tmp; then
+    grep -E '^\{' bench_results/resnet50_${R}.json.tmp > bench_results/resnet50_${R}.json
+    rm -f bench_results/resnet50_${R}.json.tmp bench_results/resnet50_${R}.err
+    echo "=== finalized resnet50 from part a:" >&2
+    cat bench_results/resnet50_${R}.json >&2
+  fi
+fi
+
+cap() {   # cap <name> <cmd...>  -> bench_results/<name>_r04.json
+  local name="$1"; shift
+  local out="bench_results/${name}_${R}.json"
+  for attempt in 1 2; do
+    echo "=== $name (attempt $attempt) $(date -u +%H:%M)" >&2
+    "$@" > "$out.tmp" 2> "bench_results/${name}_${R}.err"
+    if grep -qE '^\{' "$out.tmp"; then
+      grep -E '^\{' "$out.tmp" > "$out"
+      rm -f "$out.tmp" "bench_results/${name}_${R}.err"
+      cat "$out" >&2
+      return 0
+    fi
+    rm -f "$out.tmp"
+    sleep 120
+  done
+  echo "FAILED $name (see bench_results/${name}_${R}.err)" >&2
+  return 1
+}
+
+# 1. flash lse re-validation with the calibrated gate
+python - > bench_results/flash_lse_smoke2_${R}.txt 2>&1 <<'EOF'
+import os
+import numpy as np
+import jax, jax.numpy as jnp
+
+def dense(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        t = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+rng = np.random.default_rng(0)
+b, t, h, d = 2, 256, 4, 64
+q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32) for _ in range(3))
+
+from horovod_tpu.ops import flash_attention as fa
+
+rq, rk, rv = jax.grad(
+    lambda q, k, v: dense(q, k, v, True).astype(jnp.float32).sum(),
+    argnums=(0, 1, 2))(q, k, v)
+
+grads = {}
+ok_oracle = {}
+for layout, env in (("broadcast", "1"), ("compact", "")):
+    os.environ["HOROVOD_FLASH_LSE_BROADCAST"] = env
+    try:
+        def loss(q, k, v):
+            return fa.flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        grads[layout] = (np.asarray(gq), np.asarray(gk), np.asarray(gv))
+        ok = True
+        for name, a, bb in (("dq", gq, rq), ("dk", gk, rk), ("dv", gv, rv)):
+            err = float(jnp.max(jnp.abs(a - bb)))
+            print(layout, name, "maxerr-vs-fp32-oracle", err)
+            ok = ok and err < 2e-2   # bf16-epsilon MXU rounding allowance
+    except Exception as e:
+        print(layout, "EXCEPTION", repr(e)[:300])
+        ok = False
+    ok_oracle[layout] = ok
+
+# the real layout gate: both interchange layouts must agree tightly
+agree = False
+if "compact" in grads and "broadcast" in grads:
+    errs = [float(np.abs(a - b).max())
+            for a, b in zip(grads["compact"], grads["broadcast"])]
+    print("cross-layout maxerr dq/dk/dv:", errs)
+    agree = max(errs) < 1e-5
+
+print("RESULT compact=%s broadcast=%s agree=%s" % (
+    "PASS" if ok_oracle.get("compact") else "FAIL",
+    "PASS" if ok_oracle.get("broadcast") else "FAIL",
+    "PASS" if agree else "FAIL"))
+if ok_oracle.get("compact") and agree:
+    print("FLASH LSE LAYOUTS PASS ON TPU")
+EOF
+tail -3 bench_results/flash_lse_smoke2_${R}.txt >&2
+if ! grep -q "FLASH LSE LAYOUTS PASS ON TPU" bench_results/flash_lse_smoke2_${R}.txt; then
+  if grep -q "broadcast=PASS" bench_results/flash_lse_smoke2_${R}.txt; then
+    echo "compact lse FAILED calibrated gate; pinning broadcast" >&2
+    export HOROVOD_FLASH_LSE_BROADCAST=1
+  else
+    echo "flash failed calibrated gate — LM benches fall back to dense" >&2
+    export BENCH_FLASH=0
+  fi
+fi
+
+# 2. space_to_depth stem A/B (resnet50 default landed in part a)
+cap resnet50_s2d       env BENCH_INNER=1 BENCH_STEM=space_to_depth python bench.py
+
+# 3. GPT-2 medium: fresh default; flash block sweep; no-remat big batch
+cap gpt2_medium        env BENCH_MODEL=gpt2_medium python bench_lm.py
+for blk in 64 256 512; do
+  cap gpt2_blk${blk}   env BENCH_MODEL=gpt2_medium BENCH_FLASH_BLOCK=${blk} python bench_lm.py
+done
+cap gpt2_noremat_b16   env BENCH_MODEL=gpt2_medium BENCH_BATCH=16 BENCH_REMAT=0 python bench_lm.py
+cap gpt2_seq1024       env BENCH_MODEL=gpt2_medium BENCH_BATCH=4 BENCH_SEQ=1024 python bench_lm.py
+
+# 4. BERT-large: fresh default + no-remat big batch
+cap bert_large         env BENCH_MODEL=bert_large python bench_lm.py
+cap bert_noremat_b16   env BENCH_MODEL=bert_large BENCH_BATCH=16 BENCH_REMAT=0 python bench_lm.py
+
+# 5. ViT-B/16 (config #5 — round-3 capture died in the outage)
+cap vit_b16            env BENCH_INNER=1 BENCH_MODEL=vit_b16 python bench.py
+
+# 6. allreduce busbw on the real chip (world=1: single-device round trip)
+cap allreduce          python bench_allreduce.py
+
+# 7. batch-512 confirm (HBM-bound => flat) for the roofline note
+cap resnet50_b512      env BENCH_INNER=1 BENCH_BATCH=512 python bench.py
+
+echo "=== chipwork_r04b complete $(date -u +%H:%M)" >&2

@@ -468,3 +468,31 @@ class TestConfigFile:
         assert args.command == [
             "python", "t.py", "--config-file", "u.yaml"
         ]
+
+
+class TestCheckBuild:
+    """hvdrun --check-build prints the build summary and exits 0 without
+    needing -np or a command (ref: horovodrun --check-build [V])."""
+
+    def test_check_build_runs_without_np(self, capsys):
+        from horovod_tpu.runner.launch import run_commandline
+
+        assert run_commandline(["--check-build"]) == 0
+        out = capsys.readouterr().out
+        assert "Available Frameworks" in out
+        assert "XLA collectives" in out
+        assert "[X] JAX / Flax" in out
+        # GPU-era transports must honestly report absent
+        assert "[ ] NCCL" in out
+
+    def test_short_flag(self, capsys):
+        from horovod_tpu.runner.launch import run_commandline
+
+        assert run_commandline(["-cb"]) == 0
+        assert "Available Controllers" in capsys.readouterr().out
+
+    def test_check_build_in_command_not_ours(self):
+        """-cb inside the launched command must not trigger the mode."""
+        args = parse_args(["-np", "2", "--", "python", "t.py", "-cb"])
+        assert args.check_build is False
+        assert args.command == ["python", "t.py", "-cb"]
