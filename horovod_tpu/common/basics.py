@@ -121,6 +121,13 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
         _metrics.configure_export()  # HOROVOD_METRICS_FILE, if set
         _maybe_init_jax_distributed(cfg)
         topology = topo_mod.discover(cfg)
+        if cfg.rendezvous_addr:
+            # Same-version gang guard (the launch driver's probe in the
+            # reference, driver_service.py [V]); mismatch raises, any
+            # rendezvous trouble only warns.
+            from ..runner.rendezvous import check_version_consistency
+
+            check_version_consistency(cfg, topology, log)
         _state.config = cfg
         _state.topology = topology
         _state.mesh = topology.world_mesh()

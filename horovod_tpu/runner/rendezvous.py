@@ -283,12 +283,7 @@ def broadcast_via_kv(obj, root_rank: int = 0, name: Optional[str] = None):
             "broadcast_object across processes needs the runner's "
             "rendezvous (HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT not set)"
         )
-    secret = (
-        bytes.fromhex(cfg.secret_key_hex) if cfg.secret_key_hex else None
-    )
-    client = RendezvousClient(
-        cfg.rendezvous_addr, cfg.rendezvous_port, secret_key=secret
-    )
+    client = _client_from_cfg(cfg)
     # Broadcast is a collective: every process calls it in the same
     # order, so a per-name call counter is identical everywhere. Folding
     # it into the key makes each round a fresh key — a reused explicit
@@ -343,6 +338,79 @@ def read_heartbeats(store_or_client) -> Dict[int, float]:
     return out
 
 
+def _client_from_cfg(cfg) -> "RendezvousClient":
+    """Shared construction of the worker-side KV client from config
+    (secret decode + endpoint) — used by the object collectives and the
+    version guard alike."""
+    secret = (
+        bytes.fromhex(cfg.secret_key_hex) if cfg.secret_key_hex else None
+    )
+    return RendezvousClient(
+        cfg.rendezvous_addr, cfg.rendezvous_port, secret_key=secret
+    )
+
+
+def check_version_consistency(cfg, topology, log=None) -> None:
+    """Fail fast when gang members run different horovod_tpu versions
+    (ref: the launch driver's same-version probe across hosts,
+    horovod/runner/driver/driver_service.py [V] — there it happens
+    before launch; here each worker checks itself against the lead
+    worker at init over the rendezvous KV, which catches the same skew
+    without an extra pre-launch RPC round).
+
+    Non-root workers publish their version and compare against rank 0's
+    (pairwise-to-root detects any skew). A TIMEOUT waiting for rank 0
+    only warns — the check must never turn a slow coordinator into a
+    hard failure — but an actual mismatch raises, because a skewed gang
+    fails later in far less diagnosable ways (wire-format or op-surface
+    drift mid-training).
+    """
+    import os as _os
+
+    import horovod_tpu
+
+    if not cfg.rendezvous_addr or not cfg.rendezvous_port:
+        return
+    mine = getattr(horovod_tpu, "__version__", "unknown")
+    client = _client_from_cfg(cfg)
+    # Scope keyed by the elastic epoch: the KV server outlives worker
+    # gangs across elastic restarts, and a stale 'version/0' from a
+    # previous incarnation would either fake a skew (gang upgraded
+    # between epochs) or mask a real one.
+    scope = f"version.{_os.environ.get('HOROVOD_ELASTIC_EPOCH', '0')}"
+    try:
+        client.put(scope, str(topology.rank), mine.encode())
+        if topology.rank == 0:
+            return
+        raw = client.wait(
+            scope, "0",
+            timeout=min(30.0, float(cfg.gloo_timeout_seconds)),
+        )
+    except TimeoutError:
+        if log is not None:
+            log.warning(
+                "version check: rank 0 did not publish within the "
+                "window; skipping (my version %s)", mine,
+            )
+        return
+    except (OSError, RuntimeError) as e:
+        # RuntimeError = non-200 from the KV (auth skew mid-re-key,
+        # transient 500). The guard's contract: only an actual version
+        # MISMATCH may fail init; rendezvous trouble warns.
+        if log is not None:
+            log.warning("version check skipped (rendezvous: %s)", e)
+        return
+    lead_version = raw.decode()
+    if lead_version != mine:
+        raise RuntimeError(
+            f"horovod_tpu version skew in the gang: rank "
+            f"{topology.rank} runs {mine} but rank 0 runs "
+            f"{lead_version}. Install the same version on every host "
+            f"(the reference's driver enforces this before launch "
+            f"[V])."
+        )
+
+
 def allgather_via_kv(obj, name: Optional[str] = None):
     """Object allgather through the rendezvous KV — the multi-controller
     backend of ``hvd.allgather_object`` (ref: horovod/torch/functions.py
@@ -359,12 +427,7 @@ def allgather_via_kv(obj, name: Optional[str] = None):
             "allgather_object across processes needs the runner's "
             "rendezvous (HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT not set)"
         )
-    secret = (
-        bytes.fromhex(cfg.secret_key_hex) if cfg.secret_key_hex else None
-    )
-    client = RendezvousClient(
-        cfg.rendezvous_addr, cfg.rendezvous_port, secret_key=secret
-    )
+    client = _client_from_cfg(cfg)
     base = "allgather_object" if name is None else name
     count = _broadcast_counts.get(base, 0)
     _broadcast_counts[base] = count + 1

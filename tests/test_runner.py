@@ -470,6 +470,106 @@ class TestConfigFile:
         ]
 
 
+class TestVersionConsistency:
+    """Same-version gang guard at init (ref: the launch driver's probe
+    across hosts, horovod/runner/driver/driver_service.py [V])."""
+
+    class _Cfg:
+        def __init__(self, port):
+            self.rendezvous_addr = "127.0.0.1"
+            self.rendezvous_port = port
+            self.secret_key_hex = None
+            self.gloo_timeout_seconds = 1.0
+
+    class _Topo:
+        def __init__(self, rank):
+            self.rank = rank
+
+    def test_same_version_passes_and_rank0_publishes(self):
+        from horovod_tpu.runner.rendezvous import check_version_consistency
+
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            cfg = self._Cfg(port)
+            check_version_consistency(cfg, self._Topo(0))
+            # rank 0 published its version for the others, in the
+            # elastic-epoch-keyed scope
+            client = RendezvousClient("127.0.0.1", port)
+            import horovod_tpu
+
+            assert client.get("version.0", "0").decode() == \
+                horovod_tpu.__version__
+            check_version_consistency(cfg, self._Topo(1))  # matches
+        finally:
+            server.stop()
+
+    def test_mismatch_raises_with_both_versions(self):
+        from horovod_tpu.runner.rendezvous import check_version_consistency
+
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            client = RendezvousClient("127.0.0.1", port)
+            client.put("version.0", "0", b"9.9.9-other")
+            with pytest.raises(RuntimeError, match="9.9.9-other"):
+                check_version_consistency(
+                    self._Cfg(port), self._Topo(2)
+                )
+        finally:
+            server.stop()
+
+    def test_stale_epoch_key_ignored(self, monkeypatch):
+        """A previous elastic incarnation's version key must not fake a
+        skew: the scope is keyed by HOROVOD_ELASTIC_EPOCH."""
+        from horovod_tpu.runner.rendezvous import check_version_consistency
+
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            client = RendezvousClient("127.0.0.1", port)
+            client.put("version.0", "0", b"0.0.1-previous-gang")
+            monkeypatch.setenv("HOROVOD_ELASTIC_EPOCH", "3")
+            # epoch-3 rank 0 publishes the current version; rank 1 then
+            # compares within epoch 3 and must NOT see the epoch-0 key
+            check_version_consistency(self._Cfg(port), self._Topo(0))
+            check_version_consistency(self._Cfg(port), self._Topo(1))
+        finally:
+            server.stop()
+
+    def test_auth_skew_warns_not_fails(self):
+        """A non-200 from the KV (e.g. secret out of sync mid-re-key)
+        must warn, never fail init — only a real mismatch raises."""
+        from horovod_tpu.runner.rendezvous import check_version_consistency
+
+        key = make_secret_key()
+        server = RendezvousServer(secret_key=key)
+        port = server.start()
+        try:
+            cfg = self._Cfg(port)  # client has NO secret → 403 on put
+            check_version_consistency(cfg, self._Topo(1))
+        finally:
+            server.stop()
+
+    def test_timeout_warns_but_passes(self):
+        from horovod_tpu.runner.rendezvous import check_version_consistency
+
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            # rank 0 never publishes; non-root must not hard-fail
+            check_version_consistency(self._Cfg(port), self._Topo(1))
+        finally:
+            server.stop()
+
+    def test_no_rendezvous_is_noop(self):
+        from horovod_tpu.runner.rendezvous import check_version_consistency
+
+        cfg = self._Cfg(0)
+        cfg.rendezvous_addr = None
+        check_version_consistency(cfg, self._Topo(1))
+
+
 class TestCheckBuild:
     """hvdrun --check-build prints the build summary and exits 0 without
     needing -np or a command (ref: horovodrun --check-build [V])."""
