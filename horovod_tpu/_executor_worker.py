@@ -16,6 +16,13 @@ def main() -> None:
     payload_path = sys.argv[1]
     out_dir = os.environ["HOROVOD_EXECUTOR_OUT"]
     rank = os.environ.get("HOROVOD_RANK", "0")
+    epoch = os.environ.get("HOROVOD_ELASTIC_EPOCH")
+    if epoch is not None:
+        # Elastic gangs restart into the same HOROVOD_EXECUTOR_OUT; a
+        # per-epoch subdirectory keeps a shrunken final gang from
+        # reading a larger earlier epoch's stale results.
+        out_dir = os.path.join(out_dir, f"epoch.{epoch}")
+        os.makedirs(out_dir, exist_ok=True)
     with open(payload_path, "rb") as f:
         fn, args, kwargs = pickle.load(f)
     try:

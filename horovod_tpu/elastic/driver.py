@@ -396,6 +396,18 @@ class ElasticDriver:
                 return True
         return self.compute_assignment() is not None
 
+    def gang_info(self):
+        """``(epoch, lead_ranks)`` of the current (on success: final)
+        gang — what an executor needs to collect per-rank results from
+        the right epoch directory (per-host placement launches one
+        process per host, so result files exist at LEAD ranks only)."""
+        with self._lock:
+            epoch = (
+                self._assignment.epoch if self._assignment else None
+            )
+            ranks = [int(b["HOROVOD_RANK"]) for b in self._blocks]
+        return epoch, ranks
+
     def stop(self) -> None:
         self._stop.set()
 

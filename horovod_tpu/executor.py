@@ -39,6 +39,30 @@ def _default_coordinator_port() -> int:
     return 9874 + random.SystemRandom().randrange(8000)
 
 
+def _collect_results(
+    out_dir: str, expected_ranks: Sequence[int], code: int
+) -> List[Any]:
+    """Read per-rank result pickles, surfacing a worker's actual
+    exception before the bare exit code (shared by Executor.run and
+    ElasticRayExecutor.run — the collection rules must not diverge)."""
+    results: List[Any] = []
+    for rank in expected_ranks:
+        path = os.path.join(out_dir, f"result.{rank}.pkl")
+        if not os.path.exists(path):
+            raise RuntimeError(
+                f"executor job failed with exit code {code}: "
+                f"rank {rank} produced no result"
+            )
+        with open(path, "rb") as f:
+            status, value = pickle.load(f)
+        if status == "error":
+            raise RuntimeError(f"rank {rank} raised: {value}")
+        results.append(value)
+    if code != 0:
+        raise RuntimeError(f"executor job failed with exit code {code}")
+    return results
+
+
 class Executor:
     """Run functions across a horovod_tpu worker set
     (ref: RayExecutor's start/run/shutdown lifecycle [V])."""
@@ -119,27 +143,7 @@ class Executor:
             out_dir = os.path.join(tmp, "out")
             os.makedirs(out_dir)
             code, expected_ranks = self._launch(payload, out_dir)
-            # Read the per-process results FIRST: a worker that raised
-            # writes its error pickle and exits nonzero, and "rank N
-            # raised: ValueError ..." beats "exit code 1".
-            results: List[Any] = []
-            for rank in expected_ranks:
-                path = os.path.join(out_dir, f"result.{rank}.pkl")
-                if not os.path.exists(path):
-                    raise RuntimeError(
-                        f"executor job failed with exit code {code}: "
-                        f"rank {rank} produced no result"
-                    )
-                with open(path, "rb") as f:
-                    status, value = pickle.load(f)
-                if status == "error":
-                    raise RuntimeError(f"rank {rank} raised: {value}")
-                results.append(value)
-            if code != 0:
-                raise RuntimeError(
-                    f"executor job failed with exit code {code}"
-                )
-            return results
+            return _collect_results(out_dir, expected_ranks, code)
 
     # `execute` is RayExecutor's name for the same thing [V]
     execute = run
@@ -395,3 +399,174 @@ class RayExecutor(Executor):
             ray.kill(coord)  # one actor per run() would otherwise leak
 
     execute = run
+
+
+class RayHostDiscovery:
+    """Elastic host discovery over the ray cluster's live node set
+    (ref: horovod/ray/elastic.py RayHostDiscovery: maps ray.nodes() to
+    host:slots [V]). Satisfies elastic.discovery.HostDiscovery.
+
+    Slots per node default to the node's CPU resource divided by
+    ``cpus_per_slot``; ``slots_per_host`` overrides with a fixed count
+    (the TPU-pod deployment: one worker process per host driving the
+    host's chips, so slots == 1 regardless of CPU count).
+    """
+
+    def __init__(
+        self,
+        cpus_per_slot: int = 1,
+        slots_per_host: Optional[int] = None,
+    ) -> None:
+        self._cpus_per_slot = max(int(cpus_per_slot), 1)
+        self._slots_per_host = slots_per_host
+
+    def find_available_hosts_and_slots(self):
+        from .runner.hosts import HostInfo
+
+        ray = _ray_or_none()
+        if ray is None or not ray.is_initialized():
+            return []
+        hosts = []
+        for node in ray.nodes():
+            if not node.get("Alive"):
+                continue
+            address = node.get("NodeManagerAddress") or node.get(
+                "NodeManagerHostname"
+            )
+            if not address:
+                continue
+            if self._slots_per_host is not None:
+                slots = int(self._slots_per_host)
+            else:
+                cpus = int(node.get("Resources", {}).get("CPU", 0))
+                slots = cpus // self._cpus_per_slot
+            if slots > 0:
+                hosts.append(HostInfo(hostname=address, slots=slots))
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Elastic gang over a ray cluster (ref: horovod/ray/elastic.py
+    ElasticRayExecutor [V]): the ray cluster's live node set drives
+    membership, the elastic driver supervises gang restarts, and the
+    user function runs under ``hvd.elastic`` semantics — on membership
+    change workers receive HostsUpdatedInterrupt, commit their State,
+    and the gang relaunches on the new node set.
+
+    Execution engine: the same worker-payload machinery as
+    ``Executor.run`` supervised by ``elastic.ElasticDriver`` (process
+    launch over ssh/local — a TPU worker owns its hosts's chips, so
+    one process per host is the deployment model; ray provides
+    membership, not task placement). Returns the results of the final
+    successful gang, ordered by rank. Without ray installed, pass
+    ``discovery=`` explicitly (any HostDiscovery) — the documented
+    degraded mode, which the tests exercise with a scripted discovery.
+    """
+
+    def __init__(
+        self,
+        min_np: int = 1,
+        max_np: Optional[int] = None,
+        slots_per_host: Optional[int] = None,
+        env: Optional[dict] = None,
+        start_timeout: float = 600.0,
+        reset_limit: Optional[int] = None,
+        discovery=None,
+        discovery_interval: float = 1.0,
+        work_dir: Optional[str] = None,
+    ) -> None:
+        self.min_np = int(min_np)
+        self.max_np = max_np
+        self.slots_per_host = slots_per_host
+        self.env = dict(env or {})
+        self.start_timeout = start_timeout
+        self.reset_limit = reset_limit
+        self.discovery = discovery
+        self.discovery_interval = discovery_interval
+        self.work_dir = work_dir
+        self._started = False
+
+    def start(self) -> None:
+        """Connect to ray (when available) and resolve the discovery
+        source; like the reference, start() owns cluster attachment and
+        run() owns the job."""
+        if self.discovery is None:
+            ray = _ray_or_none()
+            if ray is None:
+                raise RuntimeError(
+                    "ElasticRayExecutor needs ray installed, or an "
+                    "explicit discovery= (any elastic HostDiscovery)"
+                )
+            if not ray.is_initialized():
+                ray.init(ignore_reinit_error=True)
+            self.discovery = RayHostDiscovery(
+                slots_per_host=self.slots_per_host
+            )
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._started = False
+
+    def __enter__(self) -> "ElasticRayExecutor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def run(
+        self,
+        fn: Callable,
+        args: Sequence = (),
+        kwargs: Optional[dict] = None,
+    ) -> List[Any]:
+        if not self._started:
+            raise RuntimeError("ElasticRayExecutor.run before start()")
+        from .elastic.driver import ElasticDriver
+
+        kwargs = kwargs or {}
+        with tempfile.TemporaryDirectory(
+            prefix="hvd_elastic_", dir=self.work_dir
+        ) as tmp:
+            payload = os.path.join(tmp, "payload.pkl")
+            with open(payload, "wb") as f:
+                pickle.dump((fn, tuple(args), kwargs), f)
+            out_dir = os.path.join(tmp, "out")
+            os.makedirs(out_dir)
+            command = [
+                sys.executable,
+                "-m",
+                "horovod_tpu._executor_worker",
+                payload,
+            ]
+            driver = ElasticDriver(
+                discovery=self.discovery,
+                command=command,
+                min_np=self.min_np,
+                max_np=self.max_np,
+                slots_per_host=self.slots_per_host,
+                discovery_interval=self.discovery_interval,
+                start_timeout=self.start_timeout,
+                reset_limit=self.reset_limit,
+                extra_env={
+                    **self.env,
+                    "HOROVOD_EXECUTOR_OUT": out_dir,
+                },
+            )
+            try:
+                code = driver.run()
+                epoch, lead_ranks = driver.gang_info()
+            finally:
+                driver.shutdown()
+            if epoch is None or not lead_ranks:
+                raise RuntimeError(
+                    f"elastic executor job failed with exit code {code}:"
+                    f" no gang was launched"
+                )
+            # Final-gang results live in the per-epoch subdirectory the
+            # workers wrote (stale larger epochs must not be read), at
+            # the LEAD ranks of that gang (per-host placement = one
+            # process, one result, per host).
+            return _collect_results(
+                os.path.join(out_dir, f"epoch.{epoch}"), lead_ranks, code
+            )

@@ -101,3 +101,92 @@ def test_distributed_function(tmp_path):
     finally:
         sys.path.remove(str(tmp_path))
     assert results == [(3.0, 0, 2), (3.0, 1, 2)]
+
+
+# ------------------------------------------------- elastic ray surface
+
+
+class _FakeRay:
+    """ray-module shape for RayHostDiscovery: nodes() + is_initialized."""
+
+    def __init__(self, nodes):
+        self._nodes = nodes
+
+    def is_initialized(self):
+        return True
+
+    def nodes(self):
+        return self._nodes
+
+
+def test_ray_host_discovery_maps_nodes(monkeypatch):
+    from horovod_tpu import executor as ex_mod
+    from horovod_tpu.executor import RayHostDiscovery
+
+    fake = _FakeRay(
+        [
+            {"Alive": True, "NodeManagerAddress": "10.0.0.1",
+             "Resources": {"CPU": 8.0}},
+            {"Alive": False, "NodeManagerAddress": "10.0.0.2",
+             "Resources": {"CPU": 8.0}},      # dead → excluded
+            {"Alive": True, "NodeManagerAddress": "10.0.0.3",
+             "Resources": {}},                # no CPUs → excluded
+        ]
+    )
+    monkeypatch.setattr(ex_mod, "_ray_or_none", lambda: fake)
+    hosts = RayHostDiscovery(cpus_per_slot=4).find_available_hosts_and_slots()
+    assert [(h.hostname, h.slots) for h in hosts] == [("10.0.0.1", 2)]
+
+
+def test_ray_host_discovery_slots_override(monkeypatch):
+    from horovod_tpu import executor as ex_mod
+    from horovod_tpu.executor import RayHostDiscovery
+
+    fake = _FakeRay(
+        [{"Alive": True, "NodeManagerAddress": "10.0.0.1",
+          "Resources": {"CPU": 96.0}}]
+    )
+    monkeypatch.setattr(ex_mod, "_ray_or_none", lambda: fake)
+    hosts = RayHostDiscovery(
+        slots_per_host=1
+    ).find_available_hosts_and_slots()
+    assert [(h.hostname, h.slots) for h in hosts] == [("10.0.0.1", 1)]
+
+
+def test_ray_host_discovery_without_ray_is_empty():
+    from horovod_tpu.executor import RayHostDiscovery
+
+    assert RayHostDiscovery().find_available_hosts_and_slots() == []
+
+
+def test_elastic_ray_executor_requires_ray_or_discovery():
+    from horovod_tpu.executor import ElasticRayExecutor
+
+    with pytest.raises(RuntimeError, match="discovery"):
+        ElasticRayExecutor().start()
+
+
+def test_elastic_ray_executor_run_before_start():
+    from horovod_tpu.executor import ElasticRayExecutor
+
+    with pytest.raises(RuntimeError, match="before start"):
+        ElasticRayExecutor(discovery=object()).run(os.getenv, ("HOME",))
+
+
+@pytest.mark.slow
+def test_elastic_ray_executor_end_to_end():
+    """Scripted discovery (the documented no-ray mode) over localhost:
+    the elastic driver launches the gang, the payload machinery returns
+    per-rank results of the final gang."""
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.executor import ElasticRayExecutor
+    from horovod_tpu.runner.hosts import HostInfo
+
+    with ElasticRayExecutor(
+        min_np=2,
+        max_np=2,
+        discovery=FixedHosts([HostInfo(hostname="127.0.0.1", slots=2)]),
+        start_timeout=120.0,
+    ) as ex:
+        results = ex.run(os.getenv, args=("HOROVOD_RANK",))
+    assert results == ["0", "1"]
