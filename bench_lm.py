@@ -56,6 +56,9 @@ def main():
         # escape hatch: dense attention (e.g. if the Pallas kernel
         # misbehaves on a new libtpu)
         cfg = dataclasses_replace(cfg, flash_attention=False)
+    if os.environ.get("BENCH_HEAD") == "fp32":
+        # A/B escape hatch for the mixed-precision LM head default
+        cfg = dataclasses_replace(cfg, head_mixed_precision=False)
     if os.environ.get("BENCH_FLASH_BLOCK"):
         bq = int(os.environ["BENCH_FLASH_BLOCK"])
         if bq < 8 or (bq & (bq - 1)) != 0:
@@ -153,6 +156,7 @@ def main():
         "seq": seq,
         "world": world,
         "remat": remat,
+        "head": "mixed" if cfg.head_mixed_precision else "fp32",
         "platform": jax.devices()[0].platform,
     }
     result.update(mfu_fields(flops, iters, dt, jax.devices()[0].platform,

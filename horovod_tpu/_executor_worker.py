@@ -16,7 +16,10 @@ def main() -> None:
     payload_path = sys.argv[1]
     out_dir = os.environ["HOROVOD_EXECUTOR_OUT"]
     rank = os.environ.get("HOROVOD_RANK", "0")
-    epoch = os.environ.get("HOROVOD_ELASTIC_EPOCH")
+    # `or None`: plain Executor jobs override an inherited elastic
+    # epoch with "" (nested Executor.run inside an elastic worker must
+    # collect from the flat out_dir it owns)
+    epoch = os.environ.get("HOROVOD_ELASTIC_EPOCH") or None
     if epoch is not None:
         # Elastic gangs restart into the same HOROVOD_EXECUTOR_OUT; a
         # per-epoch subdirectory keeps a shrunken final gang from

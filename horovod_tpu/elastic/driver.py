@@ -239,6 +239,10 @@ class ElasticDriver:
             self._procs = procs
             self._blocks = blocks
             self._assignment = assignment
+            self._last_gang = (
+                assignment.epoch,
+                [int(b["HOROVOD_RANK"]) for b in blocks],
+            )
 
     def _terminate_gang(self, grace: float = 10.0) -> None:
         with self._lock:
@@ -397,16 +401,14 @@ class ElasticDriver:
         return self.compute_assignment() is not None
 
     def gang_info(self):
-        """``(epoch, lead_ranks)`` of the current (on success: final)
-        gang — what an executor needs to collect per-rank results from
-        the right epoch directory (per-host placement launches one
-        process per host, so result files exist at LEAD ranks only)."""
+        """``(epoch, lead_ranks)`` of the LAST LAUNCHED gang — what an
+        executor needs to collect per-rank results from the right epoch
+        directory (per-host placement launches one process per host, so
+        result files exist at LEAD ranks only). Survives _reset(): after
+        a failed gang drains capacity, the failed ranks' error pickles
+        are still the best diagnostic and must stay reachable."""
         with self._lock:
-            epoch = (
-                self._assignment.epoch if self._assignment else None
-            )
-            ranks = [int(b["HOROVOD_RANK"]) for b in self._blocks]
-        return epoch, ranks
+            return getattr(self, "_last_gang", (None, []))
 
     def stop(self) -> None:
         self._stop.set()

@@ -180,7 +180,13 @@ class Executor:
                 port,
                 coordinator_port,
                 secret.hex(),
-                extra={**self.env, "HOROVOD_EXECUTOR_OUT": out_dir},
+                extra={
+                    **self.env,
+                    "HOROVOD_EXECUTOR_OUT": out_dir,
+                    # nested-in-elastic: results go to OUR flat out_dir,
+                    # not an inherited epoch subdirectory
+                    "HOROVOD_ELASTIC_EPOCH": "",
+                },
             )
             command = [
                 sys.executable,
@@ -561,7 +567,8 @@ class ElasticRayExecutor:
             if epoch is None or not lead_ranks:
                 raise RuntimeError(
                     f"elastic executor job failed with exit code {code}:"
-                    f" no gang was launched"
+                    f" no gang was ever launched (capacity below min_np"
+                    f" within start_timeout)"
                 )
             # Final-gang results live in the per-epoch subdirectory the
             # workers wrote (stale larger epochs must not be read), at

@@ -44,6 +44,14 @@ class TransformerConfig:
     # shrunk to the sequence length when it is shorter.
     flash_block_q: int = 128
     flash_block_k: int = 128
+    # LM head precision. True (default): bf16 operands on the MXU with
+    # fp32 accumulation (preferred_element_type) and fp32 logits out —
+    # the standard TPU head recipe; input rounding is bf16-epsilon on
+    # logits while softmax/loss stay full fp32. False: the all-fp32
+    # head (operands cast up, matmul at fp32 MXU rate — several times
+    # slower on a vocab_size-wide projection that is ~15% of forward
+    # FLOPs at GPT-2 scale).
+    head_mixed_precision: bool = True
 
     def uses_flash(self, mask=None, seq=None) -> bool:
         """THE gating rule for the Pallas flash path — single source
@@ -172,6 +180,38 @@ class Block(nn.Module):
         return x + h
 
 
+class LMHead(nn.Module):
+    """Vocabulary projection with the TPU mixed-precision recipe (see
+    TransformerConfig.head_mixed_precision). Same param tree as the
+    nn.Dense it replaces (kernel fp32 [d_model, vocab], bias fp32), so
+    checkpoints are layout-compatible either way."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (cfg.d_model, cfg.vocab_size),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32
+        )
+        if cfg.head_mixed_precision:
+            y = jax.lax.dot_general(
+                x.astype(cfg.dtype),
+                kernel.astype(cfg.dtype),
+                dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            y = x.astype(jnp.float32) @ kernel
+        return y + bias
+
+
 class Transformer(nn.Module):
     cfg: TransformerConfig
 
@@ -189,7 +229,5 @@ class Transformer(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"block_{i}")(x, mask, train)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
-        # logits in fp32
-        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")(
-            x.astype(jnp.float32)
-        )
+        # fp32 logits; matmul precision per cfg.head_mixed_precision
+        return LMHead(cfg, name="lm_head")(x)

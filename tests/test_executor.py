@@ -190,3 +190,55 @@ def test_elastic_ray_executor_end_to_end():
     ) as ex:
         results = ex.run(os.getenv, args=("HOROVOD_RANK",))
     assert results == ["0", "1"]
+
+
+@pytest.mark.slow
+def test_elastic_ray_executor_surfaces_worker_exception():
+    """When the gang fails and the blacklist drains capacity, the
+    rank's actual exception (from the failed epoch's result pickle)
+    must surface — not a generic exit code or 'no gang launched'."""
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.executor import ElasticRayExecutor
+    from horovod_tpu.runner.hosts import HostInfo
+
+    with ElasticRayExecutor(
+        min_np=1,
+        max_np=1,
+        discovery=FixedHosts([HostInfo(hostname="127.0.0.1", slots=1)]),
+        start_timeout=5.0,
+    ) as ex:
+        with pytest.raises(RuntimeError, match="raised: ValueError"):
+            ex.run(int, args=("not-a-number",))
+
+
+def test_executor_worker_epoch_subdir(tmp_path):
+    """With HOROVOD_ELASTIC_EPOCH set the worker writes its result into
+    the per-epoch subdirectory (stale-epoch isolation for elastic
+    executors); without it, flat (plain Executor contract)."""
+    import pickle
+    import subprocess
+
+    payload = tmp_path / "p.pkl"
+    with open(payload, "wb") as f:
+        pickle.dump((len, (("abc"),), {}), f)
+    base_env = {
+        **os.environ,
+        "HOROVOD_EXECUTOR_OUT": str(tmp_path),
+        "HOROVOD_RANK": "4",
+    }
+    subprocess.run(
+        [sys.executable, "-m", "horovod_tpu._executor_worker",
+         str(payload)],
+        env={**base_env, "HOROVOD_ELASTIC_EPOCH": "2"},
+        check=True,
+    )
+    with open(tmp_path / "epoch.2" / "result.4.pkl", "rb") as f:
+        assert pickle.load(f) == ("ok", 3)
+    subprocess.run(
+        [sys.executable, "-m", "horovod_tpu._executor_worker",
+         str(payload)],
+        env=base_env,
+        check=True,
+    )
+    with open(tmp_path / "result.4.pkl", "rb") as f:
+        assert pickle.load(f) == ("ok", 3)

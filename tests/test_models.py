@@ -92,6 +92,36 @@ def test_transformer_causality():
     assert not np.allclose(np.asarray(l1[0, -1]), np.asarray(l2[0, -1]))
 
 
+def test_lm_head_mixed_matches_fp32_within_bf16_rounding():
+    """The mixed-precision head (bf16 operands, fp32 accumulation) must
+    agree with the all-fp32 head to bf16 input-rounding tolerance, on
+    an IDENTICAL param tree (checkpoints are layout-compatible)."""
+    import dataclasses
+
+    # bf16 trunk for BOTH configs: identical activations reach the
+    # head, so the only difference measured is the head matmul's
+    # precision (tiny()'s fp32 dtype would make the comparison vacuous)
+    cfg32 = dataclasses.replace(
+        TransformerConfig.tiny(causal=True),
+        dtype=jnp.bfloat16,
+        head_mixed_precision=False,
+    )
+    cfgmx = dataclasses.replace(cfg32, head_mixed_precision=True)
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    p32 = Transformer(cfg32).init(jax.random.PRNGKey(0), tokens,
+                                  train=False)
+    pmx = Transformer(cfgmx).init(jax.random.PRNGKey(0), tokens,
+                                  train=False)
+    s32 = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype.name), p32)
+    smx = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype.name), pmx)
+    assert s32 == smx
+    l32 = Transformer(cfg32).apply(p32, tokens, train=False)
+    lmx = Transformer(cfgmx).apply(p32, tokens, train=False)
+    assert lmx.dtype == jnp.float32
+    scale = float(jnp.max(jnp.abs(l32)))
+    assert float(jnp.max(jnp.abs(lmx - l32))) <= 0.02 * max(scale, 1.0)
+
+
 def test_transformer_named_configs():
     gpt2 = TransformerConfig.gpt2_medium()
     assert (gpt2.num_layers, gpt2.d_model) == (24, 1024) and gpt2.causal
