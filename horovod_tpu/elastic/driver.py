@@ -99,6 +99,7 @@ class ElasticDriver:
         self._procs: List[subprocess.Popen] = []
         self._blocks: List[Dict[str, str]] = []
         self._assignment: Optional[SlotAssignment] = None
+        self._last_gang: tuple = (None, [])  # survives _reset()
         self._stop = threading.Event()
         self._lock = threading.Lock()
         # Cross-process stall signal (stall_inspector.cc's "ranks
@@ -408,7 +409,7 @@ class ElasticDriver:
         a failed gang drains capacity, the failed ranks' error pickles
         are still the best diagnostic and must stay reachable."""
         with self._lock:
-            return getattr(self, "_last_gang", (None, []))
+            return self._last_gang
 
     def stop(self) -> None:
         self._stop.set()

@@ -44,7 +44,22 @@ def _collect_results(
 ) -> List[Any]:
     """Read per-rank result pickles, surfacing a worker's actual
     exception before the bare exit code (shared by Executor.run and
-    ElasticRayExecutor.run — the collection rules must not diverge)."""
+    ElasticRayExecutor.run — the collection rules must not diverge).
+
+    On a failed job, scan EVERY expected rank for an error pickle
+    before complaining about a missing one: in a multi-rank gang the
+    raising rank writes its error while its peers get SIGTERM'd mid-fn
+    (no pickle at all), and "rank 1 raised: ValueError…" must beat
+    "rank 0 produced no result"."""
+    if code != 0:
+        for rank in expected_ranks:
+            path = os.path.join(out_dir, f"result.{rank}.pkl")
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                status, value = pickle.load(f)
+            if status == "error":
+                raise RuntimeError(f"rank {rank} raised: {value}")
     results: List[Any] = []
     for rank in expected_ranks:
         path = os.path.join(out_dir, f"result.{rank}.pkl")

@@ -1,0 +1,41 @@
+"""``import horovod_tpu.keras as hvd`` — the standalone-Keras binding
+(ref: horovod/keras/__init__.py [V]).
+
+Upstream keeps two Keras modules for the multi-backend Keras era; since
+standalone Keras is tf.keras's successor with the same training-loop
+API, this is one surface: everything re-exports from
+:mod:`horovod_tpu.tensorflow.keras`.
+"""
+
+from __future__ import annotations
+
+from ..tensorflow.keras import (  # noqa: F401
+    Adasum,
+    Average,
+    DistributedOptimizer,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_variables,
+    callbacks,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    load_model,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+
+
+def __getattr__(name):
+    import horovod_tpu.tensorflow.keras as _k
+
+    return getattr(_k, name)

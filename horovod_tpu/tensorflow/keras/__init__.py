@@ -1,0 +1,50 @@
+"""``import horovod_tpu.tensorflow.keras as hvd`` — the tf.keras
+binding surface (ref: horovod/tensorflow/keras/__init__.py [V]).
+
+The reference mounts a Keras-flavored module beside the TF one: same
+runtime (init/rank/size/ops), plus the Keras ``DistributedOptimizer``,
+the four callbacks under ``hvd.callbacks``, and ``hvd.load_model``.
+Here the TF shim already carries all of that (its optimizer IS the
+Keras flavor — TF1 Session training is out of scope, docs/design.md),
+so this module re-exports the core names explicitly and forwards
+everything else (elastic, process sets, predicates, grouped ops…) to
+:mod:`horovod_tpu.tensorflow` via module ``__getattr__`` — scripts
+port by changing one import, whichever subset of the surface they use.
+"""
+
+from __future__ import annotations
+
+# the callbacks submodule reference scripts address as hvd.callbacks
+from .. import callbacks  # noqa: F401
+from .. import (  # noqa: F401
+    Adasum,
+    Average,
+    DistributedOptimizer,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_variables,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    load_model,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+
+
+def __getattr__(name):
+    """Everything else (elastic, alltoall/reducescatter, grouped ops,
+    process sets, build predicates…) lives on the TF shim — forward so
+    the keras module is never a narrower surface than its parent [V]."""
+    import horovod_tpu.tensorflow as _tf
+
+    return getattr(_tf, name)

@@ -242,3 +242,27 @@ def test_executor_worker_epoch_subdir(tmp_path):
     )
     with open(tmp_path / "result.4.pkl", "rb") as f:
         assert pickle.load(f) == ("ok", 3)
+
+
+def test_collect_results_surfaces_error_over_missing(tmp_path):
+    """Failed gang: rank 0 was SIGTERM'd (no pickle), rank 1 wrote its
+    error — the error must win over 'rank 0 produced no result'."""
+    import pickle
+
+    from horovod_tpu.executor import _collect_results
+
+    with open(tmp_path / "result.1.pkl", "wb") as f:
+        pickle.dump(("error", "ValueError: boom"), f)
+    with pytest.raises(RuntimeError, match="rank 1 raised: ValueError"):
+        _collect_results(str(tmp_path), [0, 1], 1)
+
+
+def test_collect_results_success_path_unchanged(tmp_path):
+    import pickle
+
+    from horovod_tpu.executor import _collect_results
+
+    for r, v in ((0, "a"), (1, "b")):
+        with open(tmp_path / f"result.{r}.pkl", "wb") as f:
+            pickle.dump(("ok", v), f)
+    assert _collect_results(str(tmp_path), [0, 1], 0) == ["a", "b"]

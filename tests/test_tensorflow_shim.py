@@ -242,3 +242,50 @@ def test_alltoall_v_over_process_set_tf(hvdtf):
         np.testing.assert_allclose(out[0].numpy(), x[0].numpy())
     finally:
         hvdtf.remove_process_set(ps)
+
+
+class TestKerasModules:
+    """Import-compat modules (ref: horovod/tensorflow/keras/__init__.py
+    + horovod/keras/__init__.py [V]): one-import porting for Keras
+    scripts, never a narrower surface than the TF shim."""
+
+    def test_tensorflow_keras_surface(self, hvd):
+        import horovod_tpu.tensorflow.keras as hvd_k
+
+        assert hvd_k.is_initialized()
+        assert hvd_k.size() >= 1
+        # keras flavor carries the optimizer, callbacks and load_model
+        assert callable(hvd_k.DistributedOptimizer)
+        assert callable(hvd_k.load_model)
+        assert hasattr(hvd_k.callbacks, "BroadcastGlobalVariablesCallback")
+        assert hasattr(hvd_k.callbacks, "MetricAverageCallback")
+
+    def test_forwarding_covers_parent_surface(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+        import horovod_tpu.tensorflow.keras as hvd_k
+
+        # everything the TF shim exposes is reachable from the keras
+        # module (the reference keeps the two surfaces in lockstep)
+        for name in ("alltoall", "reducescatter", "grouped_allreduce",
+                     "join", "add_process_set", "elastic"):
+            assert getattr(hvd_k, name) is getattr(hvd_tf, name)
+
+    def test_standalone_keras_alias(self, hvd):
+        import horovod_tpu.keras as hvd_sk
+        import horovod_tpu.tensorflow.keras as hvd_k
+
+        assert hvd_sk.DistributedOptimizer is hvd_k.DistributedOptimizer
+        assert hvd_sk.callbacks is hvd_k.callbacks
+        assert hvd_sk.elastic is hvd_k.elastic
+
+    def test_keras_allreduce_runs(self, hvd):
+        import numpy as np
+
+        import horovod_tpu.tensorflow.keras as hvd_k
+
+        tf = pytest.importorskip("tensorflow")
+        x = tf.constant([1.0, 2.0])
+        out = hvd_k.allreduce(x, op=hvd_k.Sum)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(x) * hvd_k.size()
+        )
