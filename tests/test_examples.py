@@ -89,3 +89,12 @@ def test_tensorflow2_mnist_example():
 def test_zero1_example():
     out = _run_example("zero1_data_parallel.py")
     assert re.search(r"\dx smaller", out)
+
+
+@pytest.mark.slow
+def test_tensorflow2_keras_mnist_example():
+    pytest.importorskip("tensorflow")
+    out = _run_example(
+        "tensorflow2_keras_mnist.py", "--steps", "4", "--batch", "8",
+    )
+    assert "DONE" in out
