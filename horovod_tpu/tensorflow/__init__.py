@@ -95,18 +95,22 @@ class _TFHandle:
 
 
 def allreduce_async(tensor, average=None, name=None, op=None,
-                    process_set=None):
+                    process_set=None, prescale_factor=1.0,
+                    postscale_factor=1.0):
     _warn_nonmember_controller("allreduce", process_set)
     handle = _eager.allreduce_async(
         _replicated_payload(tensor), average=average, name=name, op=op,
-        process_set=process_set,
+        process_set=process_set, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
     )
     return _TFHandle(handle, tensor)
 
 
-def allreduce(tensor, average=None, name=None, op=None, process_set=None):
+def allreduce(tensor, average=None, name=None, op=None, process_set=None,
+              prescale_factor=1.0, postscale_factor=1.0):
     return allreduce_async(
-        tensor, average=average, name=name, op=op, process_set=process_set
+        tensor, average=average, name=name, op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
     ).wait()
 
 
@@ -179,7 +183,8 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
-                      process_set=None):
+                      process_set=None, prescale_factor=1.0,
+                      postscale_factor=1.0):
     """Atomic multi-tensor allreduce (ref: hvd.grouped_allreduce in
     horovod/tensorflow/mpi_ops.py [V]): one fused collective for the
     whole list."""
@@ -187,6 +192,7 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
     handles = _eager.grouped_allreduce_async(
         [_replicated_payload(t) for t in tensors],
         average=average, name=name, op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
     )
     return [
         _TFHandle(h, t).wait() for h, t in zip(handles, tensors)

@@ -183,36 +183,44 @@ class _TorchHandle:
 
 
 def allreduce_async(
-    tensor, average=None, name=None, op=None, process_set=None
+    tensor, average=None, name=None, op=None, process_set=None,
+    prescale_factor=1.0, postscale_factor=1.0,
 ) -> _TorchHandle:
     _warn_nonmember_controller("allreduce", process_set)
     handle = _eager.allreduce_async(
         _replicated_payload(tensor), average=average, name=name, op=op,
-        process_set=process_set,
+        process_set=process_set, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
     )
     return _TorchHandle(handle, tensor)
 
 
-def allreduce(tensor, average=None, name=None, op=None, process_set=None):
+def allreduce(tensor, average=None, name=None, op=None, process_set=None,
+              prescale_factor=1.0, postscale_factor=1.0):
     return allreduce_async(
-        tensor, average=average, name=name, op=op, process_set=process_set
+        tensor, average=average, name=name, op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
     ).wait()
 
 
 def allreduce_async_(
-    tensor, average=None, name=None, op=None, process_set=None
+    tensor, average=None, name=None, op=None, process_set=None,
+    prescale_factor=1.0, postscale_factor=1.0,
 ) -> _TorchHandle:
     _warn_nonmember_controller("allreduce_", process_set)
     handle = _eager.allreduce_async(
         _replicated_payload(tensor), average=average, name=name, op=op,
-        process_set=process_set,
+        process_set=process_set, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
     )
     return _TorchHandle(handle, tensor, inplace_target=tensor)
 
 
-def allreduce_(tensor, average=None, name=None, op=None, process_set=None):
+def allreduce_(tensor, average=None, name=None, op=None, process_set=None,
+               prescale_factor=1.0, postscale_factor=1.0):
     return allreduce_async_(
-        tensor, average=average, name=name, op=op, process_set=process_set
+        tensor, average=average, name=name, op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor
     ).wait()
 
 
@@ -231,7 +239,8 @@ class _GroupedHandle:
 
 
 def grouped_allreduce_async(
-    tensors, average=None, name=None, op=None, process_set=None
+    tensors, average=None, name=None, op=None, process_set=None,
+    prescale_factor=1.0, postscale_factor=1.0,
 ) -> _GroupedHandle:
     """Atomic multi-tensor allreduce (ref: hvd.grouped_allreduce /
     group_table.cc [V]): rides the eager path's begin/end_group so the
@@ -241,6 +250,7 @@ def grouped_allreduce_async(
     handles = _eager.grouped_allreduce_async(
         [_replicated_payload(t) for t in tensors],
         average=average, name=name, op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
     )
     return _GroupedHandle(
         [_TorchHandle(h, t) for h, t in zip(handles, tensors)]
@@ -248,9 +258,11 @@ def grouped_allreduce_async(
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
-                      process_set=None):
+                      process_set=None, prescale_factor=1.0,
+                      postscale_factor=1.0):
     return grouped_allreduce_async(
-        tensors, average=average, name=name, op=op, process_set=process_set
+        tensors, average=average, name=name, op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
     ).wait()
 
 

@@ -289,3 +289,12 @@ class TestKerasModules:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(x) * hvd_k.size()
         )
+
+
+def test_tf_allreduce_prescale_postscale(hvdtf):
+    x = tf.constant([2.0, 2.0])
+    out = hvdtf.allreduce(
+        x, op=hvdtf.Sum, prescale_factor=0.5, postscale_factor=3.0
+    )
+    want = 2.0 * 0.5 * hvdtf.size() * 3.0
+    np.testing.assert_allclose(np.asarray(out), np.full(2, want))

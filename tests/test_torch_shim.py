@@ -491,3 +491,21 @@ def test_nonmember_alltoall_output_does_not_alias_input(hvdt):
         )
     finally:
         hvdt.remove_process_set(ps)
+
+
+def test_allreduce_prescale_postscale(hvdt):
+    """prescale/postscale ride through to the eager path (ref: the
+    reference's allreduce prescale_factor/postscale_factor args [V])."""
+    x = torch.full((3,), 4.0)
+    out = hvdt.allreduce(
+        x, op=hvdt.Sum, prescale_factor=0.5, postscale_factor=10.0
+    )
+    want = 4.0 * 0.5 * hvdt.size() * 10.0
+    assert torch.allclose(out, torch.full((3,), want))
+
+
+def test_grouped_allreduce_prescale(hvdt):
+    xs = [torch.ones(2), torch.full((2,), 2.0)]
+    outs = hvdt.grouped_allreduce(xs, op=hvdt.Sum, prescale_factor=2.0)
+    assert torch.allclose(outs[0], torch.full((2,), 2.0 * hvdt.size()))
+    assert torch.allclose(outs[1], torch.full((2,), 4.0 * hvdt.size()))
