@@ -259,14 +259,49 @@ def join(joined_ranks=None) -> int:
     return _eager.join(joined_ranks)
 
 
+class _NoneCompressor:
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _FP16Compressor:
+    """fp16 wire compression for tf tensors (ref:
+    horovod/tensorflow/compression.py [V])."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating:
+            tensor = tf.cast(tensor, tf.float16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if tensor.dtype != ctx else tensor
+
+
+class Compression:
+    """hvd.Compression namespace for tf tensors [V]."""
+
+    none = _NoneCompressor
+    fp16 = _FP16Compressor
+
+
 class DistributedGradientTape:
     """Wrap a tf.GradientTape so gradient() allreduces the grads (ref:
     horovod/tensorflow/__init__.py DistributedGradientTape [V])."""
 
-    def __init__(self, tape, op=None, process_set=None):
+    def __init__(self, tape, op=None, process_set=None,
+                 compression=None):
         self._tape = tape
         self._op = op
         self._process_set = process_set
+        self._compression = compression or Compression.none
 
     def __getattr__(self, name):
         return getattr(self._tape, name)
@@ -275,7 +310,9 @@ class DistributedGradientTape:
         if g is None:
             return None
         g = _densify(g)
-        return allreduce(g, op=self._op, process_set=self._process_set)
+        g, ctx = self._compression.compress(g)
+        out = allreduce(g, op=self._op, process_set=self._process_set)
+        return self._compression.decompress(out, ctx)
 
     def gradient(self, target, sources, output_gradients=None, **kwargs):
         # **kwargs forwards tf.GradientTape extras (unconnected_gradients)
@@ -348,7 +385,8 @@ def load_model(path, custom_objects=None, compile=True, **kwargs):
     )
 
 
-def DistributedOptimizer(optimizer, op=None, process_set=None):
+def DistributedOptimizer(optimizer, op=None, process_set=None,
+                         compression=None):
     """Wrap a Keras optimizer so apply_gradients() allreduces gradients
     first (ref: horovod/tensorflow/keras/__init__.py
     DistributedOptimizer [V]). Like the reference, this builds a dynamic
@@ -359,17 +397,20 @@ def DistributedOptimizer(optimizer, op=None, process_set=None):
     class _DistributedKerasOptimizer(base_cls):
         _hvd_op = op
         _hvd_process_set = process_set
+        _hvd_compression = compression or Compression.none
 
         def _hvd_reduce(self, g):
             g = _densify(g)
+            g, _hvd_ctx = self._hvd_compression.compress(g)
             # model.fit traces apply_gradients into a tf.function; the
             # shim's collectives are host bridges, so symbolic tensors
             # route through py_function (same host round-trip either
             # way — this is the documented cost profile of the shim).
             if tf.executing_eagerly():
-                return allreduce(
+                out = allreduce(
                     g, op=self._hvd_op, process_set=self._hvd_process_set
                 )
+                return self._hvd_compression.decompress(out, _hvd_ctx)
             out = tf.py_function(
                 func=lambda t: allreduce(
                     t, op=self._hvd_op, process_set=self._hvd_process_set
@@ -378,7 +419,7 @@ def DistributedOptimizer(optimizer, op=None, process_set=None):
                 Tout=g.dtype,
             )
             out.set_shape(g.shape)
-            return out
+            return self._hvd_compression.decompress(out, _hvd_ctx)
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             pairs = list(grads_and_vars)

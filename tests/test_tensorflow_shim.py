@@ -298,3 +298,24 @@ def test_tf_allreduce_prescale_postscale(hvdtf):
     )
     want = 2.0 * 0.5 * hvdtf.size() * 3.0
     np.testing.assert_allclose(np.asarray(out), np.full(2, want))
+
+
+def test_tf_compression_fp16_round_trip(hvdtf):
+    x = tf.constant([1.5, -2.25, 3.0])
+    tape_like, ctx = hvdtf.Compression.fp16.compress(x)
+    assert tape_like.dtype == tf.float16
+    back = hvdtf.Compression.fp16.decompress(tape_like, ctx)
+    assert back.dtype == tf.float32
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_tf_tape_with_fp16_compression(hvdtf):
+    x = tf.Variable([2.0, 4.0])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(x * x)
+    dtape = hvdtf.DistributedGradientTape(
+        tape, compression=hvdtf.Compression.fp16
+    )
+    g = dtape.gradient(y, x)
+    assert g.dtype == tf.float32  # decompressed back
+    np.testing.assert_allclose(np.asarray(g), [4.0, 8.0])
