@@ -395,3 +395,24 @@ def test_check_build_reports_mxnet(hvdm, capsys):
 
     assert run_commandline(["--check-build"]) == 0
     assert "[X] MXNet (host bridge)" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32])
+@pytest.mark.parametrize("op_name", ["Sum", "Average", "Min", "Max"])
+def test_mxnet_op_dtype_matrix(hvdm, dtype, op_name):
+    """op x dtype closed-form grid over the NDArray bridge (the
+    reference's test_mxnet.py pattern [V])."""
+    if op_name == "Average" and np.issubdtype(dtype, np.integer):
+        pytest.skip("average over ints is float-contract territory")
+    op = getattr(hvdm, op_name)
+    x = FakeNDArray(np.asarray([1, 5, 7], dtype=dtype))
+    out = hvdm.allreduce(x, op=op)
+    base = x.asnumpy()
+    expect = {
+        "Sum": base * hvdm.size(),
+        "Average": base,
+        "Min": base,
+        "Max": base,
+    }[op_name]
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    assert out.dtype == np.dtype(dtype)
