@@ -1,0 +1,172 @@
+// _hvd_cext: the CPython-C-API half of the framework bindings.
+//
+// TPU-native rebuild of the reference's native binding layer (ref:
+// horovod/torch/adapter_v2.cc TorchTensor wrapping a torch storage for
+// the C core with zero copies, and horovod/common/ops/
+// collective_operations.cc MemcpyInFusionBuffer — SURVEY.md §2.3). On
+// TPU the collective data plane is XLA's, so the adapter's surviving
+// job is HOST staging: framework tensors expose their bytes through the
+// buffer protocol and this module copies them into / out of one
+// contiguous block with the GIL released — no ctypes pointer
+// marshalling, no per-tensor Python allocations. Consumers: the torch
+// shim's elastic TorchState commit snapshot and _native/loader.py's
+// pack/unpack fast path.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// Acquire C-contiguous buffer views of every element of `seq_obj`.
+// On failure releases everything acquired so far and returns false with
+// a Python error set.
+bool collect_buffers(PyObject* fast_seq, int flags,
+                     std::vector<Py_buffer>* out) {
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast_seq);
+  out->reserve(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PySequence_Fast_GET_ITEM(fast_seq, i);
+    Py_buffer view;
+    if (PyObject_GetBuffer(item, &view, flags) != 0) {
+      for (Py_buffer& b : *out) PyBuffer_Release(&b);
+      out->clear();
+      return false;
+    }
+    out->push_back(view);
+  }
+  return true;
+}
+
+void release_all(std::vector<Py_buffer>* views) {
+  for (Py_buffer& b : *views) PyBuffer_Release(&b);
+  views->clear();
+}
+
+PyObject* pack_into(PyObject*, PyObject* args) {
+  PyObject* dst_obj;
+  PyObject* srcs_obj;
+  if (!PyArg_ParseTuple(args, "OO:pack_into", &dst_obj, &srcs_obj)) {
+    return nullptr;
+  }
+  Py_buffer dst;
+  if (PyObject_GetBuffer(dst_obj, &dst,
+                         PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) != 0) {
+    return nullptr;
+  }
+  PyObject* seq = PySequence_Fast(srcs_obj, "srcs must be a sequence");
+  if (seq == nullptr) {
+    PyBuffer_Release(&dst);
+    return nullptr;
+  }
+  std::vector<Py_buffer> srcs;
+  if (!collect_buffers(seq, PyBUF_C_CONTIGUOUS, &srcs)) {
+    Py_DECREF(seq);
+    PyBuffer_Release(&dst);
+    return nullptr;
+  }
+  Py_ssize_t total = 0;
+  for (const Py_buffer& b : srcs) total += b.len;
+  if (total > dst.len) {
+    PyErr_Format(PyExc_ValueError,
+                 "pack_into: dst holds %zd bytes, sources total %zd",
+                 dst.len, total);
+    release_all(&srcs);
+    Py_DECREF(seq);
+    PyBuffer_Release(&dst);
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  char* out = static_cast<char*>(dst.buf);
+  Py_ssize_t off = 0;
+  for (const Py_buffer& b : srcs) {
+    if (b.len > 0) std::memcpy(out + off, b.buf, static_cast<size_t>(b.len));
+    off += b.len;
+  }
+  Py_END_ALLOW_THREADS
+  release_all(&srcs);
+  Py_DECREF(seq);
+  PyBuffer_Release(&dst);
+  return PyLong_FromSsize_t(total);
+}
+
+PyObject* unpack_into(PyObject*, PyObject* args) {
+  PyObject* src_obj;
+  PyObject* dsts_obj;
+  if (!PyArg_ParseTuple(args, "OO:unpack_into", &src_obj, &dsts_obj)) {
+    return nullptr;
+  }
+  Py_buffer src;
+  if (PyObject_GetBuffer(src_obj, &src, PyBUF_C_CONTIGUOUS) != 0) {
+    return nullptr;
+  }
+  PyObject* seq = PySequence_Fast(dsts_obj, "dsts must be a sequence");
+  if (seq == nullptr) {
+    PyBuffer_Release(&src);
+    return nullptr;
+  }
+  std::vector<Py_buffer> dsts;
+  if (!collect_buffers(seq, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS, &dsts)) {
+    Py_DECREF(seq);
+    PyBuffer_Release(&src);
+    return nullptr;
+  }
+  Py_ssize_t total = 0;
+  for (const Py_buffer& b : dsts) total += b.len;
+  if (total > src.len) {
+    PyErr_Format(PyExc_ValueError,
+                 "unpack_into: src holds %zd bytes, destinations need %zd",
+                 src.len, total);
+    release_all(&dsts);
+    Py_DECREF(seq);
+    PyBuffer_Release(&src);
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  const char* in = static_cast<const char*>(src.buf);
+  Py_ssize_t off = 0;
+  for (const Py_buffer& b : dsts) {
+    if (b.len > 0) std::memcpy(b.buf, in + off, static_cast<size_t>(b.len));
+    off += b.len;
+  }
+  Py_END_ALLOW_THREADS
+  release_all(&dsts);
+  Py_DECREF(seq);
+  PyBuffer_Release(&src);
+  return PyLong_FromSsize_t(total);
+}
+
+PyMethodDef methods[] = {
+    {"pack_into", pack_into, METH_VARARGS,
+     "pack_into(dst, srcs) -> int\n\n"
+     "Copy the raw bytes of each buffer-protocol object in `srcs`,\n"
+     "in order, into the writable C-contiguous buffer `dst` (GIL\n"
+     "released during the copies). Returns total bytes written.\n"
+     "Raises ValueError when `dst` is too small."},
+    {"unpack_into", unpack_into, METH_VARARGS,
+     "unpack_into(src, dsts) -> int\n\n"
+     "Scatter consecutive byte ranges of `src` into the writable\n"
+     "buffers `dsts` (each filled to its own length, GIL released).\n"
+     "Returns total bytes read. Raises ValueError when `src` is\n"
+     "shorter than the destinations' total."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module = {
+    PyModuleDef_HEAD_INIT,
+    "_hvd_cext",
+    "Buffer-protocol host staging: the CPython-extension native half\n"
+    "of the framework bindings (see csrc/cext.cc header).",
+    -1,
+    methods,
+    nullptr,
+    nullptr,
+    nullptr,
+    nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__hvd_cext(void) { return PyModule_Create(&module); }

@@ -46,6 +46,34 @@ def _stale() -> bool:
     )
 
 
+def _build(sources: List[str], out: str, extra: List[str]) -> Optional[str]:
+    """Compile ``sources`` into the shared object ``out``; returns the
+    path on success, the existing artifact (if any) on failure. Build to
+    a temp name then os.replace: concurrent builders (e.g.
+    pytest-launched worker processes) each produce a complete .so and
+    the last rename wins — nobody ever dlopens a half-written file."""
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-std=c++17", "-O3", "-fPIC", "-Wall", "-pthread",
+        "-fvisibility=hidden", "-shared",
+        *extra,
+        *sources,
+        "-o", tmp,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=300, cwd=_CSRC
+        )
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, OSError):
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return out if os.path.exists(out) else None
+
+
 def lib_path() -> Optional[str]:
     """Path to an up-to-date libhvd_native.so, building it if needed.
     Returns None when the sources are missing or the build fails."""
@@ -53,25 +81,49 @@ def lib_path() -> Optional[str]:
         return _LIB
     if not all(os.path.exists(p) for p in _source_paths()):
         return _LIB if os.path.exists(_LIB) else None
-    # Build to a temp name then os.replace: concurrent builders (e.g.
-    # pytest-launched worker processes) each produce a complete .so and
-    # the last rename wins — nobody ever dlopens a half-written file.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
-    os.close(fd)
-    cmd = [
-        os.environ.get("CXX", "g++"),
-        "-std=c++17", "-O3", "-fPIC", "-Wall", "-pthread",
-        "-fvisibility=hidden", "-shared",
-        *_source_paths(),
-        "-o", tmp,
-    ]
-    try:
-        subprocess.run(
-            cmd, check=True, capture_output=True, timeout=300, cwd=_CSRC
-        )
-        os.replace(tmp, _LIB)
-        return _LIB
-    except (subprocess.SubprocessError, OSError):
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        return _LIB if os.path.exists(_LIB) else None
+    return _build(_source_paths(), _LIB, [])
+
+
+# ------------------------------------------------- CPython extension half
+
+def _ext_suffix() -> str:
+    """ABI-tagged extension suffix (e.g. .cpython-311-x86_64-linux-gnu.so)
+    so checkouts shared between interpreters never load an extension
+    compiled against another version's headers."""
+    import importlib.machinery
+
+    return importlib.machinery.EXTENSION_SUFFIXES[0]
+
+
+_EXT = os.path.join(_HERE, "_hvd_cext" + _ext_suffix())
+_EXT_SRC = os.path.join(_CSRC, "cext.cc")
+
+
+def _ext_stale() -> bool:
+    if not os.path.exists(_EXT):
+        return True
+    return (
+        os.path.exists(_EXT_SRC)
+        and os.path.getmtime(_EXT_SRC) > os.path.getmtime(_EXT)
+    )
+
+
+def ext_path() -> Optional[str]:
+    """Path to the up-to-date ``_hvd_cext`` CPython extension module
+    (csrc/cext.cc), building it against this interpreter's headers on
+    first call. A plain ``.so`` suffix imports fine on Linux
+    (``importlib.machinery.EXTENSION_SUFFIXES`` ends with ``.so``);
+    undefined Python symbols resolve from the host process at import,
+    exactly like a setuptools-built extension."""
+    if not _ext_stale():
+        return _EXT
+    if not os.path.exists(_EXT_SRC):
+        return _EXT if os.path.exists(_EXT) else None
+    import sysconfig
+
+    include = sysconfig.get_paths().get("include")
+    if not include or not os.path.exists(
+        os.path.join(include, "Python.h")
+    ):
+        return _EXT if os.path.exists(_EXT) else None
+    return _build([_EXT_SRC], _EXT, ["-I", include])

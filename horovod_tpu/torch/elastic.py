@@ -21,9 +21,66 @@ from ..elastic.state import ObjectState, State  # noqa: F401 — re-export
 from ..elastic.worker import run  # noqa: F401 — hvd.torch.elastic.run
 
 
+class _Ineligible(Exception):
+    """A tensor the native packed snapshot cannot stage (non-CPU
+    device, numpy-unsupported dtype like bfloat16)."""
+
+
+class _PackedLeaf:
+    """Marker in a packed-snapshot skeleton: tensor #index of the
+    block, restored to torch dtype ``dtype``."""
+
+    __slots__ = ("index", "dtype")
+
+    def __init__(self, index: int, dtype) -> None:
+        self.index = index
+        self.dtype = dtype
+
+
+class _PackedStateDict:
+    """A state dict snapshotted into ONE contiguous native block
+    (``loader.PackedSnapshot``) — the adapter_v2-style native half of
+    the commit: tensor bytes reach C through the buffer protocol, the
+    staging memcpy runs without the GIL, and restore materializes
+    zero-copy views (``load_state_dict`` does the one unavoidable copy
+    back into the live storages)."""
+
+    def __init__(self, skeleton: Any, snap) -> None:
+        self._skeleton = skeleton
+        self._snapshot = snap
+
+    @property
+    def nbytes(self) -> int:
+        return self._snapshot.nbytes
+
+    def materialize(self, copy_tensors: bool = False) -> Any:
+        """State dict over zero-copy views into the block; with
+        ``copy_tensors`` every tensor is an owned clone (required when
+        the consumer may keep references that are later mutated in
+        place — see TorchState.restore's optimizer leg)."""
+        import torch
+
+        def build(v):
+            if isinstance(v, _PackedLeaf):
+                t = torch.from_numpy(
+                    self._snapshot.view(v.index)
+                ).view(v.dtype)
+                return t.clone() if copy_tensors else t
+            if isinstance(v, dict):
+                return {k: build(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return type(v)(build(x) for x in v)
+            return copy.deepcopy(v)
+
+        return build(self._skeleton)
+
+
 class TorchState(ObjectState):
     """Commit/restore/sync over a torch model + optimizer
-    (ref: horovod/torch/elastic/state.py TorchState [V])."""
+    (ref: horovod/torch/elastic/state.py TorchState [V]). Commits
+    prefer the native packed snapshot (one block, GIL-released staging
+    — csrc/cext.cc); per-tensor clones remain the fallback when the
+    native layer is off or a tensor is ineligible."""
 
     def __init__(self, model=None, optimizer=None, **kwargs: Any) -> None:
         self.model = model
@@ -48,27 +105,81 @@ class TorchState(ObjectState):
 
         return clone(sd)
 
+    @staticmethod
+    def _pack_state_dict(sd):
+        """Native packed snapshot of ``sd``; None when any tensor is
+        ineligible or the native layer is unavailable."""
+        import torch
+
+        from .._native import loader as _native_loader
+
+        leaves: list = []
+
+        def strip(v):
+            if isinstance(v, torch.Tensor):
+                t = v.detach()
+                if t.device.type != "cpu":
+                    raise _Ineligible
+                try:
+                    leaves.append(t.contiguous().numpy())
+                except (RuntimeError, TypeError):
+                    raise _Ineligible  # bfloat16 & friends
+                return _PackedLeaf(len(leaves) - 1, v.dtype)
+            if isinstance(v, dict):
+                return {k: strip(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return type(v)(strip(x) for x in v)
+            return copy.deepcopy(v)
+
+        try:
+            skeleton = strip(sd)
+        except _Ineligible:
+            return None
+        snap = _native_loader.snapshot_arrays(leaves)
+        if snap is None:
+            return None
+        return _PackedStateDict(skeleton, snap)
+
+    def _snapshot(self, sd):
+        packed = self._pack_state_dict(sd)
+        if packed is not None:
+            return packed
+        return self._clone_state_dict(sd)
+
     def save(self) -> None:
         if self.model is not None:
-            self._saved_model_state = self._clone_state_dict(
+            self._saved_model_state = self._snapshot(
                 self.model.state_dict()
             )
         if self.optimizer is not None:
-            self._saved_optimizer_state = self._clone_state_dict(
+            self._saved_optimizer_state = self._snapshot(
                 self.optimizer.state_dict()
             )
         super().save()
 
     def restore(self) -> None:
-        # load_state_dict copies (params via copy_, optimizer via its
-        # own deepcopy), so the snapshots can be passed directly
+        # Module.load_state_dict copies into the live param storages
+        # (copy_), so the model leg can consume zero-copy views. But
+        # Optimizer.load_state_dict SHALLOW-copies state tensors
+        # (torch>=2.x: ``.to()`` on a matching device/dtype returns the
+        # same tensor) — handing it views/clones it keeps would let the
+        # next opt.step() mutate the committed snapshot in place, so the
+        # optimizer leg always gets owned copies.
         if self.model is not None and self._saved_model_state is not None:
-            self.model.load_state_dict(self._saved_model_state)
+            saved = self._saved_model_state
+            if isinstance(saved, _PackedStateDict):
+                saved = saved.materialize()
+            self.model.load_state_dict(saved)
         if (
             self.optimizer is not None
             and self._saved_optimizer_state is not None
         ):
-            self.optimizer.load_state_dict(self._saved_optimizer_state)
+            saved = self._saved_optimizer_state
+            if isinstance(saved, _PackedStateDict):
+                saved = saved.materialize(copy_tensors=True)
+            else:
+                saved = self._clone_state_dict(saved)
+            self.optimizer.load_state_dict(saved)
         super().restore()
 
     def sync(self) -> None:

@@ -277,3 +277,96 @@ def test_hmac_interop_cpp_python():
         assert cli.get("big", "k") == payload
     finally:
         srv.stop()
+
+
+# ------------------------------------------- cext (CPython binding half)
+
+class TestCExt:
+    """csrc/cext.cc — the buffer-protocol native half (SURVEY.md §2.3:
+    the adapter layer's surviving TPU job is host staging)."""
+
+    def test_builds_and_loads(self):
+        ext = loader.get_ext()
+        assert ext is not None, "CPython extension failed to build"
+        assert hasattr(ext, "pack_into")
+        assert hasattr(ext, "unpack_into")
+
+    def test_pack_unpack_into_roundtrip(self, rng):
+        ext = loader.get_ext()
+        srcs = [
+            rng.normal(size=(3, 7)).astype(np.float32),
+            np.arange(5, dtype=np.int64),
+            b"raw-bytes-source",          # plain buffer object
+            memoryview(bytes(range(9))),  # memoryview source
+        ]
+        total = sum(
+            s.nbytes if isinstance(s, np.ndarray) else len(bytes(s))
+            for s in srcs
+        )
+        dst = np.empty(total + 8, dtype=np.uint8)  # oversize dst is fine
+        written = ext.pack_into(dst, srcs)
+        assert written == total
+        outs = [np.empty_like(srcs[0]), np.empty_like(srcs[1]),
+                bytearray(len(srcs[2])), bytearray(len(bytes(srcs[3])))]
+        read = ext.unpack_into(dst, outs)
+        assert read == total
+        np.testing.assert_array_equal(outs[0], srcs[0])
+        np.testing.assert_array_equal(outs[1], srcs[1])
+        assert bytes(outs[2]) == srcs[2]
+        assert bytes(outs[3]) == bytes(srcs[3])
+
+    def test_dst_too_small_raises(self):
+        ext = loader.get_ext()
+        with pytest.raises(ValueError, match="dst holds"):
+            ext.pack_into(np.empty(3, np.uint8),
+                          [np.zeros(4, np.uint8)])
+
+    def test_src_too_short_raises(self):
+        ext = loader.get_ext()
+        with pytest.raises(ValueError, match="destinations need"):
+            ext.unpack_into(np.zeros(3, np.uint8),
+                            [np.empty(4, np.uint8)])
+
+    def test_non_buffer_source_raises(self):
+        ext = loader.get_ext()
+        with pytest.raises(TypeError):
+            ext.pack_into(np.empty(8, np.uint8), [object()])
+
+    def test_readonly_dst_rejected(self):
+        ext = loader.get_ext()
+        with pytest.raises((TypeError, BufferError)):
+            ext.pack_into(b"readonly", [np.zeros(2, np.uint8)])
+
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_NATIVE", "0")
+        assert loader.get_ext() is None
+        assert loader.snapshot_arrays([np.zeros(2)]) is None
+
+
+class TestPackedSnapshot:
+    def test_roundtrip_views_and_copies(self, rng):
+        arrays = [
+            rng.normal(size=(2, 3)).astype(np.float32),
+            np.arange(6, dtype=np.int32).reshape(3, 2),
+            np.array([True, False, True]),
+            np.empty((0, 4), dtype=np.float64),  # zero-byte leaf
+            np.array(7.25, dtype=np.float32),    # 0-d: shape must survive
+        ]
+        snap = loader.snapshot_arrays(arrays)
+        assert snap is not None
+        assert len(snap) == len(arrays)
+        assert snap.nbytes == sum(a.nbytes for a in arrays)
+        # mutate the sources: the snapshot must not move
+        originals = [a.copy() for a in arrays]
+        for a in arrays:
+            if a.size:
+                a.fill(0)
+        for i, orig in enumerate(originals):
+            np.testing.assert_array_equal(snap.view(i), orig)
+            assert snap.view(i).dtype == orig.dtype
+            assert snap.view(i).shape == orig.shape
+        # views alias the block; arrays() are owned copies
+        assert np.shares_memory(snap.view(0), snap.buf)
+        copies = snap.arrays()
+        assert not np.shares_memory(copies[0], snap.buf)
+        np.testing.assert_array_equal(copies[1], originals[1])

@@ -240,3 +240,143 @@ class TestElasticSampler:
         assert s2.processed_indices == s.processed_indices
         s.reset()  # same post-restore view: both exclude the processed set
         assert s2.indices == s.indices
+
+
+def test_torch_state_packed_native_snapshot(hvd):
+    """Commit rides the native packed block (csrc/cext.cc) when every
+    tensor is CPU/numpy-eligible; restore from the block is exact."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu._native import loader as native_loader
+    from horovod_tpu.torch.elastic import TorchState, _PackedStateDict
+
+    torch.manual_seed(1)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2)
+    )
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    # populate momentum buffers so the optimizer snapshot has tensors
+    model(torch.randn(8, 4)).pow(2).mean().backward()
+    opt.step()
+
+    state = TorchState(model=model, optimizer=opt, batch=1)
+    if native_loader.ext_available() or native_loader.available():
+        assert isinstance(state._saved_model_state, _PackedStateDict)
+        assert isinstance(state._saved_optimizer_state, _PackedStateDict)
+        assert state._saved_model_state.nbytes == sum(
+            t.numel() * t.element_size()
+            for t in model.state_dict().values()
+        )
+
+    committed = {
+        k: v.detach().clone() for k, v in model.state_dict().items()
+    }
+    mom_committed = [
+        b["momentum_buffer"].detach().clone()
+        for b in opt.state_dict()["state"].values()
+    ]
+    # mutate weights + momentum, then roll back
+    model(torch.randn(8, 4)).pow(2).mean().backward()
+    opt.step()
+    state.restore()
+    for k, v in model.state_dict().items():
+        assert torch.equal(v, committed[k]), k
+    for got, want in zip(
+        (b["momentum_buffer"]
+         for b in opt.state_dict()["state"].values()),
+        mom_committed,
+    ):
+        assert torch.equal(got, want)
+
+
+def test_torch_state_packed_preserves_0d_adam_step(hvd):
+    """Adam's 0-d 'step' tensors must come back 0-d from the packed
+    block (np.ascontiguousarray promotes 0-d to (1,); the snapshot
+    records the original shape)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.torch.elastic import TorchState
+
+    model = torch.nn.Linear(3, 3)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    model(torch.randn(2, 3)).sum().backward()
+    opt.step()
+    step_shapes = [
+        s["step"].shape for s in opt.state_dict()["state"].values()
+    ]
+    state = TorchState(model=model, optimizer=opt)
+    model(torch.randn(2, 3)).sum().backward()
+    opt.step()
+    state.restore()
+    for s, want in zip(
+        opt.state_dict()["state"].values(), step_shapes
+    ):
+        assert s["step"].shape == want
+
+
+def test_torch_state_bf16_falls_back_to_clone(hvd):
+    """A numpy-unsupported dtype anywhere in the state dict routes the
+    whole snapshot through the per-tensor clone path — still correct."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.torch.elastic import TorchState, _PackedStateDict
+
+    class WithBf16(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(3, 3)
+            self.register_buffer(
+                "scale", torch.ones(4, dtype=torch.bfloat16)
+            )
+
+        def forward(self, x):
+            return self.lin(x)
+
+    model = WithBf16()
+    state = TorchState(model=model)
+    assert not isinstance(state._saved_model_state, _PackedStateDict)
+    with torch.no_grad():
+        model.lin.weight.add_(1.0)
+        model.scale.mul_(2.0)
+    state.restore()
+    assert torch.all(model.scale == torch.ones(4, dtype=torch.bfloat16))
+
+
+def test_torch_state_double_restore_does_not_corrupt_snapshot(hvd):
+    """Optimizer.load_state_dict shallow-copies (torch>=2.x), so a
+    restore must hand it OWNED tensors: commit -> restore -> train ->
+    restore again has to return the committed state, not the
+    post-training values (on both the packed and clone paths)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.torch.elastic import TorchState
+
+    def run_cycle():
+        torch.manual_seed(3)
+        model = torch.nn.Linear(4, 4)
+        opt = torch.optim.SGD(
+            model.parameters(), lr=0.5, momentum=0.9
+        )
+        model(torch.randn(8, 4)).pow(2).mean().backward()
+        opt.step()
+        state = TorchState(model=model, optimizer=opt)
+        committed = [
+            b["momentum_buffer"].clone()
+            for b in opt.state_dict()["state"].values()
+        ]
+        state.restore()
+        # train AFTER the restore: if the live optimizer aliases the
+        # snapshot, these steps corrupt it in place
+        for _ in range(3):
+            opt.zero_grad()
+            model(torch.randn(8, 4)).pow(2).mean().backward()
+            opt.step()
+        state.restore()
+        got = [
+            b["momentum_buffer"]
+            for b in opt.state_dict()["state"].values()
+        ]
+        for g, w in zip(got, committed):
+            assert torch.equal(g, w)
+
+    run_cycle()  # packed path (native available in CI)
+    import os
+    from unittest import mock
+    with mock.patch.dict(os.environ, {"HOROVOD_NATIVE": "0"}):
+        run_cycle()  # clone fallback path
