@@ -91,6 +91,7 @@ from .ops.eager import (  # noqa: F401
     grouped_allreduce_async,
     grouped_reducescatter,
     grouped_reducescatter_async,
+    barrier,
     join,
     join_ranks,
     my_row,

@@ -17,10 +17,28 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_load_failed = False
-_ext: Optional[Any] = None
-_ext_failed = False
+# name ("lib"/"ext") -> loaded object, or None after a failed attempt
+_cache: dict = {}
+
+
+def _native_disabled() -> bool:
+    return (os.environ.get("HOROVOD_NATIVE", "1") == "0"
+            or os.environ.get("HOROVOD_TPU_NATIVE", "1") == "0")
+
+
+def _load_once(name: str, load) -> Optional[Any]:
+    """Env-gated, lock-guarded, attempt-once loader cache shared by the
+    ctypes library and the CPython extension halves."""
+    if _native_disabled():
+        return None
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        try:
+            _cache[name] = load()
+        except (ImportError, OSError):
+            _cache[name] = None
+        return _cache[name]
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -85,69 +103,53 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_kv_drop_scope.argtypes = [c.c_void_p, c.c_char_p]
 
 
+def _load_lib() -> Optional[ctypes.CDLL]:
+    from . import build
+
+    path = build.lib_path()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    _declare(lib)
+    return lib
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded library, building it on first call; None if disabled
     (HOROVOD_NATIVE=0; HOROVOD_TPU_NATIVE=0 is honored as an alias) or
     unbuildable."""
-    global _lib, _load_failed
-    if (os.environ.get("HOROVOD_NATIVE", "1") == "0"
-            or os.environ.get("HOROVOD_TPU_NATIVE", "1") == "0"):
-        return None
-    with _lock:
-        if _lib is not None or _load_failed:
-            return _lib
-        try:
-            from . import build
-
-            path = build.lib_path()
-            if path is None:
-                _load_failed = True
-                return None
-            lib = ctypes.CDLL(path)
-            _declare(lib)
-            _lib = lib
-        except OSError:
-            _load_failed = True
-        return _lib
+    return _load_once("lib", _load_lib)
 
 
 def available() -> bool:
     return get_lib() is not None
 
 
+def _load_ext() -> Optional[Any]:
+    import importlib.util
+
+    from . import build
+
+    path = build.ext_path()
+    if path is None:
+        return None
+    spec = importlib.util.spec_from_file_location(
+        "horovod_tpu._native._hvd_cext", path
+    )
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def get_ext() -> Optional[Any]:
     """The ``_hvd_cext`` CPython extension module (csrc/cext.cc) —
     the native binding half that reads framework tensors through the
     buffer protocol (zero-copy, GIL released during staging copies).
-    None when native is disabled (HOROVOD_NATIVE=0) or unbuildable."""
-    global _ext, _ext_failed
-    if (os.environ.get("HOROVOD_NATIVE", "1") == "0"
-            or os.environ.get("HOROVOD_TPU_NATIVE", "1") == "0"):
-        return None
-    with _lock:
-        if _ext is not None or _ext_failed:
-            return _ext
-        try:
-            import importlib.util
-
-            from . import build
-
-            path = build.ext_path()
-            if path is None:
-                _ext_failed = True
-                return None
-            spec = importlib.util.spec_from_file_location(
-                "horovod_tpu._native._hvd_cext", path
-            )
-            if spec is None or spec.loader is None:
-                _ext_failed = True
-                return None
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            _ext = mod
-        except (ImportError, OSError):
-            _ext_failed = True
-        return _ext
+    None when native is disabled (same env gate as :func:`get_lib`) or
+    unbuildable (e.g. no Python dev headers)."""
+    return _load_once("ext", _load_ext)
 
 
 def ext_available() -> bool:

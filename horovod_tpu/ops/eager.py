@@ -587,3 +587,24 @@ def join(joined_ranks: Optional[Sequence[int]] = None) -> int:
     if joined_ranks:
         return max(joined_ranks)
     return -1
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    """Block until every process in ``process_set`` (default: all) has
+    entered the barrier (ref: horovod/common/basics.py ``barrier`` and
+    its torch/TF bindings [V]).
+
+    Implemented the reference's way — as a degenerate collective: a
+    one-element allreduce over the set, fetched to the host. Pending
+    fused work flushes first (enqueue-then-wait drives the cycle), and
+    under multi-controller ``jax.distributed`` the global-array result
+    cannot materialize until every participating process has
+    contributed its shard, which is exactly the barrier."""
+    st = basics._require_init()
+    token = jnp.zeros((st.topology.size, 1), jnp.float32)
+    result = allreduce(
+        token, op=Average,
+        name=_auto_name("barrier", None),
+        process_set=process_set,
+    )
+    np.asarray(my_row(result))  # host fetch = the synchronization point

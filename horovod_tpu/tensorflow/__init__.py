@@ -259,6 +259,28 @@ def join(joined_ranks=None) -> int:
     return _eager.join(joined_ranks)
 
 
+def barrier(process_set=None) -> None:
+    """Block until all processes (or all members of ``process_set``)
+    reach the barrier (ref: horovod.tensorflow.barrier [V])."""
+    _eager.barrier(process_set=process_set)
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None):
+    """Pickle-broadcast an arbitrary Python object from ``root_rank``
+    (ref: horovod.tensorflow.broadcast_object [V])."""
+    from ..optimizer import broadcast_object as _impl
+
+    return _impl(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj, name=None):
+    """Gather one arbitrary Python object per rank into a list
+    (ref: horovod.tensorflow.allgather_object [V])."""
+    from ..optimizer import allgather_object as _impl
+
+    return _impl(obj, name=name)
+
+
 class _NoneCompressor:
     @staticmethod
     def compress(tensor):

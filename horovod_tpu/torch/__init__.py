@@ -447,6 +447,12 @@ def join(joined_ranks=None) -> int:
     return _eager.join(joined_ranks)
 
 
+def barrier(process_set=None) -> None:
+    """Block until all processes (or all members of ``process_set``)
+    reach the barrier (ref: horovod.torch.barrier [V])."""
+    _eager.barrier(process_set=process_set)
+
+
 # ------------------------------------------------------- module helpers
 
 

@@ -123,6 +123,24 @@ out = hvd.reducescatter(rm(base), op=hvd.Sum)
 total = base(0) + base(1) + base(2)
 check("reducescatter", hvd.my_row(out), total[2 * me: 2 * me + 2])
 
+# 10. barrier — nobody leaves before the slowest process enters.
+#     Rank 2 enters ~0.8s after rank 0; rank 0's wait must absorb
+#     that skew (lower-bound assert, robust to slow machines).
+import time
+time.sleep(0.4 * me)
+t0 = time.monotonic()
+hvd.barrier()
+waited = time.monotonic() - t0
+if me == 0:
+    assert waited > 0.3, f"barrier did not block rank 0 (waited {waited:.3f}s)"
+print(f"OK barrier rank={me}", flush=True)
+
+# 11. barrier over a process set (non-member rank 2 passes through)
+ps01b = hvd.add_process_set([0, 1])
+hvd.barrier(process_set=ps01b)
+hvd.remove_process_set(ps01b)
+print(f"OK barrier_pset rank={me}", flush=True)
+
 print(f"WORKER_DONE {me}", flush=True)
 '''
 
@@ -158,7 +176,7 @@ def test_eager_op_family_across_three_real_processes(tmp_path):
     for tag in (
         "allreduce_sum", "join_average", "adasum_pset", "broadcast_root2",
         "allgather_v", "alltoall_v", "pset_excl0", "grouped_1",
-        "grouped_2", "reducescatter",
+        "grouped_2", "reducescatter", "barrier", "barrier_pset",
     ):
         for r in range(3):
             assert f"OK {tag} rank={r}" in logs, (tag, r, logs[-3000:])

@@ -327,3 +327,21 @@ def test_grouped_allgather_aborts_cleanly_on_bad_member(hvd):
     # the queue still works after the aborted group
     out = hvd.allreduce(good, op=hvd_mod.Sum)
     np.testing.assert_allclose(np.asarray(out[0]), np.full(2, 28.0))
+
+
+def test_barrier_single_controller(hvd):
+    """hvd.barrier() (ref: horovod/common/basics.py barrier [V]):
+    returns promptly under a single controller, flushes pending fused
+    work first, and accepts a process set."""
+    import horovod_tpu as hvd_mod
+
+    h = hvd_mod.allreduce_async(
+        hvd_mod.replicate(np.ones(3, np.float32)), op=hvd_mod.Sum
+    )
+    hvd_mod.barrier()  # must drive/flush the pending cycle
+    assert h.poll()
+    ps = hvd_mod.add_process_set([0, 1])
+    try:
+        hvd_mod.barrier(process_set=ps)
+    finally:
+        hvd_mod.remove_process_set(ps)

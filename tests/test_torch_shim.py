@@ -509,3 +509,16 @@ def test_grouped_allreduce_prescale(hvdt):
     outs = hvdt.grouped_allreduce(xs, op=hvdt.Sum, prescale_factor=2.0)
     assert torch.allclose(outs[0], torch.full((2,), 2.0 * hvdt.size()))
     assert torch.allclose(outs[1], torch.full((2,), 4.0 * hvdt.size()))
+
+
+def test_torch_barrier(hvd):
+    """hvd.torch.barrier parity (ref: horovod.torch.barrier [V])."""
+    import horovod_tpu.torch as hvdt
+
+    hvdt.barrier()
+    ps = hvdt.add_process_set([0, 1]) if hasattr(hvdt, "add_process_set") else None
+    if ps is not None:
+        try:
+            hvdt.barrier(process_set=ps)
+        finally:
+            hvdt.remove_process_set(ps)
