@@ -87,6 +87,16 @@ def main():
     )
     opt_state = opt.init(params)
 
+    # Chunked fused linear-cross-entropy (ops/fused_xent.py): never
+    # materializes the (batch·seq, vocab) logits — the step's largest
+    # activation (~823 MB fp32 at GPT-2-medium b8/s512) — at the cost
+    # of one logits recompute in backward. BENCH_FUSED_XENT=1 enables
+    # it for the on-chip A/B; BENCH_XENT_CHUNK tunes the vocab chunk.
+    fused_xent = os.environ.get("BENCH_FUSED_XENT", "0") not in (
+        "0", "false", "off"
+    )
+    xent_chunk = int(os.environ.get("BENCH_XENT_CHUNK", "8192"))
+
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -98,6 +108,25 @@ def main():
         tokens, labels = tokens[0], labels[0]
 
         def loss_fn(p):
+            if fused_xent:
+                from horovod_tpu.ops.fused_xent import (
+                    fused_linear_cross_entropy,
+                )
+
+                hidden = model.apply(
+                    p, tokens, train=True, return_hidden=True
+                )
+                head = p["params"]["lm_head"]
+                return fused_linear_cross_entropy(
+                    hidden.reshape(-1, cfg.d_model),
+                    head["kernel"],
+                    head["bias"],
+                    labels.reshape(-1),
+                    chunk=xent_chunk,
+                    compute_dtype=(
+                        cfg.dtype if cfg.head_mixed_precision else None
+                    ),
+                ).mean()
             logits = model.apply(p, tokens, train=True)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), labels
@@ -157,6 +186,7 @@ def main():
         "world": world,
         "remat": remat,
         "head": "mixed" if cfg.head_mixed_precision else "fp32",
+        "xent": "fused" if fused_xent else "dense",
         "platform": jax.devices()[0].platform,
     }
     result.update(mfu_fields(flops, iters, dt, jax.devices()[0].platform,

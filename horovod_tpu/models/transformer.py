@@ -216,7 +216,10 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, mask=None, train: bool = True):
+    def __call__(
+        self, tokens, mask=None, train: bool = True,
+        return_hidden: bool = False,
+    ):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype)(tokens)
         pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype)(
@@ -229,5 +232,12 @@ class Transformer(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"block_{i}")(x, mask, train)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
+        if return_hidden:
+            # pre-head activations for the chunked fused loss
+            # (ops/fused_xent.py): callers apply the lm_head params
+            # through fused_linear_cross_entropy and never materialize
+            # the (tokens, vocab) logits. Param tree is unchanged —
+            # init traces the default path below.
+            return x
         # fp32 logits; matmul precision per cfg.head_mixed_precision
         return LMHead(cfg, name="lm_head")(x)

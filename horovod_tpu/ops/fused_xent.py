@@ -1,0 +1,185 @@
+"""Chunked fused linear + softmax-cross-entropy for LM heads.
+
+TPU-first replacement for the reference's ``logits -> loss`` tail
+(ref: the synthetic LM benches materialize full logits and call the
+framework's cross-entropy — e.g. examples/pytorch/pytorch_synthetic
+_benchmark.py's criterion path [V]; SURVEY.md §2.6 treats the LM loss
+as framework-side). At GPT-2 vocabulary width the logits tensor is the
+single largest activation in the step: ``(batch·seq, vocab)`` fp32 is
+~823 MB at batch 8 / seq 512 / V=50257, written once forward, read by
+softmax, and the same again for ``dlogits`` backward — all HBM
+traffic on a step whose profile is bandwidth-sensitive (docs/perf.md).
+
+This op never materializes them. The vocabulary axis is processed in
+chunks (an unrolled loop — every matmul stays MXU-sized and XLA's cost
+analysis sees every FLOP; no ``while`` body undercounting):
+
+* forward: online logsumexp (running max + scaled sum) plus a gathered
+  target logit per token; only ``(N,)`` statistics survive the loop.
+* backward (custom VJP): recompute each chunk's logits from the saved
+  activations, form ``softmax - onehot`` locally, and accumulate
+  ``dx`` / write ``dW``/``db`` slices.
+
+Cost: one extra ``N·d·chunk``-per-chunk matmul in backward (the logits
+recompute), ~``2NdV`` FLOPs ≈ +4% of a GPT-2-medium step — traded for
+never writing/reading the two ``(N, V)`` fp32 tensors and an ~800 MB
+lower activation footprint (which is what lets batch grow without
+remat). Matmul precision follows the LM head recipe: ``compute_dtype``
+operands (bf16 by default) with fp32 accumulation, fp32 statistics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _partial_logits(x, kernel, bias, start: int, width: int, dtype):
+    """Logits for vocab columns [start, start+width) — fp32 out."""
+    k = lax.slice_in_dim(kernel, start, start + width, axis=1)
+    b = lax.slice_in_dim(bias, start, start + width, axis=0)
+    if dtype is not None:
+        y = lax.dot_general(
+            x.astype(dtype),
+            k.astype(dtype),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        y = jnp.dot(x.astype(jnp.float32), k.astype(jnp.float32))
+    return y + b[None, :].astype(jnp.float32)
+
+
+def _chunk_starts(vocab: int, chunk: int):
+    """(start, width) pairs covering [0, vocab) — full chunks plus one
+    static tail, no padding, no overlap."""
+    chunk = max(1, min(int(chunk), vocab))
+    starts = [(s, chunk) for s in range(0, vocab - chunk + 1, chunk)]
+    done = starts[-1][0] + chunk if starts else 0
+    if done < vocab:
+        starts.append((done, vocab - done))
+    return starts
+
+
+@functools.lru_cache(maxsize=None)
+def _build(chunk: int, dtype_name: Optional[str]):
+    dtype = None if dtype_name is None else jnp.dtype(dtype_name)
+
+    @jax.custom_vjp
+    def f(x, kernel, bias, labels):
+        loss, _ = f_fwd(x, kernel, bias, labels)
+        return loss
+
+    def f_fwd(x, kernel, bias, labels):
+        n = x.shape[0]
+        vocab = kernel.shape[1]
+        m = jnp.full((n,), -np.inf, jnp.float32)
+        s = jnp.zeros((n,), jnp.float32)
+        tl = jnp.zeros((n,), jnp.float32)
+        for start, width in _chunk_starts(vocab, chunk):
+            logits = _partial_logits(x, kernel, bias, start, width, dtype)
+            cmax = jnp.max(logits, axis=-1)
+            new_m = jnp.maximum(m, cmax)
+            s = s * jnp.exp(m - new_m) + jnp.sum(
+                jnp.exp(logits - new_m[:, None]), axis=-1
+            )
+            m = new_m
+            local = labels - start
+            hit = (local >= 0) & (local < width)
+            idx = jnp.clip(local, 0, width - 1)
+            tl = tl + jnp.where(
+                hit,
+                jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0],
+                0.0,
+            )
+        lse = m + jnp.log(s)
+        return lse - tl, (x, kernel, bias, labels, lse)
+
+    def f_bwd(res, g):
+        x, kernel, bias, labels, lse = res
+        n = x.shape[0]
+        vocab = kernel.shape[1]
+        dx = jnp.zeros(x.shape, jnp.float32)
+        dw_slices = []
+        db_slices = []
+        for start, width in _chunk_starts(vocab, chunk):
+            logits = _partial_logits(x, kernel, bias, start, width, dtype)
+            p = jnp.exp(logits - lse[:, None])
+            local = labels - start
+            hit = (local >= 0) & (local < width)
+            idx = jnp.clip(local, 0, width - 1)
+            dlogits = p * g[:, None]
+            dlogits = dlogits.at[jnp.arange(n), idx].add(
+                jnp.where(hit, -g, 0.0)
+            )
+            k = lax.slice_in_dim(kernel, start, start + width, axis=1)
+            if dtype is not None:
+                dl = dlogits.astype(dtype)
+                dx = dx + lax.dot_general(
+                    dl, k.astype(dtype),
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                dwc = lax.dot_general(
+                    x.astype(dtype), dl,
+                    dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                dx = dx + jnp.dot(dlogits, k.astype(jnp.float32).T)
+                dwc = jnp.dot(x.astype(jnp.float32).T, dlogits)
+            dw_slices.append(dwc.astype(kernel.dtype))
+            db_slices.append(dlogits.sum(axis=0).astype(bias.dtype))
+        dw = jnp.concatenate(dw_slices, axis=1)
+        db = jnp.concatenate(db_slices, axis=0)
+        dlabels = np.zeros(labels.shape, jax.dtypes.float0)
+        return dx.astype(x.dtype), dw, db, dlabels
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def fused_linear_cross_entropy(
+    x,
+    kernel,
+    bias,
+    labels,
+    *,
+    chunk: int = 8192,
+    compute_dtype: Any = jnp.bfloat16,
+):
+    """Per-token softmax cross-entropy of ``x @ kernel + bias`` against
+    integer ``labels`` — without materializing the logits.
+
+    Args:
+      x: ``(N, d_model)`` activations (any float dtype; gradients come
+        back in the same dtype).
+      kernel: ``(d_model, vocab)`` projection (fp32 master weights).
+      bias: ``(vocab,)``.
+      labels: ``(N,)`` int32/int64 targets in ``[0, vocab)``.
+      chunk: vocabulary chunk width. The working set per chunk is
+        ``N × chunk`` fp32; the loop is unrolled, so every chunk is a
+        full MXU matmul and XLA sees the true FLOP count.
+      compute_dtype: matmul operand dtype (None = all-fp32). Default
+        bf16 matches ``TransformerConfig.head_mixed_precision``.
+
+    Returns ``(N,)`` fp32 per-token losses (mean-reduce for the usual
+    scalar objective). Numerics match the materialized
+    ``optax.softmax_cross_entropy_with_integer_labels`` path to the
+    matmul-precision tolerance (exactly, under ``compute_dtype=None``).
+    """
+    if x.ndim != 2:
+        raise ValueError(f"x must be (tokens, d_model); got {x.shape}")
+    if labels.shape != x.shape[:1]:
+        raise ValueError(
+            f"labels shape {labels.shape} != tokens axis {x.shape[:1]}"
+        )
+    dtype_name = None if compute_dtype is None else jnp.dtype(
+        compute_dtype
+    ).name
+    return _build(int(chunk), dtype_name)(x, kernel, bias, labels)

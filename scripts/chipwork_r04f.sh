@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Round-4 part f: chunked fused linear-cross-entropy A/B on the LM
+# benches (ops/fused_xent.py, BENCH_FUSED_XENT) — see the experiment
+# comment above the cap list. Runs after the c->d->e chain drains;
+# same skip-if-done + probe-gated discipline.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+R=r04
+
+# Wait until the whole c->d->e chain AND any in-flight bench claim
+# are gone (one TPU process at a time — docs/perf.md operational
+# rules; an earlier draft gated only on part e and could have
+# stacked a claim on top of part c).
+while pgrep -f "chipwork_r04[cde].sh" >/dev/null 2>&1 \
+      || pgrep -f "python bench(_lm|_allreduce)?.py" >/dev/null 2>&1; do
+  sleep 120
+done
+
+probe_backend() {
+  timeout 7200 python - <<'PYEOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+PYEOF
+}
+wait_backend() {
+  echo "=== probing TPU backend $(date -u +%H:%M)" >&2
+  until probe_backend; do
+    echo "backend still down $(date -u +%H:%M); retry in 300s" >&2
+    sleep 300
+  done
+  echo "=== backend UP $(date -u +%H:%M)" >&2
+}
+run_one() {
+  local name="$1"; shift
+  local out="bench_results/${name}_${R}.json"
+  echo "=== $name $(date -u +%H:%M)" >&2
+  "$@" > "$out.tmp" 2> "bench_results/${name}_${R}.err"
+  if grep -qE '^\{' "$out.tmp"; then
+    grep -E '^\{' "$out.tmp" > "$out"
+    rm -f "$out.tmp" "bench_results/${name}_${R}.err"
+    cat "$out" >&2
+    return 0
+  fi
+  rm -f "$out.tmp"
+  return 1
+}
+cap() {
+  local name="$1"
+  local out="bench_results/${name}_${R}.json"
+  if [ -s "$out" ]; then
+    echo "=== $name already captured, skipping" >&2
+    return 0
+  fi
+  if run_one "$@"; then return 0; fi
+  echo "=== $name failed; gating on backend health before one retry" >&2
+  wait_backend
+  if run_one "$@"; then return 0; fi
+  echo "FAILED $name twice with backend up (see .err)" >&2
+  return 1
+}
+
+# Part f: chunked fused linear-cross-entropy A/B (ops/fused_xent.py,
+# BENCH_FUSED_XENT) — the round-4 HBM-traffic experiment on the LM
+# benches: same configs as the committed dense captures, plus the
+# memory-headroom config (batch 32, no remat) the fused loss is meant
+# to unlock.
+
+cap gpt2_fxent         env BENCH_MODEL=gpt2_medium BENCH_FUSED_XENT=1 python bench_lm.py
+cap gpt2_best_fxent    env BENCH_MODEL=gpt2_medium BENCH_BATCH=16 BENCH_REMAT=0 BENCH_FLASH_BLOCK=256 BENCH_FUSED_XENT=1 python bench_lm.py
+cap gpt2_b32_fxent     env BENCH_MODEL=gpt2_medium BENCH_BATCH=32 BENCH_REMAT=0 BENCH_FUSED_XENT=1 python bench_lm.py
+cap bert_fxent         env BENCH_MODEL=bert_large BENCH_BATCH=16 BENCH_REMAT=0 BENCH_FUSED_XENT=1 python bench_lm.py
+
+echo "=== chipwork_r04f complete $(date -u +%H:%M)" >&2

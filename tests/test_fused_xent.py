@@ -1,0 +1,182 @@
+"""Chunked fused linear-cross-entropy (ops/fused_xent.py) vs the
+materialized logits path — the numerics contract is exact equality of
+value AND gradients under compute_dtype=None, and matmul-precision
+agreement under the bf16 head recipe."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu.ops.fused_xent import (
+    _chunk_starts,
+    fused_linear_cross_entropy,
+)
+
+
+def _dense_loss(x, kernel, bias, labels, dtype):
+    if dtype is not None:
+        logits = jax.lax.dot_general(
+            x.astype(dtype), kernel.astype(dtype),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + bias[None, :].astype(jnp.float32)
+    else:
+        logits = x.astype(jnp.float32) @ kernel.astype(jnp.float32) + bias
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def _problem(n=24, d=16, vocab=101, seed=0, x_dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(n, d)), x_dtype)
+    kernel = jnp.asarray(rng.normal(size=(d, vocab)) * 0.2, jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(vocab,)) * 0.1, jnp.float32)
+    # hit every boundary class: 0, vocab-1, chunk edges
+    labels = jnp.asarray(
+        np.concatenate(
+            [[0, vocab - 1], rng.integers(0, vocab, size=n - 2)]
+        ),
+        jnp.int32,
+    )
+    return x, kernel, bias, labels
+
+
+def test_chunk_starts_cover_exactly():
+    for vocab, chunk in [(101, 32), (101, 101), (101, 1000), (64, 64),
+                         (64, 16), (7, 3), (1, 5)]:
+        spans = _chunk_starts(vocab, chunk)
+        cols = [c for s, w in spans for c in range(s, s + w)]
+        assert cols == list(range(vocab)), (vocab, chunk)
+
+
+@pytest.mark.parametrize("chunk", [16, 32, 101, 4096])
+def test_fp32_exact_match(chunk):
+    x, kernel, bias, labels = _problem()
+    want = _dense_loss(x, kernel, bias, labels, None)
+    got = fused_linear_cross_entropy(
+        x, kernel, bias, labels, chunk=chunk, compute_dtype=None
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("chunk", [16, 37, 101])
+def test_fp32_gradients_match(chunk):
+    x, kernel, bias, labels = _problem()
+
+    def fused(x, k, b):
+        return fused_linear_cross_entropy(
+            x, k, b, labels, chunk=chunk, compute_dtype=None
+        ).mean()
+
+    def dense(x, k, b):
+        return _dense_loss(x, k, b, labels, None).mean()
+
+    gf = jax.grad(fused, argnums=(0, 1, 2))(x, kernel, bias)
+    gd = jax.grad(dense, argnums=(0, 1, 2))(x, kernel, bias)
+    for got, want, name in zip(gf, gd, ("dx", "dW", "db")):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6,
+            err_msg=name,
+        )
+
+
+def test_bf16_head_recipe_agrees_with_dense_bf16():
+    x, kernel, bias, labels = _problem(n=32, d=32, vocab=257)
+
+    def fused(x, k, b):
+        return fused_linear_cross_entropy(
+            x, k, b, labels, chunk=64, compute_dtype=jnp.bfloat16
+        ).mean()
+
+    def dense(x, k, b):
+        return _dense_loss(x, k, b, labels, jnp.bfloat16).mean()
+
+    lv_f = fused(x, kernel, bias)
+    lv_d = dense(x, kernel, bias)
+    # same operand rounding, fp32 accumulation: only chunk-order of the
+    # logsumexp differs
+    np.testing.assert_allclose(float(lv_f), float(lv_d), rtol=5e-3)
+    gf = jax.grad(fused, argnums=(0, 1, 2))(x, kernel, bias)
+    gd = jax.grad(dense, argnums=(0, 1, 2))(x, kernel, bias)
+    for got, want, name in zip(gf, gd, ("dx", "dW", "db")):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=0.08, atol=5e-3, err_msg=name,
+        )
+
+
+def test_bf16_activations_gradient_dtype():
+    x, kernel, bias, labels = _problem(x_dtype=jnp.bfloat16)
+    dx = jax.grad(
+        lambda x: fused_linear_cross_entropy(
+            x, kernel, bias, labels, chunk=32
+        ).mean()
+    )(x)
+    assert dx.dtype == jnp.bfloat16
+
+
+def test_jit_and_shapes():
+    x, kernel, bias, labels = _problem()
+    f = jax.jit(
+        lambda x, k, b, l: fused_linear_cross_entropy(
+            x, k, b, l, chunk=32, compute_dtype=None
+        )
+    )
+    out = f(x, kernel, bias, labels)
+    assert out.shape == labels.shape and out.dtype == jnp.float32
+    with pytest.raises(ValueError, match="tokens, d_model"):
+        fused_linear_cross_entropy(x[None], kernel, bias, labels)
+    with pytest.raises(ValueError, match="labels shape"):
+        fused_linear_cross_entropy(x, kernel, bias, labels[:3])
+
+
+def test_transformer_hidden_path_matches_logits_path(hvd):
+    """model(..., return_hidden=True) + fused loss == logits + optax
+    loss on a tiny causal transformer (the bench_lm integration)."""
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    cfg = TransformerConfig.tiny(causal=True)
+    model = Transformer(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    labels = jnp.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens, train=False)
+
+    def dense_loss(p):
+        logits = model.apply(p, tokens, train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+
+    def fused_loss(p):
+        h = model.apply(p, tokens, train=False, return_hidden=True)
+        head = p["params"]["lm_head"]
+        return fused_linear_cross_entropy(
+            h.reshape(-1, cfg.d_model),
+            head["kernel"], head["bias"],
+            labels.reshape(-1),
+            chunk=64,
+            compute_dtype=cfg.dtype if cfg.head_mixed_precision else None,
+        ).mean()
+
+    np.testing.assert_allclose(
+        float(dense_loss(params)), float(fused_loss(params)), rtol=5e-3
+    )
+    gd = jax.grad(dense_loss)(params)
+    gf = jax.grad(fused_loss)(params)
+    flat_d = jax.tree_util.tree_leaves_with_path(gd)
+    flat_f = {jax.tree_util.keystr(k): v
+              for k, v in jax.tree_util.tree_leaves_with_path(gf)}
+    for key, want in flat_d:
+        got = flat_f[jax.tree_util.keystr(key)]
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=0.1, atol=6e-3, err_msg=jax.tree_util.keystr(key),
+        )

@@ -321,11 +321,9 @@ def test_tf_tape_with_fp16_compression(hvdtf):
     np.testing.assert_allclose(np.asarray(g), [4.0, 8.0])
 
 
-def test_tf_barrier_and_object_helpers(hvd):
+def test_tf_barrier_and_object_helpers(hvdtf):
     """hvd.tensorflow barrier/broadcast_object/allgather_object parity
     (ref: horovod/tensorflow/__init__.py [V])."""
-    import horovod_tpu.tensorflow as hvdtf
-
     hvdtf.barrier()
     obj = {"epoch": 3, "name": "x"}
     assert hvdtf.broadcast_object(obj, root_rank=0) == obj

@@ -516,9 +516,8 @@ def test_torch_barrier(hvd):
     import horovod_tpu.torch as hvdt
 
     hvdt.barrier()
-    ps = hvdt.add_process_set([0, 1]) if hasattr(hvdt, "add_process_set") else None
-    if ps is not None:
-        try:
-            hvdt.barrier(process_set=ps)
-        finally:
-            hvdt.remove_process_set(ps)
+    ps = hvdt.add_process_set([0, 1])
+    try:
+        hvdt.barrier(process_set=ps)
+    finally:
+        hvdt.remove_process_set(ps)
