@@ -137,7 +137,7 @@ def param_specs(cfg: ParallelTransformerConfig) -> Params:
         "tail": {
             "lnf_scale": P(),
             "lnf_bias": P(),
-            "lm_head": P(),
+            "lm_head": P(None, "tp"),  # vocab-parallel head (see loss)
             "moe": MoEParams(
                 router=P(),
                 w1=P("ep"),
@@ -225,11 +225,39 @@ def _forward_loss(params, tokens, labels, cfg: ParallelTransformerConfig):
     ).reshape(x.shape)
 
     x = _layer_norm(x, params["tail"]["lnf_scale"], params["tail"]["lnf_bias"])
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                        params["tail"]["lm_head"].astype(jnp.float32))
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    loss = nll.mean()
+    # Vocab-parallel cross-entropy (the Megatron-style tail; single-chip
+    # analog: ops/fused_xent.py). The head is sharded over "tp" on its
+    # vocabulary axis — each member computes only its (bt, V/tp) logit
+    # shard and the softmax statistics cross the axis as two scalars
+    # per token (pmax of the shard max, psum of the scaled expsum, psum
+    # of the masked target logit). Full-vocab logits never exist on any
+    # device, so head memory AND logit traffic scale down with tp.
+    tp_idx = lax.axis_index("tp")
+    head = params["tail"]["lm_head"]  # local shard: [d, V/tp]
+    v_local = head.shape[1]
+    logits = jnp.einsum(
+        "btd,dv->btv", x.astype(jnp.float32), head.astype(jnp.float32)
+    )
+    # stop_gradient BEFORE pmax: the stability shift carries no
+    # gradient, and pmax has no differentiation rule — a symbolically
+    # zero tangent keeps autodiff from ever asking for one
+    m = lax.pmax(jnp.max(lax.stop_gradient(logits), axis=-1), "tp")
+    s = lax.psum(
+        jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), "tp"
+    )
+    lse = m + jnp.log(s)
+    local = labels - tp_idx * v_local
+    hit = (local >= 0) & (local < v_local)
+    idx = jnp.clip(local, 0, v_local - 1)
+    target = lax.psum(
+        jnp.where(
+            hit,
+            jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0],
+            0.0,
+        ),
+        "tp",
+    )
+    loss = (lse - target).mean()
     return lax.pmean(loss, DATA_AXES)
 
 
@@ -270,6 +298,12 @@ def make_train_step(cfg: ParallelTransformerConfig, mesh: Mesh):
     specs = param_specs(cfg)
     data_spec = P(("dp", "ep"), "sp")
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = axis_sizes.get("tp", 1)
+    if cfg.vocab_size % tp:
+        raise ValueError(
+            f"vocab_size={cfg.vocab_size} must divide evenly over the "
+            f"tp axis ({tp}) for the vocab-parallel head"
+        )
 
     def per_device_step(params, tokens, labels):
         loss, grads = jax.value_and_grad(_forward_loss)(
