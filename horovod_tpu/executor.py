@@ -28,6 +28,24 @@ from .runner.rendezvous import RendezvousServer
 from .runner.secret import make_secret_key
 
 
+def _dump_payload(obj, f) -> None:
+    """Serialize the (fn, args, kwargs) payload. cloudpickle when
+    available (ref: horovod.spark serializes the train fn with
+    cloudpickle so closures and script-/notebook-defined functions
+    work [V]); plain pickle otherwise (importable-by-reference
+    functions only). The worker loads with stdlib ``pickle.load`` —
+    cloudpickle emits standard pickle bytecode — but a payload pickled
+    BY VALUE references cloudpickle internals, so multi-host jobs
+    shipping closures need cloudpickle importable on every worker
+    host too (same requirement as the reference's Spark workers)."""
+    try:
+        import cloudpickle as _cp
+    except ImportError:
+        pickle.dump(obj, f)
+    else:
+        _cp.dump(obj, f)
+
+
 def _default_coordinator_port() -> int:
     """Per-job pseudo-random coordinator port: the port binds on worker
     0's host, unprobeable from the driver, so freeness can't be
@@ -154,7 +172,7 @@ class Executor:
         ) as tmp:
             payload = os.path.join(tmp, "payload.pkl")
             with open(payload, "wb") as f:
-                pickle.dump((fn, tuple(args), kwargs), f)
+                _dump_payload((fn, tuple(args), kwargs), f)
             out_dir = os.path.join(tmp, "out")
             os.makedirs(out_dir)
             code, expected_ranks = self._launch(payload, out_dir)
@@ -233,6 +251,48 @@ def run(
     num_proc)`` [V]: each "task" is one rank; returns all ranks'
     results."""
     with Executor(num_workers=num_proc or 1, **executor_kwargs) as ex:
+        return ex.run(fn, args=args, kwargs=kwargs)
+
+
+def run_elastic(
+    fn: Callable,
+    args: Sequence = (),
+    kwargs: Optional[dict] = None,
+    num_proc: Optional[int] = None,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    discovery=None,
+    **executor_kwargs,
+) -> List[Any]:
+    """One-shot ELASTIC form — parity with
+    ``horovod.spark.run_elastic(fn, args, num_proc, min_np, max_np)``
+    [V]: run ``fn`` under ``hvd.elastic`` semantics (commit/restore
+    State, gang restart on failure or membership change) and return
+    the final successful gang's results ordered by rank.
+
+    Without a ``discovery`` source the gang is a fixed local one of
+    ``num_proc`` slots — the elastic machinery over static membership,
+    which is exactly the reference's shape on a static Spark cluster
+    (workers can still fail and be relaunched; capacity just never
+    grows). Pass any ``elastic.discovery.HostDiscovery`` for dynamic
+    membership."""
+    n = int(num_proc or 1)
+    if discovery is None:
+        from .elastic.discovery import FixedHosts
+        from .runner.hosts import HostInfo
+
+        discovery = FixedHosts([HostInfo(hostname="127.0.0.1", slots=n)])
+        if max_np is None:
+            max_np = n
+    # With a caller-supplied discovery, absent max_np stays UNBOUNDED
+    # (the reference's semantics); coercing it to num_proc's default
+    # could silently cap the gang below min_np.
+    with ElasticRayExecutor(
+        min_np=int(min_np or n),
+        max_np=None if max_np is None else int(max_np),
+        discovery=discovery,
+        **executor_kwargs,
+    ) as ex:
         return ex.run(fn, args=args, kwargs=kwargs)
 
 
@@ -551,7 +611,7 @@ class ElasticRayExecutor:
         ) as tmp:
             payload = os.path.join(tmp, "payload.pkl")
             with open(payload, "wb") as f:
-                pickle.dump((fn, tuple(args), kwargs), f)
+                _dump_payload((fn, tuple(args), kwargs), f)
             out_dir = os.path.join(tmp, "out")
             os.makedirs(out_dir)
             command = [

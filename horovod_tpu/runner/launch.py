@@ -319,6 +319,13 @@ def worker_envs(
             env["HOROVOD_NUM_PROCESSES"] = str(n_proc)
             env["HOROVOD_PROCESS_ID"] = str(i)
             env.setdefault("JAX_PLATFORMS", "cpu")
+            # Per-slot is the CPU-backend local mode by contract; an
+            # ambient axon/TPU PJRT plugin would override JAX_PLATFORMS
+            # via sitecustomize and every rank would sit in the
+            # exclusive chip-claim queue until start_timeout. Empty
+            # pool = plugin registers nothing, CPU wins. Caller-passed
+            # env (extra) still overrides.
+            env.setdefault("PALLAS_AXON_POOL_IPS", "")
             # One device per slot, whatever the ambient XLA_FLAGS say —
             # an inherited --xla_force_host_platform_device_count=8
             # (e.g. from a test harness) would give every rank 8 local

@@ -39,6 +39,7 @@ from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
 import numpy as np
 
 from ..executor import run  # noqa: F401  — horovod.spark.run parity
+from ..executor import run_elastic  # noqa: F401  — run_elastic parity
 
 
 class Store:
