@@ -187,6 +187,13 @@ def main():
         "remat": remat,
         "head": "mixed" if cfg.head_mixed_precision else "fp32",
         "xent": "fused" if fused_xent else "dense",
+        # provenance: the kernel auto-shrinks to the sequence, so record
+        # the EFFECTIVE block, not the config ask (r04 flipped the
+        # default 128->512 mid-capture-chain; without this field those
+        # artifacts would be indistinguishable)
+        "flash_block": (
+            _effective_block(seq, cfg) if cfg.uses_flash(seq=seq) else None
+        ),
         "platform": jax.devices()[0].platform,
     }
     result.update(mfu_fields(flops, iters, dt, jax.devices()[0].platform,
@@ -194,6 +201,12 @@ def main():
     if flops_note:
         result["flops_note"] = flops_note
     print(json.dumps(result))
+
+
+def _effective_block(seq, cfg):
+    from horovod_tpu.ops.flash_attention import _pick_block
+
+    return _pick_block(seq, cfg.flash_block_q)
 
 
 def dataclasses_replace(cfg, **kw):

@@ -26,9 +26,14 @@ def main() -> None:
         # reading a larger earlier epoch's stale results.
         out_dir = os.path.join(out_dir, f"epoch.{epoch}")
         os.makedirs(out_dir, exist_ok=True)
-    with open(payload_path, "rb") as f:
-        fn, args, kwargs = pickle.load(f)
     try:
+        # Inside the reporting block: unpickle failure (e.g. a payload
+        # cloudpickled by value on a driver whose cloudpickle the worker
+        # host lacks) must surface as an ('error', ...) result, not as
+        # a missing result file the driver reports as 'produced no
+        # result'.
+        with open(payload_path, "rb") as f:
+            fn, args, kwargs = pickle.load(f)
         value = fn(*args, **kwargs)
         result = ("ok", value)
     except BaseException as exc:  # report, don't swallow

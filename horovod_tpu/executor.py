@@ -276,11 +276,20 @@ def run_elastic(
     (workers can still fail and be relaunched; capacity just never
     grows). Pass any ``elastic.discovery.HostDiscovery`` for dynamic
     membership."""
-    n = int(num_proc or 1)
+    # A fixed local gang must be able to reach min_np — num_proc=None
+    # with min_np=2 would otherwise build a 1-slot gang that can never
+    # form and die as an opaque start_timeout 600s later.
+    n = max(int(num_proc or 1), int(min_np or 1))
     if discovery is None:
         from .elastic.discovery import FixedHosts
         from .runner.hosts import HostInfo
 
+        if num_proc is not None and int(num_proc) < int(min_np or 1):
+            raise ValueError(
+                f"run_elastic: num_proc={num_proc} is below "
+                f"min_np={min_np} and no discovery source was given — "
+                "the fixed local gang could never satisfy min_np"
+            )
         discovery = FixedHosts([HostInfo(hostname="127.0.0.1", slots=n)])
         if max_np is None:
             max_np = n

@@ -234,15 +234,21 @@ def _pick_block(seq: int, preferred: int) -> int:
     return max(b, 1)
 
 
-def supports_seq(t: int, block_q: int = 128, block_k: int = 128) -> bool:
+def supports_seq(t: int, block_q: int = 512, block_k: int = 512) -> bool:
     """Whether the kernels can tile this sequence length. Mosaic needs
     each block's trailing dims to be (8k, 128k)-aligned or the full
-    array dim, so the auto-shrunk block must stay >= 8 or cover the
-    whole sequence. Prime-ish lengths (e.g. ViT's 14*14+1 = 197
-    tokens) fail and must take the dense path."""
-    bq = _pick_block(t, block_q)
-    bk = _pick_block(t, block_k)
-    return (bq >= 8 or bq == t) and (bk >= 8 or bk == t)
+    array dim; we additionally require the chosen block to be 8-aligned
+    (sublane) unless the whole sequence is shorter than one sublane —
+    full-dim unaligned tiles (e.g. ViT's 14*14+1 = 197 tokens) were
+    never validated on hardware and take the dense path. (Before r04
+    the check accepted ANY t <= preferred via the full-dim early-out;
+    raising the preferred block to 512 would have silently routed 197
+    through the kernel.)"""
+
+    def ok(b: int) -> bool:
+        return b % 8 == 0 or (b == t and t < 8)
+
+    return ok(_pick_block(t, block_q)) and ok(_pick_block(t, block_k))
 
 
 @functools.partial(
@@ -367,8 +373,8 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
 ) -> jax.Array:
     """Attention over [batch, seq, heads, head_dim] tensors (the model
     layout), softmax scale 1/√d. Differentiable (custom VJP, blockwise
