@@ -281,6 +281,27 @@ def test_spark_run_elastic_parity():
     assert results == ["0", "1"]
 
 
+def test_run_elastic_rejects_gang_below_min_np():
+    """num_proc < min_np on a fixed local gang can never form: must be
+    an immediate ValueError, not an opaque start_timeout 600s later."""
+    from horovod_tpu.executor import run_elastic
+
+    with pytest.raises(ValueError, match="min_np"):
+        run_elastic(os.getenv, num_proc=1, min_np=2)
+
+
+def test_run_elastic_sizes_default_gang_to_min_np():
+    """num_proc omitted + min_np set: the fixed local gang is sized to
+    min_np (the reference defaults num_proc to cluster parallelism, not
+    1 — a 1-slot gang would deadlock against min_np=2)."""
+    from horovod_tpu.executor import run_elastic
+
+    results = run_elastic(
+        os.getenv, args=("HOROVOD_RANK",), min_np=2, start_timeout=120.0
+    )
+    assert results == ["0", "1"]
+
+
 @pytest.mark.slow
 def test_run_ships_closures_and_real_collectives():
     """The payload must travel by VALUE (cloudpickle), not by module
