@@ -163,14 +163,28 @@ class TestStaleArtifactFallback:
         assert parsed["value"] == 2577.0
 
     def test_orchestrator_reprints_stale_tpu_line(self, tmp_path):
-        self._write(tmp_path, "resnet50_r03.json", self._tpu_line(2577.0))
+        art = self._tpu_line(2585.0)
+        art["stem"] = "space_to_depth"  # the r04 default config
+        self._write(tmp_path, "resnet50_s2d_r04.json", art)
         proc = self._run_orchestrator(tmp_path, {})
         assert proc.returncode == 0, proc.stderr[-2000:]
         line = json.loads(proc.stdout.strip().splitlines()[-1])
-        assert line["value"] == 2577.0
+        assert line["value"] == 2585.0
         assert line["platform"] == "tpu"
         assert line["stale"] is True
         assert "captured_at" in line and "source" in line
+
+    def test_orchestrator_never_substitutes_conv7_for_default(self, tmp_path):
+        """Artifacts predating the stem field were conv7 captures; the
+        r04 space_to_depth default must not reprint them (3% apart —
+        provenance over availability)."""
+        self._write(tmp_path, "resnet50_r03.json", self._tpu_line(2577.0))
+        proc = self._run_orchestrator(tmp_path, {"BENCH_PLATFORM": ""})
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        # falls past the stale rung: either the CPU fallback (also dies
+        # under BENCH_FAIL_INNER here) -> diagnostic value-0 line
+        assert not line.get("stale")
+        assert line["value"] == 0.0
 
     def test_orchestrator_diagnostic_line_when_nothing_left(self, tmp_path):
         """No stale artifact + CPU fallback also fails: still ONE
