@@ -10,7 +10,10 @@ riding ICI via XLA collectives.
 """
 
 from .mesh import MeshSpec  # noqa: F401
-from .ring_attention import ring_attention  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_flash_attention,
+)
 from .tp import column_parallel_dense, row_parallel_dense  # noqa: F401
 from .pipeline import gpipe  # noqa: F401
 from .moe import MoEParams, moe_ffn, init_moe_params  # noqa: F401

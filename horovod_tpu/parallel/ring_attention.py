@@ -165,3 +165,192 @@ def _ring_attention_bwd(axis_name, causal, res, do):
 
 
 ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention with the Pallas flash kernels doing the block math.
+#
+# The dense ring above materializes each hop's [B,H,Tq,Tk] score matrix
+# in fp32 HBM; at long context that matrix is the whole memory story.
+# The flash kernels never materialize it — so the TPU-native long-
+# context path is: per hop, run the flash FORWARD on (q, k_hop, v_hop)
+# to get that hop's locally-softmaxed output and logsumexp, then merge
+# partials online (exact: o = Σ w_i·o_i with w_i = exp(lse_i − lse),
+# lse = logaddexp over hops). The backward is a second ring pass
+# invoking the flash backward kernels per hop with the GLOBAL (o, lse)
+# — they compute p = exp(s − lse) against whatever lse they are handed,
+# which with the global value yields exactly that hop's share of
+# dq/dk/dv (the same algebra as the dense second pass above).
+#
+# Causality across hops is block-structured: a hop whose K block
+# originates strictly before this chip's shard is fully visible
+# (causal=False kernel), the diagonal hop masks within the kernel
+# (causal=True), and future blocks are skipped. The three cases are a
+# lax.switch on the (traced) origin rank.
+# ---------------------------------------------------------------------------
+
+
+def _to_bhtd(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bhtd(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _ring_flash_fwd_pass(q, k, v, axis_name, causal):
+    from ..ops.flash_attention import _flash_fwd, _pick_block
+
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    bq = _pick_block(t, 512)
+    bk = _pick_block(t, 512)
+    qb = _to_bhtd(q)
+    kb = _to_bhtd(k)
+    vb = _to_bhtd(v)
+    perm = _ring_perm(sp)
+
+    def full_hop(kv):
+        o, lse = _flash_fwd(qb, kv[0], kv[1], False, bq, bk)
+        return o.astype(jnp.float32), lse[..., 0]
+
+    def diag_hop(kv):
+        o, lse = _flash_fwd(qb, kv[0], kv[1], True, bq, bk)
+        return o.astype(jnp.float32), lse[..., 0]
+
+    def skip_hop(kv):
+        return (
+            jnp.zeros(qb.shape, jnp.float32),
+            jnp.full(qb.shape[:2], -jnp.inf, jnp.float32),
+        )
+
+    def step(carry, i):
+        k_cur, v_cur, o_acc, lse_acc = carry
+        src = (my - i) % sp
+        if causal:
+            case = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            o_i, lse_i = lax.switch(
+                case, (full_hop, diag_hop, skip_hop), (k_cur, v_cur)
+            )
+        else:
+            o_i, lse_i = full_hop((k_cur, v_cur))
+        # online merge of softmax partials (both o's are normalized)
+        m = jnp.maximum(lse_acc, lse_i)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        w_acc = jnp.exp(jnp.where(jnp.isfinite(lse_acc), lse_acc - m_safe, -jnp.inf))
+        w_i = jnp.exp(jnp.where(jnp.isfinite(lse_i), lse_i - m_safe, -jnp.inf))
+        denom = w_acc + w_i
+        denom_safe = jnp.maximum(denom, 1e-30)
+        o_acc = (o_acc * w_acc[..., None] + o_i * w_i[..., None]) / denom_safe[
+            ..., None
+        ]
+        lse_acc = m_safe + jnp.log(denom_safe)
+        lse_acc = jnp.where(denom > 0, lse_acc, -jnp.inf)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, o_acc, lse_acc), None
+
+    o0 = jnp.zeros(qb.shape, jnp.float32)
+    lse0 = jnp.full(qb.shape[:2], -jnp.inf, jnp.float32)
+    (_, _, o, lse), _ = lax.scan(step, (kb, vb, o0, lse0), jnp.arange(sp))
+    # every query attends to at least its own position under causal, so
+    # lse is finite here; the guard above only protects intermediates
+    return _from_bhtd(o, b, h).astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_flash_attention(
+    q, k, v, axis_name: str = "sp", causal: bool = False
+):
+    """`ring_attention` with the Pallas flash kernels as the block
+    engine: same exact math and [B, T_local, H, Dh] contract, but no
+    hop ever materializes a score matrix in HBM — per-hop memory is
+    O(T_local·Dh) + the kernel's VMEM tiles. Requires a flash-tileable
+    local sequence (`ops.flash_attention.supports_seq`); use
+    `ring_attention` for odd lengths or non-TPU backends (the kernels
+    run in interpret mode off-TPU — correct but slow, tests only)."""
+    out, _ = _ring_flash_fwd_pass(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_flash_attention_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_flash_fwd_pass(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_attention_bwd(axis_name, causal, res, do):
+    from ..ops.flash_attention import _flash_bwd_vjp, _pick_block
+
+    q, k, v, out, lse = res
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    bq = _pick_block(t, 512)
+    bk = _pick_block(t, 512)
+    qb = _to_bhtd(q)
+    kb = _to_bhtd(k)
+    vb = _to_bhtd(v)
+    ob = _to_bhtd(out)
+    dob = _to_bhtd(do)
+    perm = _ring_perm(sp)
+
+    def full_hop(kv):
+        dq, dk, dv = _flash_bwd_vjp(
+            False, bq, bk, (qb, kv[0], kv[1], ob, lse), dob
+        )
+        return (
+            dq.astype(jnp.float32),
+            dk.astype(jnp.float32),
+            dv.astype(jnp.float32),
+        )
+
+    def diag_hop(kv):
+        dq, dk, dv = _flash_bwd_vjp(
+            True, bq, bk, (qb, kv[0], kv[1], ob, lse), dob
+        )
+        return (
+            dq.astype(jnp.float32),
+            dk.astype(jnp.float32),
+            dv.astype(jnp.float32),
+        )
+
+    def skip_hop(kv):
+        z = jnp.zeros(qb.shape, jnp.float32)
+        return (z, z, z)
+
+    def step(carry, i):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        src = (my - i) % sp
+        if causal:
+            case = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            dq_i, dk_i, dv_i = lax.switch(
+                case, (full_hop, diag_hop, skip_hop), (k_cur, v_cur)
+            )
+        else:
+            dq_i, dk_i, dv_i = full_hop((k_cur, v_cur))
+        dq = dq + dq_i
+        dk_cur = dk_cur + dk_i
+        dv_cur = dv_cur + dv_i
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        dk_next = lax.ppermute(dk_cur, axis_name, perm)
+        dv_next = lax.ppermute(dv_cur, axis_name, perm)
+        return (k_next, v_next, dk_next, dv_next, dq), None
+
+    z = jnp.zeros(qb.shape, jnp.float32)
+    (_, _, dk, dv, dq), _ = lax.scan(
+        step, (kb, vb, z, z, z), jnp.arange(sp)
+    )
+    return (
+        _from_bhtd(dq, b, h).astype(q.dtype),
+        _from_bhtd(dk, b, h).astype(k.dtype),
+        _from_bhtd(dv, b, h).astype(v.dtype),
+    )
+
+
+ring_flash_attention.defvjp(
+    _ring_flash_attention_fwd, _ring_flash_attention_bwd
+)
