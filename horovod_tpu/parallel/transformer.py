@@ -46,6 +46,7 @@ cross-mesh equivalence test).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Tuple
 
 import jax
@@ -57,7 +58,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import MeshSpec
 from .moe import MoEParams, init_moe_params, moe_ffn
 from .pipeline import gpipe
-from .ring_attention import ring_attention
+from .ring_attention import ring_attention, ring_flash_attention
 from .tp import column_parallel_dense, row_parallel_dense
 
 
@@ -74,6 +75,12 @@ class ParallelTransformerConfig:
     n_microbatches: int = 2
     dtype: Any = jnp.float32
     learning_rate: float = 1e-2
+    # SP attention engine. "auto": Pallas flash-block ring
+    # (ring_flash_attention) on TPU when the local sequence shard is
+    # flash-tileable, dense ring otherwise. True forces the flash ring
+    # on any backend (interpret-mode kernels off-TPU — tests), False
+    # forces the dense ring.
+    flash_ring: Any = "auto"
 
 
 Params = Dict[str, Any]
@@ -166,13 +173,14 @@ def _layer_norm(x, scale, bias):
     return ((xf - mu) * lax.rsqrt(var + 1e-5) * scale + bias).astype(x.dtype)
 
 
-def _block(layer_params, x):
+def _block(layer_params, x, use_flash_ring=False):
     """One transformer block, per-device view: heads/FFN tp-sharded,
     sequence sp-sharded (ring attention handles the full context)."""
     h = _layer_norm(x, layer_params["ln1_scale"], layer_params["ln1_bias"])
     qkv = jnp.einsum("btd,dchx->btchx", h, layer_params["wqkv"])
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H/tp,hd]
-    attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+    attn_fn = ring_flash_attention if use_flash_ring else ring_attention
+    attn = attn_fn(q, k, v, axis_name="sp", causal=True)
     proj = jnp.einsum("bthx,hxd->btd", attn, layer_params["wo"])
     x = x + lax.psum(proj, "tp")
     h = _layer_norm(x, layer_params["ln2_scale"], layer_params["ln2_bias"])
@@ -182,11 +190,20 @@ def _block(layer_params, x):
     return x + h + layer_params["b2"]
 
 
-def _stage_fn(stage_params, x):
+def _resolve_flash_ring(cfg: "ParallelTransformerConfig", t_local: int):
+    """Trace-time engine choice (backend + tileability are static)."""
+    from ..ops.flash_attention import supports_seq
+
+    if cfg.flash_ring == "auto":
+        return jax.default_backend() == "tpu" and supports_seq(t_local)
+    return bool(cfg.flash_ring)
+
+
+def _stage_fn(stage_params, x, use_flash_ring=False):
     """Apply this pp stage's layer stack (scan over its layers)."""
 
     def body(h, layer):
-        return _block(layer, h), None
+        return _block(layer, h, use_flash_ring), None
 
     out, _ = lax.scan(body, x, stage_params)
     return out
@@ -207,7 +224,13 @@ def _forward_loss(params, tokens, labels, cfg: ParallelTransformerConfig):
     b_local = x.shape[0]
     n_micro = min(cfg.n_microbatches, b_local)
     xm = x.reshape(n_micro, b_local // n_micro, t_local, -1)
-    out = gpipe(_stage_fn, params["stages"], xm, axis_name="pp")
+    use_flash_ring = _resolve_flash_ring(cfg, t_local)
+    out = gpipe(
+        functools.partial(_stage_fn, use_flash_ring=use_flash_ring),
+        params["stages"],
+        xm,
+        axis_name="pp",
+    )
     # Output lives on the last pp stage; broadcast to all stages so the
     # tail (loss) is computed everywhere (keeps the program SPMD-uniform).
     pp = lax.axis_size("pp")

@@ -165,7 +165,7 @@ def test_mesh_spec():
         MeshSpec(dp=3).build(jax.devices())
 
 
-def _run_steps(spec, n_steps=1, lr=0.05, seed=0):
+def _run_steps(spec, n_steps=1, lr=0.05, seed=0, **cfg_overrides):
     import jax
 
     from horovod_tpu.parallel.transformer import (
@@ -185,6 +185,7 @@ def _run_steps(spec, n_steps=1, lr=0.05, seed=0):
         n_microbatches=2,
         moe_capacity_factor=8.0,  # no drops → layout-independent routing
         learning_rate=lr,
+        **cfg_overrides,
     )
     mesh = spec.build(jax.devices()[: spec.size])
     params = make_sharded_params(cfg, mesh, jax.random.PRNGKey(seed))
@@ -226,6 +227,24 @@ def test_parallel_step_matches_dp_baseline(hvd, spec):
             rtol=5e-4,
             atol=1e-5,
             err_msg=f"param mismatch under {spec} at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_parallel_step_flash_ring_matches_dp_baseline(hvd):
+    """The composed transformer with the flash-block ring engine
+    (flash_ring=True — interpret-mode kernels on CPU) must take the
+    SAME training step as the dense-ring dp baseline."""
+    base_params, base_losses = _run_steps(MeshSpec(dp=2), n_steps=1)
+    test_params, test_losses = _run_steps(
+        MeshSpec(dp=2, sp=2, tp=2), n_steps=1, flash_ring=True
+    )
+    np.testing.assert_allclose(base_losses, test_losses, rtol=1e-5)
+    flat_base, _ = jax.tree_util.tree_flatten_with_path(base_params)
+    flat_test = jax.tree_util.tree_leaves(test_params)
+    for (path, b), t in zip(flat_base, flat_test):
+        np.testing.assert_allclose(
+            b, t, rtol=5e-4, atol=1e-5,
+            err_msg=f"flash-ring param mismatch at {jax.tree_util.keystr(path)}",
         )
 
 
