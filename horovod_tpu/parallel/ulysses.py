@@ -65,12 +65,20 @@ def ulysses_attention(
     """All-to-all sequence-parallel attention (module docstring).
 
     ``attn_fn(q, k, v, causal)`` runs the full-sequence attention on
-    the head shard — defaults to the dense fp32-softmax oracle; pass
-    ``ops.flash_attention.flash_attention`` on TPU for O(T) memory in
-    the inner step too.
+    the head shard. Default (None) resolves at trace time the same way
+    the composed transformer's ``flash_ring='auto'`` does: the Pallas
+    flash kernel on TPU when the FULL sequence (sp·t_local — that is
+    what the inner attention sees post-exchange) is flash-tileable,
+    the dense fp32-softmax oracle otherwise. Pass a callable to
+    override either way.
     """
     sp = lax.axis_size(axis_name)
     b, t_local, h, d = q.shape
+    if attn_fn is None:
+        from ..ops.flash_attention import flash_attention, supports_seq
+
+        if jax.default_backend() == "tpu" and supports_seq(t_local * sp):
+            attn_fn = flash_attention
     if h % sp:
         raise ValueError(
             f"ulysses_attention needs heads ({h}) divisible by the "

@@ -84,6 +84,34 @@ def test_gradients_match_dense(hvd):
         )
 
 
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_flash_inner_matches_dense_oracle(hvd, causal):
+    """attn_fn=flash_attention (the TPU 'auto' choice, interpret-mode
+    kernels here) must agree with the dense oracle through the
+    all-to-all exchanges."""
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    mesh = hvd_pkg.mesh()
+    q, k, v = _qkv(3)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, hvd_pkg.WORLD_AXIS), P(None, hvd_pkg.WORLD_AXIS),
+                  P(None, hvd_pkg.WORLD_AXIS)),
+        out_specs=P(None, hvd_pkg.WORLD_AXIS),
+        check_vma=False,
+    )
+    def sharded(q, k, v):
+        return ulysses_attention(
+            q, k, v, axis_name=hvd_pkg.WORLD_AXIS, causal=causal,
+            attn_fn=flash_attention,
+        )
+
+    got = np.asarray(jax.jit(sharded)(q, k, v))
+    want = np.asarray(dense_attention_oracle(q, k, v, causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
 def test_head_poor_model_rejected(hvd):
     mesh = hvd_pkg.mesh()
     q = k = v = jnp.zeros((1, 8, 4, 8), jnp.float32)  # 4 heads < sp=8
