@@ -59,6 +59,14 @@ def test_transformer_lm_example():
 
 
 @pytest.mark.slow
+def test_long_context_ring_example():
+    out = _run_example(
+        "long_context_ring.py", "--seq-len", "512", "--steps", "4"
+    )
+    assert "512 tokens over 8 chips" in out
+
+
+@pytest.mark.slow
 def test_elastic_example():
     out = _run_example("elastic_train.py")
     assert "elastic training complete" in out
