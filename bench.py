@@ -336,10 +336,17 @@ def orchestrate():
     # write stem/batch variants under the same metric).
     stale_config = {
         "batch": (int(os.environ.get("BENCH_BATCH", "256")), 256),
-        # default matches inner_main's default; artifacts predating the
-        # stem field were conv7 captures
-        "stem": (os.environ.get("BENCH_STEM", "space_to_depth"), "conv7"),
     }
+    if os.environ.get("BENCH_MODEL", "resnet50").startswith("resnet"):
+        # Only resnets have a stem variant, and inner_main only stamps
+        # "stem" on resnet artifacts — gating every model on it would
+        # reject valid ViT/Inception/VGG artifacts (which omit the key)
+        # against the conv7 omission-default. Artifacts predating the
+        # stem field were conv7 captures.
+        stale_config["stem"] = (
+            os.environ.get("BENCH_STEM", "space_to_depth"),
+            "conv7",
+        )
 
     def _find_stale():
         if not stale_ok or forced:

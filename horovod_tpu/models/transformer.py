@@ -20,6 +20,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..ops.flash_attention import DEFAULT_BLOCK as _DEFAULT_FLASH_BLOCK
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -41,14 +43,15 @@ class TransformerConfig:
     flash_attention: Any = "auto"
     # Flash kernel block sizes (tunable: bigger blocks = fewer K/V loop
     # iterations and larger MXU matmuls, more VMEM per program). Auto-
-    # shrunk to the sequence length when it is shorter. Default 512 won
-    # the round-4 on-chip sweep on GPT-2-medium seq-512 (83.0 samp/s /
-    # MFU 0.563 vs 60.3 / 0.409 at 128 — bench_results/gpt2_blk*_r04);
-    # VMEM per program stays modest because K/V are staged whole-sequence
-    # regardless of block_k, so bigger blocks only grow the (block_q,
-    # block_k) score tile (512x512 fp32 = 1 MiB).
-    flash_block_q: int = 512
-    flash_block_k: int = 512
+    # shrunk to the sequence length when it is shorter. The default
+    # (ops.flash_attention.DEFAULT_BLOCK = 512) won the round-4 on-chip
+    # sweep on GPT-2-medium seq-512 (83.0 samp/s / MFU 0.563 vs 60.3 /
+    # 0.409 at 128 — bench_results/gpt2_blk*_r04); VMEM per program
+    # stays modest because K/V are staged whole-sequence regardless of
+    # block_k, so bigger blocks only grow the (block_q, block_k) score
+    # tile (512x512 fp32 = 1 MiB).
+    flash_block_q: int = _DEFAULT_FLASH_BLOCK
+    flash_block_k: int = _DEFAULT_FLASH_BLOCK
     # LM head precision. True (default): bf16 operands on the MXU with
     # fp32 accumulation (preferred_element_type) and fp32 logits out —
     # the standard TPU head recipe; input rounding is bf16-epsilon on

@@ -206,8 +206,8 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal):
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
-    bq = _pick_block(t, 512)
-    bk = _pick_block(t, 512)
+    bq = _pick_block(t)  # DEFAULT_BLOCK preference, shared with the gate
+    bk = _pick_block(t)
     qb = _to_bhtd(q)
     kb = _to_bhtd(k)
     vb = _to_bhtd(v)
@@ -288,8 +288,8 @@ def _ring_flash_attention_bwd(axis_name, causal, res, do):
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
-    bq = _pick_block(t, 512)
-    bk = _pick_block(t, 512)
+    bq = _pick_block(t)  # must match the fwd pass tiling
+    bk = _pick_block(t)
     qb = _to_bhtd(q)
     kb = _to_bhtd(k)
     vb = _to_bhtd(v)

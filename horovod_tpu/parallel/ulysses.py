@@ -34,6 +34,12 @@ import jax.numpy as jnp
 from jax import lax
 
 
+# VMEM guard for the flash auto-gate: the flash kernels stage whole-
+# sequence K/V per program, so past this full-sequence length the auto
+# choice falls back to the dense path (explicit attn_fn overrides).
+_FLASH_AUTO_MAX_SEQ = 8192
+
+
 def _dense_attention(q, k, v, causal: bool):
     """fp32-softmax reference attention over [B, T, H, D] — the SAME
     precision convention as the repo-wide test oracle
@@ -77,7 +83,18 @@ def ulysses_attention(
     if attn_fn is None:
         from ..ops.flash_attention import flash_attention, supports_seq
 
-        if jax.default_backend() == "tpu" and supports_seq(t_local * sp):
+        full_t = t_local * sp
+        # The kernels stage K and V whole-sequence in VMEM per program,
+        # so the auto-gate also caps the post-exchange sequence length
+        # (~2 MB per bf16 operand at 8192·128 — comfortably inside a
+        # v5e core's ~16 MB VMEM; beyond that, per the module
+        # docstring, extreme T is ring territory). Pass attn_fn
+        # explicitly to override.
+        if (
+            jax.default_backend() == "tpu"
+            and supports_seq(full_t)
+            and full_t <= _FLASH_AUTO_MAX_SEQ
+        ):
             attn_fn = flash_attention
     if h % sp:
         raise ValueError(
