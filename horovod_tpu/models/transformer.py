@@ -121,26 +121,29 @@ class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, lengths=None):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
         qkv = nn.DenseGeneral(
             (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
         )(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        # lengths (right-padding) stays on the flash path — the kernels
+        # take it natively; only ARBITRARY masks force dense.
         use_flash = cfg.uses_flash(mask, seq=x.shape[1])
         if cfg.flash_attention and cfg.flash_attention != "auto" and (
             mask is not None
         ):
-            # Explicit True + padding mask: the flash kernel implements
-            # only the causal mask, so this degrades to the dense path.
-            # Loud, not silent.
+            # Explicit True + arbitrary mask: the flash kernel
+            # implements only causal + right-padding masking, so this
+            # degrades to the dense path. Loud, not silent.
             import warnings
 
             warnings.warn(
                 "flash_attention=True but a padding mask was passed; "
                 "falling back to dense attention (the flash path "
-                "supports the causal mask only)",
+                "supports causal and lengths= masking only — pass "
+                "lengths for right-padded batches)",
                 stacklevel=2,
             )
         if use_flash:
@@ -149,6 +152,7 @@ class MultiHeadAttention(nn.Module):
             out = flash_attention(
                 q, k, v, causal=cfg.causal,
                 block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                lengths=lengths,
             )
             return nn.DenseGeneral(
                 cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
@@ -161,10 +165,23 @@ class MultiHeadAttention(nn.Module):
             t = x.shape[1]
             causal_mask = jnp.tril(jnp.ones((t, t), bool))
             scores = jnp.where(causal_mask[None, None], scores, -1e30)
+        if lengths is not None and mask is None:
+            # dense twin of the kernel's lengths contract
+            mask = (
+                jnp.arange(x.shape[1])[None, :]
+                < jnp.asarray(lengths)[:, None]
+            )
         if mask is not None:
             scores = jnp.where(mask[:, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if lengths is not None:
+            # match the flash path: padded query rows are zero
+            valid = (
+                jnp.arange(x.shape[1])[None, :]
+                < jnp.asarray(lengths)[:, None]
+            )
+            out = jnp.where(valid[:, :, None, None], out, 0.0)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
         )(out)
@@ -174,10 +191,10 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = True):
+    def __call__(self, x, mask=None, train: bool = True, lengths=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = MultiHeadAttention(cfg)(h, mask)
+        h = MultiHeadAttention(cfg)(h, mask, lengths)
         h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -226,7 +243,7 @@ class Transformer(nn.Module):
     @nn.compact
     def __call__(
         self, tokens, mask=None, train: bool = True,
-        return_hidden: bool = False,
+        return_hidden: bool = False, lengths=None,
     ):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype)(tokens)
@@ -238,7 +255,7 @@ class Transformer(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(3,))
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x, mask, train)
+            x = block(cfg, name=f"block_{i}")(x, mask, train, lengths)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         if return_hidden:
             # pre-head activations for the chunked fused loss

@@ -82,14 +82,36 @@ def _apply_causal_mask(s, qi, j, block_q, block_k):
     return jnp.where(rows >= cols, s, _NEG_INF)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k):
+def _apply_length_mask(s, j, block_k, kv_len):
+    """Mask key columns at or beyond the sequence's valid length."""
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1
+    )
+    return jnp.where(cols < kv_len, s, _NEG_INF)
+
+
+def _length_bound(kv_len, block_k, n_blocks):
+    """K-block iteration bound under padding: blocks wholly past the
+    valid length contribute nothing."""
+    return jnp.minimum(n_blocks, (kv_len + block_k - 1) // block_k)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
+                block_q, block_k, padded=False):
+    if padded:
+        len_ref, o_ref, lse_ref = rest
+        kv_len = len_ref[0, 0]
+    else:
+        o_ref, lse_ref = rest
+        kv_len = None
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
     seq_k = k_ref.shape[1]
     n_blocks = seq_k // block_k
     if causal:
         n_blocks = _causal_bound(qi, block_q, block_k, n_blocks)
+    if padded:
+        n_blocks = _length_bound(kv_len, block_k, n_blocks)
     d = q_ref.shape[-1]
 
     def body(j, carry):
@@ -102,6 +124,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         )  # [BQ, BK]
         if causal:
             s = _apply_causal_mask(s, qi, j, block_q, block_k)
+        if padded:
+            s = _apply_length_mask(s, j, block_k, kv_len)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -127,8 +151,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     )
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
-               scale, causal, block_q, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
+               scale, causal, block_q, block_k, padded=False):
+    if padded:
+        len_ref, dq_ref = rest
+        kv_len = len_ref[0, 0]
+    else:
+        (dq_ref,) = rest
+        kv_len = None
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -142,6 +172,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
     n_blocks = seq_k // block_k
     if causal:
         n_blocks = _causal_bound(qi, block_q, block_k, n_blocks)
+    if padded:
+        n_blocks = _length_bound(kv_len, block_k, n_blocks)
     d = q_ref.shape[-1]
 
     def body(j, dq):
@@ -153,7 +185,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
         )
         if causal:
             s = _apply_causal_mask(s, qi, j, block_q, block_k)
+        if padded:
+            s = _apply_length_mask(s, j, block_k, kv_len)
         p = jnp.exp(s - lse)
+        if padded:
+            # Padded QUERY rows carry a degenerate lse (their forward
+            # row was fully masked), so exp(s - lse) overflows on valid
+            # columns; their p must be hard-zeroed or inf·0 → NaN
+            # poisons dq/dk/dv.
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, p.shape, 0
+            )
+            p = jnp.where(rows < kv_len, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -171,7 +214,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q, block_k):
+                *rest, scale, causal, block_q, block_k, padded=False):
+    if padded:
+        len_ref, dk_ref, dv_ref = rest
+        kv_len = len_ref[0, 0]
+    else:
+        dk_ref, dv_ref = rest
+        kv_len = None
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)  # [BK, D]
     v = v_ref[0].astype(jnp.float32)
@@ -181,6 +230,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     if causal:
         # Q blocks strictly before this K block see none of it.
         start = ki * block_k // block_q
+    if padded:
+        # Q blocks wholly past the valid length have do == 0 (zeroed by
+        # the wrapper) and masked p — skip them.
+        n_blocks = _length_bound(kv_len, block_q, n_blocks)
     d = k_ref.shape[-1]
 
     def body(i, carry):
@@ -204,7 +257,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         )  # [BQ, BK]
         if causal:
             s = _apply_causal_mask(s, i, ki, block_q, block_k)
+        if padded:
+            # Mask key columns past the length so their dk/dv stay 0.
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(cols < kv_len, s, _NEG_INF)
         p = jnp.exp(s - lse)
+        if padded:
+            # Same degenerate-lse hazard as _dq_kernel: padded query
+            # rows would overflow p on valid columns → inf·0 NaNs.
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, p.shape, 0
+            )
+            p = jnp.where(rows < kv_len, p, 0.0)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -269,23 +335,39 @@ def _flash_bhtd(q, k, v, causal, block_q, block_k):
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6)
+)
+def _flash_bhtd_padded(q, k, v, lens, causal, block_q, block_k):
+    """Padded variant: ``lens`` is a (bh, 1) int32 of valid key/query
+    lengths. Separate custom_vjp so the unpadded path's compiled
+    artifacts are untouched."""
+    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, lens=lens)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, lens=None):
     bh, seq, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_q = seq // block_q
     lanes = _interchange_lanes()
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, padded=lens is not None,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+    ]
+    operands = [q, k, v]
+    if lens is not None:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, i: (b, 0)))
+        operands.append(lens)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec(
@@ -297,7 +379,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
             jax.ShapeDtypeStruct((bh, seq, lanes), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -312,6 +394,27 @@ def _flash_fwd_vjp(q, k, v, causal, block_q, block_k):
 
 def _flash_bwd_vjp(causal, block_q, block_k, res, do):
     q, k, v, o, lse_lane = res
+    return _flash_bwd_impl(
+        q, k, v, o, lse_lane, do, causal, block_q, block_k
+    )
+
+
+def _flash_fwd_vjp_padded(q, k, v, lens, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, lens=lens)
+    return o, (q, k, v, o, lse[..., 0], lens)
+
+
+def _flash_bwd_vjp_padded(causal, block_q, block_k, res, do):
+    q, k, v, o, lse_lane, lens = res
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=lens
+    )
+    return dq, dk, dv, None  # int lengths carry no cotangent
+
+
+def _flash_bwd_impl(
+    q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=None
+):
     lanes = _interchange_lanes()
     if lanes == 1:
         # compact interchange: (bh, seq, 1) — the kernels' [:, 0:1]
@@ -326,42 +429,53 @@ def _flash_bwd_vjp(causal, block_q, block_k, res, do):
     scale = 1.0 / (d ** 0.5)
     n_q = seq // block_q
     n_k = seq // block_k
+    padded = lens is not None
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec(
+            (1, block_q, lanes), lambda b, i: (b, i, 0)
+        ),
+    ]
+    dq_operands = [q, k, v, do, o, lse]
+    dkv_in_specs = [
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec(
+            (1, seq, lanes), lambda b, i: (b, 0, 0)
+        ),
+    ]
+    dkv_operands = [q, k, v, do, o, lse]
+    if padded:
+        lens_spec = pl.BlockSpec((1, 1), lambda b, i: (b, 0))
+        dq_in_specs.append(lens_spec)
+        dq_operands.append(lens)
+        dkv_in_specs.append(lens_spec)
+        dkv_operands.append(lens)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, padded=padded,
         ),
         grid=(bh, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec(
-                (1, block_q, lanes), lambda b, i: (b, i, 0)
-            ),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, o, lse)
+    )(*dq_operands)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, padded=padded,
         ),
         grid=(bh, n_k),
-        in_specs=[
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec(
-                (1, seq, lanes), lambda b, i: (b, 0, 0)
-            ),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -371,11 +485,12 @@ def _flash_bwd_vjp(causal, block_q, block_k, res, do):
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, o, lse)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
 _flash_bhtd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+_flash_bhtd_padded.defvjp(_flash_fwd_vjp_padded, _flash_bwd_vjp_padded)
 
 
 def flash_attention(
@@ -385,11 +500,19 @@ def flash_attention(
     causal: bool = False,
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
+    lengths: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention over [batch, seq, heads, head_dim] tensors (the model
     layout), softmax scale 1/√d. Differentiable (custom VJP, blockwise
     recompute). Sequence length must be divisible by the chosen block
-    sizes; blocks shrink automatically for short sequences."""
+    sizes; blocks shrink automatically for short sequences.
+
+    ``lengths`` ([batch] int): per-sequence valid token counts for
+    right-padded batches — keys at or beyond a sequence's length are
+    masked out of its softmax, outputs at padded query positions are
+    zero, and the VJP routes no gradient through padded positions.
+    Equivalent to the dense path's key-validity mask
+    ``iota(t) < lengths[:, None]``, without leaving the kernel."""
     b, t, h, d = q.shape
     block_q = _pick_block(t, block_q)
     block_k = _pick_block(t, block_k)
@@ -397,7 +520,26 @@ def flash_attention(
     def to_bhtd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
-    out = _flash_bhtd(
-        to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, block_q, block_k
+    if lengths is None:
+        out = _flash_bhtd(
+            to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, block_q, block_k
+        )
+        return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    lens = jnp.asarray(lengths, jnp.int32)
+    if lens.shape != (b,):
+        raise ValueError(
+            f"lengths must be [batch]=({b},), got {lens.shape}"
+        )
+    lens_bh = jnp.repeat(lens, h)[:, None]  # (bh, 1)
+    out = _flash_bhtd_padded(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v), lens_bh,
+        causal, block_q, block_k,
     )
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    # Zero padded QUERY rows OUTSIDE the custom_vjp: the kernel writes
+    # garbage there (its fully-masked-row escape), and this `where`'s
+    # transpose also zeroes the incoming cotangent at padded rows —
+    # the exact contract the backward kernels rely on.
+    valid = jnp.arange(t)[None, :] < lens[:, None]  # [b, t]
+    return jnp.where(valid[..., None, None], out, 0.0)

@@ -92,6 +92,43 @@ def test_transformer_causality():
     assert not np.allclose(np.asarray(l1[0, -1]), np.asarray(l2[0, -1]))
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_transformer_padded_lengths_flash_matches_dense(causal):
+    """lengths= keeps the flash path (interpret kernels here) and must
+    match the dense path's masked computation logit-for-logit; padded
+    positions must not influence valid ones."""
+    import dataclasses
+
+    cfg = TransformerConfig.tiny(causal=causal)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    lengths = jnp.asarray([16, 7], jnp.int32)
+    flash_cfg = dataclasses.replace(cfg, flash_attention=True)
+    dense_cfg = dataclasses.replace(cfg, flash_attention=False)
+    params = Transformer(flash_cfg).init(
+        jax.random.PRNGKey(0), tokens, train=False
+    )
+    lf = Transformer(flash_cfg).apply(
+        params, tokens, train=False, lengths=lengths
+    )
+    ld = Transformer(dense_cfg).apply(
+        params, tokens, train=False, lengths=lengths
+    )
+    np.testing.assert_allclose(
+        np.asarray(lf), np.asarray(ld), rtol=5e-4, atol=5e-4
+    )
+    # a token edit INSIDE the padding must not change valid logits
+    tokens2 = tokens.at[1, 12].set(3)
+    lf2 = Transformer(flash_cfg).apply(
+        params, tokens2, train=False, lengths=lengths
+    )
+    np.testing.assert_allclose(
+        np.asarray(lf[1, :7]), np.asarray(lf2[1, :7]), rtol=1e-5
+    )
+
+
 def test_lm_head_mixed_matches_fp32_within_bf16_rounding():
     """The mixed-precision head (bf16 operands, fp32 accumulation) must
     agree with the all-fp32 head to bf16 input-rounding tolerance, on
