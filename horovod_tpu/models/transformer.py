@@ -165,22 +165,23 @@ class MultiHeadAttention(nn.Module):
             t = x.shape[1]
             causal_mask = jnp.tril(jnp.ones((t, t), bool))
             scores = jnp.where(causal_mask[None, None], scores, -1e30)
-        if lengths is not None and mask is None:
-            # dense twin of the kernel's lengths contract
-            mask = (
-                jnp.arange(x.shape[1])[None, :]
-                < jnp.asarray(lengths)[:, None]
-            )
-        if mask is not None:
-            scores = jnp.where(mask[:, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        valid = None
         if lengths is not None:
-            # match the flash path: padded query rows are zero
+            # dense twin of the kernel's lengths contract; combined
+            # (AND) with an explicit mask rather than ignored, so
+            # mask+lengths callers never have valid rows attending to
+            # keys past the length
             valid = (
                 jnp.arange(x.shape[1])[None, :]
                 < jnp.asarray(lengths)[:, None]
             )
+            mask = valid if mask is None else (mask & valid)
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if valid is not None:
+            # match the flash path: padded query rows are zero
             out = jnp.where(valid[:, :, None, None], out, 0.0)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"

@@ -33,6 +33,18 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _lens_spec():
+    """BlockSpec for the per-program (bh, 1) valid-length scalars.
+    They live in SMEM: a (1, 1) VMEM tile would violate Mosaic's
+    sublane rule (module header), and the value drives loop bounds —
+    scalar memory is where the official TPU flash kernels keep
+    sequence lengths."""
+    return pl.BlockSpec(
+        (1, 1), lambda b, i: (b, 0), memory_space=pltpu.SMEM
+    )
 
 
 def _interpret() -> bool:
@@ -189,10 +201,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
             s = _apply_length_mask(s, j, block_k, kv_len)
         p = jnp.exp(s - lse)
         if padded:
-            # Padded QUERY rows carry a degenerate lse (their forward
-            # row was fully masked), so exp(s - lse) overflows on valid
-            # columns; their p must be hard-zeroed or inf·0 → NaN
-            # poisons dq/dk/dv.
+            # Defense in depth, NOT load-bearing: padded query rows
+            # attend finitely over the valid keys (only COLUMNS are
+            # masked), so their lse is ordinary and p <= ~1; their
+            # contributions already vanish because the wrapper's
+            # `where` zeroes the incoming do at padded rows (making
+            # do, dp, delta all zero there). Zeroing p keeps dq at
+            # padded rows exactly 0 even if a caller bypasses the
+            # wrapper. The only degenerate-lse case, kv_len == 0, is
+            # excluded by the loop bound clamp (n_blocks == 0).
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, p.shape, 0
             )
@@ -259,14 +276,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             s = _apply_causal_mask(s, i, ki, block_q, block_k)
         if padded:
             # Mask key columns past the length so their dk/dv stay 0.
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1
-            )
-            s = jnp.where(cols < kv_len, s, _NEG_INF)
+            s = _apply_length_mask(s, ki, block_k, kv_len)
         p = jnp.exp(s - lse)
         if padded:
-            # Same degenerate-lse hazard as _dq_kernel: padded query
-            # rows would overflow p on valid columns → inf·0 NaNs.
+            # Same defense-in-depth row zeroing as _dq_kernel (see the
+            # comment there — padded-row lse is finite; this guards
+            # wrapper-bypassing callers, it does not prevent NaNs).
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, p.shape, 0
             )
@@ -362,7 +377,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, lens=None):
     ]
     operands = [q, k, v]
     if lens is not None:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, i: (b, 0)))
+        in_specs.append(_lens_spec())
         operands.append(lens)
     o, lse = pl.pallas_call(
         kernel,
@@ -453,7 +468,7 @@ def _flash_bwd_impl(
     ]
     dkv_operands = [q, k, v, do, o, lse]
     if padded:
-        lens_spec = pl.BlockSpec((1, 1), lambda b, i: (b, 0))
+        lens_spec = _lens_spec()
         dq_in_specs.append(lens_spec)
         dq_operands.append(lens)
         dkv_in_specs.append(lens_spec)
@@ -537,9 +552,12 @@ def flash_attention(
         causal, block_q, block_k,
     )
     out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    # Zero padded QUERY rows OUTSIDE the custom_vjp: the kernel writes
-    # garbage there (its fully-masked-row escape), and this `where`'s
-    # transpose also zeroes the incoming cotangent at padded rows —
-    # the exact contract the backward kernels rely on.
+    # Zero padded QUERY rows OUTSIDE the custom_vjp. The kernel's raw
+    # output there is ordinary finite attention over the valid keys
+    # (rows are never masked, only columns) — zeroing is the API
+    # contract, so padding can't leak downstream. Just as important,
+    # this `where`'s transpose zeroes the incoming cotangent at padded
+    # rows, which is what makes their dq/dk/dv contributions vanish in
+    # the backward kernels.
     valid = jnp.arange(t)[None, :] < lens[:, None]  # [b, t]
     return jnp.where(valid[..., None, None], out, 0.0)

@@ -1,0 +1,131 @@
+#!/usr/bin/env bash
+# Round-4 chip work, part i: on-chip validation of the round's NEW
+# kernel paths, queued behind the g->h capture chain:
+#   1. padded flash attention (lengths= / SMEM scalar spec) — the SMEM
+#      BlockSpec is interpret-validated only until this runs;
+#   2. flash block 512 defaults fwd+bwd vs the dense oracle (the
+#      default flip shipped mid-round; the sweep measured it but this
+#      asserts numerics at the new default);
+#   3. a bench_lm default capture with the new defaults, named
+#      gpt2_default512 (provenance: flash_block field).
+# Same discipline as parts c/g/h.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+R=r04
+
+while pgrep -f "chipwork_r04[gh].sh" >/dev/null 2>&1 \
+      || pgrep -f "python bench(_lm|_allreduce)?.py" >/dev/null 2>&1; do
+  sleep 120
+done
+
+probe_backend() {
+  timeout 7200 python - <<'PYEOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+PYEOF
+}
+wait_backend() {
+  echo "=== probing TPU backend $(date -u +%H:%M)" >&2
+  until probe_backend; do
+    echo "backend still down $(date -u +%H:%M); retry in 300s" >&2
+    sleep 300
+  done
+  echo "=== backend UP $(date -u +%H:%M)" >&2
+}
+
+wait_backend
+
+echo "=== padded + blk512 flash smoke $(date -u +%H:%M)" >&2
+python - > bench_results/flash_padded_smoke_${R}.txt 2>&1 <<'EOF'
+import numpy as np
+import jax, jax.numpy as jnp
+
+assert jax.devices()[0].platform == "tpu"
+
+def dense_padded(q, k, v, causal, lengths):
+    b, t, h, d = q.shape
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(d)
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return jnp.where(valid[:, None, :, None].transpose(0, 2, 1, 3), o, 0.0)
+
+from horovod_tpu.ops import flash_attention as fa
+
+rng = np.random.default_rng(0)
+b, t, h, d = 2, 512, 4, 64
+q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+           for _ in range(3))
+lengths = jnp.asarray([512, 301], jnp.int32)
+ok = True
+
+# 1) padded path fwd + grads at the block-512 default (SMEM lens spec)
+out = fa.flash_attention(q, k, v, causal=True, lengths=lengths)
+ref = dense_padded(q, k, v, True, lengths)
+err = float(jnp.max(jnp.abs(out - ref)))
+print("padded fwd maxerr", err); ok &= err < 2e-3
+rg = jax.grad(lambda q, k, v: (dense_padded(q, k, v, True, lengths)).sum(),
+              argnums=(0, 1, 2))(q, k, v)
+gg = jax.grad(lambda q, k, v: fa.flash_attention(
+    q, k, v, causal=True, lengths=lengths).sum(), argnums=(0, 1, 2))(q, k, v)
+for name, a, bb in zip(("dq", "dk", "dv"), gg, rg):
+    e = float(jnp.max(jnp.abs(a - bb)))
+    print("padded", name, "maxerr", e); ok &= e < 2e-3
+pad_zero = float(jnp.max(jnp.abs(gg[0][1, 301:])))
+print("padded dq pad-region max", pad_zero); ok &= pad_zero == 0.0
+
+# 2) unpadded fwd+bwd at the new 512 default vs dense
+def dense(q, k, v):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
+    s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+e = float(jnp.max(jnp.abs(
+    fa.flash_attention(q, k, v, causal=True) - dense(q, k, v))))
+print("blk512 fwd maxerr", e); ok &= e < 2e-3
+
+print("PADDED FLASH PASS ON TPU" if ok else "PADDED FLASH FAIL")
+EOF
+grep -E "PASS|FAIL" bench_results/flash_padded_smoke_${R}.txt >&2
+
+run_one() {
+  local name="$1"; shift
+  local out="bench_results/${name}_${R}.json"
+  echo "=== $name $(date -u +%H:%M)" >&2
+  "$@" > "$out.tmp" 2> "bench_results/${name}_${R}.err"
+  if grep -qE '^\{' "$out.tmp"; then
+    grep -E '^\{' "$out.tmp" > "$out"
+    rm -f "$out.tmp" "bench_results/${name}_${R}.err"
+    cat "$out" >&2
+    return 0
+  fi
+  rm -f "$out.tmp"
+  return 1
+}
+cap() {
+  local name="$1"
+  local out="bench_results/${name}_${R}.json"
+  if [ -s "$out" ]; then
+    echo "=== $name already captured, skipping" >&2
+    return 0
+  fi
+  if run_one "$@"; then return 0; fi
+  echo "=== $name failed; gating on backend health before one retry" >&2
+  wait_backend
+  if run_one "$@"; then return 0; fi
+  echo "FAILED $name twice with backend up (see .err)" >&2
+  return 1
+}
+
+# 3) fresh default capture under the shipped defaults (blk512 recorded
+#    in the flash_block provenance field)
+cap gpt2_default512 env BENCH_MODEL=gpt2_medium python bench_lm.py
+
+echo "=== chipwork_r04i complete $(date -u +%H:%M)" >&2
