@@ -96,6 +96,31 @@ def main():
         "0", "false", "off"
     )
     xent_chunk = int(os.environ.get("BENCH_XENT_CHUNK", "8192"))
+    # BENCH_PADDED=1: right-padded batch (uniform lengths in
+    # [seq*3/4, seq]) driven through the kernels' native lengths=
+    # support — measures the padded-path overhead vs the dense-mask
+    # alternative the reference-style stack would pay. Loss masks
+    # padded positions.
+    padded = os.environ.get("BENCH_PADDED", "0") not in (
+        "0", "false", "off"
+    )
+
+    # Padded mode: fixed synthetic lengths (the bench reuses one batch,
+    # so a closed-over constant is consistent with its style). Loss
+    # averages over valid positions only.
+    if padded and fused_xent:
+        raise SystemExit("BENCH_PADDED with BENCH_FUSED_XENT unsupported")
+    seq_for_lens = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_len, 512))))
+    bench_lens = (
+        jnp.asarray(
+            np.random.default_rng(7).integers(
+                3 * seq_for_lens // 4, seq_for_lens + 1, size=(batch,)
+            ),
+            jnp.int32,
+        )
+        if padded
+        else None
+    )
 
     @partial(
         jax.shard_map,
@@ -127,6 +152,20 @@ def main():
                         cfg.dtype if cfg.head_mixed_precision else None
                     ),
                 ).mean()
+            if padded:
+                logits = model.apply(
+                    p, tokens, train=True, lengths=bench_lens
+                )
+                per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), labels
+                )
+                valid = (
+                    jnp.arange(tokens.shape[1])[None, :]
+                    < bench_lens[:, None]
+                )
+                return jnp.sum(
+                    jnp.where(valid, per_tok, 0.0)
+                ) / jnp.sum(valid)
             logits = model.apply(p, tokens, train=True)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), labels
@@ -187,6 +226,10 @@ def main():
         "remat": remat,
         "head": "mixed" if cfg.head_mixed_precision else "fp32",
         "xent": "fused" if fused_xent else "dense",
+        # padded mode: samples/s counts whole padded rows; MFU uses the
+        # full-seq analytic attention flops, so it UNDERSTATES true
+        # utilization on the valid tokens (conservative)
+        "padded": padded,
         # provenance: the kernel auto-shrinks to the sequence, so record
         # the EFFECTIVE block, not the config ask (r04 flipped the
         # default 128->512 mid-capture-chain; without this field those
