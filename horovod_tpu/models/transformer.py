@@ -52,6 +52,13 @@ class TransformerConfig:
     # tile (512x512 fp32 = 1 MiB).
     flash_block_q: int = _DEFAULT_FLASH_BLOCK
     flash_block_k: int = _DEFAULT_FLASH_BLOCK
+    # Grouped-query attention (Llama/Mistral-style): number of KV heads
+    # (must divide num_heads). None = MHA (one kv head per q head, the
+    # fused qkv projection — param-tree-compatible with existing
+    # checkpoints). Setting it splits the projection into "q" and "kv"
+    # and the kernels read shared KV rows directly (no repeat ever
+    # materializes).
+    num_kv_heads: Optional[int] = None
     # LM head precision. True (default): bf16 operands on the MXU with
     # fp32 accumulation (preferred_element_type) and fp32 logits out —
     # the standard TPU head recipe; input rounding is bf16-epsilon on
@@ -124,10 +131,27 @@ class MultiHeadAttention(nn.Module):
     def __call__(self, x, mask=None, lengths=None):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
-        qkv = nn.DenseGeneral(
-            (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
-        )(x)
-        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        if cfg.num_kv_heads:
+            if cfg.num_heads % cfg.num_kv_heads:
+                raise ValueError(
+                    f"num_kv_heads ({cfg.num_kv_heads}) must divide "
+                    f"num_heads ({cfg.num_heads})"
+                )
+            q = nn.DenseGeneral(
+                (cfg.num_heads, head_dim), dtype=cfg.dtype, name="q"
+            )(x)
+            kv = nn.DenseGeneral(
+                (2, cfg.num_kv_heads, head_dim), dtype=cfg.dtype,
+                name="kv",
+            )(x)
+            k, v = kv[..., 0, :, :], kv[..., 1, :, :]
+        else:
+            qkv = nn.DenseGeneral(
+                (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
+            )(x)
+            q, k, v = (
+                qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+            )
         # lengths (right-padding) stays on the flash path — the kernels
         # take it natively; only ARBITRARY masks force dense.
         use_flash = cfg.uses_flash(mask, seq=x.shape[1])
@@ -157,6 +181,12 @@ class MultiHeadAttention(nn.Module):
             return nn.DenseGeneral(
                 cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
             )(out)
+        if cfg.num_kv_heads and cfg.num_kv_heads != cfg.num_heads:
+            # dense fallback materializes the head repeat the flash
+            # path avoids
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         # scores in fp32 for softmax stability
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32

@@ -231,7 +231,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                *rest, scale, causal, block_q, block_k, padded=False):
+                *rest, scale, causal, block_q, block_k, padded=False,
+                group=1):
+    """dK/dV over one K block. With grouped-query attention
+    (``group`` = q heads per kv head > 1) the q/do/o/lse blocks carry
+    the kv head's whole GROUP of q heads in their leading dim, and
+    dk/dv accumulate over the group (a static Python loop — group is
+    small)."""
     if padded:
         len_ref, dk_ref, dv_ref = rest
         kv_len = len_ref[0, 0]
@@ -253,16 +259,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         n_blocks = _length_bound(kv_len, block_q, n_blocks)
     d = k_ref.shape[-1]
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(
+    def member_body(gm, i, dk, dv):
+        q = q_ref[gm, pl.dslice(i * block_q, block_q), :].astype(
             jnp.float32
         )
-        lse = lse_ref[0, pl.dslice(i * block_q, block_q), :][:, 0:1]
+        do = do_ref[gm, pl.dslice(i * block_q, block_q), :].astype(
+            jnp.float32
+        )
+        lse = lse_ref[gm, pl.dslice(i * block_q, block_q), :][:, 0:1]
         delta = jnp.sum(
             do
-            * o_ref[0, pl.dslice(i * block_q, block_q), :].astype(
+            * o_ref[gm, pl.dslice(i * block_q, block_q), :].astype(
                 jnp.float32
             ),
             axis=-1,
@@ -299,6 +306,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        return dk, dv
+
+    def body(i, carry):
+        dk, dv = carry
+        for gm in range(group):  # static unroll; group == 1 for MHA
+            dk, dv = member_body(gm, i, dk, dv)
         return dk, dv
 
     dk0 = jnp.zeros((block_k, d), jnp.float32)
@@ -361,19 +374,23 @@ def _flash_bhtd_padded(q, k, v, lens, causal, block_q, block_k):
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, lens=None):
+def _flash_fwd(q, k, v, causal, block_q, block_k, lens=None, h_per_kv=1):
+    """``h_per_kv`` > 1 = grouped-query attention: k/v carry bh//r rows
+    (r = h_per_kv) and each q row p reads kv row p // r — exact because
+    rows are batch-major/head-minor with kv-head groups contiguous."""
     bh, seq, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_q = seq // block_q
     lanes = _interchange_lanes()
+    r = h_per_kv
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, padded=lens is not None,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b // r, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b // r, 0, 0)),
     ]
     operands = [q, k, v]
     if lens is not None:
@@ -428,7 +445,8 @@ def _flash_bwd_vjp_padded(causal, block_q, block_k, res, do):
 
 
 def _flash_bwd_impl(
-    q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=None
+    q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=None,
+    h_per_kv=1,
 ):
     lanes = _interchange_lanes()
     if lanes == 1:
@@ -445,10 +463,12 @@ def _flash_bwd_impl(
     n_q = seq // block_q
     n_k = seq // block_k
     padded = lens is not None
+    r = h_per_kv
+    kv_rows = bh // r
     dq_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b // r, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b // r, 0, 0)),
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         pl.BlockSpec(
@@ -456,23 +476,27 @@ def _flash_bwd_impl(
         ),
     ]
     dq_operands = [q, k, v, do, o, lse]
+    # dkv grids over KV rows; with GQA (r > 1) the q/do/o/lse blocks
+    # carry the kv row's whole contiguous group of q-head rows (leading
+    # block dim r) and the kernel accumulates over the group.
     dkv_in_specs = [
-        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((r, seq, d), lambda b, i: (b, 0, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((r, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((r, seq, d), lambda b, i: (b, 0, 0)),
         pl.BlockSpec(
-            (1, seq, lanes), lambda b, i: (b, 0, 0)
+            (r, seq, lanes), lambda b, i: (b, 0, 0)
         ),
     ]
     dkv_operands = [q, k, v, do, o, lse]
     if padded:
-        lens_spec = _lens_spec()
-        dq_in_specs.append(lens_spec)
+        dq_in_specs.append(_lens_spec())
         dq_operands.append(lens)
-        dkv_in_specs.append(lens_spec)
-        dkv_operands.append(lens)
+        dkv_in_specs.append(_lens_spec())
+        # per-KV-row lengths: every r-th q row's entry (lengths are
+        # per-batch, so the group's rows all agree)
+        dkv_operands.append(lens[::r])
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
@@ -487,9 +511,9 @@ def _flash_bwd_impl(
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, padded=padded,
+            block_q=block_q, block_k=block_k, padded=padded, group=r,
         ),
-        grid=(bh, n_k),
+        grid=(kv_rows, n_k),
         in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -506,6 +530,72 @@ def _flash_bwd_impl(
 
 _flash_bhtd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
 _flash_bhtd_padded.defvjp(_flash_fwd_vjp_padded, _flash_bwd_vjp_padded)
+
+
+# Grouped-query attention entry points (additive — the MHA custom_vjps
+# above keep their arity so existing callers and compiled paths are
+# untouched).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhtd_gqa(q, k, v, causal, block_q, block_k, h_per_kv):
+    o, _ = _flash_fwd(
+        q, k, v, causal, block_q, block_k, h_per_kv=h_per_kv
+    )
+    return o
+
+
+def _flash_fwd_vjp_gqa(q, k, v, causal, block_q, block_k, h_per_kv):
+    o, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, h_per_kv=h_per_kv
+    )
+    return o, (q, k, v, o, lse[..., 0])
+
+
+def _flash_bwd_vjp_gqa(causal, block_q, block_k, h_per_kv, res, do):
+    q, k, v, o, lse_lane = res
+    return _flash_bwd_impl(
+        q, k, v, o, lse_lane, do, causal, block_q, block_k,
+        h_per_kv=h_per_kv,
+    )
+
+
+_flash_bhtd_gqa.defvjp(_flash_fwd_vjp_gqa, _flash_bwd_vjp_gqa)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bhtd_gqa_padded(
+    q, k, v, lens, causal, block_q, block_k, h_per_kv
+):
+    o, _ = _flash_fwd(
+        q, k, v, causal, block_q, block_k, lens=lens, h_per_kv=h_per_kv
+    )
+    return o
+
+
+def _flash_fwd_vjp_gqa_padded(
+    q, k, v, lens, causal, block_q, block_k, h_per_kv
+):
+    o, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, lens=lens, h_per_kv=h_per_kv
+    )
+    return o, (q, k, v, o, lse[..., 0], lens)
+
+
+def _flash_bwd_vjp_gqa_padded(
+    causal, block_q, block_k, h_per_kv, res, do
+):
+    q, k, v, o, lse_lane, lens = res
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=lens,
+        h_per_kv=h_per_kv,
+    )
+    return dq, dk, dv, None
+
+
+_flash_bhtd_gqa_padded.defvjp(
+    _flash_fwd_vjp_gqa_padded, _flash_bwd_vjp_gqa_padded
+)
 
 
 def flash_attention(
@@ -527,18 +617,40 @@ def flash_attention(
     masked out of its softmax, outputs at padded query positions are
     zero, and the VJP routes no gradient through padded positions.
     Equivalent to the dense path's key-validity mask
-    ``iota(t) < lengths[:, None]``, without leaving the kernel."""
+    ``iota(t) < lengths[:, None]``, without leaving the kernel.
+
+    Grouped-query attention: k/v may carry FEWER heads than q
+    ([batch, seq, kv_heads, head_dim] with q heads % kv_heads == 0) —
+    each group of q heads reads one kv head, Llama/Mistral-style. The
+    kernels read the shared kv rows directly (no repeat/broadcast of
+    K/V ever materializes), so the HBM savings GQA exists for are
+    preserved."""
     b, t, h, d = q.shape
+    kv_h = k.shape[2]
+    if v.shape[2] != kv_h or h % kv_h:
+        raise ValueError(
+            f"kv heads must match and divide q heads: q={h}, "
+            f"k={k.shape[2]}, v={v.shape[2]}"
+        )
+    h_per_kv = h // kv_h
     block_q = _pick_block(t, block_q)
     block_k = _pick_block(t, block_k)
 
     def to_bhtd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        hh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hh, t, d)
 
     if lengths is None:
-        out = _flash_bhtd(
-            to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, block_q, block_k
-        )
+        if h_per_kv == 1:
+            out = _flash_bhtd(
+                to_bhtd(q), to_bhtd(k), to_bhtd(v),
+                causal, block_q, block_k,
+            )
+        else:
+            out = _flash_bhtd_gqa(
+                to_bhtd(q), to_bhtd(k), to_bhtd(v),
+                causal, block_q, block_k, h_per_kv,
+            )
         return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
     lens = jnp.asarray(lengths, jnp.int32)
@@ -547,10 +659,16 @@ def flash_attention(
             f"lengths must be [batch]=({b},), got {lens.shape}"
         )
     lens_bh = jnp.repeat(lens, h)[:, None]  # (bh, 1)
-    out = _flash_bhtd_padded(
-        to_bhtd(q), to_bhtd(k), to_bhtd(v), lens_bh,
-        causal, block_q, block_k,
-    )
+    if h_per_kv == 1:
+        out = _flash_bhtd_padded(
+            to_bhtd(q), to_bhtd(k), to_bhtd(v), lens_bh,
+            causal, block_q, block_k,
+        )
+    else:
+        out = _flash_bhtd_gqa_padded(
+            to_bhtd(q), to_bhtd(k), to_bhtd(v), lens_bh,
+            causal, block_q, block_k, h_per_kv,
+        )
     out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     # Zero padded QUERY rows OUTSIDE the custom_vjp. The kernel's raw
     # output there is ordinary finite attention over the valid keys

@@ -321,3 +321,32 @@ def test_transformer_flash_matches_dense_path():
     np.testing.assert_allclose(
         np.asarray(out_f), np.asarray(out_d), rtol=2e-4, atol=2e-4
     )
+
+
+def test_transformer_gqa_flash_matches_dense():
+    """num_kv_heads < num_heads: split q/kv projections, flash path
+    reads shared kv rows; must match the dense path's repeated-head
+    computation logit-for-logit."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        TransformerConfig.tiny(causal=True), num_kv_heads=2
+    )
+    assert cfg.num_heads % 2 == 0 and cfg.num_heads != 2
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    flash_cfg = dataclasses.replace(cfg, flash_attention=True)
+    dense_cfg = dataclasses.replace(cfg, flash_attention=False)
+    params = Transformer(flash_cfg).init(
+        jax.random.PRNGKey(0), tokens, train=False
+    )
+    # the GQA param tree splits the projection
+    blk = params["params"]["block_0"]["MultiHeadAttention_0"]
+    assert "q" in blk and "kv" in blk and "qkv" not in blk
+    lf = Transformer(flash_cfg).apply(params, tokens, train=False)
+    ld = Transformer(dense_cfg).apply(params, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(lf), np.asarray(ld), rtol=5e-4, atol=5e-4
+    )
