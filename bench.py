@@ -96,7 +96,16 @@ def inner_main():
     elif model_name == "vit_b16":
         # BASELINE.json config #5's model (the elastic-bench pairing);
         # LayerNorm-based, so the batch_stats collection stays empty.
-        model = model_zoo.ViT(model_zoo.ViTConfig.b16())
+        # BENCH_VIT_FLASHPAD: auto (default) pads 197->200 tokens and
+        # runs the flash kernels with lengths=197 on TPU; 0 keeps the
+        # dense control. Recorded as "attn" on the artifact.
+        import dataclasses as _dc
+
+        _fp = os.environ.get("BENCH_VIT_FLASHPAD", "auto")
+        vit_cfg = model_zoo.ViTConfig.b16()
+        if _fp in ("0", "false", "off"):
+            vit_cfg = _dc.replace(vit_cfg, flash_pad=False)
+        model = model_zoo.ViT(vit_cfg)
     else:
         raise SystemExit(f"unknown BENCH_MODEL {model_name!r}")
 
@@ -188,6 +197,15 @@ def inner_main():
         # config provenance: the stale-artifact fallback must not
         # substitute a stem-variant probe for the default config
         result["stem"] = stem
+    if model_name == "vit_b16":
+        # flash-pad engages on TPU under the auto default (r04: the
+        # padded kernels made ViT's 197 tokens tileable via 200+lengths)
+        result["attn"] = (
+            "dense"
+            if os.environ.get("BENCH_VIT_FLASHPAD", "auto")
+            in ("0", "false", "off") or platform != "tpu"
+            else "flash_pad"
+        )
     result.update(
         _mfu_fields(flops, n_iters, dt, platform, step_bytes=step_bytes)
     )
@@ -346,6 +364,16 @@ def orchestrate():
         stale_config["stem"] = (
             os.environ.get("BENCH_STEM", "space_to_depth"),
             "conv7",
+        )
+    if os.environ.get("BENCH_MODEL") == "vit_b16":
+        # same provenance rule for ViT's attention engine (artifacts
+        # predating the attn field were dense captures)
+        stale_config["attn"] = (
+            "dense"
+            if os.environ.get("BENCH_VIT_FLASHPAD", "auto")
+            in ("0", "false", "off")
+            else "flash_pad",
+            "dense",
         )
 
     def _find_stale():

@@ -350,3 +350,28 @@ def test_transformer_gqa_flash_matches_dense():
     np.testing.assert_allclose(
         np.asarray(lf), np.asarray(ld), rtol=5e-4, atol=5e-4
     )
+
+
+def test_vit_flash_pad_matches_dense():
+    """ViT's untileable token count (tiny: 16+1=17) padded to the next
+    8-multiple with lengths= must reproduce the unpadded dense model's
+    logits exactly — on both the dense-with-lengths path and the
+    flash-forced path (interpret kernels)."""
+    import dataclasses
+
+    x = jnp.asarray(
+        np.random.default_rng(2).normal(size=(2, 32, 32, 3)), jnp.float32
+    )
+    base = dataclasses.replace(ViTConfig.tiny(), flash_pad=False)
+    params = ViT(base).init(jax.random.PRNGKey(0), x, train=False)
+    want = ViT(base).apply(params, x, train=False)
+    for cfg in (
+        dataclasses.replace(ViTConfig.tiny(), flash_pad=True),
+        dataclasses.replace(
+            ViTConfig.tiny(), flash_pad=True, flash_attention=True
+        ),
+    ):
+        got = ViT(cfg).apply(params, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-5, atol=5e-5
+        )
