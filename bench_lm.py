@@ -59,6 +59,12 @@ def main():
     if os.environ.get("BENCH_HEAD") == "fp32":
         # A/B escape hatch for the mixed-precision LM head default
         cfg = dataclasses_replace(cfg, head_mixed_precision=False)
+    if os.environ.get("BENCH_KV_HEADS"):
+        # grouped-query attention A/B: fewer KV heads (must divide the
+        # model's head count); the kernels read shared KV rows directly
+        cfg = dataclasses_replace(
+            cfg, num_kv_heads=int(os.environ["BENCH_KV_HEADS"])
+        )
     if os.environ.get("BENCH_FLASH_BLOCK"):
         bq = int(os.environ["BENCH_FLASH_BLOCK"])
         if bq < 8 or (bq & (bq - 1)) != 0:
@@ -230,6 +236,7 @@ def main():
         # full-seq analytic attention flops, so it UNDERSTATES true
         # utilization on the valid tokens (conservative)
         "padded": padded,
+        "kv_heads": cfg.num_kv_heads or cfg.num_heads,
         # provenance: the kernel auto-shrinks to the sequence, so record
         # the EFFECTIVE block, not the config ask (r04 flipped the
         # default 128->512 mid-capture-chain; without this field those

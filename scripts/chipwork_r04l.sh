@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Round-4 chip work, part l: GQA LM A/B (BENCH_KV_HEADS).
+# the kernels' native lengths= path under real load) after parts g/h/i
+# drain. Same discipline.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+R=r04
+
+while pgrep -f "chipwork_r04[ghijk].sh" >/dev/null 2>&1 \
+      || pgrep -f "python bench(_lm|_allreduce)?.py" >/dev/null 2>&1; do
+  sleep 120
+done
+
+probe_backend() {
+  timeout 7200 python - <<'PYEOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+PYEOF
+}
+wait_backend() {
+  echo "=== probing TPU backend $(date -u +%H:%M)" >&2
+  until probe_backend; do
+    echo "backend still down $(date -u +%H:%M); retry in 300s" >&2
+    sleep 300
+  done
+  echo "=== backend UP $(date -u +%H:%M)" >&2
+}
+run_one() {
+  local name="$1"; shift
+  local out="bench_results/${name}_${R}.json"
+  echo "=== $name $(date -u +%H:%M)" >&2
+  "$@" > "$out.tmp" 2> "bench_results/${name}_${R}.err"
+  if grep -qE '^\{' "$out.tmp"; then
+    grep -E '^\{' "$out.tmp" > "$out"
+    rm -f "$out.tmp" "bench_results/${name}_${R}.err"
+    cat "$out" >&2
+    return 0
+  fi
+  rm -f "$out.tmp"
+  return 1
+}
+cap() {
+  local name="$1"
+  local out="bench_results/${name}_${R}.json"
+  if [ -s "$out" ]; then
+    echo "=== $name already captured, skipping" >&2
+    return 0
+  fi
+  if run_one "$@"; then return 0; fi
+  echo "=== $name failed; gating on backend health before one retry" >&2
+  wait_backend
+  if run_one "$@"; then return 0; fi
+  echo "FAILED $name twice with backend up (see .err)" >&2
+  return 1
+}
+
+cap gpt2_gqa4 env BENCH_MODEL=gpt2_medium BENCH_KV_HEADS=4 python bench_lm.py
+cap gpt2_gqa8 env BENCH_MODEL=gpt2_medium BENCH_KV_HEADS=8 python bench_lm.py
+
+echo "=== chipwork_r04l complete $(date -u +%H:%M)" >&2
