@@ -52,6 +52,11 @@ class TransformerConfig:
     # tile (512x512 fp32 = 1 MiB).
     flash_block_q: int = _DEFAULT_FLASH_BLOCK
     flash_block_k: int = _DEFAULT_FLASH_BLOCK
+    # Mistral-style causal sliding window (requires causal=True): row r
+    # attends (r-window, r]. On the flash path the band is masked
+    # in-kernel with the block loops clamped to it; the dense path
+    # builds the band mask explicitly.
+    sliding_window: Optional[int] = None
     # Grouped-query attention (Llama/Mistral-style): number of KV heads
     # (must divide num_heads). None = MHA (one kv head per q head, the
     # fused qkv projection — param-tree-compatible with existing
@@ -176,7 +181,7 @@ class MultiHeadAttention(nn.Module):
             out = flash_attention(
                 q, k, v, causal=cfg.causal,
                 block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-                lengths=lengths,
+                lengths=lengths, window=cfg.sliding_window,
             )
             return nn.DenseGeneral(
                 cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
@@ -194,7 +199,15 @@ class MultiHeadAttention(nn.Module):
         if cfg.causal:
             t = x.shape[1]
             causal_mask = jnp.tril(jnp.ones((t, t), bool))
+            if cfg.sliding_window:
+                rows = jnp.arange(t)[:, None]
+                cols = jnp.arange(t)[None, :]
+                causal_mask = causal_mask & (
+                    rows - cols < cfg.sliding_window
+                )
             scores = jnp.where(causal_mask[None, None], scores, -1e30)
+        elif cfg.sliding_window:
+            raise ValueError("sliding_window requires causal=True")
         valid = None
         if lengths is not None:
             # dense twin of the kernel's lengths contract; combined

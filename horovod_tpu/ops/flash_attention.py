@@ -102,6 +102,26 @@ def _apply_length_mask(s, j, block_k, kv_len):
     return jnp.where(cols < kv_len, s, _NEG_INF)
 
 
+def _apply_window_mask(s, qi, j, block_q, block_k, window):
+    """Causal sliding window: row attends cols in (row-window, row] —
+    mask row - col >= window (the >= diagonal side is the causal
+    mask's job). Every row keeps its own diagonal, so no row is ever
+    fully masked."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0
+    )
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1
+    )
+    return jnp.where(rows - cols < window, s, _NEG_INF)
+
+
+def _window_start(qi, block_q, block_k, window):
+    """First K block any row of Q block qi can see: lowest needed col
+    is qi*BQ - window + 1."""
+    return jnp.maximum(0, (qi * block_q - window + 1) // block_k)
+
+
 def _length_bound(kv_len, block_k, n_blocks):
     """K-block iteration bound under padding: blocks wholly past the
     valid length contribute nothing."""
@@ -109,7 +129,7 @@ def _length_bound(kv_len, block_k, n_blocks):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
-                block_q, block_k, padded=False):
+                block_q, block_k, padded=False, window=None):
     if padded:
         len_ref, o_ref, lse_ref = rest
         kv_len = len_ref[0, 0]
@@ -120,10 +140,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
     seq_k = k_ref.shape[1]
     n_blocks = seq_k // block_k
+    start = 0
     if causal:
         n_blocks = _causal_bound(qi, block_q, block_k, n_blocks)
     if padded:
         n_blocks = _length_bound(kv_len, block_k, n_blocks)
+    if window is not None:
+        start = _window_start(qi, block_q, block_k, window)
     d = q_ref.shape[-1]
 
     def body(j, carry):
@@ -138,6 +161,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             s = _apply_causal_mask(s, qi, j, block_q, block_k)
         if padded:
             s = _apply_length_mask(s, j, block_k, kv_len)
+        if window is not None:
+            s = _apply_window_mask(s, qi, j, block_q, block_k, window)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -153,7 +178,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(start, n_blocks, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     # lane width comes from the out spec: 128 broadcast lanes or the
@@ -164,7 +189,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
-               scale, causal, block_q, block_k, padded=False):
+               scale, causal, block_q, block_k, padded=False,
+               window=None):
     if padded:
         len_ref, dq_ref = rest
         kv_len = len_ref[0, 0]
@@ -182,10 +208,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
     )
     seq_k = k_ref.shape[1]
     n_blocks = seq_k // block_k
+    start = 0
     if causal:
         n_blocks = _causal_bound(qi, block_q, block_k, n_blocks)
     if padded:
         n_blocks = _length_bound(kv_len, block_k, n_blocks)
+    if window is not None:
+        start = _window_start(qi, block_q, block_k, window)
     d = q_ref.shape[-1]
 
     def body(j, dq):
@@ -199,6 +228,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
             s = _apply_causal_mask(s, qi, j, block_q, block_k)
         if padded:
             s = _apply_length_mask(s, j, block_k, kv_len)
+        if window is not None:
+            s = _apply_window_mask(s, qi, j, block_q, block_k, window)
         p = jnp.exp(s - lse)
         if padded:
             # Defense in depth, NOT load-bearing: padded query rows
@@ -225,14 +256,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
         )
 
     dq = jax.lax.fori_loop(
-        0, n_blocks, body, jnp.zeros((block_q, d), jnp.float32)
+        start, n_blocks, body, jnp.zeros((block_q, d), jnp.float32)
     )
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                 *rest, scale, causal, block_q, block_k, padded=False,
-                group=1):
+                group=1, window=None):
     """dK/dV over one K block. With grouped-query attention
     (``group`` = q heads per kv head > 1) the q/do/o/lse blocks carry
     the kv head's whole GROUP of q heads in their leading dim, and
@@ -257,6 +288,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         # Q blocks wholly past the valid length have do == 0 (zeroed by
         # the wrapper) and masked p — skip them.
         n_blocks = _length_bound(kv_len, block_q, n_blocks)
+    if window is not None:
+        # Sliding window adds an END bound over Q blocks: the last row
+        # that sees any col of this K block is (ki+1)*BK - 1 + W - 1.
+        n_blocks = jnp.minimum(
+            n_blocks,
+            ((ki + 1) * block_k - 1 + window - 1) // block_q + 1,
+        )
     d = k_ref.shape[-1]
 
     def member_body(gm, i, dk, dv):
@@ -284,6 +322,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         if padded:
             # Mask key columns past the length so their dk/dv stay 0.
             s = _apply_length_mask(s, ki, block_k, kv_len)
+        if window is not None:
+            s = _apply_window_mask(s, i, ki, block_q, block_k, window)
         p = jnp.exp(s - lse)
         if padded:
             # Same defense-in-depth row zeroing as _dq_kernel (see the
@@ -356,28 +396,34 @@ def supports_seq(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
-def _flash_bhtd(q, k, v, causal, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k)
+def _flash_bhtd(q, k, v, causal, block_q, block_k, window):
+    o, _ = _flash_fwd(
+        q, k, v, causal, block_q, block_k, window=window
+    )
     return o
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
 )
-def _flash_bhtd_padded(q, k, v, lens, causal, block_q, block_k):
+def _flash_bhtd_padded(q, k, v, lens, causal, block_q, block_k, window):
     """Padded variant: ``lens`` is a (bh, 1) int32 of valid key/query
     lengths. Separate custom_vjp so the unpadded path's compiled
     artifacts are untouched."""
-    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, lens=lens)
+    o, _ = _flash_fwd(
+        q, k, v, causal, block_q, block_k, lens=lens, window=window
+    )
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, lens=None, h_per_kv=1):
+def _flash_fwd(q, k, v, causal, block_q, block_k, lens=None, h_per_kv=1,
+               window=None):
     """``h_per_kv`` > 1 = grouped-query attention: k/v carry bh//r rows
     (r = h_per_kv) and each q row p reads kv row p // r — exact because
-    rows are batch-major/head-minor with kv-head groups contiguous."""
+    rows are batch-major/head-minor with kv-head groups contiguous.
+    ``window`` = causal sliding window width (requires causal)."""
     bh, seq, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_q = seq // block_q
@@ -386,6 +432,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, lens=None, h_per_kv=1):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, padded=lens is not None,
+        window=window,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -415,8 +462,10 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, lens=None, h_per_kv=1):
     return o, lse
 
 
-def _flash_fwd_vjp(q, k, v, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k)
+def _flash_fwd_vjp(q, k, v, causal, block_q, block_k, window):
+    o, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, window=window
+    )
     # Keep ONE lane as the residual — the broadcast 128-lane layout is a
     # Mosaic in-kernel constraint, not something worth holding across
     # the whole forward pass (24 BERT-large layers of (bh, seq, 128)
@@ -424,29 +473,41 @@ def _flash_fwd_vjp(q, k, v, causal, block_q, block_k):
     return o, (q, k, v, o, lse[..., 0])
 
 
-def _flash_bwd_vjp(causal, block_q, block_k, res, do):
+def _flash_bwd_vjp_w(causal, block_q, block_k, window, res, do):
     q, k, v, o, lse_lane = res
     return _flash_bwd_impl(
-        q, k, v, o, lse_lane, do, causal, block_q, block_k
+        q, k, v, o, lse_lane, do, causal, block_q, block_k,
+        window=window,
     )
 
 
-def _flash_fwd_vjp_padded(q, k, v, lens, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, lens=lens)
+def _flash_bwd_vjp(causal, block_q, block_k, res, do):
+    """Windowless compat shim — the ring-flash engine
+    (parallel/ring_attention.py) invokes the flash backward per hop
+    through this signature."""
+    return _flash_bwd_vjp_w(causal, block_q, block_k, None, res, do)
+
+
+def _flash_fwd_vjp_padded(q, k, v, lens, causal, block_q, block_k,
+                          window):
+    o, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, lens=lens, window=window
+    )
     return o, (q, k, v, o, lse[..., 0], lens)
 
 
-def _flash_bwd_vjp_padded(causal, block_q, block_k, res, do):
+def _flash_bwd_vjp_padded(causal, block_q, block_k, window, res, do):
     q, k, v, o, lse_lane, lens = res
     dq, dk, dv = _flash_bwd_impl(
-        q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=lens
+        q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=lens,
+        window=window,
     )
     return dq, dk, dv, None  # int lengths carry no cotangent
 
 
 def _flash_bwd_impl(
     q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=None,
-    h_per_kv=1,
+    h_per_kv=1, window=None,
 ):
     lanes = _interchange_lanes()
     if lanes == 1:
@@ -501,6 +562,7 @@ def _flash_bwd_impl(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, padded=padded,
+            window=window,
         ),
         grid=(bh, n_q),
         in_specs=dq_in_specs,
@@ -512,6 +574,7 @@ def _flash_bwd_impl(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, padded=padded, group=r,
+            window=window,
         ),
         grid=(kv_rows, n_k),
         in_specs=dkv_in_specs,
@@ -528,7 +591,7 @@ def _flash_bwd_impl(
     return dq, dk, dv
 
 
-_flash_bhtd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+_flash_bhtd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp_w)
 _flash_bhtd_padded.defvjp(_flash_fwd_vjp_padded, _flash_bwd_vjp_padded)
 
 
@@ -537,58 +600,66 @@ _flash_bhtd_padded.defvjp(_flash_fwd_vjp_padded, _flash_bwd_vjp_padded)
 # untouched).
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhtd_gqa(q, k, v, causal, block_q, block_k, h_per_kv):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhtd_gqa(q, k, v, causal, block_q, block_k, h_per_kv, window):
     o, _ = _flash_fwd(
-        q, k, v, causal, block_q, block_k, h_per_kv=h_per_kv
+        q, k, v, causal, block_q, block_k, h_per_kv=h_per_kv,
+        window=window,
     )
     return o
 
 
-def _flash_fwd_vjp_gqa(q, k, v, causal, block_q, block_k, h_per_kv):
+def _flash_fwd_vjp_gqa(
+    q, k, v, causal, block_q, block_k, h_per_kv, window
+):
     o, lse = _flash_fwd(
-        q, k, v, causal, block_q, block_k, h_per_kv=h_per_kv
+        q, k, v, causal, block_q, block_k, h_per_kv=h_per_kv,
+        window=window,
     )
     return o, (q, k, v, o, lse[..., 0])
 
 
-def _flash_bwd_vjp_gqa(causal, block_q, block_k, h_per_kv, res, do):
+def _flash_bwd_vjp_gqa(
+    causal, block_q, block_k, h_per_kv, window, res, do
+):
     q, k, v, o, lse_lane = res
     return _flash_bwd_impl(
         q, k, v, o, lse_lane, do, causal, block_q, block_k,
-        h_per_kv=h_per_kv,
+        h_per_kv=h_per_kv, window=window,
     )
 
 
 _flash_bhtd_gqa.defvjp(_flash_fwd_vjp_gqa, _flash_bwd_vjp_gqa)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash_bhtd_gqa_padded(
-    q, k, v, lens, causal, block_q, block_k, h_per_kv
+    q, k, v, lens, causal, block_q, block_k, h_per_kv, window
 ):
     o, _ = _flash_fwd(
-        q, k, v, causal, block_q, block_k, lens=lens, h_per_kv=h_per_kv
+        q, k, v, causal, block_q, block_k, lens=lens,
+        h_per_kv=h_per_kv, window=window,
     )
     return o
 
 
 def _flash_fwd_vjp_gqa_padded(
-    q, k, v, lens, causal, block_q, block_k, h_per_kv
+    q, k, v, lens, causal, block_q, block_k, h_per_kv, window
 ):
     o, lse = _flash_fwd(
-        q, k, v, causal, block_q, block_k, lens=lens, h_per_kv=h_per_kv
+        q, k, v, causal, block_q, block_k, lens=lens,
+        h_per_kv=h_per_kv, window=window,
     )
     return o, (q, k, v, o, lse[..., 0], lens)
 
 
 def _flash_bwd_vjp_gqa_padded(
-    causal, block_q, block_k, h_per_kv, res, do
+    causal, block_q, block_k, h_per_kv, window, res, do
 ):
     q, k, v, o, lse_lane, lens = res
     dq, dk, dv = _flash_bwd_impl(
         q, k, v, o, lse_lane, do, causal, block_q, block_k, lens=lens,
-        h_per_kv=h_per_kv,
+        h_per_kv=h_per_kv, window=window,
     )
     return dq, dk, dv, None
 
@@ -606,6 +677,7 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
     lengths: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Attention over [batch, seq, heads, head_dim] tensors (the model
     layout), softmax scale 1/√d. Differentiable (custom VJP, blockwise
@@ -624,8 +696,21 @@ def flash_attention(
     each group of q heads reads one kv head, Llama/Mistral-style. The
     kernels read the shared kv rows directly (no repeat/broadcast of
     K/V ever materializes), so the HBM savings GQA exists for are
-    preserved."""
+    preserved.
+
+    ``window`` (int, requires ``causal=True``): Mistral-style causal
+    sliding window — row r attends cols in (r-window, r], masked
+    in-kernel with the block loops clamped to the band, so compute and
+    reads scale with window, not seq. Composes with lengths and GQA."""
     b, t, h, d = q.shape
+    if window is not None:
+        if not causal:
+            raise ValueError("window= requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        window = int(window)
+        if window >= t:
+            window = None  # full causal attention; skip the band masks
     kv_h = k.shape[2]
     if v.shape[2] != kv_h or h % kv_h:
         raise ValueError(
@@ -644,12 +729,12 @@ def flash_attention(
         if h_per_kv == 1:
             out = _flash_bhtd(
                 to_bhtd(q), to_bhtd(k), to_bhtd(v),
-                causal, block_q, block_k,
+                causal, block_q, block_k, window,
             )
         else:
             out = _flash_bhtd_gqa(
                 to_bhtd(q), to_bhtd(k), to_bhtd(v),
-                causal, block_q, block_k, h_per_kv,
+                causal, block_q, block_k, h_per_kv, window,
             )
         return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
@@ -662,12 +747,12 @@ def flash_attention(
     if h_per_kv == 1:
         out = _flash_bhtd_padded(
             to_bhtd(q), to_bhtd(k), to_bhtd(v), lens_bh,
-            causal, block_q, block_k,
+            causal, block_q, block_k, window,
         )
     else:
         out = _flash_bhtd_gqa_padded(
             to_bhtd(q), to_bhtd(k), to_bhtd(v), lens_bh,
-            causal, block_q, block_k, h_per_kv,
+            causal, block_q, block_k, h_per_kv, window,
         )
     out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     # Zero padded QUERY rows OUTSIDE the custom_vjp. The kernel's raw

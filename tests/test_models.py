@@ -375,3 +375,39 @@ def test_vit_flash_pad_matches_dense():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=5e-5, atol=5e-5
         )
+
+
+def test_transformer_mistral_trifecta_flash_matches_dense():
+    """sliding_window + num_kv_heads + lengths composed in the model:
+    flash path vs dense path logit-for-logit."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        TransformerConfig.tiny(causal=True),
+        num_kv_heads=2, sliding_window=6,
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    lengths = jnp.asarray([16, 9], jnp.int32)
+    flash_cfg = dataclasses.replace(cfg, flash_attention=True)
+    dense_cfg = dataclasses.replace(cfg, flash_attention=False)
+    params = Transformer(flash_cfg).init(
+        jax.random.PRNGKey(0), tokens, train=False
+    )
+    lf = Transformer(flash_cfg).apply(
+        params, tokens, train=False, lengths=lengths
+    )
+    ld = Transformer(dense_cfg).apply(
+        params, tokens, train=False, lengths=lengths
+    )
+    np.testing.assert_allclose(
+        np.asarray(lf), np.asarray(ld), rtol=5e-4, atol=5e-4
+    )
+    # the window actually bites: full-causal config differs
+    full = dataclasses.replace(flash_cfg, sliding_window=None)
+    lfull = Transformer(full).apply(
+        params, tokens, train=False, lengths=lengths
+    )
+    assert not np.allclose(np.asarray(lf), np.asarray(lfull), atol=1e-3)
