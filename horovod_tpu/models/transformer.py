@@ -52,6 +52,13 @@ class TransformerConfig:
     # tile (512x512 fp32 = 1 MiB).
     flash_block_q: int = _DEFAULT_FLASH_BLOCK
     flash_block_k: int = _DEFAULT_FLASH_BLOCK
+    # Rotary position embeddings (Llama/Mistral-style) applied to q/k
+    # inside every attention block. When on, the learned absolute
+    # position embedding is skipped — RoPE carries all position signal.
+    # Orthogonal to the flash kernels (the rotation happens on q/k
+    # before they enter attention).
+    rope: bool = False
+    rope_base: float = 10000.0
     # Mistral-style causal sliding window (requires causal=True): row r
     # attends (r-window, r]. On the flash path the band is masked
     # in-kernel with the block loops clamped to it; the dense path
@@ -129,6 +136,31 @@ class TransformerConfig:
         )
 
 
+def apply_rope(x, base: float = 10000.0, offset: int = 0):
+    """Rotate [batch, seq, heads, head_dim] q or k by absolute position
+    (RoFormer). Pairs are (x[..., :d/2], x[..., d/2:]) — the
+    'rotate-half' convention — so the op is two multiplies and one
+    concat, fully XLA-fusible. fp32 trig regardless of input dtype;
+    ``offset`` shifts positions (sequence-parallel shards pass their
+    global start)."""
+    b, t, h, d = x.shape
+    half = d // 2
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {d}")
+    pos = jnp.arange(offset, offset + t, dtype=jnp.float32)
+    inv_freq = base ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = pos[:, None] * inv_freq[None, :]  # [t, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
 class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
@@ -157,6 +189,9 @@ class MultiHeadAttention(nn.Module):
             q, k, v = (
                 qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
             )
+        if cfg.rope:
+            q = apply_rope(q, cfg.rope_base)
+            k = apply_rope(k, cfg.rope_base)
         # lengths (right-padding) stays on the flash path — the kernels
         # take it natively; only ARBITRARY masks force dense.
         use_flash = cfg.uses_flash(mask, seq=x.shape[1])
@@ -291,10 +326,11 @@ class Transformer(nn.Module):
     ):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype)(tokens)
-        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype)(
-            jnp.arange(tokens.shape[1])[None]
-        )
-        x = x + pos
+        if not cfg.rope:
+            pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype)(
+                jnp.arange(tokens.shape[1])[None]
+            )
+            x = x + pos
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(3,))

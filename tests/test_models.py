@@ -411,3 +411,61 @@ def test_transformer_mistral_trifecta_flash_matches_dense():
         params, tokens, train=False, lengths=lengths
     )
     assert not np.allclose(np.asarray(lf), np.asarray(lfull), atol=1e-3)
+
+
+def test_rope_properties_and_llama_shape_trains():
+    """RoPE: relative-position property (scores depend only on row-col
+    offset) and a full Llama/Mistral-shaped config (RoPE + GQA +
+    sliding window, no learned pos table) trains through flash."""
+    import dataclasses
+
+    from horovod_tpu.models.transformer import apply_rope
+
+    # property: <rope(q)_i, rope(k)_j> is a function of (i - j) only
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(1, 8, 1, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 8, 1, 16)), jnp.float32)
+    # same q/k content placed at positions (2, 5) vs (0, 3): equal dots
+    qc = jnp.broadcast_to(q[:, :1], q.shape)  # constant content
+    kc = jnp.broadcast_to(k[:, :1], k.shape)
+    rq, rk = apply_rope(qc), apply_rope(kc)
+    dots = jnp.einsum("bthd,bshd->bts", rq, rk)[0]
+    np.testing.assert_allclose(
+        np.asarray(dots[2, 5]), np.asarray(dots[0, 3]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.diag(dots)),
+        np.full(8, float(dots[0, 0])), rtol=1e-5,
+    )
+    # offset shifts positions: rope(x, offset=3)[:, 0] == rope(x)[:, 3]
+    x = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(apply_rope(x, offset=3)[:, 0]),
+        np.asarray(apply_rope(jnp.roll(x, 3, 1))[:, 3]),
+        rtol=1e-5, atol=1e-6,
+    )
+
+    cfg = dataclasses.replace(
+        TransformerConfig.tiny(causal=True),
+        rope=True, num_kv_heads=2, sliding_window=6,
+        flash_attention=True,
+    )
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)),
+                         jnp.int32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), tokens, train=False)
+    # no learned position table in the tree
+    assert not any("Embed_1" in k for k in params["params"])
+    import optax
+
+    def loss_fn(p):
+        lg = model.apply(p, tokens, train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg.astype(jnp.float32), jnp.roll(tokens, -1, 1)
+        ).mean()
+
+    l0, g = jax.value_and_grad(loss_fn)(params)
+    p2 = optax.apply_updates(
+        params, jax.tree_util.tree_map(lambda x: -0.05 * x, g)
+    )
+    assert float(loss_fn(p2)) < float(l0)
