@@ -116,11 +116,10 @@ def main():
     # averages over valid positions only.
     if padded and fused_xent:
         raise SystemExit("BENCH_PADDED with BENCH_FUSED_XENT unsupported")
-    seq_for_lens = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_len, 512))))
     bench_lens = (
         jnp.asarray(
             np.random.default_rng(7).integers(
-                3 * seq_for_lens // 4, seq_for_lens + 1, size=(batch,)
+                3 * seq // 4, seq + 1, size=(batch,)
             ),
             jnp.int32,
         )

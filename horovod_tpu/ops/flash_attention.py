@@ -700,8 +700,12 @@ def flash_attention(
 
     ``window`` (int, requires ``causal=True``): Mistral-style causal
     sliding window — row r attends cols in (r-window, r], masked
-    in-kernel with the block loops clamped to the band, so compute and
-    reads scale with window, not seq. Composes with lengths and GQA."""
+    in-kernel with the block loops clamped to the band on both sides,
+    so COMPUTE scales with the window. K/V are still staged
+    whole-sequence per program (the BlockSpecs fetch (1, seq, d)), so
+    HBM->VMEM traffic and VMEM footprint remain O(seq) — at extreme
+    sequence lengths use ring attention for the memory win. Composes
+    with lengths and GQA."""
     b, t, h, d = q.shape
     if window is not None:
         if not causal:
