@@ -59,6 +59,12 @@ def test_transformer_lm_example():
 
 
 @pytest.mark.slow
+def test_llama_shape_example():
+    out = _run_example("llama_shape_train.py", "--steps", "6")
+    assert "llama-shape loss" in out
+
+
+@pytest.mark.slow
 def test_long_context_ring_example():
     out = _run_example(
         "long_context_ring.py", "--seq-len", "512", "--steps", "4"
