@@ -113,9 +113,9 @@ def main():
 
     # Padded mode: fixed synthetic lengths (the bench reuses one batch,
     # so a closed-over constant is consistent with its style). Loss
-    # averages over valid positions only.
-    if padded and fused_xent:
-        raise SystemExit("BENCH_PADDED with BENCH_FUSED_XENT unsupported")
+    # averages over valid positions only — the fused loss composes
+    # because it returns per-token losses (masking the reduction zeroes
+    # the masked tokens' cotangents through the custom VJP).
     bench_lens = (
         jnp.asarray(
             np.random.default_rng(7).integers(
@@ -144,10 +144,11 @@ def main():
                 )
 
                 hidden = model.apply(
-                    p, tokens, train=True, return_hidden=True
+                    p, tokens, train=True, return_hidden=True,
+                    lengths=bench_lens,
                 )
                 head = p["params"]["lm_head"]
-                return fused_linear_cross_entropy(
+                per_tok = fused_linear_cross_entropy(
                     hidden.reshape(-1, cfg.d_model),
                     head["kernel"],
                     head["bias"],
@@ -156,7 +157,16 @@ def main():
                     compute_dtype=(
                         cfg.dtype if cfg.head_mixed_precision else None
                     ),
-                ).mean()
+                )
+                if padded:
+                    valid = (
+                        jnp.arange(tokens.shape[1])[None, :]
+                        < bench_lens[:, None]
+                    ).reshape(-1)
+                    return jnp.sum(
+                        jnp.where(valid, per_tok, 0.0)
+                    ) / jnp.sum(valid)
+                return per_tok.mean()
             if padded:
                 logits = model.apply(
                     p, tokens, train=True, lengths=bench_lens
