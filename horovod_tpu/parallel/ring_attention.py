@@ -206,6 +206,7 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal):
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
+    r = h // k.shape[2]  # grouped-query: q heads per kv head
     bq = _pick_block(t)  # DEFAULT_BLOCK preference, shared with the gate
     bk = _pick_block(t)
     qb = _to_bhtd(q)
@@ -214,11 +215,11 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal):
     perm = _ring_perm(sp)
 
     def full_hop(kv):
-        o, lse = _flash_fwd(qb, kv[0], kv[1], False, bq, bk)
+        o, lse = _flash_fwd(qb, kv[0], kv[1], False, bq, bk, h_per_kv=r)
         return o.astype(jnp.float32), lse[..., 0]
 
     def diag_hop(kv):
-        o, lse = _flash_fwd(qb, kv[0], kv[1], True, bq, bk)
+        o, lse = _flash_fwd(qb, kv[0], kv[1], True, bq, bk, h_per_kv=r)
         return o.astype(jnp.float32), lse[..., 0]
 
     def skip_hop(kv):
@@ -271,7 +272,17 @@ def ring_flash_attention(
     O(T_local·Dh) + the kernel's VMEM tiles. Requires a flash-tileable
     local sequence (`ops.flash_attention.supports_seq`); use
     `ring_attention` for odd lengths or non-TPU backends (the kernels
-    run in interpret mode off-TPU — correct but slow, tests only)."""
+    run in interpret mode off-TPU — correct but slow, tests only).
+
+    Grouped-query attention: k/v may carry fewer heads than q
+    (q heads % kv heads == 0) — the per-hop kernels read shared KV rows
+    directly, so long-context GQA rides the ring without ever
+    materializing a head repeat."""
+    if v.shape[2] != k.shape[2] or q.shape[2] % k.shape[2]:
+        raise ValueError(
+            "kv heads must match and divide q heads: "
+            f"q={q.shape[2]}, k={k.shape[2]}, v={v.shape[2]}"
+        )
     out, _ = _ring_flash_fwd_pass(q, k, v, axis_name, causal)
     return out
 
@@ -282,12 +293,13 @@ def _ring_flash_attention_fwd(q, k, v, axis_name, causal):
 
 
 def _ring_flash_attention_bwd(axis_name, causal, res, do):
-    from ..ops.flash_attention import _flash_bwd_vjp, _pick_block
+    from ..ops.flash_attention import _flash_bwd_impl, _pick_block
 
     q, k, v, out, lse = res
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
+    r = h // k.shape[2]  # grouped-query: q heads per kv head
     bq = _pick_block(t)  # must match the fwd pass tiling
     bk = _pick_block(t)
     qb = _to_bhtd(q)
@@ -298,8 +310,8 @@ def _ring_flash_attention_bwd(axis_name, causal, res, do):
     perm = _ring_perm(sp)
 
     def full_hop(kv):
-        dq, dk, dv = _flash_bwd_vjp(
-            False, bq, bk, (qb, kv[0], kv[1], ob, lse), dob
+        dq, dk, dv = _flash_bwd_impl(
+            qb, kv[0], kv[1], ob, lse, dob, False, bq, bk, h_per_kv=r
         )
         return (
             dq.astype(jnp.float32),
@@ -308,8 +320,8 @@ def _ring_flash_attention_bwd(axis_name, causal, res, do):
         )
 
     def diag_hop(kv):
-        dq, dk, dv = _flash_bwd_vjp(
-            True, bq, bk, (qb, kv[0], kv[1], ob, lse), dob
+        dq, dk, dv = _flash_bwd_impl(
+            qb, kv[0], kv[1], ob, lse, dob, True, bq, bk, h_per_kv=r
         )
         return (
             dq.astype(jnp.float32),
@@ -318,8 +330,11 @@ def _ring_flash_attention_bwd(axis_name, causal, res, do):
         )
 
     def skip_hop(kv):
-        z = jnp.zeros(qb.shape, jnp.float32)
-        return (z, z, z)
+        return (
+            jnp.zeros(qb.shape, jnp.float32),
+            jnp.zeros(kb.shape, jnp.float32),
+            jnp.zeros(kb.shape, jnp.float32),
+        )
 
     def step(carry, i):
         k_cur, v_cur, dk_cur, dv_cur, dq = carry
@@ -340,14 +355,15 @@ def _ring_flash_attention_bwd(axis_name, causal, res, do):
         dv_next = lax.ppermute(dv_cur, axis_name, perm)
         return (k_next, v_next, dk_next, dv_next, dq), None
 
-    z = jnp.zeros(qb.shape, jnp.float32)
+    zq = jnp.zeros(qb.shape, jnp.float32)
+    zkv = jnp.zeros(kb.shape, jnp.float32)
     (_, _, dk, dv, dq), _ = lax.scan(
-        step, (kb, vb, z, z, z), jnp.arange(sp)
+        step, (kb, vb, zkv, zkv, zq), jnp.arange(sp)
     )
     return (
         _from_bhtd(dq, b, h).astype(q.dtype),
-        _from_bhtd(dk, b, h).astype(k.dtype),
-        _from_bhtd(dv, b, h).astype(v.dtype),
+        _from_bhtd(dk, b, h // r).astype(k.dtype),
+        _from_bhtd(dv, b, h // r).astype(v.dtype),
     )
 
 
