@@ -44,8 +44,14 @@ def _dense_attention(q, k, v, causal: bool):
     """fp32-softmax reference attention over [B, T, H, D] — the SAME
     precision convention as the repo-wide test oracle
     (tests/conftest.py dense_attention_oracle): fp32 scores, fp32
-    probability-value matmul, cast at the end."""
+    probability-value matmul, cast at the end. Grouped-query inputs
+    (fewer kv heads) are repeated here — the flash path shares rows
+    instead."""
     d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -96,11 +102,21 @@ def ulysses_attention(
             and full_t <= _FLASH_AUTO_MAX_SEQ
         ):
             attn_fn = flash_attention
-    if h % sp:
+    kv_h = k.shape[2]
+    if h % sp or kv_h % sp:
+        # kv heads must ALSO split evenly (grouped-query inputs): each
+        # rank then holds whole q-head groups, so the post-exchange
+        # local q-head -> kv-head map stays the kernel's contiguous
+        # x // (h/g) rule.
         raise ValueError(
-            f"ulysses_attention needs heads ({h}) divisible by the "
-            f"sequence-parallel axis size ({sp}); use ring_attention "
-            "for head-poor models"
+            f"ulysses_attention needs q heads ({h}) and kv heads "
+            f"({kv_h}) divisible by the sequence-parallel axis size "
+            f"({sp}); use ring_attention for head-poor models"
+        )
+    if v.shape[2] != kv_h or h % kv_h:
+        raise ValueError(
+            "kv heads must match and divide q heads: "
+            f"q={h}, k={kv_h}, v={v.shape[2]}"
         )
 
     def seq_to_heads(x):

@@ -127,3 +127,55 @@ def test_head_poor_model_rejected(hvd):
 
     with pytest.raises(ValueError, match="divisible"):
         jax.jit(sharded)(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_gqa_matches_repeat_heads_oracle(hvd, causal):
+    """Grouped-query inputs through the exchanges (sp=2 mesh so a REAL
+    head grouping passes the kv%sp rule: h=8, kv=4, rep=2): kv heads
+    split over sp like q heads, whole q-head groups per rank, so the
+    inner attention's contiguous group rule stays exact."""
+    from jax.sharding import Mesh
+
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    g = 4  # kv heads: h=8 -> two q heads share each kv head
+    q, k, v = _qkv(4)
+    kg = k[:, :, :g]
+    vg = v[:, :, :g]
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("sp",))
+
+    for attn_fn in (None, flash_attention):
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+        def sharded(q, k, v):
+            return ulysses_attention(
+                q, k, v, axis_name="sp", causal=causal,
+                attn_fn=attn_fn,
+            )
+
+        got = np.asarray(jax.jit(sharded)(q, kg, vg))
+        rep = q.shape[2] // g
+        want = np.asarray(dense_attention_oracle(
+            q, jnp.repeat(kg, rep, 2), jnp.repeat(vg, rep, 2), causal
+        ))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    # head-poor GQA (kv heads not divisible by sp) is rejected loudly
+    mesh8 = hvd_pkg.mesh()
+
+    @partial(
+        jax.shard_map, mesh=mesh8,
+        in_specs=(P(None, hvd_pkg.WORLD_AXIS),) * 3,
+        out_specs=P(None, hvd_pkg.WORLD_AXIS),
+        check_vma=False,
+    )
+    def sharded8(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=hvd_pkg.WORLD_AXIS)
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(sharded8)(q, kg, vg)
