@@ -86,7 +86,10 @@ def main():
             per_tok = optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), labels
             )
-            valid = jnp.arange(t)[None, :] < lengths[:, None]
+            # next-token targets: position lengths-1 would read its
+            # label FROM the padding (and t-1 wraps), so the loss mask
+            # stops one short of the valid length
+            valid = jnp.arange(t)[None, :] < (lengths[:, None] - 1)
             return jnp.sum(jnp.where(valid, per_tok, 0.0)) / jnp.sum(valid)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

@@ -481,13 +481,6 @@ def _flash_bwd_vjp_w(causal, block_q, block_k, window, res, do):
     )
 
 
-def _flash_bwd_vjp(causal, block_q, block_k, res, do):
-    """Windowless compat shim — the ring-flash engine
-    (parallel/ring_attention.py) invokes the flash backward per hop
-    through this signature."""
-    return _flash_bwd_vjp_w(causal, block_q, block_k, None, res, do)
-
-
 def _flash_fwd_vjp_padded(q, k, v, lens, causal, block_q, block_k,
                           window):
     o, lse = _flash_fwd(

@@ -95,14 +95,32 @@ def _ring_fwd_pass(q, k, v, axis_name, causal):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_attention_mha(q, k, v, axis_name: str = "sp",
+                        causal: bool = False):
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal)
+    return out
+
+
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     """q, k, v: [B, T_local, H, Dh] (this chip's sequence shard).
 
     Returns [B, T_local, H, Dh] — exact softmax(QKᵀ)V over the full
     (sp·T_local)-token sequence. Differentiable via the second-ring-pass
-    VJP (module docstring)."""
-    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal)
-    return out
+    VJP (module docstring). Grouped-query inputs (fewer kv heads) are
+    repeated to full width here, OUTSIDE the custom VJP, so the
+    repeat's transpose group-sums dk/dv — the dense path materializes
+    scores anyway; use ``ring_flash_attention`` to keep the shared-KV
+    saving."""
+    if v.shape[2] != k.shape[2] or q.shape[2] % k.shape[2]:
+        raise ValueError(
+            "kv heads must match and divide q heads: "
+            f"q={q.shape[2]}, k={k.shape[2]}, v={v.shape[2]}"
+        )
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return _ring_attention_mha(q, k, v, axis_name, causal)
 
 
 def _ring_attention_fwd(q, k, v, axis_name, causal):
@@ -164,7 +182,7 @@ def _ring_attention_bwd(axis_name, causal, res, do):
     )
 
 
-ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
+_ring_attention_mha.defvjp(_ring_attention_fwd, _ring_attention_bwd)
 
 
 # ---------------------------------------------------------------------------

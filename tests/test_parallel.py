@@ -474,3 +474,43 @@ def test_ring_flash_gqa_matches_dense(hvd, rng, causal):
                                atol=5e-5)
     np.testing.assert_allclose(np.asarray(gv), np.asarray(dv), rtol=5e-4,
                                atol=5e-5)
+
+
+def test_dense_ring_gqa_matches_repeat_heads(hvd, rng):
+    """Dense ring with grouped-query inputs: repeat OUTSIDE the custom
+    VJP means dk/dv group-sum automatically — fwd + grads vs the
+    repeat-heads oracle."""
+    b, t, h, g, d = 1, 32, 4, 2, 8
+    q = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, t, g, d)).astype(np.float32)
+    v = rng.normal(size=(b, t, g, d)).astype(np.float32)
+    w = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    mesh = mesh_1d("sp")
+
+    def ring_loss(q, k, v, w):
+        o = ring_attention(q, k, v, "sp", causal=True)
+        return jnp.sum(o * w)
+
+    gq, gk, gv = jax.jit(
+        jax.shard_map(
+            lambda q, k, v, w: jax.grad(ring_loss, argnums=(0, 1, 2))(
+                q, k, v, w
+            ),
+            mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )(q, k, v, w)
+    rep = h // g
+
+    def dense_loss(q, k, v):
+        kk = jnp.repeat(k, rep, axis=2)
+        vv = jnp.repeat(v, rep, axis=2)
+        return jnp.sum(dense_attention(q, kk, vv, True) * w)
+
+    dq, dk, dv = jax.grad(dense_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    )
+    for got, want in ((gq, dq), (gk, dk), (gv, dv)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-5
+        )
