@@ -136,18 +136,22 @@ class TransformerConfig:
         )
 
 
-def apply_rope(x, base: float = 10000.0, offset: int = 0):
+def apply_rope(x, base: float = 10000.0, offset=0):
     """Rotate [batch, seq, heads, head_dim] q or k by absolute position
     (RoFormer). Pairs are (x[..., :d/2], x[..., d/2:]) — the
     'rotate-half' convention — so the op is two multiplies and one
     concat, fully XLA-fusible. fp32 trig regardless of input dtype;
     ``offset`` shifts positions (sequence-parallel shards pass their
-    global start)."""
+    global start — may be a traced value, e.g. axis_index·t_local)."""
     b, t, h, d = x.shape
     half = d // 2
     if d % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {d}")
-    pos = jnp.arange(offset, offset + t, dtype=jnp.float32)
+    # offset + iota rather than arange(offset, ...) so traced offsets
+    # (SP shards) work
+    pos = jnp.asarray(offset, jnp.float32) + jnp.arange(
+        t, dtype=jnp.float32
+    )
     inv_freq = base ** (
         -jnp.arange(0, half, dtype=jnp.float32) / half
     )

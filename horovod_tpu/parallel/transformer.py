@@ -81,6 +81,11 @@ class ParallelTransformerConfig:
     # on any backend (interpret-mode kernels off-TPU — tests), False
     # forces the dense ring.
     flash_ring: Any = "auto"
+    # Rotary position embeddings instead of the learned pos table: the
+    # rotation offset is this shard's global start (axis_index("sp") *
+    # t_local) — RoPE's relative form is what makes it compose with
+    # sequence parallelism without any cross-shard exchange.
+    rope: bool = False
 
 
 Params = Dict[str, Any]
@@ -173,12 +178,18 @@ def _layer_norm(x, scale, bias):
     return ((xf - mu) * lax.rsqrt(var + 1e-5) * scale + bias).astype(x.dtype)
 
 
-def _block(layer_params, x, use_flash_ring=False):
+def _block(layer_params, x, use_flash_ring=False, rope=False):
     """One transformer block, per-device view: heads/FFN tp-sharded,
     sequence sp-sharded (ring attention handles the full context)."""
     h = _layer_norm(x, layer_params["ln1_scale"], layer_params["ln1_bias"])
     qkv = jnp.einsum("btd,dchx->btchx", h, layer_params["wqkv"])
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H/tp,hd]
+    if rope:
+        from ..models.transformer import apply_rope
+
+        offset = lax.axis_index("sp") * x.shape[1]
+        q = apply_rope(q, offset=offset)
+        k = apply_rope(k, offset=offset)
     attn_fn = ring_flash_attention if use_flash_ring else ring_attention
     attn = attn_fn(q, k, v, axis_name="sp", causal=True)
     proj = jnp.einsum("bthx,hxd->btd", attn, layer_params["wo"])
@@ -199,11 +210,11 @@ def _resolve_flash_ring(cfg: "ParallelTransformerConfig", t_local: int):
     return bool(cfg.flash_ring)
 
 
-def _stage_fn(stage_params, x, use_flash_ring=False):
+def _stage_fn(stage_params, x, use_flash_ring=False, rope=False):
     """Apply this pp stage's layer stack (scan over its layers)."""
 
     def body(h, layer):
-        return _block(layer, h, use_flash_ring), None
+        return _block(layer, h, use_flash_ring, rope), None
 
     out, _ = lax.scan(body, x, stage_params)
     return out
@@ -217,8 +228,11 @@ def _forward_loss(params, tokens, labels, cfg: ParallelTransformerConfig):
     sp_idx = lax.axis_index("sp")
     t_local = tokens.shape[1]
     x = params["embed"]["tok"][tokens]
-    pos = params["embed"]["pos"][sp_idx * t_local + jnp.arange(t_local)]
-    x = x + pos[None]
+    if not cfg.rope:
+        pos = params["embed"]["pos"][
+            sp_idx * t_local + jnp.arange(t_local)
+        ]
+        x = x + pos[None]
 
     # Pipeline over microbatches (batch split).
     b_local = x.shape[0]
@@ -226,7 +240,9 @@ def _forward_loss(params, tokens, labels, cfg: ParallelTransformerConfig):
     xm = x.reshape(n_micro, b_local // n_micro, t_local, -1)
     use_flash_ring = _resolve_flash_ring(cfg, t_local)
     out = gpipe(
-        functools.partial(_stage_fn, use_flash_ring=use_flash_ring),
+        functools.partial(
+            _stage_fn, use_flash_ring=use_flash_ring, rope=cfg.rope
+        ),
         params["stages"],
         xm,
         axis_name="pp",

@@ -514,3 +514,24 @@ def test_dense_ring_gqa_matches_repeat_heads(hvd, rng):
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-5
         )
+
+
+def test_parallel_step_rope_matches_dp_baseline(hvd):
+    """RoPE x sequence parallelism: the per-shard rotation offset
+    (axis_index * t_local) must reproduce the dp-only baseline's step
+    exactly on an sp-bearing mesh — the relative-position property
+    under real sharding."""
+    base_params, base_losses = _run_steps(
+        MeshSpec(dp=2), n_steps=1, rope=True
+    )
+    test_params, test_losses = _run_steps(
+        MeshSpec(dp=2, sp=2, tp=2), n_steps=1, rope=True
+    )
+    np.testing.assert_allclose(base_losses, test_losses, rtol=1e-5)
+    flat_base, _ = jax.tree_util.tree_flatten_with_path(base_params)
+    flat_test = jax.tree_util.tree_leaves(test_params)
+    for (path, b), t in zip(flat_base, flat_test):
+        np.testing.assert_allclose(
+            b, t, rtol=5e-4, atol=1e-5,
+            err_msg=f"rope param mismatch at {jax.tree_util.keystr(path)}",
+        )
