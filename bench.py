@@ -200,12 +200,7 @@ def inner_main():
     if model_name == "vit_b16":
         # flash-pad engages on TPU under the auto default (r04: the
         # padded kernels made ViT's 197 tokens tileable via 200+lengths)
-        result["attn"] = (
-            "dense"
-            if os.environ.get("BENCH_VIT_FLASHPAD", "auto")
-            in ("0", "false", "off") or platform != "tpu"
-            else "flash_pad"
-        )
+        result["attn"] = _vit_attn_mode(platform)
     result.update(
         _mfu_fields(flops, n_iters, dt, platform, step_bytes=step_bytes)
     )
@@ -243,6 +238,18 @@ def _extract_json(stdout):
             except json.JSONDecodeError:
                 continue
     return None
+
+
+def _vit_attn_mode(platform: str) -> str:
+    """ViT attention-engine provenance (the artifact's "attn" field):
+    ONE predicate shared by inner_main's stamp and the stale-gate's
+    expectation so the two can't drift (ADVICE r4). flash_pad engages
+    only on TPU under the auto default."""
+    if os.environ.get("BENCH_VIT_FLASHPAD", "auto") in (
+        "0", "false", "off"
+    ):
+        return "dense"
+    return "flash_pad" if platform == "tpu" else "dense"
 
 
 def _stale_artifact(metric, config=None):
@@ -368,13 +375,10 @@ def orchestrate():
     if os.environ.get("BENCH_MODEL") == "vit_b16":
         # same provenance rule for ViT's attention engine (artifacts
         # predating the attn field were dense captures)
-        stale_config["attn"] = (
-            "dense"
-            if os.environ.get("BENCH_VIT_FLASHPAD", "auto")
-            in ("0", "false", "off")
-            else "flash_pad",
-            "dense",
-        )
+        # stale candidates are platform-filtered to "tpu" inside
+        # _stale_artifact, so the expectation evaluates the shared
+        # predicate at platform="tpu"
+        stale_config["attn"] = (_vit_attn_mode("tpu"), "dense")
 
     def _find_stale():
         if not stale_ok or forced:

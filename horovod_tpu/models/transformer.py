@@ -89,10 +89,23 @@ class TransformerConfig:
         if mask is not None:
             return False
         if seq is not None:
-            from ..ops.flash_attention import supports_seq
+            from ..ops.flash_attention import fits_vmem, supports_seq
 
             if not supports_seq(
                 seq, self.flash_block_q, self.flash_block_k
+            ):
+                return False
+            # The backward dK/dV kernel stages the whole q-head group
+            # whole-sequence; past the VMEM budget the dense path is
+            # the one that compiles (ADVICE r4).
+            import numpy as _np
+
+            if not fits_vmem(
+                seq,
+                self.d_model // self.num_heads,
+                self.num_heads // (self.num_kv_heads or self.num_heads),
+                _np.dtype(self.dtype).itemsize,
+                self.flash_block_k,
             ):
                 return False
         if self.flash_attention == "auto":

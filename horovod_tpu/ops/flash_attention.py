@@ -395,6 +395,73 @@ def supports_seq(
     return ok(_pick_block(t, block_q)) and ok(_pick_block(t, block_k))
 
 
+_VMEM_BUDGET_DEFAULT = 12 * 2**20  # headroom under a v5e core's ~16 MiB
+
+
+def _vmem_budget() -> int:
+    import os
+
+    return int(
+        os.environ.get("HOROVOD_FLASH_VMEM_BUDGET", _VMEM_BUDGET_DEFAULT)
+    )
+
+
+def bwd_vmem_bytes(
+    seq: int,
+    d: int,
+    h_per_kv: int = 1,
+    itemsize: int = 2,
+    block_k: int = None,
+) -> int:
+    """Per-program VMEM staging estimate for the dK/dV backward kernel
+    — the family's largest stager. With grouped-query attention it
+    fetches the KV row's whole q-head group whole-sequence ((r, seq, d)
+    blocks for q/do/o plus an (r, seq, lanes) fp32 lse), so the
+    footprint grows r-fold on top of the whole-sequence staging the
+    module header documents (ADVICE r4). e.g. r=8, seq=4096, d=128,
+    bf16: ~25 MiB — past a v5e core's ~16 MiB."""
+    lanes = _interchange_lanes()
+    bk = _pick_block(seq, block_k if block_k else DEFAULT_BLOCK)
+    stage = h_per_kv * seq * (3 * d * itemsize + 4 * lanes)  # q/do/o+lse
+    stage += 4 * bk * d * itemsize  # k/v in-blocks + dk/dv out-blocks
+    return stage
+
+
+def fits_vmem(
+    seq: int,
+    d: int,
+    h_per_kv: int = 1,
+    itemsize: int = 2,
+    block_k: int = None,
+) -> bool:
+    """Whether the backward kernels' per-program staging fits the
+    per-core VMEM budget (HOROVOD_FLASH_VMEM_BUDGET bytes, default
+    12 MiB of a v5e core's ~16). TransformerConfig.uses_flash and the
+    ulysses/ring auto-gates fall back to the dense engines when this
+    fails; direct ``flash_attention``/``ring_flash_attention`` callers
+    get a warning rather than an error (forward-only use stages ~3x
+    less and may still compile)."""
+    return (
+        bwd_vmem_bytes(seq, d, h_per_kv, itemsize, block_k)
+        <= _vmem_budget()
+    )
+
+
+def _warn_vmem(seq, d, h_per_kv, itemsize, block_k=None, what=""):
+    import warnings
+
+    warnings.warn(
+        f"{what or 'flash_attention'} backward staging estimate "
+        f"{bwd_vmem_bytes(seq, d, h_per_kv, itemsize, block_k) / 2**20:.0f}"
+        f" MiB (seq={seq}, head_dim={d}, q-heads-per-kv={h_per_kv}) "
+        f"exceeds the VMEM budget ({_vmem_budget() / 2**20:.0f} MiB)"
+        f"; Mosaic compilation of the dK/dV kernel will likely fail"
+        f" — use ring attention over more chips, more KV heads, or the"
+        f" dense path.",
+        stacklevel=3,
+    )
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
@@ -715,6 +782,8 @@ def flash_attention(
             f"k={k.shape[2]}, v={v.shape[2]}"
         )
     h_per_kv = h // kv_h
+    if not fits_vmem(t, d, h_per_kv, q.dtype.itemsize, block_k):
+        _warn_vmem(t, d, h_per_kv, q.dtype.itemsize, block_k)
     block_q = _pick_block(t, block_q)
     block_k = _pick_block(t, block_k)
 

@@ -301,6 +301,17 @@ def ring_flash_attention(
             "kv heads must match and divide q heads: "
             f"q={q.shape[2]}, k={k.shape[2]}, v={v.shape[2]}"
         )
+    from ..ops.flash_attention import _warn_vmem, fits_vmem
+
+    # each backward hop runs the same dK/dV kernel at the LOCAL length,
+    # with the same r-fold group staging — the VMEM budget applies
+    # per-hop (ADVICE r4)
+    r = q.shape[2] // k.shape[2]
+    if not fits_vmem(q.shape[1], q.shape[3], r, q.dtype.itemsize):
+        _warn_vmem(
+            q.shape[1], q.shape[3], r, q.dtype.itemsize,
+            what="ring_flash_attention (per hop)",
+        )
     out, _ = _ring_flash_fwd_pass(q, k, v, axis_name, causal)
     return out
 

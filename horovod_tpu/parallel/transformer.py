@@ -202,11 +202,24 @@ def _block(layer_params, x, use_flash_ring=False, rope=False):
 
 
 def _resolve_flash_ring(cfg: "ParallelTransformerConfig", t_local: int):
-    """Trace-time engine choice (backend + tileability are static)."""
-    from ..ops.flash_attention import supports_seq
+    """Trace-time engine choice (backend + tileability are static).
+    The auto gate also checks the per-hop backward VMEM budget — each
+    ring hop runs the dK/dV kernel at the local length (ADVICE r4)."""
+    import numpy as np
+
+    from ..ops.flash_attention import fits_vmem, supports_seq
 
     if cfg.flash_ring == "auto":
-        return jax.default_backend() == "tpu" and supports_seq(t_local)
+        return (
+            jax.default_backend() == "tpu"
+            and supports_seq(t_local)
+            and fits_vmem(
+                t_local,
+                cfg.d_model // cfg.num_heads,
+                1,
+                np.dtype(cfg.dtype).itemsize,
+            )
+        )
     return bool(cfg.flash_ring)
 
 

@@ -87,19 +87,31 @@ def ulysses_attention(
     sp = lax.axis_size(axis_name)
     b, t_local, h, d = q.shape
     if attn_fn is None:
-        from ..ops.flash_attention import flash_attention, supports_seq
+        from ..ops.flash_attention import (
+            fits_vmem,
+            flash_attention,
+            supports_seq,
+        )
 
         full_t = t_local * sp
         # The kernels stage K and V whole-sequence in VMEM per program,
         # so the auto-gate also caps the post-exchange sequence length
         # (~2 MB per bf16 operand at 8192·128 — comfortably inside a
         # v5e core's ~16 MB VMEM; beyond that, per the module
-        # docstring, extreme T is ring territory). Pass attn_fn
+        # docstring, extreme T is ring territory). With grouped-query
+        # inputs the backward dK/dV kernel stages r-fold more, so the
+        # gate also checks the VMEM budget (ADVICE r4). Pass attn_fn
         # explicitly to override.
         if (
             jax.default_backend() == "tpu"
             and supports_seq(full_t)
             and full_t <= _FLASH_AUTO_MAX_SEQ
+            and fits_vmem(
+                full_t,
+                d,
+                q.shape[2] // k.shape[2],
+                q.dtype.itemsize,
+            )
         ):
             attn_fn = flash_attention
     kv_h = k.shape[2]

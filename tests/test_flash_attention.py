@@ -100,6 +100,46 @@ def test_untileable_seq_falls_back_to_dense():
     assert not cfg.uses_flash(seq=197)
 
 
+def test_vmem_footprint_gate():
+    """The dK/dV backward kernel stages the whole q-head group
+    whole-sequence, so big seq*(h/kv_h) products must gate the model
+    off the flash path before Mosaic fails compilation (ADVICE r4)."""
+    from horovod_tpu.models.transformer import TransformerConfig
+    from horovod_tpu.ops.flash_attention import bwd_vmem_bytes, fits_vmem
+
+    # bench configs stay comfortably inside the budget
+    assert fits_vmem(512, 64, 1, 2)  # gpt2-medium
+    assert fits_vmem(512, 64, 16, 2)  # gpt2-medium @ 1 kv head
+    assert fits_vmem(8192, 128, 1, 2)  # ulysses auto-gate cap, MHA
+    # the advisor's example: r=8, seq 4k, d=128, bf16 — ~25 MiB
+    assert bwd_vmem_bytes(4096, 128, 8, 2) > 16 * 2**20
+    assert not fits_vmem(4096, 128, 8, 2)
+
+    # uses_flash applies the same gate from config geometry
+    big = TransformerConfig(
+        num_layers=1, d_model=1024, num_heads=8, num_kv_heads=1,
+        causal=True, flash_attention=True,
+    )
+    assert big.uses_flash(seq=512)
+    assert not big.uses_flash(seq=4096)
+
+    # direct kernel calls warn (forward-only may still compile)
+    import warnings
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 4096, 8, 128)), jnp.bfloat16)
+    kv = jnp.asarray(rng.normal(size=(1, 4096, 1, 128)), jnp.bfloat16)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        flash_attention(q, kv, kv, causal=True)
+    assert any("VMEM budget" in str(x.message) for x in w)
+
+
 def test_vit_forward_with_flash_forced_on():
     """The full ViT (seq 197) must run even with flash_attention=True —
     the dense fallback, not a Mosaic compile error."""
