@@ -1,0 +1,198 @@
+"""Eager-dispatch fusion microbenchmark — the measurement behind the
+core-runtime redesign's premise (VERDICT r4 Weak #2 / item 3).
+
+`ops/fusion.py` exists because "many small eager collectives are slow
+if dispatched one XLA executable each" (module header; ref:
+fusion_buffer_manager.cc, parameter_manager.cc semantics [V]). This
+harness measures that claim directly, on whatever backend is present:
+
+  * unfused — threshold=1 byte: every enqueue flushes a single-entry
+    batch → N executable launches per step (the no-fusion world).
+  * fused — threshold > N·bytes: one flush concatenates all N entries
+    into one [world, total] buffer → ONE launch per step.
+  * traced — one jit'd shard_map psum over the same total bytes: the
+    floor (no queue, no scatter-back, no per-entry Python).
+  * autotune — `common/autotune.py`'s BayesianOptimizer proposes
+    (threshold, cycle) pairs against the same workload; the run shows
+    whether the GP's pick beats the shipped defaults.
+
+Per mode prints one JSON line:
+  {"metric": "eager_fusion", "mode": ..., "n_tensors": N,
+   "bytes_each": B, "value": ms/step, "unit": "ms"}
+then a speedup summary and the autotune verdict line.
+
+Env: BENCH_FUSION_N (default 200), BENCH_FUSION_BYTES (default 1 MiB),
+BENCH_ITERS (default 10), BENCH_AUTOTUNE_TRIALS (default 10, 0 = skip),
+BENCH_PLATFORM=cpu for the simulated mesh (sim lines carry the
+quarantine note — dispatch overhead on CPU validates logic only).
+"""
+
+import json
+import os
+import time
+
+_SIM_NOTE = (
+    "logic-validation only (CPU simulation); NOT a TPU dispatch number"
+)
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from _benchlib import sync as _sync
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.topology import WORLD_AXIS
+    from horovod_tpu.ops import traced
+
+    n_tensors = int(os.environ.get("BENCH_FUSION_N", "200"))
+    nbytes = int(os.environ.get("BENCH_FUSION_BYTES", str(1 << 20)))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    trials = int(os.environ.get("BENCH_AUTOTUNE_TRIALS", "10"))
+    n_elems = max(nbytes // 4, 1)
+
+    hvd.init()
+    fusion = basics._state.fusion
+    world = hvd.size()
+    platform = jax.devices()[0].platform
+    mesh = hvd.mesh()
+
+    default_threshold = fusion.threshold_bytes
+    default_cycle = fusion.cycle_time_ms
+
+    rng = np.random.default_rng(0)
+    bufs0 = [
+        jnp.asarray(
+            rng.normal(size=(world, n_elems)).astype(np.float32)
+        )
+        for _ in range(n_tensors)
+    ]
+
+    def eager_step(bufs):
+        handles = [
+            hvd.allreduce_async(b, op=hvd.Average, name=f"t{i}")
+            for i, b in enumerate(bufs)
+        ]
+        return [h.wait() for h in handles]
+
+    def run_eager(threshold, cycle_ms):
+        fusion.threshold_bytes = int(threshold)
+        fusion.cycle_time_ms = float(cycle_ms)
+        bufs = eager_step(list(bufs0))  # warm: compile executors
+        bufs = eager_step(bufs)  # warm again on committed outputs
+        _sync(sum(jnp.sum(b) for b in bufs))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bufs = eager_step(bufs)
+        _sync(sum(jnp.sum(b) for b in bufs))
+        return (time.perf_counter() - t0) / iters * 1e3  # ms/step
+
+    def emit(mode, ms, extra=None):
+        line = {
+            "metric": "eager_fusion",
+            "mode": mode,
+            "n_tensors": n_tensors,
+            "bytes_each": nbytes,
+            "world": world,
+            "value": round(ms, 3),
+            "unit": "ms",
+            "platform": platform,
+        }
+        if extra:
+            line.update(extra)
+        if platform != "tpu":
+            line["note"] = _SIM_NOTE
+        print(json.dumps(line), flush=True)
+        return ms
+
+    total = n_tensors * nbytes
+    ms_unfused = emit("unfused", run_eager(1, 1e9))
+    ms_fused = emit("fused", run_eager(total * 2, 1e9))
+    ms_default = emit(
+        "default",
+        run_eager(default_threshold, default_cycle),
+        {"threshold": default_threshold, "cycle_ms": default_cycle},
+    )
+
+    # traced floor: ONE psum over the same bytes, chained for sync
+    from functools import partial
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P(WORLD_AXIS),
+        out_specs=P(WORLD_AXIS),
+        check_vma=False,
+    )
+    def reduce(x):
+        return traced.allreduce(x[0], op=hvd.Average)[None]
+
+    step = jax.jit(reduce)
+    x = jnp.ones((world, n_tensors * n_elems), jnp.float32)
+    x = step(step(x))
+    _sync(x)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = step(x)
+    _sync(x)
+    ms_traced = emit(
+        "traced", (time.perf_counter() - t0) / iters * 1e3
+    )
+
+    line = {
+        "metric": "eager_fusion_speedup",
+        "value": round(ms_unfused / ms_fused, 3),
+        "unit": "x",
+        "unfused_ms": round(ms_unfused, 3),
+        "fused_ms": round(ms_fused, 3),
+        "traced_ms": round(ms_traced, 3),
+        "world": world,
+        "platform": platform,
+    }
+    if platform != "tpu":
+        line["note"] = _SIM_NOTE
+    print(json.dumps(line), flush=True)
+
+    if trials > 0:
+        from horovod_tpu.common.autotune import BayesianOptimizer
+
+        bo = BayesianOptimizer(seed=0)
+        # seed the GP with the three corners already measured
+        bo.observe(1, 1e3, -ms_unfused)
+        bo.observe(total * 2, 1e3, -ms_fused)
+        bo.observe(default_threshold, default_cycle, -ms_default)
+        for _ in range(trials):
+            thr, cyc = bo.suggest()
+            bo.observe(thr, cyc, -run_eager(thr, cyc))
+        (best_thr, best_cyc) = bo.best()
+        ms_best = run_eager(best_thr, best_cyc)
+        line = {
+            "metric": "fusion_autotune",
+            "threshold": int(best_thr),
+            "cycle_ms": round(float(best_cyc), 3),
+            "value": round(ms_best, 3),
+            "unit": "ms",
+            "default_ms": round(ms_default, 3),
+            "default_threshold": default_threshold,
+            "trials": trials,
+            "world": world,
+            "platform": platform,
+        }
+        if platform != "tpu":
+            line["note"] = _SIM_NOTE
+        print(json.dumps(line), flush=True)
+
+    # restore shipped defaults (harmless — process exits anyway)
+    fusion.threshold_bytes = default_threshold
+    fusion.cycle_time_ms = default_cycle
+
+
+if __name__ == "__main__":
+    main()

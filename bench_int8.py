@@ -1,0 +1,131 @@
+"""int8 quantized-allreduce compute-tax microbenchmark (VERDICT r4
+item 5 / Weak #4).
+
+`traced.quantized_allreduce`'s wire claim ("true ~4x fewer bytes on
+ICI") is a byte model; single-chip hardware can't prove busbw, but the
+KERNEL-SIDE cost — two stochastic-rounding quantize stages (Pallas
+`int8_quantize`), dequant-sum, and the optional error-feedback residual
+— is measurable today and decides whether the wire win survives at
+real link speeds. This harness times, per payload size:
+
+  * plain  — `traced.allreduce` (psum; folds to a copy at world=1)
+  * quant  — `traced.quantized_allreduce`
+  * quant_ef — the same with `return_residual=True` (EF carry)
+
+and prints per size one JSON line:
+  {"metric": "int8_compute_tax", "bytes": N, "value": quant_ms/plain_ms,
+   "plain_ms": ..., "quant_ms": ..., "quant_ef_ms": ..., "ef_over_quant": ...}
+
+Abort criterion for the docs (docs/perf.md): at a v5e-class ICI rate,
+int8 wins only if (quant_ms − plain_ms) < 0.75 · wire_time_fp32(bytes)
+· ring_factor — the tax must undercut the bytes it saves.
+
+Env: BENCH_SIZES (bytes, comma-sep; default 1,4,16,64,256 MiB),
+BENCH_ITERS (default 20), BENCH_PLATFORM=cpu for the simulated mesh
+(sim lines carry the quarantine note).
+"""
+
+import json
+import os
+import time
+from functools import partial
+
+_SIM_NOTE = (
+    "logic-validation only (CPU simulation); NOT a TPU kernel-cost "
+    "number"
+)
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from _benchlib import sync as _sync
+    from horovod_tpu.common.topology import WORLD_AXIS
+    from horovod_tpu.ops import traced
+    from horovod_tpu.ops.reduction_ops import Average
+
+    devices = jax.devices()
+    world = len(devices) if devices[0].platform != "tpu" else 1
+    mesh = Mesh(np.array(devices[:world]), (WORLD_AXIS,))
+    platform = devices[0].platform
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    sizes_env = os.environ.get("BENCH_SIZES")
+    if sizes_env:
+        sizes = [int(s) for s in sizes_env.split(",")]
+    else:
+        sizes = [1 << 20, 4 << 20, 16 << 20, 64 << 20, 256 << 20]
+
+    def timed(step, x):
+        x = step(step(x))  # compile fresh + committed-input variants
+        _sync(x)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            x = step(x)
+        _sync(x)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    for nbytes in sizes:
+        n = max(nbytes // 4, 1)
+
+        def shmap(fn):
+            return jax.jit(
+                partial(
+                    jax.shard_map,
+                    mesh=mesh,
+                    in_specs=P(WORLD_AXIS),
+                    out_specs=P(WORLD_AXIS),
+                    check_vma=False,
+                )(fn)
+            )
+
+        plain = shmap(
+            lambda x: traced.allreduce(x[0], op=Average)[None]
+        )
+        quant = shmap(
+            lambda x: traced.quantized_allreduce(x[0], op=Average)[None]
+        )
+
+        def _ef(x):
+            out, res = traced.quantized_allreduce(
+                x[0], op=Average, return_residual=True
+            )
+            # fold the residual back in the way the EF optimizer does —
+            # the carry must stay live, not be DCE'd
+            return (out + 1e-6 * res)[None]
+
+        quant_ef = shmap(_ef)
+
+        x0 = jnp.asarray(
+            np.random.default_rng(0)
+            .normal(size=(world, n))
+            .astype(np.float32)
+        )
+        ms_plain = timed(plain, x0)
+        ms_quant = timed(quant, x0)
+        ms_ef = timed(quant_ef, x0)
+        line = {
+            "metric": "int8_compute_tax",
+            "bytes": nbytes,
+            "world": world,
+            "value": round(ms_quant / ms_plain, 3),
+            "unit": "x",
+            "plain_ms": round(ms_plain, 3),
+            "quant_ms": round(ms_quant, 3),
+            "quant_ef_ms": round(ms_ef, 3),
+            "ef_over_quant": round(ms_ef / ms_quant, 3),
+            "platform": platform,
+        }
+        if platform != "tpu":
+            line["note"] = _SIM_NOTE
+        print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

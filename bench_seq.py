@@ -1,0 +1,136 @@
+"""Sequence-length scaling sweep for the flash-attention kernels
+(VERDICT r4 item 8 — long-context perf evidence).
+
+Times ONE attention op (fwd, and fwd+bwd through the custom VJP) at
+growing sequence lengths on the real chip, reporting achieved TFLOP/s
+so the O(T²) compute scaling and the kernels' efficiency at long T are
+visible in one table. The dense-path control runs where it fits in HBM
+(the score matrix is b·h·t² fp32 — 16 GiB stops it well before the
+flash path stops).
+
+Causal attention FLOPs (the convention docs/perf.md uses): forward is
+two t×t×d matmuls per (batch, head) halved by the causal mask —
+2 · 2 · b·h·t²·d · ½. Backward recomputes P and runs five matmuls:
+2.5× forward.
+
+Per (engine, seq) prints one JSON line:
+  {"metric": "attn_seq_sweep", "engine": "flash|dense", "seq": T,
+   "value": ms fwd+bwd, "unit": "ms", "fwd_ms": ..., "tflops": ...}
+
+Env: BENCH_SEQS (comma-sep, default 1024,2048,4096,8192), BENCH_BATCH
+(default 4), BENCH_HEADS (16), BENCH_HEAD_DIM (64), BENCH_ITERS (10),
+BENCH_DENSE_MAX_SEQ (default 4096), BENCH_PLATFORM=cpu for interpret-
+mode logic validation (sim note attached).
+"""
+
+import json
+import os
+import time
+
+_SIM_NOTE = (
+    "logic-validation only (CPU interpret mode); NOT a TPU kernel "
+    "number"
+)
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from _benchlib import sync as _sync
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    platform = jax.devices()[0].platform
+    seqs = [
+        int(s)
+        for s in os.environ.get(
+            "BENCH_SEQS", "1024,2048,4096,8192"
+        ).split(",")
+    ]
+    b = int(os.environ.get("BENCH_BATCH", "4"))
+    h = int(os.environ.get("BENCH_HEADS", "16"))
+    d = int(os.environ.get("BENCH_HEAD_DIM", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    dense_max = int(os.environ.get("BENCH_DENSE_MAX_SEQ", "4096"))
+
+    def dense(q, k, v):
+        t = q.shape[1]
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k,
+            preferred_element_type=jnp.float32,
+        ) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+        ).astype(q.dtype)
+
+    def run(engine, attn, t):
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(
+                rng.normal(size=(b, t, h, d)), jnp.bfloat16
+            )
+            for _ in range(3)
+        )
+
+        fwd = jax.jit(lambda q, k, v: attn(q, k, v))
+        loss_grad = jax.jit(
+            jax.grad(
+                lambda q, k, v: attn(q, k, v)
+                .astype(jnp.float32)
+                .sum(),
+                argnums=(0, 1, 2),
+            )
+        )
+
+        def timed(fn, args):
+            out = fn(*args)
+            _sync(jax.tree.leaves(out)[0])
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            _sync(jax.tree.leaves(out)[0])
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        fwd_ms = timed(fwd, (q, k, v))
+        # jax.grad re-runs the forward inside, so this IS fwd+bwd
+        both_ms = timed(loss_grad, (q, k, v))
+        fwd_flops = 2.0 * b * h * t * t * d  # 2 matmuls · ½ causal
+        total_flops = fwd_flops * 3.5
+        line = {
+            "metric": "attn_seq_sweep",
+            "engine": engine,
+            "seq": t,
+            "batch": b,
+            "heads": h,
+            "head_dim": d,
+            "value": round(both_ms, 3),
+            "unit": "ms",
+            "fwd_ms": round(fwd_ms, 3),
+            "fwd_tflops": round(fwd_flops / (fwd_ms / 1e3) / 1e12, 2),
+            "tflops": round(total_flops / (both_ms / 1e3) / 1e12, 2),
+            "platform": platform,
+        }
+        if platform != "tpu":
+            line["note"] = _SIM_NOTE
+        print(json.dumps(line), flush=True)
+
+    for t in seqs:
+        run(
+            "flash",
+            lambda q, k, v: flash_attention(q, k, v, causal=True),
+            t,
+        )
+        if t <= dense_max:
+            run("dense", dense, t)
+
+
+if __name__ == "__main__":
+    main()

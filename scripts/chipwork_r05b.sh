@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Round-5 chip work, part b: the three NEW microbench harnesses, queued
+# behind part a's capture roster (VERDICT r4 items 3/5/8):
+#   * bench_fusion.py — eager fused-vs-unfused dispatch + GP autotune
+#     validation (the fusion engine's premise, measured on chip)
+#   * bench_int8.py — quantized_allreduce kernel-side tax vs plain psum
+#   * bench_seq.py  — flash kernel seq sweep 1k/2k/4k/8k vs dense
+# Same discipline as part a (skip-if-done, probe gate, HOLD file).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+R=r05
+
+echo "=== chipwork_r05b start $(date -u +%F' '%H:%M)" >&2
+
+while pgrep -f "chipwork_r05a.sh" >/dev/null 2>&1 \
+      || pgrep -f "python bench(_lm|_allreduce|_fusion|_int8|_seq)?.py" >/dev/null 2>&1; do
+  sleep 120
+done
+
+probe_backend() {
+  timeout 7200 python - <<'PYEOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+PYEOF
+}
+
+wait_backend() {
+  echo "=== probing TPU backend $(date -u +%H:%M)" >&2
+  until probe_backend; do
+    echo "backend still down $(date -u +%H:%M); retry in 300s" >&2
+    sleep 300
+  done
+  echo "=== backend UP $(date -u +%H:%M)" >&2
+}
+
+hold_gate() {
+  while [ -e scripts/CHIP_HOLD ]; do
+    echo "=== CHIP_HOLD present; waiting $(date -u +%H:%M)" >&2
+    sleep 60
+  done
+}
+
+run_one() {  # multi-line JSON harnesses: keep EVERY json line
+  local name="$1"; shift
+  local out="bench_results/${name}_${R}.json"
+  echo "=== $name $(date -u +%H:%M)" >&2
+  "$@" > "$out.tmp" 2> "bench_results/${name}_${R}.err"
+  if grep -qE '^\{' "$out.tmp"; then
+    grep -E '^\{' "$out.tmp" > "$out"
+    rm -f "$out.tmp" "bench_results/${name}_${R}.err"
+    cat "$out" >&2
+    return 0
+  fi
+  rm -f "$out.tmp"
+  return 1
+}
+
+cap() {
+  local name="$1"
+  local out="bench_results/${name}_${R}.json"
+  if [ -s "$out" ]; then
+    echo "=== $name already captured, skipping" >&2
+    return 0
+  fi
+  hold_gate
+  if run_one "$@"; then return 0; fi
+  echo "=== $name failed; gating on backend health before one retry" >&2
+  wait_backend
+  hold_gate
+  if run_one "$@"; then return 0; fi
+  echo "FAILED $name twice with backend up (see .err)" >&2
+  return 1
+}
+
+wait_backend
+
+cap fusion_dispatch   python bench_fusion.py
+cap int8_tax          python bench_int8.py
+cap attn_seq_sweep    python bench_seq.py
+
+echo "=== chipwork_r05b complete $(date -u +%F' '%H:%M)" >&2
