@@ -1,0 +1,1 @@
+"""Test-support shims (conformance fakes for optional cluster deps)."""

@@ -1,0 +1,432 @@
+"""In-process conformance fake of the slice of the ray API that
+``horovod_tpu.executor``'s ray backend consumes.
+
+This is a CONFORMANCE SHIM, not a ray reimplementation (VERDICT r4
+item 6): it exists so the real-ray code path — ``RayExecutor.start``'s
+placement-group reservation, ``run``'s per-rank remote tasks + the
+rank→IP registry actor, and ``RayHostDiscovery.
+find_available_hosts_and_slots`` (ref: horovod/ray/runner.py,
+horovod/ray/elastic.py [V]) — EXECUTES in CI on machines without ray,
+instead of sitting behind a perpetual importorskip.
+
+Fidelity choices that make it a real conformance check rather than a
+mock:
+
+* remote FUNCTIONS run in genuine subprocesses (``spawn`` context), so
+  the executor's cross-process assumptions hold or fail for real: the
+  task payload (fn + args) must survive cloudpickle, the actor handle
+  riding in the args must be picklable, and each worker's
+  ``os.environ`` mutations are isolated the way separate ray workers'
+  are.
+* ACTORS live in the parent behind a socket RPC
+  (multiprocessing.connection), so worker subprocesses exercise true
+  cross-process actor calls — the rank-registration barrier in
+  ``_worker`` genuinely blocks until every rank has registered.
+* ``ray.get``/``ray.kill``/placement-group lifecycle follow ray's
+  calling conventions (futures, ``timeout=``, ``GetTimeoutError``).
+
+What it does NOT fake: resource accounting (placement groups always
+"fit"), multi-node topology (every task reports 127.0.0.1 — which is
+also what a single-host ray cluster reports), and scheduling (tasks
+all start immediately). Tests that need those still require real ray
+(``@pytest.mark.ray``).
+
+Usage::
+
+    from horovod_tpu.testing import fake_ray
+    with fake_ray.installed():
+        ex = RayExecutor(num_workers=2, use_ray=True)
+        ...
+
+``install()`` refuses to shadow a real ray installation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import inspect
+import multiprocessing as mp
+import os
+import sys
+import threading
+import types
+from multiprocessing.connection import Client, Listener
+
+_AUTHKEY = b"horovod-tpu-fake-ray"
+_mp = mp.get_context("spawn")
+
+
+class GetTimeoutError(TimeoutError):
+    """ray.exceptions.GetTimeoutError stand-in."""
+
+
+# ----------------------------------------------------------------- futures
+
+
+class _Immediate:
+    """Already-completed object ref (actor calls resolve eagerly)."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+class _TaskFuture:
+    """Object ref for a subprocess task."""
+
+    def __init__(self, proc, conn):
+        self._proc = proc
+        self._conn = conn
+        self._result = None
+        self._done = False
+
+    def _wait(self, timeout=None):
+        if self._done:
+            return
+        if timeout is not None and not self._conn.poll(timeout):
+            raise GetTimeoutError(
+                f"task did not complete within {timeout}s"
+            )
+        try:
+            self._result = self._conn.recv()
+        except EOFError:
+            self._result = (
+                "err",
+                RuntimeError(
+                    "worker subprocess died without reporting a result "
+                    f"(exitcode={self._proc.exitcode})"
+                ),
+            )
+        self._proc.join()
+        self._done = True
+
+
+# ------------------------------------------------------------------ actors
+
+
+class _ActorServer:
+    """Hosts one actor instance in the parent; serves method calls over
+    a socket so handles work from worker subprocesses."""
+
+    def __init__(self, instance):
+        self._instance = instance
+        self._lock = threading.Lock()  # actor = single logical thread
+        self._listener = Listener(("127.0.0.1", 0), authkey=_AUTHKEY)
+        self.address = self._listener.address
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                name, args, kwargs = conn.recv()
+                try:
+                    with self._lock:
+                        out = getattr(self._instance, name)(
+                            *args, **kwargs
+                        )
+                    conn.send(("ok", out))
+                except Exception as e:  # noqa: BLE001 — transported
+                    try:
+                        conn.send(("err", e))
+                    except Exception:
+                        conn.send(("err", RuntimeError(repr(e))))
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+_ACTORS = {}  # address -> _ActorServer (parent process only)
+
+
+class _ActorMethod:
+    def __init__(self, handle, name):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        conn = Client(self._handle._address, authkey=_AUTHKEY)
+        try:
+            conn.send((self._name, args, kwargs))
+            status, value = conn.recv()
+        finally:
+            conn.close()
+        if status == "err":
+            raise value
+        return _Immediate(value)
+
+
+class ActorHandle:
+    """Picklable handle: (address,) — works from any process."""
+
+    def __init__(self, address):
+        self._address = address
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ActorMethod(self, name)
+
+
+class _ActorClass:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def options(self, **_ignored):
+        return self
+
+    def remote(self, *args, **kwargs):
+        server = _ActorServer(self._cls(*args, **kwargs))
+        _ACTORS[server.address] = server
+        return ActorHandle(server.address)
+
+
+# ------------------------------------------------------------- remote fns
+
+
+def _pickler():
+    """cloudpickle when available (closures travel by value — ray's own
+    behavior); stdlib pickle otherwise (module-level functions only) —
+    the same fallback executor._dump_payload uses."""
+    try:
+        import cloudpickle
+
+        return cloudpickle
+    except ImportError:
+        import pickle
+
+        return pickle
+
+
+def _child_main(payload, conn):
+    """Subprocess entry: a fresh interpreter (spawn), so the fake must
+    be installed BEFORE the task body's own ``import ray`` runs."""
+    install()
+    fn, args, kwargs = _pickler().loads(payload)
+    try:
+        conn.send(("ok", fn(*args, **kwargs)))
+    except Exception as e:  # noqa: BLE001 — transported to parent
+        try:
+            conn.send(("err", e))
+        except Exception:
+            conn.send(("err", RuntimeError(repr(e))))
+    finally:
+        conn.close()
+
+
+class _RemoteFunction:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def options(self, **_ignored):  # scheduling strategies: accepted
+        return self
+
+    def remote(self, *args, **kwargs):
+        payload = _pickler().dumps((self._fn, args, kwargs))
+        parent_conn, child_conn = _mp.Pipe()
+        proc = _mp.Process(
+            target=_child_main, args=(payload, child_conn)
+        )
+        proc.start()
+        child_conn.close()
+        return _TaskFuture(proc, parent_conn)
+
+
+def remote(obj=None, **_ray_opts):
+    """@ray.remote — on a class yields an actor class, on a function a
+    remote function; the decorator-with-options form returns itself."""
+    if obj is None:
+        return remote
+    if inspect.isclass(obj):
+        return _ActorClass(obj)
+    return _RemoteFunction(obj)
+
+
+# ---------------------------------------------------------------- core api
+
+_initialized = False
+
+
+def init(*_args, ignore_reinit_error=False, **_kwargs):
+    global _initialized
+    if _initialized and not ignore_reinit_error:
+        raise RuntimeError("ray.init called twice")
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized
+
+
+def shutdown():
+    global _initialized
+    _initialized = False
+    for addr in list(_ACTORS):
+        _ACTORS.pop(addr).stop()
+
+
+def get(refs, timeout=None):
+    if isinstance(refs, (list, tuple)):
+        return type(refs)(get(r, timeout) for r in refs)
+    if isinstance(refs, _Immediate):
+        return refs.value
+    if isinstance(refs, _TaskFuture):
+        refs._wait(timeout)
+        status, value = refs._result
+        if status == "err":
+            raise value
+        return value
+    return refs
+
+
+def kill(handle, no_restart=True):  # noqa: ARG001 — ray signature
+    server = _ACTORS.pop(getattr(handle, "_address", None), None)
+    if server is not None:
+        server.stop()
+
+
+def nodes():
+    return [
+        {
+            "Alive": True,
+            "NodeManagerAddress": "127.0.0.1",
+            "Resources": {"CPU": float(os.cpu_count() or 1)},
+        }
+    ]
+
+
+# ----------------------------------------------------- placement groups
+
+
+class PlacementGroup:
+    def __init__(self, bundles, strategy):
+        self.bundle_specs = list(bundles)
+        self.strategy = strategy
+
+    def ready(self):
+        return _Immediate(self)
+
+
+def placement_group(bundles, strategy="PACK", **_kwargs):
+    return PlacementGroup(bundles, strategy)
+
+
+def remove_placement_group(pg):  # noqa: ARG001 — resources aren't real
+    pass
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(
+        self,
+        placement_group=None,
+        placement_group_bundle_index=None,
+        **kwargs,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.kwargs = kwargs
+
+
+# -------------------------------------------------------------- install
+
+
+def _build_modules():
+    ray_mod = types.ModuleType("ray")
+    ray_mod.__fake_ray__ = True
+    ray_mod.remote = remote
+    ray_mod.get = get
+    ray_mod.kill = kill
+    ray_mod.init = init
+    ray_mod.is_initialized = is_initialized
+    ray_mod.shutdown = shutdown
+    ray_mod.nodes = nodes
+    ray_mod.exceptions = types.ModuleType("ray.exceptions")
+    ray_mod.exceptions.GetTimeoutError = GetTimeoutError
+
+    util = types.ModuleType("ray.util")
+    util.__fake_ray__ = True
+    util.get_node_ip_address = lambda: "127.0.0.1"
+
+    pg_mod = types.ModuleType("ray.util.placement_group")
+    pg_mod.__fake_ray__ = True
+    pg_mod.placement_group = placement_group
+    pg_mod.remove_placement_group = remove_placement_group
+    pg_mod.PlacementGroup = PlacementGroup
+
+    ss_mod = types.ModuleType("ray.util.scheduling_strategies")
+    ss_mod.__fake_ray__ = True
+    ss_mod.PlacementGroupSchedulingStrategy = (
+        PlacementGroupSchedulingStrategy
+    )
+
+    util.placement_group = pg_mod
+    util.scheduling_strategies = ss_mod
+    ray_mod.util = util
+    return {
+        "ray": ray_mod,
+        "ray.exceptions": ray_mod.exceptions,
+        "ray.util": util,
+        "ray.util.placement_group": pg_mod,
+        "ray.util.scheduling_strategies": ss_mod,
+    }
+
+
+def install():
+    """Register the fake under ``sys.modules['ray']`` (+ submodules).
+    No-op when already installed; refuses to shadow REAL ray."""
+    existing = sys.modules.get("ray")
+    if existing is not None:
+        if getattr(existing, "__fake_ray__", False):
+            return
+        raise RuntimeError(
+            "refusing to install fake_ray over a real ray import"
+        )
+    try:
+        import ray  # noqa: F401 — probe for a real installation
+
+        raise RuntimeError(
+            "refusing to install fake_ray: real ray is importable"
+        )
+    except ImportError:
+        pass
+    sys.modules.update(_build_modules())
+
+
+def uninstall():
+    for name in (
+        "ray",
+        "ray.exceptions",
+        "ray.util",
+        "ray.util.placement_group",
+        "ray.util.scheduling_strategies",
+    ):
+        mod = sys.modules.get(name)
+        if mod is not None and getattr(mod, "__fake_ray__", False):
+            del sys.modules[name]
+    shutdown()
+
+
+@contextlib.contextmanager
+def installed():
+    install()
+    try:
+        yield sys.modules["ray"]
+    finally:
+        uninstall()
