@@ -66,6 +66,25 @@ def test_traced_timeline_produces_chrome_trace(hvd, tmp_path):
     # XLA op-level events exist (the per-collective visibility claim)
     assert any("psum" in n or "all-reduce" in n or "jit" in n
                for n in names)
+    # the distilled per-collective device spans (VERDICT r4 item 9):
+    # a named ALLREDUCE phase span with the HLO op recorded, on the
+    # dedicated 'horovod collectives' track, with a real duration
+    spans = [
+        e for e in events
+        if str(e.get("name", "")).startswith("ALLREDUCE")
+        and e.get("ph") == "X"
+    ]
+    assert spans, names
+    assert any(
+        "psum" in s["args"]["hlo_op"] or "all-reduce" in s["args"]["hlo_op"]
+        for s in spans
+    )
+    procs = [
+        e for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and e.get("args", {}).get("name") == "horovod collectives"
+    ]
+    assert procs
 
 
 def test_timeline_step_noop_without_session(hvd):
