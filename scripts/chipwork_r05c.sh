@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Round-5 chip work, part c: ResNet copy/transpose profile (VERDICT r4
+# item 4 — the 4.9 ms layout-change bucket: recover it or close the
+# case with this data). Queued behind parts a/b; same discipline.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+R=r05
+
+echo "=== chipwork_r05c start $(date -u +%F' '%H:%M)" >&2
+
+while pgrep -f "chipwork_r05[ab].sh" >/dev/null 2>&1 \
+      || pgrep -f "python bench(_lm|_allreduce|_fusion|_int8|_seq)?.py" >/dev/null 2>&1; do
+  sleep 120
+done
+
+probe_backend() {
+  timeout 7200 python - <<'PYEOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+PYEOF
+}
+wait_backend() {
+  echo "=== probing TPU backend $(date -u +%H:%M)" >&2
+  until probe_backend; do
+    echo "backend still down $(date -u +%H:%M); retry in 300s" >&2
+    sleep 300
+  done
+  echo "=== backend UP $(date -u +%H:%M)" >&2
+}
+hold_gate() {
+  while [ -e scripts/CHIP_HOLD ]; do sleep 60; done
+}
+
+run_one() {
+  local name="$1"; shift
+  local out="bench_results/${name}_${R}.json"
+  echo "=== $name $(date -u +%H:%M)" >&2
+  "$@" > "bench_results/${name}_${R}.txt" 2> "bench_results/${name}_${R}.err"
+  if grep -qE '^\{' "bench_results/${name}_${R}.txt"; then
+    grep -E '^\{' "bench_results/${name}_${R}.txt" > "$out"
+    rm -f "bench_results/${name}_${R}.err"
+    cat "$out" >&2
+    return 0
+  fi
+  return 1
+}
+cap() {
+  local name="$1"
+  if [ -s "bench_results/${name}_${R}.json" ]; then
+    echo "=== $name already captured, skipping" >&2
+    return 0
+  fi
+  hold_gate
+  if run_one "$@"; then return 0; fi
+  echo "=== $name failed; gating on backend health before one retry" >&2
+  wait_backend
+  hold_gate
+  if run_one "$@"; then return 0; fi
+  echo "FAILED $name twice with backend up (see .err)" >&2
+  return 1
+}
+
+wait_backend
+
+cap resnet50_copy_profile       python scripts/profile_resnet_copies.py
+cap resnet50_copy_profile_conv7 env BENCH_STEM=conv7 python scripts/profile_resnet_copies.py
+
+echo "=== chipwork_r05c complete $(date -u +%F' '%H:%M)" >&2
