@@ -15,7 +15,7 @@ from .ring_attention import (  # noqa: F401
     ring_flash_attention,
 )
 from .tp import column_parallel_dense, row_parallel_dense  # noqa: F401
-from .pipeline import gpipe  # noqa: F401
+from .pipeline import gpipe, pipeline_1f1b  # noqa: F401
 from .moe import MoEParams, moe_ffn, init_moe_params  # noqa: F401
 from .fsdp import fsdp_shard, fsdp_sharding, fsdp_spec  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
