@@ -119,42 +119,121 @@ def test_1f1b_schedule_invariants():
     two live stash entries collide in their modular slot."""
     from horovod_tpu.parallel.pipeline import _build_1f1b_schedule
 
-    for pp, n_micro in [(2, 1), (2, 5), (4, 4), (4, 9), (8, 16)]:
-        s = _build_1f1b_schedule(pp, n_micro)
+    from horovod_tpu.parallel.pipeline import _default_in_flight
+
+    for pp, n_micro, v in [
+        (2, 1, 1), (2, 5, 1), (4, 4, 1), (4, 9, 1), (8, 16, 1),
+        (1, 3, 2), (2, 4, 2), (2, 7, 3), (4, 8, 2),
+    ]:
+        cap = _default_in_flight(pp)
+        s = _build_1f1b_schedule(pp, n_micro, v)
         T = s["do_f"].shape[0]
-        S = pp + 1
-        t_f = np.full((pp, n_micro), -1)
-        t_b = np.full((pp, n_micro), -1)
+        S = cap + 1
+        N = v * pp  # global stages; g = c*pp + device
+        t_f = np.full((N, n_micro), -1)
+        t_b = np.full((N, n_micro), -1)
         for t in range(T):
             for st in range(pp):
                 if s["do_f"][t, st]:
+                    g = s["f_c"][t, st] * pp + st
                     m = s["f_idx"][t, st]
-                    assert t_f[st, m] == -1
-                    t_f[st, m] = t
+                    assert t_f[g, m] == -1
+                    t_f[g, m] = t
                 if s["do_b"][t, st]:
+                    g = s["b_c"][t, st] * pp + st
                     m = s["b_idx"][t, st]
-                    assert t_b[st, m] == -1
-                    t_b[st, m] = t
+                    assert t_b[g, m] == -1
+                    t_b[g, m] = t
         assert (t_f >= 0).all() and (t_b >= 0).all()
-        for st in range(pp):
+        for g in range(N):
             for m in range(n_micro):
-                if st > 0:
-                    assert t_f[st - 1, m] < t_f[st, m]
-                if st < pp - 1:
-                    assert t_b[st + 1, m] < t_b[st, m]
+                if g > 0:
+                    assert t_f[g - 1, m] < t_f[g, m]
+                if g < N - 1:
+                    assert t_b[g + 1, m] < t_b[g, m]
                 else:
-                    assert t_f[st, m] <= t_b[st, m]  # same-tick ok
-        # memory bound + slot collision freedom per stage
-        for st in range(pp):
+                    assert t_f[g, m] <= t_b[g, m]  # same-tick ok
+        # memory bound + slot collision freedom per global stage
+        for g in range(N):
             for t in range(T):
                 live = [
                     m for m in range(n_micro)
-                    if t_f[st, m] <= t and (t_b[st, m] == -1 or t_b[st, m] > t)
-                    and t_f[st, m] >= 0
+                    if t_f[g, m] <= t and (t_b[g, m] == -1 or t_b[g, m] > t)
+                    and t_f[g, m] >= 0
                 ]
-                assert len(live) <= pp, (pp, n_micro, st, t, live)
+                assert len(live) <= cap, (pp, n_micro, v, g, t, live)
                 slots = [m % S for m in live]
                 assert len(set(slots)) == len(slots)
+
+
+def test_1f1b_ring_routing_replay():
+    """Symbolic replay of the ra_*/rc_* receive tables against the two
+    ppermute rings, at pp >= 3 where the chunk-boundary wrap (device
+    pp-1 -> 0) differs from ordinary neighbors: every consumed
+    activation must be EXACTLY the act the previous global stage
+    produced for that microbatch, every consumed cotangent the next
+    stage's, and no inbox slot may be overwritten while still live."""
+    from horovod_tpu.parallel.pipeline import (
+        _build_1f1b_schedule,
+        _default_in_flight,
+    )
+
+    for pp, n_micro, v in [(3, 7, 1), (3, 6, 2), (4, 9, 3), (5, 7, 2)]:
+        cap = _default_in_flight(pp)
+        S = cap + 1
+        s = _build_1f1b_schedule(pp, n_micro, v)
+        T = s["do_f"].shape[0]
+        N = v * pp
+        sent_a = [None] * pp  # tag carried on the fwd ring
+        sent_c = [None] * pp
+        inbox_a = [dict() for _ in range(pp)]  # (c, slot) -> tag
+        inbox_c = [dict() for _ in range(pp)]
+        consumed_f = set()  # acts awaiting consumption, by (g, m)
+        pending_a = [dict() for _ in range(pp)]  # (c,slot) -> (g,m) live
+        pending_c = [dict() for _ in range(pp)]
+        for t in range(T):
+            recv_a = [sent_a[(d - 1) % pp] for d in range(pp)]
+            recv_c = [sent_c[(d + 1) % pp] for d in range(pp)]
+            for d in range(pp):
+                if s["ra_v"][t, d]:
+                    key = (s["ra_c"][t, d], s["ra_s"][t, d])
+                    # overwrite of a live (unconsumed) act = data loss
+                    assert key not in pending_a[d], (pp, v, t, d, key)
+                    assert recv_a[d] is not None
+                    inbox_a[d][key] = recv_a[d]
+                    pending_a[d][key] = recv_a[d]
+                if s["rc_v"][t, d]:
+                    key = (s["rc_c"][t, d], s["rc_s"][t, d])
+                    assert key not in pending_c[d], (pp, v, t, d, key)
+                    assert recv_c[d] is not None
+                    inbox_c[d][key] = recv_c[d]
+                    pending_c[d][key] = recv_c[d]
+            new_sent_a = list(sent_a)
+            new_sent_c = list(sent_c)
+            for d in range(pp):
+                if s["do_f"][t, d]:
+                    c, m = s["f_c"][t, d], s["f_idx"][t, d]
+                    g = c * pp + d
+                    if g > 0:
+                        key = (c, m % S)
+                        got = inbox_a[d].get(key)
+                        assert got == ("act", g - 1, m), (
+                            pp, v, t, d, g, m, got
+                        )
+                        pending_a[d].pop(key, None)
+                    new_sent_a[d] = ("act", g, m)
+                if s["do_b"][t, d]:
+                    c, m = s["b_c"][t, d], s["b_idx"][t, d]
+                    g = c * pp + d
+                    if g < N - 1:
+                        key = (c, m % S)
+                        got = inbox_c[d].get(key)
+                        assert got == ("cot", g + 1, m), (
+                            pp, v, t, d, g, m, got
+                        )
+                        pending_c[d].pop(key, None)
+                    new_sent_c[d] = ("cot", g, m)
+            sent_a, sent_c = new_sent_a, new_sent_c
 
 
 def test_1f1b_matches_autodiff_oracle(hvd, rng):
@@ -294,12 +373,97 @@ def test_1f1b_tail_params_and_input_cotangents(hvd, rng):
     )
 
 
+@pytest.mark.parametrize("pp,v", [(2, 2), (4, 2)])
+def test_1f1b_interleaved_matches_autodiff_oracle(hvd, rng, pp, v):
+    """Interleaved 1F1B (v chunks/device, pp*v global stages): loss,
+    per-chunk stage grads, tail grads, and input cotangents must all
+    match the composed autodiff oracle. Chunk c on device s is global
+    stage c*pp + s (Megatron layout); pp=4 exercises the ring wrap
+    with non-trivial neighbors at runtime."""
+    from horovod_tpu.parallel.pipeline import pipeline_1f1b
+
+    n_micro, bm, d = 5, 2, 8
+    N = pp * v
+    mesh = Mesh(np.asarray(jax.devices()[:pp]), ("pp",))
+    x = rng.normal(size=(n_micro, bm, d)).astype(np.float32)
+    y = rng.normal(size=(n_micro, bm, d)).astype(np.float32)
+    w_global = (0.5 * rng.normal(size=(N, d, d))).astype(np.float32)
+    w_tail = (0.5 * rng.normal(size=(d, d))).astype(np.float32)
+    # device-major layout: w_dev[s, c] = w_global[c*pp + s]
+    w_dev = np.stack(
+        [[w_global[c * pp + s] for c in range(v)] for s in range(pp)]
+    )
+
+    def stage_fn(params, xb):
+        return jnp.tanh(xb @ params)
+
+    def tail_loss(tail, out, tgt):
+        return jnp.mean((out @ tail - tgt) ** 2)
+
+    def per_device(x, y, w_shard, w_tail):
+        loss, grads, tail_grads, dx = pipeline_1f1b(
+            stage_fn,
+            tail_loss,
+            w_shard[0],  # [v, d, d]
+            x,
+            y,
+            axis_name="pp",
+            loss_params=w_tail,
+            return_dx=True,
+            virtual_stages=v,
+        )
+        stage = lax.axis_index("pp")
+        dx = lax.psum(
+            jnp.where(stage == 0, dx, jnp.zeros_like(dx)), "pp"
+        )
+        return loss, grads[None], tail_grads, dx
+
+    loss, gw, gtail, gx = jax.jit(
+        jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(), P(), P("pp"), P()),
+            out_specs=(P(), P("pp"), P(), P()),
+            check_vma=False,
+        )
+    )(x, y, w_dev, w_tail)
+
+    def full_loss(w_all, tail, xin):
+        total = 0.0
+        for m in range(n_micro):
+            h = xin[m]
+            for g in range(N):
+                h = jnp.tanh(h @ w_all[g])
+            total = total + tail_loss(tail, h, y[m])
+        return total / n_micro
+
+    ref_loss, (rw, rtail, rx) = jax.value_and_grad(
+        full_loss, argnums=(0, 1, 2)
+    )(w_global, w_tail, x)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    # gw: [pp, v, d, d] device-major; map back to global stage order
+    gw = np.asarray(gw)
+    for g in range(N):
+        s, c = g % pp, g // pp
+        np.testing.assert_allclose(
+            gw[s, c], np.asarray(rw[g]), rtol=1e-4, atol=1e-5,
+            err_msg=f"stage grad mismatch at global stage {g}",
+        )
+    np.testing.assert_allclose(
+        np.asarray(gtail), np.asarray(rtail), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(gx), np.asarray(rx), rtol=1e-4, atol=1e-5
+    )
+
+
 def test_1f1b_activation_memory_bounded(hvd, rng):
     """The 1F1B claim in numbers: growing n_micro 4x must NOT grow the
-    schedule's live activation buffers — they are [pp+1, ...] stashes —
-    while gpipe-with-autodiff's backward grows O(n_micro). Measured on
-    the compiled executable's buffer assignment when the backend
-    reports it; falls back to asserting the carry structure."""
+    schedule's live activation buffers — they are [v, max_in_flight+1]
+    stashes (default window 2·pp+1), O(pp) and independent of n_micro
+    — while gpipe-with-autodiff's backward grows O(n_micro). Measured
+    on the compiled executable's buffer assignment when the backend
+    reports it."""
     from horovod_tpu.parallel.pipeline import pipeline_1f1b
 
     pp, bm, d = 4, 4, 64
