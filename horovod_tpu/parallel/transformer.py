@@ -92,6 +92,12 @@ class ParallelTransformerConfig:
     # tail runs per-MICROBATCH (per-micro expert capacity). "gpipe":
     # differentiate through the fill/drain scan — checkpoints
     # O(n_micro) activations; demo/small-model path (VERDICT r4 #7).
+    # The composed model runs pipeline_1f1b at virtual_stages=1:
+    # Megatron-interleaved chunking needs the L axis pre-permuted so
+    # P("pp") hands each device its STRIDED global stages (c*pp+s),
+    # which would make the sharded param layout factorization-dependent
+    # — use pipeline_1f1b(virtual_stages=...) directly for interleaved
+    # custom stacks.
     pipeline_schedule: str = "1f1b"
 
 

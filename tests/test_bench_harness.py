@@ -232,3 +232,88 @@ def test_bench_allreduce_cpu_sim_end_to_end():
     # CPU-sim quarantine: every non-TPU scaling line carries the
     # logic-validation-only note (VERDICT r3 weak #8)
     assert all("logic-validation only" in ln["note"] for ln in scaling)
+
+
+# ------------------------------------------------ round-5 microbenches
+
+
+def _run_harness(script, env, timeout=420):
+    """Run a bench harness as a user would (subprocess, tiny config);
+    return its parsed JSON lines. Keeps the chip-queued harnesses from
+    rotting while they wait out a backend outage. hermetic_cpu_env is
+    load-bearing: it strips the sitecustomize gate that would register
+    the real TPU plugin at child startup (one-chip discipline — a raw
+    env copy would claim the chip out from under the capture chains)."""
+    from _hermetic import hermetic_cpu_env
+
+    full_env = hermetic_cpu_env(n_devices=8)
+    full_env.update(env)
+    full_env.setdefault("BENCH_PLATFORM", "cpu")
+    proc = subprocess.run(
+        [sys.executable, script],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=full_env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) + "/..",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [
+        json.loads(ln)
+        for ln in proc.stdout.splitlines()
+        if ln.startswith("{")
+    ]
+    assert lines, proc.stdout
+    return lines
+
+
+@pytest.mark.slow
+def test_bench_fusion_harness_smoke():
+    lines = _run_harness(
+        "bench_fusion.py",
+        {
+            "BENCH_FUSION_N": "8",
+            "BENCH_FUSION_BYTES": "16384",
+            "BENCH_ITERS": "2",
+            "BENCH_AUTOTUNE_TRIALS": "2",
+        },
+    )
+    modes = {l["mode"] for l in lines if l["metric"] == "eager_fusion"}
+    assert modes == {"unfused", "fused", "default", "traced"}
+    assert any(l["metric"] == "eager_fusion_speedup" for l in lines)
+    auto = [l for l in lines if l["metric"] == "fusion_autotune"]
+    assert auto and auto[0]["trials"] == 2
+    # CPU lines must carry the quarantine note
+    assert all("note" in l for l in lines)
+
+
+@pytest.mark.slow
+def test_bench_int8_harness_smoke():
+    lines = _run_harness(
+        "bench_int8.py",
+        {"BENCH_SIZES": "65536", "BENCH_ITERS": "2"},
+    )
+    (line,) = lines
+    assert line["metric"] == "int8_compute_tax"
+    assert line["quant_ms"] > 0 and line["plain_ms"] > 0
+    assert "note" in line
+
+
+@pytest.mark.slow
+def test_bench_seq_harness_smoke():
+    lines = _run_harness(
+        "bench_seq.py",
+        {
+            "BENCH_SEQS": "128",
+            "BENCH_BATCH": "1",
+            "BENCH_HEADS": "2",
+            "BENCH_ITERS": "2",
+        },
+    )
+    engines = {l["engine"] for l in lines}
+    assert engines == {"flash", "dense"}
+    # "tflops" is rounded to 2dp and can legitimately round to 0.0 at
+    # this tiny config on a slow host — assert structure, not speed
+    assert all(
+        "tflops" in l and l["value"] > 0 and "note" in l for l in lines
+    )
