@@ -73,6 +73,23 @@ def test_long_context_ring_example():
 
 
 @pytest.mark.slow
+def test_pipeline_1f1b_example():
+    out = _run_example(
+        "pipeline_1f1b_train.py", "--steps", "8", "--pp", "4"
+    )
+    assert "1F1B (pp=4, v=1, 4 global stages) works" in out
+
+
+@pytest.mark.slow
+def test_pipeline_1f1b_interleaved_example():
+    out = _run_example(
+        "pipeline_1f1b_train.py",
+        "--steps", "8", "--pp", "2", "--virtual-stages", "2",
+    )
+    assert "1F1B (pp=2, v=2, 4 global stages) works" in out
+
+
+@pytest.mark.slow
 def test_elastic_example():
     out = _run_example("elastic_train.py")
     assert "elastic training complete" in out
