@@ -542,6 +542,26 @@ def hierarchical_allreduce(
     op = resolve_op(op, None)
     if op not in (Average, Sum):
         raise ValueError("hierarchical_allreduce supports Sum/Average only")
+    out, _ = _two_level_allreduce(
+        tensor, op, intra_axis, inter_axis,
+        lambda shard: (lax.psum(shard, inter_axis), None),
+        prescale=prescale_factor, postscale=postscale_factor,
+    )
+    return out
+
+
+def _two_level_allreduce(
+    tensor, op, intra_axis, inter_axis, inter_reduce,
+    prescale=1.0, postscale=1.0,
+):
+    """Shared rs-intra → inter_reduce → ag-intra scaffolding
+    (flatten/pad/unpad, Average divisor, scale factors) for
+    :func:`hierarchical_allreduce` and its quantized composition —
+    one copy of the dataflow, two inter-stage reducers.
+    ``inter_reduce(shard) -> (reduced_shard, extra_or_None)``; a
+    non-None extra (the EF residual) gets the output's dual transform:
+    divided by the intra size, all-gathered, unpadded (see
+    hierarchical_quantized_allreduce's carry semantics)."""
     intra_n = lax.axis_size(intra_axis)
     inter_n = lax.axis_size(inter_axis)
     shape, dtype = tensor.shape, tensor.dtype
@@ -550,15 +570,82 @@ def hierarchical_allreduce(
     padded = -(-m // intra_n) * intra_n
     if padded != m:
         flat = jnp.pad(flat, (0, padded - m))
-    if prescale_factor != 1.0:
-        flat = flat * jnp.asarray(prescale_factor, flat.dtype)
+    if prescale != 1.0:
+        flat = flat * jnp.asarray(prescale, flat.dtype)
     shard = lax.psum_scatter(
         flat, intra_axis, scatter_dimension=0, tiled=True
     )                                       # [padded/L], summed intra
-    shard = lax.psum(shard, inter_axis)     # cross-slice hop on 1/L bytes
-    out = lax.all_gather(shard, intra_axis, tiled=True)  # [padded]
+    red, extra = inter_reduce(shard)        # cross-slice hop, 1/L bytes
+    out = lax.all_gather(red, intra_axis, tiled=True)  # [padded]
     if op == Average:
         out = out / jnp.asarray(intra_n * inter_n, out.dtype)
-    if postscale_factor != 1.0:
-        out = out * jnp.asarray(postscale_factor, out.dtype)
-    return out[:m].reshape(shape).astype(dtype)
+    if postscale != 1.0:
+        out = out * jnp.asarray(postscale, out.dtype)
+    out = out[:m].reshape(shape).astype(dtype)
+    if extra is None:
+        return out, None
+    extra_full = lax.all_gather(
+        extra / jnp.asarray(intra_n, extra.dtype), intra_axis,
+        tiled=True,
+    )
+    return out, extra_full[:m].reshape(shape).astype(dtype)
+
+
+def hierarchical_quantized_allreduce(
+    tensor,
+    op=None,
+    intra_axis: str = INTRA_AXIS,
+    inter_axis: str = INTER_AXIS,
+    seed=0,
+    return_residual: bool = False,
+):
+    """Hierarchical allreduce with the int8 wire on the CROSS-SLICE hop
+    only — EQuARX's placement insight (PAPERS.md, pattern reference)
+    composed from this module's two primitives: ICI is fast, so the
+    intra reduce-scatter and all-gather stay full-precision; DCN is
+    the bottleneck, so the inter-slice allreduce of the 1/L-sized
+    shards rides :func:`quantized_allreduce`'s two-stage int8 (~4x
+    fewer bytes exactly where bytes are scarcest). Quantization error
+    is confined to the inter stage — two stochastic roundings on
+    intra-summed shards — so the error bound matches flat
+    ``quantized_allreduce`` while the ICI legs contribute none.
+
+    ``return_residual=True``: error-feedback carry in INPUT units.
+    The inter-stage residual lives on each rank's intra-shard; it is
+    re-broadcast over ``intra_axis`` divided by the intra size, so
+    adding it to the NEXT step's tensor makes the intra
+    reduce-scatter reconstruct exactly one copy at the shard owner
+    (each intra member contributes res/L to the same segment). Use
+    with ``DistributedOptimizer(error_feedback=True)`` semantics.
+    Sum/Average only.
+    """
+    op = resolve_op(op, None)
+    if op not in (Average, Sum):
+        raise ValueError(
+            "hierarchical_quantized_allreduce supports Sum/Average only"
+        )
+
+    # input-unit carry (the `extra` leg of the shared scaffold): the
+    # error enters the output linearly through the final (sum-level)
+    # value, so no Average rescale is needed — a +res correction at
+    # the input restores the output by res/n, exactly cancelling the
+    # -res/n the quantization cost it.
+    def inter(shard):
+        if return_residual:
+            return quantized_allreduce(
+                shard, op=Sum, axis_name=inter_axis, seed=seed,
+                return_residual=True,
+            )
+        return (
+            quantized_allreduce(
+                shard, op=Sum, axis_name=inter_axis, seed=seed
+            ),
+            None,
+        )
+
+    out, residual = _two_level_allreduce(
+        tensor, op, intra_axis, inter_axis, inter
+    )
+    if not return_residual:
+        return out
+    return out, residual
