@@ -631,17 +631,11 @@ def hierarchical_quantized_allreduce(
     # the input restores the output by res/n, exactly cancelling the
     # -res/n the quantization cost it.
     def inter(shard):
-        if return_residual:
-            return quantized_allreduce(
-                shard, op=Sum, axis_name=inter_axis, seed=seed,
-                return_residual=True,
-            )
-        return (
-            quantized_allreduce(
-                shard, op=Sum, axis_name=inter_axis, seed=seed
-            ),
-            None,
+        r = quantized_allreduce(
+            shard, op=Sum, axis_name=inter_axis, seed=seed,
+            return_residual=return_residual,
         )
+        return r if return_residual else (r, None)
 
     out, residual = _two_level_allreduce(
         tensor, op, intra_axis, inter_axis, inter

@@ -247,8 +247,9 @@ class ShardedDistributedOptimizer:
         if self._world is not None and n != self._world:
             raise ValueError(
                 f"world changed between init ({self._world}) and update "
-                f"({n}): re-run init(params) after a topology change "
-                "(elastic restarts rebuild optimizer state)"
+                f"({n}): call reshard_state(state, params, {n}) after a "
+                "topology change — it carries the optimizer moments "
+                "over (re-running init would reset them)"
             )
         idx = jax.lax.axis_index(self._axis)
         # shard_map hands each rank its [1, ...] state slice
@@ -293,3 +294,59 @@ class ShardedDistributedOptimizer:
         from jax.sharding import PartitionSpec as P
 
         return P(self._axis)
+
+    # -- elastic -----------------------------------------------------------
+    def reshard_state(self, state, params, new_world: int):
+        """Host-side elastic reshard: convert the [old_world, ...]
+        stacked state into [new_world, ...] PRESERVING optimizer
+        moments across a gang restart — the elastic alternative to
+        the "re-run init(params)" error, which would reset Adam
+        moments on every world change. Call OUTSIDE jit, with the
+        restored full params, after the new gang forms::
+
+            state = opt.reshard_state(state, params, hvd.size())
+
+        Mechanics: every sharded leaf is the optimizer moment over the
+        param's zero-padded flat vector, split rank-major; resharding
+        concatenates the old shards and re-splits at the new padding
+        (tail entries beyond the param's size are padding positions —
+        zeros that no update ever reads back). Replicated leaves
+        (scalars like Adam's ``count``; 0-d params) re-broadcast."""
+        if new_world < 1:
+            raise ValueError(f"new_world must be >= 1, got {new_world}")
+        template = self._inner.init(
+            jax.tree_util.tree_map(
+                lambda p: _shard_host(p, new_world, 0), params
+            )
+        )
+        old_leaves = jax.tree_util.tree_leaves(state)
+        tmpl_leaves, treedef = jax.tree_util.tree_flatten(template)
+        if len(old_leaves) != len(tmpl_leaves):
+            raise ValueError(
+                "state does not match this optimizer's structure "
+                f"({len(old_leaves)} leaves vs {len(tmpl_leaves)})"
+            )
+        out = []
+        for o, t in zip(old_leaves, tmpl_leaves):
+            o = np.asarray(o)
+            t = jnp.asarray(t)
+            if t.ndim == 0:
+                # replicated leaf, stacked [old_world] -> [new_world]
+                out.append(
+                    jnp.broadcast_to(
+                        jnp.asarray(o.reshape(-1)[0]), (new_world,)
+                    )
+                )
+                continue
+            per_rank = t.size  # new shard length (new padding)
+            full = o.reshape(-1)
+            need = new_world * per_rank
+            if full.size < need:  # new world pads more: extend zeros
+                full = np.pad(full, (0, need - full.size))
+            else:  # old world padded more: drop only padding tail
+                full = full[:need]
+            out.append(
+                jnp.asarray(full.reshape(new_world, per_rank), t.dtype)
+            )
+        self._world = new_world
+        return jax.tree_util.tree_unflatten(treedef, out)
