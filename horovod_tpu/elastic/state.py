@@ -109,6 +109,13 @@ class JaxState(ObjectState):
     pytrees are snapshotted to host numpy and re-placed on the current
     mesh, replicated, on restore/sync — the broadcast-from-root that
     TorchState does with hvd.broadcast_parameters [V].
+
+    ZeRO-1 note: a ShardedDistributedOptimizer state carries a leading
+    [world] axis; after a WORLD-SIZE change, run it through
+    ``opt.reshard_state(state.opt_state, state.params, hvd.size())``
+    in your reset/on_hosts_updated callback before training resumes —
+    it carries the optimizer moments across the new gang instead of
+    resetting them (docs/api.md, tests/test_sharded_optimizer.py).
     """
 
     _TREE_PREFIX = "_tree_"
