@@ -342,7 +342,12 @@ def pipeline_1f1b(
     T = sched["do_f"].shape[0]
     micro_shape = x_micro.shape[1:]
     dtype = x_micro.dtype
-    tables = {k: jnp.asarray(t) for k, t in sched.items()}
+    # ONE stacked [T, K, pp] table: the scan body gathers a single
+    # [K, pp] row per tick instead of 12 separate dynamic slices
+    keys = tuple(sorted(sched))
+    table = jnp.asarray(
+        np.stack([sched[k] for k in keys], axis=1)
+    )
 
     # normalize to the chunked form: leaves carry a leading [v] axis
     chunked_params = (
@@ -361,37 +366,67 @@ def pipeline_1f1b(
     def upd(arr, val, i):
         return lax.dynamic_update_index_in_dim(arr, val, i, axis=0)
 
-    def idx2(arr, c, i):  # [v, S, ...] -> [...]
-        return idx(idx(arr, c), i)
+    # v == 1 is the common path (the composed transformer): chunk
+    # indices are statically 0 there, so use static slices instead of
+    # per-tick dynamic indexing on singleton axes
+    if v == 1:
+        def idx2(arr, c, i):  # [1, S, ...] -> [...]
+            return idx(arr[0], i)
 
-    def upd2(arr, val, c, i):
-        return upd(arr, upd(idx(arr, c), val, i), c)
+        def upd2(arr, val, c, i):
+            return upd(arr[0], val, i)[None]
+
+        def chunk_of(tree_, c):
+            return jax.tree.map(lambda p: p[0], tree_)
+
+        def acc_chunk(acc, d, c, cond):
+            """acc[c] += d where cond (chunk axis static at v=1)."""
+            return jax.tree.map(
+                lambda a, dd: a + jnp.where(
+                    cond, dd, jnp.zeros_like(dd)
+                )[None],
+                acc,
+                d,
+            )
+    else:
+        def idx2(arr, c, i):  # [v, S, ...] -> [...]
+            return idx(idx(arr, c), i)
+
+        def upd2(arr, val, c, i):
+            return upd(arr, upd(idx(arr, c), val, i), c)
+
+        def chunk_of(tree_, c):
+            return jax.tree.map(lambda p: idx(p, c), tree_)
+
+        def acc_chunk(acc, d, c, cond):
+            return jax.tree.map(
+                lambda a, dd: masked_set(a, idx(a, c) + dd, c, cond),
+                acc,
+                d,
+            )
+
+    def masked_set(arr, val, i, cond):
+        """arr[i] = val where cond, else unchanged (read-modify-write
+        keeps the scan carry shape-stable)."""
+        return upd(arr, jnp.where(cond, val, idx(arr, i)), i)
+
+    def masked_set2(arr, val, c, i, cond):
+        return upd2(arr, jnp.where(cond, val, idx2(arr, c, i)), c, i)
 
     def step(carry, t):
-        row = {k: idx(tab, t)[stage] for k, tab in tables.items()}
+        vals = idx(table, t)[:, stage]  # [K]
+        row = {k: vals[j] for j, k in enumerate(keys)}
 
         # ring exchanges — unconditional, every tick (receivers gate)
         recv_a = lax.ppermute(carry["sent_a"], axis_name, fwd_perm)
         recv_c = lax.ppermute(carry["sent_c"], axis_name, bwd_perm)
-        inbox_a = upd2(
-            carry["inbox_a"],
-            jnp.where(
-                row["ra_v"] == 1,
-                recv_a,
-                idx2(carry["inbox_a"], row["ra_c"], row["ra_s"]),
-            ),
-            row["ra_c"],
-            row["ra_s"],
+        inbox_a = masked_set2(
+            carry["inbox_a"], recv_a, row["ra_c"], row["ra_s"],
+            row["ra_v"] == 1,
         )
-        inbox_c = upd2(
-            carry["inbox_c"],
-            jnp.where(
-                row["rc_v"] == 1,
-                recv_c,
-                idx2(carry["inbox_c"], row["rc_c"], row["rc_s"]),
-            ),
-            row["rc_c"],
-            row["rc_s"],
+        inbox_c = masked_set2(
+            carry["inbox_c"], recv_c, row["rc_c"], row["rc_s"],
+            row["rc_v"] == 1,
         )
 
         # ---- forward micro-op (masked when not scheduled)
@@ -407,8 +442,7 @@ def pipeline_1f1b(
             idx(x_micro, row["f_idx"]),
             idx2(inbox_a, f_c, f_slot),
         )
-        params_f = jax.tree.map(lambda p: idx(p, f_c), chunked_params)
-        y = stage_fn(params_f, x_in)
+        y = stage_fn(chunk_of(chunked_params, f_c), x_in)
         tgt = idx(y_micro, row["f_idx"])
         if loss_params is None:
             l_m, dy_m = jax.value_and_grad(
@@ -426,24 +460,16 @@ def pipeline_1f1b(
                 carry_lacc,
                 dlp_m,
             )
-        stash_x = upd2(
-            carry["stash_x"],
-            jnp.where(
-                do_f, x_in, idx2(carry["stash_x"], f_c, f_slot)
-            ),
-            f_c,
-            f_slot,
+        stash_x = masked_set2(
+            carry["stash_x"], x_in, f_c, f_slot, do_f
         )
         # dy is only ever read by the FINAL global stage's backward —
         # one [S] bank suffices; other chunks' dy writes are masked off
-        stash_dy = upd(
+        stash_dy = masked_set(
             carry["stash_dy"],
-            jnp.where(
-                jnp.logical_and(do_f, last_f),
-                dy_m.astype(dtype),
-                idx(carry["stash_dy"], f_slot),
-            ),
+            dy_m.astype(dtype),
             f_slot,
+            jnp.logical_and(do_f, last_f),
         )
         loss = carry["loss"] + jnp.where(
             jnp.logical_and(do_f, last_f),
@@ -464,19 +490,11 @@ def pipeline_1f1b(
             idx(stash_dy, b_slot),
             idx2(inbox_c, b_c, b_slot),
         )
-        params_b = jax.tree.map(lambda p: idx(p, b_c), chunked_params)
-        _, pull = jax.vjp(stage_fn, params_b, x_b)
-        dp, dx = pull(dy_b.astype(dtype))
-        gacc = jax.tree.map(
-            lambda a, d: upd(
-                a,
-                idx(a, b_c)
-                + jnp.where(do_b, d, jnp.zeros_like(d)),
-                b_c,
-            ),
-            carry["gacc"],
-            dp,
+        _, pull = jax.vjp(
+            stage_fn, chunk_of(chunked_params, b_c), x_b
         )
+        dp, dx = pull(dy_b.astype(dtype))
+        gacc = acc_chunk(carry["gacc"], dp, b_c, do_b)
         sent_c = jnp.where(do_b, dx, carry["sent_c"])
 
         out = {
@@ -492,13 +510,9 @@ def pipeline_1f1b(
         if loss_params is not None:
             out["lacc"] = carry_lacc
         if return_dx:
-            take_dx = jnp.logical_and(do_b, first_b)
-            out["dx"] = upd(
-                carry["dx"],
-                jnp.where(
-                    take_dx, dx, idx(carry["dx"], row["b_idx"])
-                ),
-                row["b_idx"],
+            out["dx"] = masked_set(
+                carry["dx"], dx, row["b_idx"],
+                jnp.logical_and(do_b, first_b),
             )
         return out, None
 
