@@ -16,6 +16,18 @@ harness measures that claim directly, on whatever backend is present:
     (threshold, cycle) pairs against the same workload; the run shows
     whether the GP's pick beats the shipped defaults.
 
+A/B legs for the compile-fused rework (ISSUE 1), each emitting one
+JSON artifact under BENCH_ARTIFACT_DIR (default bench_results/fusion):
+
+  * ab_pack      — host-side pack (pre-rework dispatch) vs in-JIT
+                   pack/unpack (one donated executable per batch).
+  * ab_bucketing — drifting batch compositions with power-of-two
+                   bucketing on vs off; reports executor recompiles,
+                   bucket-tier hits and pad bytes alongside ms/step.
+  * ab_gather    — same-key broadcast+allgather+reducescatter groups
+                   fused through the batch machinery vs dispatched
+                   serially (threshold=1).
+
 Per mode prints one JSON line:
   {"metric": "eager_fusion", "mode": ..., "n_tensors": N,
    "bytes_each": B, "value": ms/step, "unit": "ms"}
@@ -24,7 +36,10 @@ then a speedup summary and the autotune verdict line.
 Env: BENCH_FUSION_N (default 200), BENCH_FUSION_BYTES (default 1 MiB),
 BENCH_ITERS (default 10), BENCH_AUTOTUNE_TRIALS (default 10, 0 = skip),
 BENCH_PLATFORM=cpu for the simulated mesh (sim lines carry the
-quarantine note — dispatch overhead on CPU validates logic only).
+quarantine note — dispatch overhead on CPU validates logic only),
+BENCH_DRYRUN=1 for the CI smoke configuration (tiny sizes, A/B legs
+only exercised for correctness of the harness itself),
+BENCH_ARTIFACT_DIR for the per-leg JSON artifact directory.
 """
 
 import json
@@ -52,11 +67,22 @@ def main():
     from horovod_tpu.common.topology import WORLD_AXIS
     from horovod_tpu.ops import traced
 
-    n_tensors = int(os.environ.get("BENCH_FUSION_N", "200"))
-    nbytes = int(os.environ.get("BENCH_FUSION_BYTES", str(1 << 20)))
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
-    trials = int(os.environ.get("BENCH_AUTOTUNE_TRIALS", "10"))
+    dryrun = os.environ.get("BENCH_DRYRUN", "").strip() in ("1", "true")
+    if dryrun:
+        n_tensors = int(os.environ.get("BENCH_FUSION_N", "8"))
+        nbytes = int(os.environ.get("BENCH_FUSION_BYTES", "4096"))
+        iters = int(os.environ.get("BENCH_ITERS", "2"))
+        trials = int(os.environ.get("BENCH_AUTOTUNE_TRIALS", "0"))
+    else:
+        n_tensors = int(os.environ.get("BENCH_FUSION_N", "200"))
+        nbytes = int(os.environ.get("BENCH_FUSION_BYTES", str(1 << 20)))
+        iters = int(os.environ.get("BENCH_ITERS", "10"))
+        trials = int(os.environ.get("BENCH_AUTOTUNE_TRIALS", "10"))
     n_elems = max(nbytes // 4, 1)
+    artifact_dir = os.environ.get(
+        "BENCH_ARTIFACT_DIR", os.path.join("bench_results", "fusion")
+    )
+    os.makedirs(artifact_dir, exist_ok=True)
 
     hvd.init()
     fusion = basics._state.fusion
@@ -66,12 +92,17 @@ def main():
 
     default_threshold = fusion.threshold_bytes
     default_cycle = fusion.cycle_time_ms
+    default_injit = fusion.injit_pack
+    default_bucketing = fusion.bucketing
 
     rng = np.random.default_rng(0)
+    # Host arrays on purpose: the eager layer stages numpy to FRESH
+    # device buffers, so the donation path (default-on for TPU/GPU)
+    # can never consume a buffer a later leg still holds. jnp inputs
+    # here would be donated/deleted by the first run_eager and crash
+    # every subsequent leg on aliasing backends.
     bufs0 = [
-        jnp.asarray(
-            rng.normal(size=(world, n_elems)).astype(np.float32)
-        )
+        rng.normal(size=(world, n_elems)).astype(np.float32)
         for _ in range(n_tensors)
     ]
 
@@ -94,7 +125,7 @@ def main():
         _sync(sum(jnp.sum(b) for b in bufs))
         return (time.perf_counter() - t0) / iters * 1e3  # ms/step
 
-    def emit(mode, ms, extra=None):
+    def emit(mode, ms, extra=None, leg=None):
         line = {
             "metric": "eager_fusion",
             "mode": mode,
@@ -110,6 +141,11 @@ def main():
         if platform != "tpu":
             line["note"] = _SIM_NOTE
         print(json.dumps(line), flush=True)
+        if leg:
+            with open(
+                os.path.join(artifact_dir, f"fusion_{leg}.json"), "a"
+            ) as f:
+                f.write(json.dumps(line) + "\n")
         return ms
 
     total = n_tensors * nbytes
@@ -120,6 +156,130 @@ def main():
         run_eager(default_threshold, default_cycle),
         {"threshold": default_threshold, "cycle_ms": default_cycle},
     )
+
+    # ---- A/B leg 1: host-side pack vs in-JIT pack/unpack -------------
+    fusion.injit_pack = False
+    ms_hostpack = emit(
+        "host_pack", run_eager(total * 2, 1e9), leg="ab_pack"
+    )
+    fusion.injit_pack = True
+    ms_injit = run_eager(total * 2, 1e9)
+    emit(
+        "injit_pack",
+        ms_injit,
+        {
+            "speedup_vs_host_pack": round(ms_hostpack / ms_injit, 3),
+            "donate": fusion.donate,
+        },
+        leg="ab_pack",
+    )
+
+    # ---- A/B leg 2: shape bucketing under composition churn ---------
+    # Workload: every step reshapes the SAME bytes into a different
+    # composition (rotating split points), the drifting-tensor-set case
+    # the bucket tier exists for. Without bucketing each composition
+    # would need its own executable; with it they share one bucket.
+    churn_steps = 4 if dryrun else 8
+    churn_elems = n_elems * 4
+
+    def churn_compositions():
+        comps = []
+        for s in range(churn_steps):
+            # drift both the split point AND the total (staying inside
+            # one power-of-two bucket) — the realistic "tensor set
+            # changes a little every cycle" shape
+            total = churn_elems - s * max(churn_elems // 64, 1)
+            a = (s + 1) * total // (churn_steps + 1)
+            comps.append([max(a, 1), max(total - a, 1)])
+        return comps
+
+    def run_churn():
+        comps = churn_compositions()
+        # warm one composition so the bucket exists
+        for sizes in comps[:1]:
+            for h in [
+                hvd.allreduce_async(
+                    jnp.ones((world, n), jnp.float32), op=hvd.Average
+                )
+                for n in sizes
+            ]:
+                h.wait()
+        m0, b0, p0 = (
+            fusion.cache_misses,
+            fusion.bucket_hits,
+            fusion.pad_bytes_total,
+        )
+        t0 = time.perf_counter()
+        for sizes in comps:
+            handles = [
+                hvd.allreduce_async(
+                    jnp.ones((world, n), jnp.float32), op=hvd.Average
+                )
+                for n in sizes
+            ]
+            _sync(sum(jnp.sum(h.wait()) for h in handles))
+        ms = (time.perf_counter() - t0) / len(comps) * 1e3
+        return ms, {
+            "recompiles": fusion.cache_misses - m0,
+            "bucket_hits": fusion.bucket_hits - b0,
+            "pad_bytes": fusion.pad_bytes_total - p0,
+            "compositions": len(comps),
+        }
+
+    fusion.threshold_bytes = 1 << 40
+    fusion.cycle_time_ms = 1e9
+    fusion.bucketing = True
+    ms, extra = run_churn()
+    emit("bucketing_on", ms, extra, leg="ab_bucketing")
+    fusion.bucketing = False
+    ms, extra = run_churn()
+    emit("bucketing_off", ms, extra, leg="ab_bucketing")
+    fusion.bucketing = default_bucketing
+
+    # ---- A/B leg 3: gather-family fusion vs serial dispatch ---------
+    gather_n = 4 if dryrun else 16
+    g_elems = max(n_elems // 4, world)
+    g_elems -= g_elems % world  # reducescatter divisibility
+    # Host arrays (see bufs0): each buffer feeds THREE collectives per
+    # step AND every timed iteration — a jnp.Array here would be
+    # donated by the first fused executable and crash the second.
+    g_bufs = [
+        np.ones((world, max(g_elems, world)), np.float32)
+        for _ in range(gather_n)
+    ]
+
+    def gather_step():
+        hs = [
+            hvd.broadcast_async(b, root_rank=0, name=f"gb{i}")
+            for i, b in enumerate(g_bufs)
+        ]
+        hs += [
+            hvd.allgather_async(b, name=f"ga{i}")
+            for i, b in enumerate(g_bufs)
+        ]
+        hs += [
+            hvd.reducescatter_async(b, op=hvd.Sum, name=f"gr{i}")
+            for i, b in enumerate(g_bufs)
+        ]
+        outs = [h.wait() for h in hs]
+        return outs[0]
+
+    def run_gather(threshold):
+        fusion.threshold_bytes = int(threshold)
+        fusion.cycle_time_ms = 1e9
+        gather_step()  # warm
+        d0 = fusion.dispatches
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = gather_step()
+        _sync(jnp.sum(out))
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        return ms, {"dispatches_per_step": (fusion.dispatches - d0) // iters}
+
+    ms, extra = run_gather(1 << 40)
+    emit("gather_fused", ms, extra, leg="ab_gather")
+    ms, extra = run_gather(1)
+    emit("gather_serial", ms, extra, leg="ab_gather")
 
     # traced floor: ONE psum over the same bytes, chained for sync
     from functools import partial
@@ -192,6 +352,8 @@ def main():
     # restore shipped defaults (harmless — process exits anyway)
     fusion.threshold_bytes = default_threshold
     fusion.cycle_time_ms = default_cycle
+    fusion.injit_pack = default_injit
+    fusion.bucketing = default_bucketing
 
 
 if __name__ == "__main__":

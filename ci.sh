@@ -7,8 +7,12 @@
 #   2. native+TSAN — csrc/ builds clean AND passes a ThreadSanitizer
 #                    stress of its concurrent pieces (SURVEY.md §5.2)
 #   3. tests       — the full CPU suite on the virtual 8-device mesh
+#   4. bench-smoke — bench_fusion.py dryrun: the fusion A/B measurement
+#                    harness (host-pack vs in-JIT, bucketing, gather
+#                    fusion) must run green and emit per-leg artifacts,
+#                    so the engine's premise-measurement can't rot
 #
-# Usage: ./ci.sh [lint|native|tests|all]   (default: all)
+# Usage: ./ci.sh [lint|native|tests|bench-smoke|all]   (default: all)
 
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -49,10 +53,26 @@ tests() {
   python -m pytest tests/ -q
 }
 
+bench_smoke() {
+  step "bench-smoke: bench_fusion.py dryrun (A/B harness + artifacts)"
+  local art_dir
+  art_dir="$(mktemp -d)"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
+    python bench_fusion.py
+  # the A/B legs must have produced their per-leg JSON artifacts
+  for leg in ab_pack ab_bucketing ab_gather; do
+    test -s "$art_dir/fusion_${leg}.json" \
+      || { echo "missing artifact: fusion_${leg}.json" >&2; exit 1; }
+  done
+  echo "bench-smoke artifacts OK: $art_dir"
+}
+
 case "${1:-all}" in
-  lint)   lint ;;
-  native) native ;;
-  tests)  tests ;;
-  all)    lint; native; tests ;;
-  *) echo "usage: $0 [lint|native|tests|all]" >&2; exit 2 ;;
+  lint)        lint ;;
+  native)      native ;;
+  tests)       tests ;;
+  bench-smoke) bench_smoke ;;
+  all)         lint; native; tests; bench_smoke ;;
+  *) echo "usage: $0 [lint|native|tests|bench-smoke|all]" >&2; exit 2 ;;
 esac

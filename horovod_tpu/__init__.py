@@ -19,6 +19,13 @@ eager API keeps Horovod's async-handle semantics on top of a fusion-cycle
 dispatcher (ops/fusion.py). Everything honors the HOROVOD_* env contract.
 """
 
+from .common import compat as _compat
+
+# Publish jax.shard_map (+ check_vma kwarg mapping) on old JAX before
+# anything — library modules, tests, and user scripts alike assume the
+# modern spelling exists once horovod_tpu is imported.
+_compat.install()
+
 from .common.basics import (  # noqa: F401
     HorovodInternalError,
     HostsUpdatedInterrupt,

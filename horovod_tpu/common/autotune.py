@@ -162,6 +162,7 @@ class ParameterManager:
         self._optimizer = BayesianOptimizer(noise=gp_noise)
         self._log_path = log_path
         self._bytes = 0
+        self._wire_bytes = 0
         self._seconds = 0.0
         self._steps = 0
         self._samples = 0
@@ -186,17 +187,42 @@ class ParameterManager:
     def frozen(self) -> bool:
         return self._frozen
 
-    def record(self, bytes_: int, seconds: float) -> None:
+    def record(
+        self,
+        bytes_: int,
+        seconds: float,
+        wire_bytes: Optional[int] = None,
+    ) -> None:
+        """One flush sample. ``bytes_`` is USEFUL payload; ``wire_bytes``
+        (>= bytes_) is what actually moved, bucket padding included. The
+        score is goodput — useful bytes per second — so a parameter
+        choice that pads more pays for its padding in time without
+        being credited for the padded bytes; the wire/pad split is
+        still logged and exported so the padding cost stays visible."""
         if self._frozen:
             return
         self._bytes += bytes_
+        self._wire_bytes += wire_bytes if wire_bytes is not None else bytes_
         self._seconds += seconds
         self._steps += 1
         if self._steps < self._steps_per_sample:
             return
         score = self._bytes / max(self._seconds, 1e-9)
-        self._log(score)
-        self._bytes, self._seconds, self._steps = 0, 0.0, 0
+        pad = self._wire_bytes - self._bytes
+        self._log(score, note=f"pad_bytes={pad}" if pad else "")
+        from .metrics import registry as _metrics
+
+        _metrics.update(
+            "autotune",
+            {
+                "score": score,
+                "sample_bytes": self._bytes,
+                "sample_wire_bytes": self._wire_bytes,
+                "sample_pad_bytes": pad,
+            },
+        )
+        self._bytes, self._wire_bytes = 0, 0
+        self._seconds, self._steps = 0.0, 0
         if self._warmup_left > 0:
             self._warmup_left -= 1
             return

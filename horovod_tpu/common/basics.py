@@ -145,6 +145,10 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
             threshold_bytes=cfg.fusion_threshold_bytes,
             cycle_time_ms=cfg.cycle_time_ms,
             cache_capacity=cfg.cache_capacity,
+            injit_pack=cfg.fusion_injit,
+            bucketing=cfg.fusion_buckets,
+            donate=cfg.fusion_donate,
+            promote_after=cfg.fusion_promote_after,
         )
         if cfg.timeline:
             from .timeline import Timeline

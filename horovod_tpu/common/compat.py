@@ -1,0 +1,95 @@
+"""JAX cross-version compatibility shims.
+
+The codebase (library, tests, benches, examples) is written against the
+modern JAX surface: top-level ``jax.shard_map`` with the ``check_vma=``
+kwarg (the >= 0.6/0.8 spelling). Older installs — the pinned 0.4.x
+toolchain included — only ship ``jax.experimental.shard_map.shard_map``
+with the equivalent kwarg spelled ``check_rep=``. This module bridges
+both directions with ONE wrapper:
+
+* ``compat.shard_map`` — call it like modern ``jax.shard_map``:
+  ``shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...)``,
+  the deferred/decorator form ``shard_map(mesh=..., ...)``(f), and
+  ``functools.partial(shard_map, mesh=...)`` all work. ``check_vma`` /
+  ``check_rep`` are accepted interchangeably and forwarded under
+  whichever name the underlying implementation takes (dropped when it
+  takes neither).
+* ``compat.install()`` — publishes the wrapper as ``jax.shard_map``
+  when the attribute is missing, so downstream code (tests, examples,
+  user scripts) written against the modern spelling runs unmodified on
+  old JAX. A real ``jax.shard_map`` is never shadowed.
+
+``install()`` runs from ``horovod_tpu/__init__`` — importing the
+package is enough to get a working ``jax.shard_map`` everywhere.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax as _jax
+
+_native = getattr(_jax, "shard_map", None)
+if _native is None or getattr(_native, "__horovod_tpu_shim__", False):
+    from jax.experimental.shard_map import shard_map as _native  # type: ignore
+
+_params = inspect.signature(_native).parameters
+if "check_vma" in _params:
+    _CHECK_KW = "check_vma"
+elif "check_rep" in _params:
+    _CHECK_KW = "check_rep"
+else:
+    _CHECK_KW = None
+
+
+def shard_map(
+    f=None,
+    *,
+    mesh=None,
+    in_specs=None,
+    out_specs=None,
+    check_vma=None,
+    check_rep=None,
+    **kwargs,
+):
+    """Version-portable ``jax.shard_map``; see module docstring."""
+    check = check_vma if check_vma is not None else check_rep
+
+    def bind(fn):
+        kw = dict(kwargs)
+        kw["mesh"] = mesh
+        kw["in_specs"] = in_specs
+        kw["out_specs"] = out_specs
+        if check is not None and _CHECK_KW is not None:
+            kw[_CHECK_KW] = check
+        return _native(fn, **kw)
+
+    return bind if f is None else bind(f)
+
+
+shard_map.__horovod_tpu_shim__ = True
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` on new JAX; on old JAX, ``psum(1, axis)`` of a
+    static value — which JAX evaluates at trace time to the concrete
+    axis size (the historical spelling of the same query)."""
+    native = getattr(_jax.lax, "axis_size", None)
+    if native is not None and not getattr(
+        native, "__horovod_tpu_shim__", False
+    ):
+        return native(axis_name)
+    return _jax.lax.psum(1, axis_name)
+
+
+axis_size.__horovod_tpu_shim__ = True
+
+
+def install() -> None:
+    """Expose the wrappers as ``jax.shard_map`` / ``jax.lax.axis_size``
+    on JAX versions that lack the modern names. Idempotent; never
+    shadows a real implementation."""
+    if getattr(_jax, "shard_map", None) is None:
+        _jax.shard_map = shard_map
+    if getattr(_jax.lax, "axis_size", None) is None:
+        _jax.lax.axis_size = axis_size

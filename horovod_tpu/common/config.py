@@ -68,6 +68,18 @@ class Config:
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     cache_capacity: int = DEFAULT_CACHE_CAPACITY
     batch_d2d_memcopies: bool = True
+    # in-JIT pack/unpack: one donated executable per fused batch
+    # (ops/fusion.py; off = pre-rework host-side pack, the A/B baseline)
+    fusion_injit: bool = True
+    # power-of-two byte bucketing of the fused buffer (executor-cache
+    # stability under batch-composition churn)
+    fusion_buckets: bool = True
+    # donate fused-batch inputs so the fusion buffer aliases them
+    # (None = auto: on where the backend supports aliasing — TPU/GPU)
+    fusion_donate: Optional[bool] = None
+    # promote a batch composition to its own exact executable after
+    # this many sightings (before that, churn rides the bucket tier)
+    fusion_promote_after: int = 2
 
     # --- reduction behavior ---
     hierarchical_allreduce: bool = False
@@ -132,6 +144,15 @@ class Config:
             cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_TIME_MS),
             cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", DEFAULT_CACHE_CAPACITY),
             batch_d2d_memcopies=_env_bool("HOROVOD_BATCH_D2D_MEMCOPIES", True),
+            fusion_injit=_env_bool("HOROVOD_FUSION_INJIT", True),
+            fusion_buckets=_env_bool("HOROVOD_FUSION_BUCKETS", True),
+            fusion_donate=(
+                None
+                if env.get("HOROVOD_FUSION_DONATE", "auto").strip().lower()
+                in ("auto", "")
+                else _env_bool("HOROVOD_FUSION_DONATE")
+            ),
+            fusion_promote_after=_env_int("HOROVOD_FUSION_PROMOTE_AFTER", 2),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
             autotune=_env_bool("HOROVOD_AUTOTUNE"),

@@ -134,6 +134,24 @@ class Timeline:
                 }
             )
 
+    def counter(self, name: str, value: float) -> None:
+        """Chrome-trace counter track (ph "C") — the fusion manager
+        feeds per-cycle gauges (bucket pad bytes, fused dispatches)
+        here so padding/dispatch cost lines up with the per-tensor
+        lifecycle rows in the same trace."""
+        if not self._active:
+            return
+        with self._lock:
+            self._emit(
+                {
+                    "name": name,
+                    "ph": "C",
+                    "pid": 0,
+                    "ts": self._now_us(),
+                    "args": {name: value},
+                }
+            )
+
     def mark_cycle(self) -> None:
         """One eager fusion-cycle boundary (HOROVOD_TIMELINE_MARK_CYCLES)."""
         if self._mark_cycles and self._active:

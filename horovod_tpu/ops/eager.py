@@ -16,6 +16,16 @@ common/topology.py). Helpers:
 
 Uneven-shape support (allgather-v, alltoall-v) follows the reference's
 MPI_*v semantics via padding on the fused path or host repack.
+
+Buffer donation: on backends with aliasing support (TPU/GPU), the fused
+dispatch path DONATES its input buffers to the compiled executable so
+the fusion buffer aliases the argument storage instead of doubling peak
+HBM (``HOROVOD_FUSION_DONATE``; see ops/fusion.py). Treat eager
+collectives as CONSUMING their inputs — the reference's in-place
+``allreduce_`` contract — and use the returned array; re-reading a
+donated ``jax.Array`` input afterwards raises. Inputs passed as numpy
+are staged to fresh device buffers first and are never affected. Set
+``HOROVOD_FUSION_DONATE=0`` for strict functional semantics.
 """
 
 from __future__ import annotations

@@ -327,6 +327,22 @@ def pipeline_1f1b(
     n_micro >> pp. Microbatch loss is averaged, matching a
     full-batch mean loss when loss_fn itself averages over its
     microbatch.
+
+    Tail-FLOPs multiplier (ADVICE r5 — know what the masking costs):
+    to keep collectives inside stage_fn mesh-uniform, EVERY tick runs
+    one full forward AND one full vjp on every stage — idle ticks
+    compute on zeros and their effects are `where`-masked out, but the
+    FLOPs are really spent. One step therefore executes T·pp stage
+    evaluations (T = schedule length ≈ n_micro·v + O(pp) fill/drain
+    ticks, each a fwd+bwd pair on all pp stages) against the
+    n_micro·v·pp evaluations the math needs: compute overhead ≈
+    T/(n_micro·v), i.e. ~1 + O(pp/n_micro) — the same n_micro >> pp
+    regime that shrinks the bubble also amortizes the masked tail.
+    At small n_micro the tail dominates: n_micro = pp burns roughly
+    4× the useful FLOPs. This is a deliberate trade (uniformity lets
+    tp/dp collectives live inside stage_fn; recompute keeps the
+    activation live-set O(pp)) — see docs/perf.md §"1F1B tail FLOPs"
+    for the measured framing.
     """
     pp = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)

@@ -52,7 +52,12 @@ def _block_scores(q, k_cur, scale, q_pos, k_pos, causal):
 
 def _ring_fwd_pass(q, k, v, axis_name, causal):
     sp = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    # axis_index only matters for causal masking; when causal=False
+    # the value would be dead code, and a dead cross-replica
+    # primitive inside custom_vjp+shard_map lowers to a PartitionId
+    # in the auto-SPMD region, which XLA rejects (JAX 0.4.x) —
+    # skip it entirely on the non-causal path
+    my = lax.axis_index(axis_name) if causal else 0
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     qf = q.astype(jnp.float32)
@@ -131,7 +136,12 @@ def _ring_attention_fwd(q, k, v, axis_name, causal):
 def _ring_attention_bwd(axis_name, causal, res, do):
     q, k, v, out, lse = res
     sp = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    # axis_index only matters for causal masking; when causal=False
+    # the value would be dead code, and a dead cross-replica
+    # primitive inside custom_vjp+shard_map lowers to a PartitionId
+    # in the auto-SPMD region, which XLA rejects (JAX 0.4.x) —
+    # skip it entirely on the non-causal path
+    my = lax.axis_index(axis_name) if causal else 0
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     qf = q.astype(jnp.float32)
@@ -222,7 +232,12 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal):
     from ..ops.flash_attention import _flash_fwd, _pick_block
 
     sp = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    # axis_index only matters for causal masking; when causal=False
+    # the value would be dead code, and a dead cross-replica
+    # primitive inside custom_vjp+shard_map lowers to a PartitionId
+    # in the auto-SPMD region, which XLA rejects (JAX 0.4.x) —
+    # skip it entirely on the non-causal path
+    my = lax.axis_index(axis_name) if causal else 0
     b, t, h, d = q.shape
     r = h // k.shape[2]  # grouped-query: q heads per kv head
     bq = _pick_block(t)  # DEFAULT_BLOCK preference, shared with the gate
@@ -326,7 +341,12 @@ def _ring_flash_attention_bwd(axis_name, causal, res, do):
 
     q, k, v, out, lse = res
     sp = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    # axis_index only matters for causal masking; when causal=False
+    # the value would be dead code, and a dead cross-replica
+    # primitive inside custom_vjp+shard_map lowers to a PartitionId
+    # in the auto-SPMD region, which XLA rejects (JAX 0.4.x) —
+    # skip it entirely on the non-causal path
+    my = lax.axis_index(axis_name) if causal else 0
     b, t, h, d = q.shape
     r = h // k.shape[2]  # grouped-query: q heads per kv head
     bq = _pick_block(t)  # must match the fwd pass tiling

@@ -55,6 +55,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common.compat import shard_map
 from .mesh import MeshSpec
 from .moe import MoEParams, init_moe_params, moe_ffn
 from .pipeline import gpipe, pipeline_1f1b
@@ -478,7 +479,7 @@ def make_train_step(cfg: ParallelTransformerConfig, mesh: Mesh):
         )
         return params, loss
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         per_device_step,
         mesh=mesh,
         in_specs=(specs, data_spec, data_spec),

@@ -49,11 +49,24 @@ import multiprocessing as mp
 import os
 import sys
 import threading
+import time
 import types
 from multiprocessing.connection import Client, Listener
 
-_AUTHKEY = b"horovod-tpu-fake-ray"
+# Per-session RPC authkey (ADVICE r5, security-low): generated lazily
+# from os.urandom so a loopback listener from one test session can never
+# be driven by a stale/foreign client that knows a hard-coded constant.
+# Worker subprocesses (fresh interpreters under spawn) can't re-derive
+# it, so the key travels INSIDE the pickled ActorHandle.
+_AUTHKEY = None
 _mp = mp.get_context("spawn")
+
+
+def _session_authkey() -> bytes:
+    global _AUTHKEY
+    if _AUTHKEY is None:
+        _AUTHKEY = os.urandom(32)
+    return _AUTHKEY
 
 
 class GetTimeoutError(TimeoutError):
@@ -79,12 +92,17 @@ class _TaskFuture:
         self._result = None
         self._done = False
 
-    def _wait(self, timeout=None):
+    def _wait(self, timeout=None, deadline=None):
+        """Block until done. ``deadline`` (time.monotonic-based) wins
+        over ``timeout``: ray.get over a LIST applies its timeout as one
+        overall deadline for the whole batch, not per element."""
         if self._done:
             return
-        if timeout is not None and not self._conn.poll(timeout):
+        if deadline is not None:
+            timeout = deadline - time.monotonic()
+        if timeout is not None and not self._conn.poll(max(timeout, 0)):
             raise GetTimeoutError(
-                f"task did not complete within {timeout}s"
+                "task did not complete within the timeout"
             )
         try:
             self._result = self._conn.recv()
@@ -110,7 +128,8 @@ class _ActorServer:
     def __init__(self, instance):
         self._instance = instance
         self._lock = threading.Lock()  # actor = single logical thread
-        self._listener = Listener(("127.0.0.1", 0), authkey=_AUTHKEY)
+        self.authkey = _session_authkey()
+        self._listener = Listener(("127.0.0.1", 0), authkey=self.authkey)
         self.address = self._listener.address
         self._closed = False
         threading.Thread(target=self._accept_loop, daemon=True).start()
@@ -162,7 +181,7 @@ class _ActorMethod:
         self._name = name
 
     def remote(self, *args, **kwargs):
-        conn = Client(self._handle._address, authkey=_AUTHKEY)
+        conn = Client(self._handle._address, authkey=self._handle._authkey)
         try:
             conn.send((self._name, args, kwargs))
             status, value = conn.recv()
@@ -174,10 +193,13 @@ class _ActorMethod:
 
 
 class ActorHandle:
-    """Picklable handle: (address,) — works from any process."""
+    """Picklable handle: (address, authkey) — works from any process.
+    The per-session authkey rides in the pickle because a spawned
+    worker's fresh interpreter has no other way to learn it."""
 
-    def __init__(self, address):
+    def __init__(self, address, authkey):
         self._address = address
+        self._authkey = authkey
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -195,7 +217,7 @@ class _ActorClass:
     def remote(self, *args, **kwargs):
         server = _ActorServer(self._cls(*args, **kwargs))
         _ACTORS[server.address] = server
-        return ActorHandle(server.address)
+        return ActorHandle(server.address, server.authkey)
 
 
 # ------------------------------------------------------------- remote fns
@@ -283,12 +305,21 @@ def shutdown():
 
 
 def get(refs, timeout=None):
+    # ray semantics: over a list, ``timeout`` is ONE overall deadline
+    # for the whole batch (ADVICE r5) — thread a single monotonic
+    # deadline through every element rather than restarting the clock
+    # per ref.
+    deadline = None if timeout is None else time.monotonic() + timeout
+    return _get_by_deadline(refs, deadline)
+
+
+def _get_by_deadline(refs, deadline):
     if isinstance(refs, (list, tuple)):
-        return type(refs)(get(r, timeout) for r in refs)
+        return type(refs)(_get_by_deadline(r, deadline) for r in refs)
     if isinstance(refs, _Immediate):
         return refs.value
     if isinstance(refs, _TaskFuture):
-        refs._wait(timeout)
+        refs._wait(deadline=deadline)
         status, value = refs._result
         if status == "err":
             raise value

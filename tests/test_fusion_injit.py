@@ -1,0 +1,395 @@
+"""Compile-fused eager cycles: in-JIT pack/unpack, donated fusion
+buffers, shape-bucketed executor cache, gather-family fusion.
+
+Acceptance tests for the core-runtime rework (ISSUE 1): one fused cycle
+dispatches as ONE cached executable (pack + collective + unpack inside
+`jax.jit`), the executor cache stays stable under batch-composition
+churn via power-of-two bucketing, and broadcast/allgather/reducescatter
+groups batch through the same machinery as allreduce — with numerical
+parity against the host-pack (pre-rework) path everywhere, process-set
+and join-mask cases included.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.ops import fusion as fusion_mod
+
+
+def rank_major(fn, dtype=np.float32):
+    return np.stack([np.asarray(fn(r), dtype=dtype) for r in range(8)])
+
+
+def _fusion():
+    return hvd_mod.common.basics.state().fusion
+
+
+def _freeze_cycle(fusion):
+    fusion.cycle_time_ms = 1e6
+    fusion.threshold_bytes = 1 << 30
+
+
+def _batch_allreduce(hvd, sizes, op=None, **kw):
+    op = op if op is not None else hvd_mod.Sum
+    handles = [
+        hvd.allreduce_async(
+            rank_major(lambda r, n=n: np.arange(n, dtype=np.float32) + r),
+            op=op,
+            name=f"b{i}",
+            **kw,
+        )
+        for i, n in enumerate(sizes)
+    ]
+    return [h.wait() for h in handles]
+
+
+# ------------------------------------------------------- single executable
+
+
+def test_one_executor_invocation_per_fused_flush(hvd):
+    """A fused batch — pack, collective, unpack included — is ONE
+    executor invocation, and the dispatch path performs zero host-side
+    jnp.concatenate once the executable is cached."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    sizes = [3, 5, 2, 7]
+    _batch_allreduce(hvd, sizes)  # warm: compiles the fused executable
+
+    d0, inv0 = fusion.dispatches, fusion.cache_hits
+    real_concat = fusion_mod.jnp.concatenate
+    calls = []
+
+    def spy(*a, **k):
+        calls.append(a)
+        return real_concat(*a, **k)
+
+    fusion_mod.jnp.concatenate = spy
+    try:
+        outs = _batch_allreduce(hvd, sizes)
+    finally:
+        fusion_mod.jnp.concatenate = real_concat
+    assert fusion.dispatches == d0 + 1  # one invocation for the batch
+    assert fusion.cache_hits == inv0 + 1  # served by the exact tier
+    assert calls == []  # pack ran inside the compiled program
+    for i, (n, out) in enumerate(zip(sizes, outs)):
+        np.testing.assert_allclose(
+            np.asarray(out[0]), 8 * np.arange(n) + 28.0
+        )
+
+
+def test_donation_plumbing_and_stats(hvd):
+    """donate_argnums reaches the fused executable (observable through
+    the donated-bytes counter) without breaking results. On CPU the
+    backend ignores donation, which is exactly why `donate` defaults
+    off here — this test forces the plumbing on."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    fusion.donate = True
+    fusion._executors.clear()
+    import warnings
+
+    d0 = fusion.donated_bytes_total
+    with warnings.catch_warnings():
+        # CPU: "Some donated buffers were not usable" — expected noise
+        warnings.simplefilter("ignore")
+        outs = _batch_allreduce(hvd, [4, 4])
+    assert fusion.donated_bytes_total == d0 + 2 * 8 * 4 * 4
+    np.testing.assert_allclose(np.asarray(outs[0][0]), 8 * np.arange(4) + 28.0)
+
+
+# --------------------------------------------------------- bucketed cache
+
+
+def test_bucket_reuses_executor_across_compositions(hvd):
+    """≥3 distinct batch compositions inside one bucket run on ONE
+    bucket-tier program: after the first composition compiles its exact
+    executable and the second composition compiles the shared core,
+    further compositions add ZERO compiles."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    m0, b0 = fusion.cache_misses, fusion.bucket_hits
+    _batch_allreduce(hvd, [2, 3])  # 5 elems → bucket 8: exact compile
+    _batch_allreduce(hvd, [1, 4])  # same bucket: core compile, fallback
+    _batch_allreduce(hvd, [5])     # fallback, no compile
+    outs = _batch_allreduce(hvd, [4, 1])  # fallback, no compile
+    assert fusion.cache_misses == m0 + 2
+    assert fusion.bucket_hits == b0 + 3
+    np.testing.assert_allclose(np.asarray(outs[0][0]), 8 * np.arange(4) + 28.0)
+
+
+def test_hot_composition_promoted_to_exact_executable(hvd):
+    """A composition seen promote_after times graduates from the
+    bucket-tier fallback to its own single-dispatch fused executable."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    assert fusion.promote_after == 2
+    _batch_allreduce(hvd, [6, 2])  # bucket 8 first seen: exact compile
+    _batch_allreduce(hvd, [3, 5])  # sighting 1: core compile + fallback
+    p0 = fusion.promotions
+    _batch_allreduce(hvd, [3, 5])  # sighting 2: promoted
+    assert fusion.promotions == p0 + 1
+    h0 = fusion.cache_hits
+    outs = _batch_allreduce(hvd, [3, 5])  # exact hit from here on
+    assert fusion.cache_hits == h0 + 1
+    np.testing.assert_allclose(np.asarray(outs[1][0]), 8 * np.arange(5) + 28.0)
+
+
+def test_cache_stats_expose_bucketing_counters(hvd):
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    pad0 = fusion.pad_bytes_total
+    # First composition in the bucket rides the EXACT tier, which is
+    # keyed on full shapes and therefore packs unpadded — no dead zeros
+    # on the wire for a stable job.
+    _batch_allreduce(hvd, [5])  # 5 elems → bucket 8, exact tier: no pad
+    assert fusion.pad_bytes_total == pad0
+    # A second composition in the same bucket rides the padded
+    # bucket-tier core: 3 pad elems × 8 rank rows × 4 bytes.
+    _batch_allreduce(hvd, [3, 2])
+    stats = fusion.cache_stats()
+    for key in (
+        "hits",
+        "misses",
+        "evictions",
+        "bucket_hits",
+        "promotions",
+        "recompiles",
+        "dispatches",
+        "bucket_pad_bytes",
+        "donated_bytes",
+    ):
+        assert key in stats, key
+    assert fusion.pad_bytes_total == pad0 + 3 * 8 * 4
+    assert fusion.last_cycle_pad_bytes == 3 * 8 * 4
+    from horovod_tpu.common.metrics import registry
+
+    snap = registry.snapshot()
+    assert snap.get("fusion.bucket_pad_bytes") == float(fusion.pad_bytes_total)
+    assert "fusion.last_cycle_dispatches" in snap
+
+
+def test_bucketing_off_pads_nothing(hvd):
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    fusion.bucketing = False
+    pad0 = fusion.pad_bytes_total
+    outs = _batch_allreduce(hvd, [5, 3])
+    assert fusion.pad_bytes_total == pad0
+    np.testing.assert_allclose(np.asarray(outs[0][0]), 8 * np.arange(5) + 28.0)
+
+
+def test_capacity_zero_still_fuses_without_caching(hvd):
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    fusion.cache_capacity = 0
+    fusion._executors.clear()
+    outs = _batch_allreduce(hvd, [2, 2])
+    assert fusion.cache_stats()["size"] == 0
+    np.testing.assert_allclose(np.asarray(outs[0][0]), 8 * np.arange(2) + 28.0)
+
+
+# ------------------------------------------------- parity: in-JIT vs host
+
+
+def _parity_legs(hvd, run):
+    """Run `run(hvd)` under the in-JIT leg and the host-pack leg and
+    compare results elementwise."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    fusion.injit_pack = True
+    injit = [np.asarray(o) for o in run(hvd)]
+    fusion.injit_pack = False
+    host = [np.asarray(o) for o in run(hvd)]
+    fusion.injit_pack = True
+    assert len(injit) == len(host)
+    for a, b in zip(injit, host):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    return injit
+
+
+def test_parity_allreduce_mixed_shapes_and_scales(hvd):
+    def run(hvd):
+        handles = [
+            hvd.allreduce_async(
+                rank_major(lambda r: np.full((3, 2), float(r + 1))),
+                op=hvd_mod.Sum,
+                prescale_factor=0.5,
+                postscale_factor=2.0,
+            ),
+            hvd.allreduce_async(
+                rank_major(lambda r: np.arange(7.0) * (r + 1)),
+                op=hvd_mod.Average,
+            ),
+        ]
+        return [h.wait() for h in handles]
+
+    outs = _parity_legs(hvd, run)
+    np.testing.assert_allclose(outs[0][0], np.full((3, 2), 36.0))
+
+
+@pytest.mark.parametrize("op_name", ["Min", "Max", "Product"])
+def test_parity_minmaxprod_with_bucket_padding(hvd, op_name):
+    op = getattr(hvd_mod, op_name)
+
+    def run(hvd):
+        # 5 elems → bucket 8: the zero tail must not leak into min/prod
+        handles = [
+            hvd.allreduce_async(
+                rank_major(lambda r: np.arange(1.0, 6.0) + r), op=op
+            )
+        ]
+        return [h.wait() for h in handles]
+
+    _parity_legs(hvd, run)
+
+
+def test_parity_fused_broadcast_group_vs_serial(hvd):
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    tensors = [
+        rank_major(lambda r, i=i: np.full((2 + i,), float(r * 10 + i)))
+        for i in range(3)
+    ]
+
+    # fused: all three share one cycle, same root → one batch
+    handles = [
+        hvd.broadcast_async(t, root_rank=5, name=f"bc{i}")
+        for i, t in enumerate(tensors)
+    ]
+    d0 = fusion.dispatches
+    fused = [np.asarray(h.wait()) for h in handles]
+    assert fusion.dispatches == d0 + 1
+
+    # serial: threshold 1 byte → every enqueue flushes alone
+    fusion.threshold_bytes = 1
+    serial = [
+        np.asarray(hvd.broadcast(t, root_rank=5)) for t in tensors
+    ]
+    for a, b in zip(fused, serial):
+        np.testing.assert_allclose(a, b)
+        np.testing.assert_allclose(a[2], a[5])  # every row = root's row
+
+
+def test_parity_fused_allgather_group_vs_serial(hvd):
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    tensors = [
+        rank_major(lambda r, i=i: np.full((1 + i, 2), float(r + i)))
+        for i in range(3)
+    ]
+    handles = [
+        hvd.allgather_async(t, name=f"ag{i}") for i, t in enumerate(tensors)
+    ]
+    d0 = fusion.dispatches
+    fused = [np.asarray(h.wait()) for h in handles]
+    assert fusion.dispatches == d0 + 1  # one executable for the trio
+
+    fusion.threshold_bytes = 1
+    serial = [np.asarray(hvd.allgather(t)) for t in tensors]
+    for a, b in zip(fused, serial):
+        np.testing.assert_allclose(a, b)
+
+
+def test_parity_fused_reducescatter_group_vs_serial(hvd):
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    tensors = [
+        rank_major(lambda r, i=i: np.arange(16.0 + 8 * i) + r)
+        for i in range(2)
+    ]
+    handles = [
+        hvd.reducescatter_async(t, op=hvd_mod.Sum, name=f"rs{i}")
+        for i, t in enumerate(tensors)
+    ]
+    d0 = fusion.dispatches
+    fused = [np.asarray(h.wait()) for h in handles]
+    assert fusion.dispatches == d0 + 1
+
+    fusion.threshold_bytes = 1
+    serial = [
+        np.asarray(hvd.reducescatter(t, op=hvd_mod.Sum)) for t in tensors
+    ]
+    for a, b in zip(fused, serial):
+        np.testing.assert_allclose(a, b)
+
+
+def test_parity_process_set_gather_family(hvd):
+    ps = hvd.add_process_set([1, 3, 5])
+
+    def run(hvd):
+        ag = hvd.allgather_async(
+            rank_major(lambda r: np.full((2,), float(r))), process_set=ps
+        )
+        rs = hvd.reducescatter_async(
+            rank_major(lambda r: np.arange(6.0) + r),
+            op=hvd_mod.Sum,
+            process_set=ps,
+        )
+        bc = hvd.broadcast_async(
+            rank_major(lambda r: np.full((3,), float(r))),
+            root_rank=3,
+            process_set=ps,
+        )
+        return [h.wait() for h in (ag, rs, bc)]
+
+    ag, rs, bc = _parity_legs(hvd, run)
+    # members gather member rows; non-members receive zeros
+    np.testing.assert_allclose(ag[1][0], np.full(2, 1.0))
+    np.testing.assert_allclose(ag[0], np.zeros_like(ag[0]))
+    # broadcast: members take root 3's row, non-members keep their own
+    np.testing.assert_allclose(bc[5], np.full(3, 3.0))
+    np.testing.assert_allclose(bc[2], np.full(3, 2.0))
+
+
+def test_parity_join_mask_and_process_set_allreduce(hvd):
+    ps = hvd.add_process_set([0, 1, 2, 3])
+
+    def run(hvd):
+        outs = []
+        with hvd.join_ranks([2]):
+            outs.append(
+                hvd.allreduce(
+                    rank_major(lambda r: np.full((4,), float(r))),
+                    op=hvd_mod.Average,
+                    process_set=ps,
+                )
+            )
+        outs.append(
+            hvd.allreduce(
+                rank_major(lambda r: np.full((3,), float(r + 1))),
+                op=hvd_mod.Adasum,
+                process_set=ps,
+            )
+        )
+        with hvd.join_ranks([1]):
+            outs.append(
+                hvd.allreduce(
+                    rank_major(lambda r: np.full((3,), float(r + 1))),
+                    op=hvd_mod.Adasum,
+                    process_set=ps,
+                )
+            )
+        return outs
+
+    avg, adasum, adasum_join = _parity_legs(hvd, run)
+    # joined rank 2 excluded: mean of {0,1,3} = 4/3 for members
+    np.testing.assert_allclose(avg[0], np.full(4, 4.0 / 3.0), rtol=1e-6)
+    np.testing.assert_allclose(avg[6], np.full(4, 6.0))  # non-member
+    np.testing.assert_allclose(adasum[7], np.full(3, 8.0))  # non-member
+
+
+def test_fused_engine_survives_composition_churn_correctly(hvd):
+    """Regression: drifting compositions (the bucket-fallback path) and
+    repeated compositions (the exact path) interleave with identical
+    numerics."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    rng = np.random.default_rng(7)
+    for trial in range(6):
+        sizes = rng.integers(1, 9, size=rng.integers(1, 4)).tolist()
+        outs = _batch_allreduce(hvd, sizes)
+        for n, out in zip(sizes, outs):
+            np.testing.assert_allclose(
+                np.asarray(out[0]), 8 * np.arange(n) + 28.0, rtol=1e-6
+            )
