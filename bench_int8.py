@@ -20,9 +20,19 @@ Abort criterion for the docs (docs/perf.md): at a v5e-class ICI rate,
 int8 wins only if (quant_ms − plain_ms) < 0.75 · wire_time_fp32(bytes)
 · ring_factor — the tax must undercut the bytes it saves.
 
+A/B leg for the quantized FUSED wire (ISSUE 2): `ab_fused` runs a
+realistic multi-tensor composition through the eager int8 wire twice —
+per-tensor (threshold=1: every entry dispatches alone, paying the
+quantize tax N times) vs fused (one batch: quantize once over the
+packed buffer, ONE dispatch) — and emits one JSON artifact per leg
+under BENCH_ARTIFACT_DIR (default bench_results/int8), reporting
+ms/step, dispatches/step and wire bytes saved. BENCH_DRYRUN=1 is the
+CI smoke configuration (tiny sizes; harness-correctness only).
+
 Env: BENCH_SIZES (bytes, comma-sep; default 1,4,16,64,256 MiB),
-BENCH_ITERS (default 20), BENCH_PLATFORM=cpu for the simulated mesh
-(sim lines carry the quarantine note).
+BENCH_ITERS (default 20), BENCH_FUSED_N (composition size, default 40),
+BENCH_PLATFORM=cpu for the simulated mesh (sim lines carry the
+quarantine note).
 """
 
 import json
@@ -55,10 +65,13 @@ def main():
     world = len(devices) if devices[0].platform != "tpu" else 1
     mesh = Mesh(np.array(devices[:world]), (WORLD_AXIS,))
     platform = devices[0].platform
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    dryrun = os.environ.get("BENCH_DRYRUN", "").strip() in ("1", "true")
+    iters = int(os.environ.get("BENCH_ITERS", "2" if dryrun else "20"))
     sizes_env = os.environ.get("BENCH_SIZES")
     if sizes_env:
         sizes = [int(s) for s in sizes_env.split(",")]
+    elif dryrun:
+        sizes = [1 << 14]
     else:
         sizes = [1 << 20, 4 << 20, 16 << 20, 64 << 20, 256 << 20]
 
@@ -125,6 +138,106 @@ def main():
         if platform != "tpu":
             line["note"] = _SIM_NOTE
         print(json.dumps(line), flush=True)
+
+    _ab_fused(world, platform, dryrun, iters)
+
+
+def _ab_fused(world, platform, dryrun, iters):
+    """A/B: the same multi-tensor composition through the eager int8
+    wire per-tensor (threshold=1) vs fused (one batch, quantize once).
+    The delta is the amortized per-dispatch quant tax the fused wire
+    exists to remove."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from _benchlib import sync as _sync
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops.compression import Compression
+
+    artifact_dir = os.environ.get(
+        "BENCH_ARTIFACT_DIR", os.path.join("bench_results", "int8")
+    )
+    os.makedirs(artifact_dir, exist_ok=True)
+    if dryrun:
+        n_tensors = int(os.environ.get("BENCH_FUSED_N", "6"))
+        elems = 512
+    else:
+        n_tensors = int(os.environ.get("BENCH_FUSED_N", "40"))
+        elems = (1 << 18) // 4  # 256 KiB each
+    hvd.init()
+    fusion = basics._state.fusion
+    world = hvd.size()
+    rng = np.random.default_rng(0)
+    # Host arrays: the eager layer stages numpy to fresh device
+    # buffers, so default-on donation can never consume a buffer a
+    # later leg still reads (see bench_fusion.py).
+    # Realistic composition: mixed sizes around the mean, like a
+    # transformer block's parameter list.
+    comp = [
+        max(elems // 2 + (i * elems) // n_tensors, 8)
+        for i in range(n_tensors)
+    ]
+    bufs = [
+        rng.normal(size=(world, n)).astype(np.float32) for n in comp
+    ]
+
+    def step():
+        handles = [
+            hvd.allreduce_async(
+                b, op=hvd.Average, name=f"qt{i}",
+                compression=Compression.int8,
+            )
+            for i, b in enumerate(bufs)
+        ]
+        return [h.wait() for h in handles]
+
+    def run(threshold):
+        fusion.threshold_bytes = int(threshold)
+        fusion.cycle_time_ms = 1e9
+        step()  # warm: compile
+        d0 = fusion.dispatches
+        s0 = fusion.wire_bytes_saved_total
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            outs = step()
+        _sync(sum(jnp.sum(o) for o in outs))
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        return ms, {
+            "dispatches_per_step": (fusion.dispatches - d0) // iters,
+            "wire_saved_per_step": (fusion.wire_bytes_saved_total - s0)
+            // iters,
+        }
+
+    total_bytes = sum(n * 4 for n in comp)
+
+    def emit(mode, ms, extra):
+        line = {
+            "metric": "int8_fused_ab",
+            "mode": mode,
+            "n_tensors": n_tensors,
+            "total_bytes": total_bytes,
+            "world": world,
+            "value": round(ms, 3),
+            "unit": "ms",
+            "platform": platform,
+        }
+        line.update(extra)
+        if platform != "tpu":
+            line["note"] = _SIM_NOTE
+        print(json.dumps(line), flush=True)
+        with open(
+            os.path.join(artifact_dir, "int8_ab_fused.json"), "a"
+        ) as f:
+            f.write(json.dumps(line) + "\n")
+        return ms
+
+    ms_serial, extra = run(1)
+    emit("per_tensor", ms_serial, extra)
+    ms_fused, extra = run(1 << 40)
+    extra["speedup_vs_per_tensor"] = round(ms_serial / ms_fused, 3)
+    emit("fused", ms_fused, extra)
 
 
 if __name__ == "__main__":

@@ -65,6 +65,12 @@ bench_smoke() {
     test -s "$art_dir/fusion_${leg}.json" \
       || { echo "missing artifact: fusion_${leg}.json" >&2; exit 1; }
   done
+  step "bench-smoke: bench_int8.py dryrun (fused-vs-per-tensor leg)"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
+    python bench_int8.py
+  test -s "$art_dir/int8_ab_fused.json" \
+    || { echo "missing artifact: int8_ab_fused.json" >&2; exit 1; }
   echo "bench-smoke artifacts OK: $art_dir"
 }
 

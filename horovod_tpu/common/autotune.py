@@ -243,3 +243,87 @@ class ParameterManager:
                 f"threshold={self._threshold} cycle_ms={self._cycle_ms} "
                 f"score={'' if score is None else f'{score:.3e}'} {note}\n"
             )
+
+
+class WireTuner:
+    """Per-bucket-tier online choice of the fused wire format
+    (``HOROVOD_FUSION_WIRE=auto``) by goodput — useful bytes per second
+    of dispatch wall time, so the measurement naturally charges each
+    format its own quant tax and credits it for the wire bytes it
+    removes.
+
+    A bandit, not a GP: the decision is a small discrete choice per
+    bucket tier (the fused-buffer geometry the executor cache is keyed
+    on), so the mechanism is explore-each-candidate-``trials``-times
+    then exploit the argmax. Two static priors bound the exploration:
+
+    * buckets under ``min_int8_bytes`` never try int8 — the per-dispatch
+      quantize tax is O(payload)+fixed while the wire saving is
+      O(payload), so below a payload floor the tax always wins (the
+      crossover bench_int8.py measures);
+    * ``candidates`` restricts the menu (int8 only where the op/dtype
+      qualify — the fusion manager filters before asking).
+    """
+
+    CANDIDATES = ("fp32", "bf16", "int8")
+
+    def __init__(self, min_int8_bytes: int = 64 * 1024, trials: int = 3):
+        self.min_int8_bytes = int(min_int8_bytes)
+        self.trials = max(int(trials), 1)
+        # (bucket_key, wire) -> [useful_bytes_total, seconds_total, n]
+        self._obs = {}
+
+    def _stats(self, bucket_key, wire):
+        return self._obs.setdefault((bucket_key, wire), [0.0, 0.0, 0])
+
+    def needs_trial(self, bucket_key, wire: str) -> bool:
+        """True while this (bucket, wire) is still under-explored.
+        The fusion manager BLOCKS on the dispatch result for exactly
+        these observations — async dispatch wall time is
+        format-independent and would teach the tuner nothing — and
+        stops recording once the trials are in (explore-then-freeze)."""
+        return self._obs.get((bucket_key, wire), (0, 0, 0))[2] < self.trials
+
+    def record(
+        self, bucket_key, wire: str, useful_bytes: int, seconds: float
+    ) -> None:
+        s = self._stats(bucket_key, wire)
+        s[0] += float(useful_bytes)
+        s[1] += float(seconds)
+        s[2] += 1
+
+    def goodput(self, bucket_key, wire: str) -> float:
+        s = self._obs.get((bucket_key, wire))
+        if not s or s[2] == 0:
+            return 0.0
+        return s[0] / max(s[1], 1e-9)
+
+    def choose(
+        self, bucket_key, payload_bytes: int, candidates=None,
+        itemsize: int = 4,
+    ) -> str:
+        """Pick the wire format for one fused dispatch of this bucket
+        tier. Tiny buckets short-circuit to fp32/bf16 (never int8);
+        candidates that cannot shrink the payload are dropped (bf16
+        saves nothing on an already-2-byte fp16/bf16 payload, and the
+        cast would silently truncate mantissa for free); otherwise
+        under-explored candidates are tried round-robin and the steady
+        state is the goodput argmax."""
+        cands = list(candidates if candidates is not None else self.CANDIDATES)
+        if payload_bytes < self.min_int8_bytes:
+            cands = [c for c in cands if c != "int8"]
+        if itemsize <= 2:
+            cands = [c for c in cands if c != "bf16"]
+        if not cands:
+            return "fp32"
+        if len(cands) == 1:
+            # nothing to compare: mark the sole candidate fully trialed
+            # so the dispatcher never pays trial synchronization for a
+            # decision with one possible answer
+            s = self._stats(bucket_key, cands[0])
+            s[2] = max(s[2], self.trials)
+            return cands[0]
+        for c in cands:
+            if self.needs_trial(bucket_key, c):
+                return c
+        return max(cands, key=lambda c: self.goodput(bucket_key, c))

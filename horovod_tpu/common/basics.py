@@ -149,6 +149,10 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
             bucketing=cfg.fusion_buckets,
             donate=cfg.fusion_donate,
             promote_after=cfg.fusion_promote_after,
+            wire=cfg.fusion_wire,
+            wire_block=cfg.fusion_wire_block,
+            wire_hier=cfg.fusion_wire_hier,
+            wire_min_bytes=cfg.fusion_wire_min_bytes,
         )
         if cfg.timeline:
             from .timeline import Timeline

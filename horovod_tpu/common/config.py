@@ -45,6 +45,18 @@ def _env_int(name: str, default: int) -> int:
         raise ValueError(f"{name} must be an integer, got {val!r}")
 
 
+def _env_choice(name: str, default: str, choices) -> str:
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    val = val.strip().lower()
+    if val not in choices:
+        raise ValueError(
+            f"{name} must be one of {'/'.join(choices)}, got {val!r}"
+        )
+    return val
+
+
 def _env_float(name: str, default: float) -> float:
     val = os.environ.get(name)
     if val is None or not val.strip():
@@ -80,6 +92,20 @@ class Config:
     # promote a batch composition to its own exact executable after
     # this many sightings (before that, churn rides the bucket tier)
     fusion_promote_after: int = 2
+    # wire format of the fused buffer's collective: fp32 (payload
+    # width), bf16 (half-width cast wire), int8 (block-scaled
+    # quantized wire, EQuARX-style), or auto (per-bucket online choice
+    # by goodput — common/autotune.py WireTuner)
+    fusion_wire: str = "fp32"
+    # elements per block scale on the int8 fused wire
+    fusion_wire_block: int = 512
+    # hierarchical wire: bf16 on the intra-host (ICI) stage, int8 on
+    # the cross-host (DCN) stage (needs HOROVOD_HIERARCHICAL_ALLREDUCE
+    # topology stages to be non-degenerate)
+    fusion_wire_hier: bool = False
+    # auto mode never tries int8 below this fused-buffer byte size
+    # (the per-dispatch quant tax dominates tiny buffers)
+    fusion_wire_min_bytes: int = 64 * 1024
 
     # --- reduction behavior ---
     hierarchical_allreduce: bool = False
@@ -153,6 +179,16 @@ class Config:
                 else _env_bool("HOROVOD_FUSION_DONATE")
             ),
             fusion_promote_after=_env_int("HOROVOD_FUSION_PROMOTE_AFTER", 2),
+            fusion_wire=_env_choice(
+                "HOROVOD_FUSION_WIRE",
+                "fp32",
+                ("fp32", "bf16", "int8", "auto"),
+            ),
+            fusion_wire_block=_env_int("HOROVOD_FUSION_WIRE_BLOCK", 512),
+            fusion_wire_hier=_env_bool("HOROVOD_FUSION_WIRE_HIER"),
+            fusion_wire_min_bytes=_env_int(
+                "HOROVOD_FUSION_WIRE_MIN_BYTES", 64 * 1024
+            ),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
             autotune=_env_bool("HOROVOD_AUTOTUNE"),

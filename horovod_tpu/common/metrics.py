@@ -23,6 +23,13 @@ import threading
 import time
 from typing import Dict, Optional
 
+# Enum gauges are exported as integer codes (JSON-lines values are
+# floats); this is the shared wire-format legend — fusion's
+# ``fusion.wire_format`` gauge and the timeline's counter track both
+# use it, so a trace and a metrics dump decode identically.
+WIRE_FORMAT_CODES = {"fp32": 0, "bf16": 1, "int8": 2}
+WIRE_FORMAT_NAMES = {v: k for k, v in WIRE_FORMAT_CODES.items()}
+
 
 class MetricsRegistry:
     def __init__(self) -> None:
@@ -59,6 +66,13 @@ class MetricsRegistry:
             self._values.clear()
 
     # -- export -------------------------------------------------------
+
+    @property
+    def exporting(self) -> bool:
+        """True when a JSON-lines sink is configured — subsystems use
+        this to skip observability work that forces a device sync
+        (e.g. the fusion manager's EF-residual norm)."""
+        return self._path is not None
 
     def configure_export(self, path: Optional[str] = None) -> None:
         """Set (or clear) the JSON-lines sink. Defaults from

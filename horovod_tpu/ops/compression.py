@@ -17,7 +17,17 @@ import jax.numpy as jnp
 
 
 class Compressor:
-    """A (compress, decompress) pair. ``compress`` returns (tensor, ctx)."""
+    """A (compress, decompress) pair. ``compress`` returns (tensor, ctx).
+
+    ``wire_format`` names the fused-wire format this compressor maps to
+    when handed to the EAGER path (``hvd.allreduce(...,
+    compression=)``): instead of compressing tensor-by-tensor on the
+    host, the fusion manager moves the whole fused buffer in that
+    format inside the compiled executable (ops/fusion.py) — quantize
+    once over the batch, one dispatch. ``None`` means the identity
+    (fp32/payload-width) wire."""
+
+    wire_format = None  # 'bf16' | 'int8' | 'int8_hier' | None
 
     @staticmethod
     def compress(tensor):
@@ -29,6 +39,13 @@ class Compressor:
 
 
 class NoneCompressor(Compressor):
+    # Explicitly "fp32": passing Compression.none must OPT OUT of a
+    # globally configured quantized wire (HOROVOD_FUSION_WIRE=int8) on
+    # the eager path — an exactness-sensitive reduction stays exact.
+    # Leaving wire_format=None would be indistinguishable from not
+    # passing compression at all (which defers to the manager knob).
+    wire_format = "fp32"
+
     @staticmethod
     def compress(tensor):
         return tensor, None
@@ -40,7 +57,15 @@ class NoneCompressor(Compressor):
 
 class FP16Compressor(Compressor):
     """Cast floating tensors to fp16 on the wire, restore original dtype
-    after (ref: FP16Compressor [V])."""
+    after (ref: FP16Compressor [V]).
+
+    On the EAGER fused path this maps to the ``bf16`` wire: the fused
+    buffer has no fp16 format (bfloat16 is the TPU-native 2-byte wire —
+    same width, fp32's exponent range, no loss-scaling dance), and
+    silently moving full-width bytes for a caller who asked for
+    half-width compression would be worse than the substitution."""
+
+    wire_format = "bf16"
 
     @staticmethod
     def compress(tensor):
@@ -56,6 +81,8 @@ class FP16Compressor(Compressor):
 
 class BF16Compressor(Compressor):
     """TPU-native wire compression: bfloat16 keeps fp32's exponent range."""
+
+    wire_format = "bf16"
 
     @staticmethod
     def compress(tensor):
@@ -87,6 +114,7 @@ class Int8Compressor(Compressor):
     # Signals _allreduce_grads to use the quantized collective instead
     # of compress -> psum -> decompress.
     quantized_wire = True
+    wire_format = "int8"
 
     @staticmethod
     def compress(tensor, seed=0):
@@ -108,6 +136,55 @@ class Int8Compressor(Compressor):
         return pallas_kernels.int8_dequantize(tensor, scale, out_dtype=dtype)
 
 
+class Int8BlockCompressor(Int8Compressor):
+    """Block-scaled int8: one float32 scale per ``block_size`` elements
+    instead of one per tensor, so mixed-magnitude regions (a fused
+    buffer, a tensor with outlier rows) never share a dynamic range —
+    the wire format the fused quantized path (ops/fusion.py) uses
+    internally, exposed for manual compress/decompress use."""
+
+    block_size = 512
+
+    @classmethod
+    def compress(cls, tensor, seed=0):
+        from . import pallas_kernels
+
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            values, scales = pallas_kernels.int8_block_quantize(
+                tensor, block_size=cls.block_size, seed=seed
+            )
+            return values, (ctx, scales)
+        return tensor, (ctx, None)
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        from . import pallas_kernels
+
+        dtype, scales = ctx
+        if scales is None:
+            return tensor
+        return pallas_kernels.int8_block_dequantize(
+            tensor, scales, block_size=cls.block_size, out_dtype=dtype
+        )
+
+
+class HierarchicalInt8Compressor(Int8BlockCompressor):
+    """Hierarchical wire placement (EQuARX's insight, PAPERS.md): bf16
+    on the intra-host stage where ICI is fast, block-scaled int8 only
+    on the cross-host stage where DCN bytes are scarce. Meaningful on
+    the eager fused path (``hvd.allreduce(..., compression=
+    Compression.hier_int8)``) on a multi-host topology — on a single
+    host the hierarchy degenerates and the flat int8 wire is used.
+    On the TRACED/optimizer path (a single mesh axis — no topology
+    split to place stages on) this behaves as flat block-scaled int8;
+    for explicit two-axis placement use
+    ``traced.hierarchical_quantized_allreduce`` over a
+    ``hierarchical_mesh()``."""
+
+    wire_format = "int8_hier"
+
+
 class Compression:
     """Namespace mirroring hvd.Compression [V] (+ TPU-native additions)."""
 
@@ -115,3 +192,5 @@ class Compression:
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    int8_block = Int8BlockCompressor
+    hier_int8 = HierarchicalInt8Compressor

@@ -41,7 +41,7 @@ from ..common import basics
 from ..common.topology import rank_sharding
 from ..common.process_sets import ProcessSet
 from .fusion import Handle, _Entry
-from .reduction_ops import Average, ReduceOp, resolve_op
+from .reduction_ops import Average, ReduceOp, Sum, resolve_op
 
 _name_counter = itertools.count()
 
@@ -114,6 +114,45 @@ def my_row(result) -> np.ndarray:
 # ----------------------------------------------------------------- allreduce
 
 
+def _wire_of(compression, return_residual: bool) -> Optional[str]:
+    """Map an eager ``compression=`` argument to the fused wire format
+    (the eager path compresses the whole fused BUFFER inside the
+    compiled executable rather than tensor-by-tensor on the host; see
+    ops/fusion.py). ``None`` defers to ``HOROVOD_FUSION_WIRE``."""
+    wire = (
+        None if compression is None
+        else getattr(compression, "wire_format", None)
+    )
+    if return_residual and wire not in (None, "int8", "int8_hier"):
+        raise ValueError(
+            "return_residual=True needs the int8 quantized wire "
+            "(Compression.int8 / int8_block, or no compression= with "
+            "HOROVOD_FUSION_WIRE=int8); the error-feedback residual IS "
+            "the quantization error"
+        )
+    if return_residual and wire is None:
+        wire = "int8"
+    return wire
+
+
+def _check_residual_eligible(op, payload) -> None:
+    """return_residual's op/dtype constraints, enforced at ENQUEUE: a
+    flush-time failure would abort the whole cycle and strand every
+    other pending entry's handle — the caller who passed the bad
+    argument must be the one who gets the exception."""
+    if op not in (Average, Sum):
+        raise ValueError(
+            f"return_residual needs the int8 quantized wire, which "
+            f"supports Sum/Average only (got op={op!r})"
+        )
+    if not jnp.issubdtype(payload.dtype, jnp.floating):
+        raise ValueError(
+            f"return_residual needs a floating payload (got "
+            f"{payload.dtype}); integer tensors ride the exact fp32 "
+            f"wire, which has no quantization residual"
+        )
+
+
 def allreduce_async(
     tensor,
     average: Optional[bool] = None,
@@ -123,10 +162,23 @@ def allreduce_async(
     postscale_factor: float = 1.0,
     process_set: Optional[ProcessSet] = None,
     mask: Optional[np.ndarray] = None,
+    compression=None,
+    return_residual: bool = False,
 ) -> Handle:
+    """``compression=`` (Compression.bf16/int8/int8_block/hier_int8;
+    fp16 maps to the bf16 wire — TPU's native 2-byte format) selects
+    the WIRE FORMAT of the fused buffer — the whole batch is cast or
+    block-quantized inside the one compiled executable, not compressed
+    per tensor on the host. ``return_residual=True`` (int8 wire only)
+    makes the handle resolve to ``(output, residual)``, the
+    error-feedback carry sliced from the fused residual buffer — add
+    it to the next step's tensor (EF-SGD)."""
     op = resolve_op(op, average)
     fusion = _fusion()
     payload = _as_rank_major(tensor, fusion.world)
+    wire = _wire_of(compression, return_residual)
+    if return_residual:
+        _check_residual_eligible(op, payload)
     if mask is None:
         mask = JoinContext._active_mask
     entry = _Entry(
@@ -138,6 +190,9 @@ def allreduce_async(
         postscale=float(postscale_factor),
         process_set=process_set,
         mask=None if mask is None else np.asarray(mask, dtype=bool),
+        wire=wire,
+        wire_block=getattr(compression, "block_size", None),
+        want_residual=bool(return_residual),
     )
     return fusion.enqueue(entry)
 
@@ -160,25 +215,38 @@ def grouped_allreduce_async(
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
     process_set: Optional[ProcessSet] = None,
+    compression=None,
+    return_residual: bool = False,
 ) -> List[Handle]:
     """Enqueue a list atomically (ref: hvd.grouped_allreduce /
     group_table.cc [V]): all members land in the same cycle, so the fusion
-    pass reduces them in one fused collective."""
+    pass reduces them in one fused collective. With ``compression=``
+    the members share ONE wire-format pass — quantize once over the
+    fused buffer (see allreduce_async); ``return_residual=True`` makes
+    each handle resolve to ``(output, residual)``."""
     base = _auto_name("grouped_allreduce", name)
     fusion = _fusion()
     mask = JoinContext._active_mask
+    wire = _wire_of(compression, return_residual)
     handles = []
     entries = []
     for i, t in enumerate(tensors):
+        payload = _as_rank_major(t, fusion.world)
+        resolved = resolve_op(op, average)
+        if return_residual:
+            _check_residual_eligible(resolved, payload)
         entry = _Entry(
             name=f"{base}.{i}",
             kind="allreduce",
-            payload=_as_rank_major(t, fusion.world),
-            op=resolve_op(op, average),
+            payload=payload,
+            op=resolved,
             prescale=float(prescale_factor),
             postscale=float(postscale_factor),
             process_set=process_set,
             mask=None if mask is None else np.asarray(mask, dtype=bool),
+            wire=wire,
+            wire_block=getattr(compression, "block_size", None),
+            want_residual=bool(return_residual),
         )
         entries.append(entry)
     # Atomic enqueue: begin_group() defers threshold/cycle flushes until

@@ -59,6 +59,21 @@ re-thought for a single controller:
   sets) is played by this executor cache: repeated (op, dtype, shape)
   batches hit an already-compiled XLA executable
   (`HOROVOD_CACHE_CAPACITY` bounds both tiers via one LRU).
+* The fused buffer can traverse the wire QUANTIZED
+  (`HOROVOD_FUSION_WIRE={fp32,bf16,int8,auto}`): on the int8 wire the
+  compiled program block-quantizes the packed buffer (one scale per
+  `HOROVOD_FUSION_WIRE_BLOCK` elements, stochastic rounding seeded per
+  rank and dispatch), runs the quantized reduce-scatter/all-gather
+  recipe of `traced.quantized_allreduce`, and dequantizes before the
+  unpack — quantize once per BATCH instead of once per tensor, still
+  exactly one dispatch, ~4x fewer wire bytes for fp32 payloads
+  (EQuARX, arXiv 2506.17615). `auto` picks the format per bucket tier
+  online by goodput (common/autotune.py WireTuner); `bf16` moves the
+  buffer as a half-width cast; `HOROVOD_FUSION_WIRE_HIER` places bf16
+  on the intra-host stage and int8 on the cross-host stage only.
+  Error-feedback residuals are sliced per entry from the fused
+  residual buffer (`allreduce(..., return_residual=True)`), so EF
+  composes with fusion.
 * Flushing is cooperative (on enqueue-over-threshold, cycle expiry at next
   enqueue, or synchronize()) — there is no background thread to race with
   JAX dispatch.
@@ -106,6 +121,9 @@ class _Entry:
     handle: "Handle" = None
     enqueue_t: float = 0.0
     group_id: Optional[int] = None  # grouped_allreduce membership
+    wire: Optional[str] = None  # per-entry wire override (None = manager)
+    wire_block: Optional[int] = None  # per-entry block size (compressor's)
+    want_residual: bool = False  # error-feedback carry (int8 wire only)
 
 
 class Handle:
@@ -147,6 +165,9 @@ def _group_key(e: _Entry) -> Tuple:
         pset,
         mask_key,
         e.extra is not None,  # v-variant allgather never fuses with even
+        e.wire,  # entries on different wire formats never share a batch
+        e.wire_block,
+        e.want_residual,
     )
 
 
@@ -183,6 +204,24 @@ class _BatchPlan:
             self.n_ranks if self.family == "reducescatter" else 1
         )
         return self.pad_elems * rows * self.itemsize
+
+
+@dataclasses.dataclass(frozen=True)
+class _ExecSpec:
+    """One batch's resolved execution recipe: geometry, cache keys, the
+    per-shard core builder, and the wire format the fused buffer will
+    traverse the collective in."""
+
+    plan: _BatchPlan
+    core_key: Tuple
+    builder: Callable
+    needs_keep: bool = False  # adasum_pset: dynamic join-mask argument
+    needs_seed: bool = False  # quantized wire: per-dispatch rounding seed
+    want_res: bool = False  # error-feedback residual outputs
+    wire: str = "fp32"  # 'fp32' | 'bf16' | 'int8'
+    hier_n: Optional[int] = None  # int8 hier: inter-group (host) count
+    tuned: bool = False  # wire chosen by the WireTuner (auto mode)
+    block: Optional[int] = None  # int8: elements per block scale
 
 
 def _make_plan(
@@ -278,6 +317,10 @@ class FusionManager:
         bucketing: Optional[bool] = None,
         donate: Optional[bool] = None,
         promote_after: Optional[int] = None,
+        wire: Optional[str] = None,
+        wire_block: Optional[int] = None,
+        wire_hier: Optional[bool] = None,
+        wire_min_bytes: Optional[int] = None,
     ):
         self.mesh = mesh
         self.threshold_bytes = threshold_bytes
@@ -296,6 +339,10 @@ class FusionManager:
             or bucketing is None
             or donate is None
             or promote_after is None
+            or wire is None
+            or wire_block is None
+            or wire_hier is None
+            or wire_min_bytes is None
         ):
             from ..common.config import Config
 
@@ -310,7 +357,24 @@ class FusionManager:
                 donate = cfg.fusion_donate
             if promote_after is None:
                 promote_after = cfg.fusion_promote_after
+            if wire is None:
+                wire = cfg.fusion_wire
+            if wire_block is None:
+                wire_block = cfg.fusion_wire_block
+            if wire_hier is None:
+                wire_hier = cfg.fusion_wire_hier
+            if wire_min_bytes is None:
+                wire_min_bytes = cfg.fusion_wire_min_bytes
         self.injit_pack = bool(injit_pack)
+        self.wire = str(wire)
+        self.wire_block = max(int(wire_block), 1)
+        self.wire_hier = bool(wire_hier)
+        self.wire_min_bytes = int(wire_min_bytes)
+        self.wire_tuner = None
+        if self.wire == "auto":
+            from ..common.autotune import WireTuner
+
+            self.wire_tuner = WireTuner(min_int8_bytes=self.wire_min_bytes)
         self.bucketing = bool(bucketing)
         if donate is None:
             # auto: donation is a no-op (plus a warning) on backends
@@ -341,6 +405,16 @@ class FusionManager:
         self.pad_bytes_total = 0  # cumulative bucket padding on the wire
         self.last_cycle_pad_bytes = 0
         self.donated_bytes_total = 0
+        # quantized-wire observability (payload-width byte model: the
+        # fused buffer's wire footprint at the chosen format vs fp32)
+        self.wire_bytes_saved_total = 0
+        self.last_cycle_wire_saved = 0
+        self.quant_blocks_total = 0  # block-scale quantizations performed
+        self.last_wire_format = "fp32"  # wire of the most recent dispatch
+        self.ef_residual_norm = 0.0  # L2 of the last EF residual batch
+        self._seed_counter = 0  # decorrelates stochastic rounding per dispatch
+        self._prev_outs = None  # queue-drain anchor for WireTuner trials
+        self._anchor_ttl = 0  # dispatches the anchor stays alive for
         self.cycles = 0
         self._group_depth = 0
         self._next_group_id = 0
@@ -416,6 +490,7 @@ class FusionManager:
         self.cycles += 1
         self.last_cycle_dispatches = 0
         self.last_cycle_pad_bytes = 0
+        self.last_cycle_wire_saved = 0
         if self.timeline is not None:
             self.timeline.mark_cycle()
         if self.stall_inspector is not None:
@@ -482,6 +557,9 @@ class FusionManager:
         _metrics.gauge(
             "fusion.last_cycle_dispatches", self.last_cycle_dispatches
         )
+        _metrics.gauge(
+            "fusion.last_cycle_wire_saved", self.last_cycle_wire_saved
+        )
         _metrics.maybe_dump()
         if self.timeline is not None:
             self.timeline.counter(
@@ -490,14 +568,29 @@ class FusionManager:
             self.timeline.counter(
                 "fusion.dispatches", self.last_cycle_dispatches
             )
+            self.timeline.counter(
+                "fusion.wire_bytes_saved", self.last_cycle_wire_saved
+            )
+            from ..common.metrics import WIRE_FORMAT_CODES
+
+            self.timeline.counter(
+                "fusion.wire_format",
+                WIRE_FORMAT_CODES.get(self.last_wire_format, 0),
+            )
         if self.parameter_manager is not None:
             # useful vs wire bytes: the GP scores goodput (useful/sec),
             # so bucket padding — which costs time but moves no payload
-            # — is penalized, not rewarded
+            # — is penalized, not rewarded; a quantized wire that
+            # removes payload bytes is credited the same way
             self.parameter_manager.record(
                 bytes_=flushed_bytes,
                 seconds=time.monotonic() - t0,
-                wire_bytes=flushed_bytes + self.last_cycle_pad_bytes,
+                wire_bytes=max(
+                    flushed_bytes
+                    + self.last_cycle_pad_bytes
+                    - self.last_cycle_wire_saved,
+                    0,
+                ),
             )
             self.threshold_bytes, self.cycle_time_ms = (
                 self.parameter_manager.current()
@@ -599,6 +692,8 @@ class FusionManager:
         return fresh
 
     def cache_stats(self) -> Dict[str, int]:
+        from ..common.metrics import WIRE_FORMAT_CODES
+
         return {
             "capacity": self.cache_capacity,
             "size": len(self._executors),
@@ -611,6 +706,9 @@ class FusionManager:
             "dispatches": self.dispatches,
             "bucket_pad_bytes": self.pad_bytes_total,
             "donated_bytes": self.donated_bytes_total,
+            "wire_bytes_saved": self.wire_bytes_saved_total,
+            "quant_blocks": self.quant_blocks_total,
+            "wire_format": WIRE_FORMAT_CODES.get(self.last_wire_format, 0),
         }
 
     def _shard_map(self, fn, in_specs=P(WORLD_AXIS), out_specs=P(WORLD_AXIS)):
@@ -624,11 +722,71 @@ class FusionManager:
 
     # ---------------------------------------------------- fused dispatch
 
-    def _classify(self, batch: List[_Entry]):
-        """Resolve a batch to (family, plan, core_key, core_builder,
-        needs_keep). `core_key` identifies the composition-independent
-        padded-buffer program; the exact fused executable's key appends
-        the per-entry shape tuple."""
+    def _hier_stages(self):
+        """Two-level replica groups of the current topology, or None
+        when the hierarchy degenerates. Factored out so tests can
+        inject a synthetic multi-host split on a single-host mesh."""
+        from ..common import basics as _basics
+
+        local = (
+            _basics.topology().local_size if _basics.is_initialized() else 1
+        )
+        return hierarchical_stage_groups(self.world, local)
+
+    def _resolve_wire(self, e0: _Entry, plan: _BatchPlan):
+        """Pick the wire format for one allreduce batch: the entry's
+        compression override beats the manager knob; ``auto`` asks the
+        per-bucket WireTuner. Returns ``(wire, hier_stages, tuned)``
+        with ``wire`` in {'fp32','bf16','int8'} — ineligible batches
+        (non-float dtype, reductions that don't commute with
+        quantization/cast) always ride fp32; ``tuned`` marks a choice
+        that came from the tuner (only those dispatches ever pay trial
+        synchronization)."""
+        import jax.numpy as _jnp
+
+        wire = e0.wire or self.wire
+        eligible = e0.op in (Average, Sum) and _jnp.issubdtype(
+            _jnp.dtype(plan.dtype), _jnp.floating
+        )
+        if e0.want_residual:
+            if not eligible:
+                raise ValueError(
+                    "return_residual needs the int8 wire, which supports "
+                    "float Sum/Average allreduce only"
+                )
+            # EF is defined by the quantization error — it forces the
+            # flat int8 wire (the hierarchical split has no single
+            # local residual to carry).
+            return "int8", None, False
+        if not eligible or wire in (None, "fp32"):
+            return "fp32", None, False
+        hier = None
+        tuned = False
+        if wire == "int8_hier" or (wire == "int8" and self.wire_hier):
+            hier = self._hier_stages()
+            wire = "int8"
+        if wire == "auto":
+            if self.wire_tuner is None:  # knob flipped after init
+                from ..common.autotune import WireTuner
+
+                self.wire_tuner = WireTuner(
+                    min_int8_bytes=self.wire_min_bytes
+                )
+            bucket_key = ("allreduce", plan.bucket, plan.dtype)
+            wire = self.wire_tuner.choose(
+                bucket_key,
+                payload_bytes=plan.bucket * plan.itemsize,
+                itemsize=plan.itemsize,
+            )
+            tuned = True
+            if wire == "int8" and self.wire_hier:
+                hier = self._hier_stages()
+        return wire, (hier if wire == "int8" else None), tuned
+
+    def _classify(self, batch: List[_Entry]) -> "_ExecSpec":
+        """Resolve a batch to an _ExecSpec. `core_key` identifies the
+        composition-independent padded-buffer program; the exact fused
+        executable's key appends the per-entry shape tuple."""
         e0 = batch[0]
         kind = e0.kind
         if kind == "allreduce":
@@ -650,17 +808,44 @@ class FusionManager:
                 builder = lambda: self._core_adasum_pset(
                     e0.prescale, e0.postscale, ranks
                 )
-                return plan, core_key, builder, True
+                return _ExecSpec(plan, core_key, builder, needs_keep=True)
             mask = None if e0.mask is None else tuple(bool(b) for b in e0.mask)
             plan = self._plan(batch, "allreduce", self.world)
+            wire, hier, tuned = self._resolve_wire(e0, plan)
+            if pset_mask is not None or mask is not None:
+                # masked hierarchy degenerates to flat inside the core;
+                # keep the spec (and so the wire-byte model + autotune
+                # feed) consistent with what actually compiles
+                hier = None
+            if wire == "int8":
+                # a compressor's block_size (Compression.int8_block
+                # subclasses) beats the manager knob, matching the
+                # traced/optimizer path's granularity
+                block = e0.wire_block or self.wire_block
+                core_key = (
+                    "allreduce_q", int(e0.op), e0.prescale, e0.postscale,
+                    pset_mask, mask, plan.bucket, plan.dtype, block,
+                    e0.want_residual, hier is not None,
+                )
+                builder = lambda: self._core_allreduce_q(
+                    e0.op, e0.prescale, e0.postscale, pset_mask, mask,
+                    block, e0.want_residual, hier,
+                )
+                return _ExecSpec(
+                    plan, core_key, builder, needs_seed=True,
+                    want_res=e0.want_residual, wire="int8",
+                    hier_n=None if hier is None else len(hier[1][0]),
+                    tuned=tuned, block=block,
+                )
             core_key = (
                 "allreduce", int(e0.op), e0.prescale, e0.postscale,
-                pset_mask, mask, plan.bucket, plan.dtype,
+                pset_mask, mask, plan.bucket, plan.dtype, wire,
             )
             builder = lambda: self._core_allreduce(
-                e0.op, e0.prescale, e0.postscale, pset_mask, mask
+                e0.op, e0.prescale, e0.postscale, pset_mask, mask,
+                wire=wire,
             )
-            return plan, core_key, builder, False
+            return _ExecSpec(plan, core_key, builder, wire=wire, tuned=tuned)
         if kind == "broadcast":
             pset_mask = self._pset_mask(e0)
             plan = self._plan(batch, "broadcast", self.world)
@@ -669,14 +854,14 @@ class FusionManager:
                 plan.dtype,
             )
             builder = lambda: self._core_broadcast(e0.root_rank, pset_mask)
-            return plan, core_key, builder, False
+            return _ExecSpec(plan, core_key, builder)
         if kind == "allgather":
             ranks = self._pset_ranks(e0)
             n_ranks = self.world if ranks is None else len(ranks)
             plan = self._plan(batch, "allgather", n_ranks)
             core_key = ("allgather", ranks, plan.bucket, plan.dtype)
             builder = lambda: self._core_allgather(ranks)
-            return plan, core_key, builder, False
+            return _ExecSpec(plan, core_key, builder)
         if kind == "reducescatter":
             ranks = self._pset_ranks(e0)
             n_ranks = self.world if ranks is None else len(ranks)
@@ -694,7 +879,7 @@ class FusionManager:
             builder = lambda: self._core_reducescatter(
                 e0.op, e0.prescale, e0.postscale, ranks
             )
-            return plan, core_key, builder, False
+            return _ExecSpec(plan, core_key, builder)
         raise ValueError(f"unknown kind {kind}")
 
     def _plan(self, batch, family, n_ranks) -> _BatchPlan:
@@ -716,7 +901,8 @@ class FusionManager:
         )
 
     def _execute_batch(self, batch: List[_Entry]) -> None:
-        plan, core_key, core_builder, needs_keep = self._classify(batch)
+        spec = self._classify(batch)
+        plan, core_key = spec.plan, spec.core_key
         exact_key = core_key + ("x", plan.shapes)
         # The exact tier is keyed on the full per-entry shape tuple, so
         # bucket padding buys it zero cache stability — it would only
@@ -733,27 +919,47 @@ class FusionManager:
             for e in batch:
                 self.timeline.begin(e.name, phase)
 
-        keep = self._keep_arg(batch[0]) if needs_keep else None
+        keep = self._keep_arg(batch[0]) if spec.needs_keep else None
+        seed = self._next_seed() if spec.needs_seed else None
         outs = None
         used_plan = plan
+        misses_before = self.cache_misses
+        trial_key = None
+        if spec.tuned:  # wire came from the tuner — no trials otherwise
+            bucket_key = ("allreduce", plan.bucket, plan.dtype)
+            if self.wire_tuner.needs_trial(bucket_key, spec.wire):
+                trial_key = bucket_key
+                self._anchor_ttl = 16  # exploration active: keep anchors
+                # drain the dispatch queue up to the PREVIOUS batch so
+                # the trial's clock measures this dispatch alone, not
+                # whatever earlier async work was still in flight
+                if self._prev_outs is not None:
+                    try:
+                        jax.block_until_ready(self._prev_outs)
+                    except RuntimeError:
+                        # the user may have DONATED the fulfilled
+                        # outputs since (deleted buffers); the queue is
+                        # then already drained past them
+                        pass
+        t_disp = time.monotonic()
         if not self.injit_pack or self.cache_capacity == 0:
             # host-pack mode (the A/B baseline leg), or caching disabled
             # — capacity 0 must not build a throwaway fused program per
             # cycle on top of an uncacheable core
             if self.injit_pack and self.cache_capacity == 0:
                 self.cache_misses += 1
-                fn = self._build_fused(exact_plan, core_builder(), needs_keep)
-                outs = self._dispatch_fused(fn, batch, exact_plan, keep)
+                fn = self._build_fused(exact_plan, spec.builder(), spec)
+                outs = self._dispatch_fused(fn, batch, exact_plan, keep, seed)
                 used_plan = exact_plan
             else:
                 fn = self._executor(core_key, lambda: self._build_core(
-                    plan, core_builder()))
-                outs = self._dispatch_core(fn, batch, plan, keep)
+                    plan, spec.builder(), spec))
+                outs = self._dispatch_core(fn, batch, plan, keep, seed, spec)
         else:
             fn = self._cache_get(exact_key)
             if fn is not None:
                 self.cache_hits += 1
-                outs = self._dispatch_fused(fn, batch, exact_plan, keep)
+                outs = self._dispatch_fused(fn, batch, exact_plan, keep, seed)
                 used_plan = exact_plan
             else:
                 seen = self._note_composition(exact_key)
@@ -765,11 +971,9 @@ class FusionManager:
                     self.cache_misses += 1
                     if not fresh_bucket:
                         self.promotions += 1
-                    fn = self._build_fused(
-                        exact_plan, core_builder(), needs_keep
-                    )
+                    fn = self._build_fused(exact_plan, spec.builder(), spec)
                     self._cache_put(exact_key, fn)
-                    outs = self._dispatch_fused(fn, batch, exact_plan, keep)
+                    outs = self._dispatch_fused(fn, batch, exact_plan, keep, seed)
                     used_plan = exact_plan
                 else:
                     # composition churn inside a known bucket: reuse (or
@@ -777,14 +981,43 @@ class FusionManager:
                     # compiling per composition
                     if core is None:
                         self.cache_misses += 1
-                        core = self._build_core(plan, core_builder())
+                        core = self._build_core(plan, spec.builder(), spec)
                         self._cache_put(core_key, core)
                     self.bucket_hits += 1
-                    outs = self._dispatch_core(core, batch, plan, keep)
+                    outs = self._dispatch_core(
+                        core, batch, plan, keep, seed, spec
+                    )
 
         self.pad_bytes_total += used_plan.pad_bytes
         self.last_cycle_pad_bytes += used_plan.pad_bytes
-        for e, out in zip(batch, outs):
+        self._account_wire(spec, used_plan)
+        if trial_key is not None and self.cache_misses == misses_before:
+            # exploration observation: pay one sync so the sample
+            # measures execution (quant tax + wire), not the
+            # format-independent async dispatch overhead; compile-time
+            # dispatches are excluded — they would poison the goodput
+            jax.block_until_ready(outs)
+            self.wire_tuner.record(
+                trial_key,
+                spec.wire,
+                useful_bytes=spec.plan.useful
+                * spec.plan.itemsize
+                * used_plan.world,
+                seconds=time.monotonic() - t_disp,
+            )
+        # the anchor pins the previous batch's outputs in memory, so it
+        # lives only while exploration is ACTIVE: each trial refreshes
+        # a small TTL, and a half-explored bucket that stops recurring
+        # stops pinning buffers after the TTL drains (it would
+        # otherwise hold a threshold-sized batch for the process
+        # lifetime)
+        self._anchor_ttl = max(self._anchor_ttl - 1, 0)
+        self._prev_outs = outs if self._anchor_ttl > 0 else None
+        resids = None
+        if spec.want_res:
+            outs, resids = outs
+            self._note_residuals(resids)
+        for i, (e, out) in enumerate(zip(batch, outs)):
             if e.kind == "allgather" and e.extra is not None:
                 # Uneven dim0: rows were padded to max length; slice each
                 # rank's valid prefix and concat (MPI_Allgatherv parity).
@@ -797,13 +1030,88 @@ class FusionManager:
                 out = jnp.concatenate(pieces, axis=1)
             if self.timeline is not None:
                 self.timeline.end(e.name, phase)
-            e.handle._fulfill(out)
+            e.handle._fulfill(
+                (out, resids[i]) if resids is not None else out
+            )
 
-    def _dispatch_fused(self, fn, batch, plan, keep):
-        """One executor invocation covering pack + collective + unpack."""
-        args = [e.payload for e in batch]
+    def _next_seed(self) -> int:
+        """Per-dispatch stochastic-rounding seed: monotone, so no two
+        fused dispatches (within or across cycles) reuse a rounding
+        pattern; the per-rank decorrelation is folded in inside the
+        compiled program (rank index is not known on the host)."""
+        s = self._seed_counter
+        self._seed_counter += 1
+        return s
+
+    def _account_wire(
+        self, spec: "_ExecSpec", used_plan: _BatchPlan
+    ) -> None:
+        """Wire-byte accounting for one dispatch, payload-width model:
+        the fused buffer's bytes at the chosen wire format vs fp32 —
+        per rank row, ``bucket·itemsize`` at fp32, ``bucket·2`` at
+        bf16, ``bucket + 4·scales`` at int8 (both quantization stages'
+        block scales counted; the hierarchical placement additionally
+        pays its bf16 intra stage and quantizes over the inter group
+        only). Ring/topology factors multiply both sides of the
+        comparison equally, so the saved-bytes ratio is exact even
+        though the absolute byte counts are buffer-level."""
+        self.last_wire_format = spec.wire
+        rows = used_plan.world
+        elems = used_plan.bucket
+        fp32_b = elems * used_plan.itemsize
+        saved = 0
+        if spec.wire == "bf16":
+            saved = max(fp32_b - elems * 2, 0) * rows
+        elif spec.wire == "int8":
+            n = spec.hier_n or self.world
+            chunk = -(-elems // n)
+            nb = -(-chunk // (spec.block or self.wire_block))
+            scale_floats = nb * (n + 1)  # stage-1 n·nb + stage-2 nb
+            wire_b = elems + scale_floats * 4
+            if spec.hier_n:
+                wire_b += elems * 2  # the bf16 intra-host stage
+            saved = max(fp32_b - wire_b, 0) * rows
+            self.quant_blocks_total += nb * (n + 1) * rows
+        self.wire_bytes_saved_total += saved
+        self.last_cycle_wire_saved += saved
+
+    def _note_residuals(self, resids) -> None:
+        """EF-residual observability: the L2 norm of the batch's carry.
+        Computed only when someone is watching (timeline or metrics
+        sink) — it forces a host sync on the eager path."""
+        from ..common.metrics import registry as _metrics
+
+        if self.timeline is None and not _metrics.exporting:
+            return
+        # one traced reduction over every entry, ONE host transfer —
+        # per-entry float() would serialize a device sync per tensor
+        # against the dispatch pipeline
+        sq = sum(
+            jnp.vdot(jnp.asarray(r, jnp.float32), jnp.asarray(r, jnp.float32))
+            for r in resids
+        )
+        self.ef_residual_norm = float(jnp.sqrt(sq))
+        _metrics.gauge("fusion.ef_residual_norm", self.ef_residual_norm)
+        if self.timeline is not None:
+            self.timeline.counter(
+                "fusion.ef_residual_norm", self.ef_residual_norm
+            )
+
+    @staticmethod
+    def _extra_args(keep, seed):
+        extra = []
         if keep is not None:
-            args.append(keep)
+            extra.append(keep)
+        if seed is not None:
+            # a committed scalar array, not a Python int: weak-typed
+            # host scalars would re-trace the executable per value
+            extra.append(jnp.int32(seed))
+        return extra
+
+    def _dispatch_fused(self, fn, batch, plan, keep, seed=None):
+        """One executor invocation covering pack + collective + unpack
+        (and, on the quantized wire, quantize + dequantize)."""
+        args = [e.payload for e in batch] + self._extra_args(keep, seed)
         self.dispatches += 1
         self.last_cycle_dispatches += 1
         if self.donate:
@@ -812,7 +1120,7 @@ class FusionManager:
             )
         return fn(*args)
 
-    def _dispatch_core(self, fn, batch, plan, keep):
+    def _dispatch_core(self, fn, batch, plan, keep, seed=None, spec=None):
         """Bucket-tier dispatch: host-side pack into the padded buffer,
         one collective invocation, host-side unpack. This is the
         pre-rework dispatch path, kept as the composition-independent
@@ -826,39 +1134,54 @@ class FusionManager:
                 self.timeline.end(e.name, "MEMCPY_IN_FUSION_BUFFER")
         self.dispatches += 1
         self.last_cycle_dispatches += 1
-        out = fn(buf, keep) if keep is not None else fn(buf)
+        out = fn(buf, *self._extra_args(keep, seed))
+        if spec is not None and spec.want_res:
+            out, res = out
+            return _unpack(out, plan), _unpack(res, plan)
         return _unpack(out, plan)
 
-    def _build_core(self, plan: _BatchPlan, per_shard) -> Callable:
+    def _mapped_core(self, per_shard, spec: "_ExecSpec"):
+        """shard_map the per-shard core with the argument/output specs
+        its flags imply: buffer (+ keep) (+ replicated seed) in, buffer
+        (+ residual buffer) out."""
+        in_specs = [P(WORLD_AXIS)]
+        if spec.needs_keep:
+            in_specs.append(P(WORLD_AXIS))
+        if spec.needs_seed:
+            in_specs.append(P())
+        out_specs = (
+            (P(WORLD_AXIS), P(WORLD_AXIS)) if spec.want_res else P(WORLD_AXIS)
+        )
+        return self._shard_map(
+            per_shard, in_specs=tuple(in_specs), out_specs=out_specs
+        )
+
+    def _build_core(
+        self, plan: _BatchPlan, per_shard, spec: "_ExecSpec"
+    ) -> Callable:
         """Compile the composition-independent padded-buffer program."""
-        if plan.family == "adasum_pset":
-            mapped = self._shard_map(
-                per_shard, in_specs=(P(WORLD_AXIS), P(WORLD_AXIS))
-            )
-        else:
-            mapped = self._shard_map(per_shard)
-        return jax.jit(mapped)
+        return jax.jit(self._mapped_core(per_shard, spec))
 
     def _build_fused(
-        self, plan: _BatchPlan, per_shard, needs_keep: bool
+        self, plan: _BatchPlan, per_shard, spec: "_ExecSpec"
     ) -> Callable:
-        """Compile the whole batch — in-JIT pack, collective, in-JIT
-        unpack — as ONE donated executable. XLA sees the reshape/concat
-        producers and the slice/reshape consumers next to the collective
-        and fuses them; donation lets the fusion buffer alias the
-        argument storage instead of doubling peak HBM."""
-        if needs_keep:
-            mapped = self._shard_map(
-                per_shard, in_specs=(P(WORLD_AXIS), P(WORLD_AXIS))
-            )
-        else:
-            mapped = self._shard_map(per_shard)
+        """Compile the whole batch — in-JIT pack, (quantize,)
+        collective, (dequantize,) in-JIT unpack — as ONE donated
+        executable. XLA sees the reshape/concat producers and the
+        slice/reshape consumers next to the collective and fuses them;
+        donation lets the fusion buffer alias the argument storage
+        instead of doubling peak HBM."""
+        mapped = self._mapped_core(per_shard, spec)
         n_tensors = len(plan.shapes)
+        want_res = spec.want_res
 
         def fused(*args):
             tensors = args[:n_tensors]
             buf = _pack(tensors, plan)
-            out = mapped(buf, args[-1]) if needs_keep else mapped(buf)
+            out = mapped(buf, *args[n_tensors:])
+            if want_res:
+                out, res = out
+                return tuple(_unpack(out, plan)), tuple(_unpack(res, plan))
             return tuple(_unpack(out, plan))
 
         kwargs = {}
@@ -875,9 +1198,12 @@ class FusionManager:
     # pack/unpack over the UNPADDED (bucket == useful) geometry — its
     # key already pins the exact shapes, so padding would buy nothing.
 
-    def _core_allreduce(self, op, prescale, postscale, pset_mask, mask):
+    def _core_allreduce(
+        self, op, prescale, postscale, pset_mask, mask, wire="fp32"
+    ):
         world = self.world
         op = ReduceOp(op)
+        bf16_wire = wire == "bf16"
         mask_arr = (
             None if mask is None else np.asarray(mask, dtype=bool)
         )
@@ -917,16 +1243,28 @@ class FusionManager:
                 contrib = x
             if op in (Average, Sum) and hier_stages is not None:
                 intra_groups, inter_groups = hier_stages
+                if bf16_wire:
+                    contrib = contrib.astype(jnp.bfloat16)
                 out = lax.psum(
                     contrib, WORLD_AXIS, axis_index_groups=intra_groups
                 )
                 out = lax.psum(
                     out, WORLD_AXIS, axis_index_groups=inter_groups
                 )
+                if bf16_wire:
+                    out = out.astype(x.dtype)
                 if op == Average:
                     out = out / jnp.asarray(world, out.dtype)
             elif op in (Average, Sum):
+                # bf16 wire: the cast is the compression — XLA fuses it
+                # into the collective's producer/consumer, so the wire
+                # moves half-width bytes at zero extra HBM passes
+                # (Compression.bf16's contract, applied buffer-wide)
+                if bf16_wire:
+                    contrib = contrib.astype(jnp.bfloat16)
                 out = lax.psum(contrib, WORLD_AXIS)
+                if bf16_wire:
+                    out = out.astype(x.dtype)
                 if op == Average:
                     count = lax.psum(active.astype(x.dtype), WORLD_AXIS)
                     out = out / jnp.maximum(count, 1)
@@ -973,6 +1311,163 @@ class FusionManager:
             if pset_arr is not None:
                 out = jnp.where(jnp.asarray(pset_arr)[idx], out, raw)
             return out
+
+        return per_shard
+
+    def _core_allreduce_q(
+        self, op, prescale, postscale, pset_mask, mask, block,
+        want_res, hier_stages,
+    ):
+        """The quantized fused wire: the whole fused buffer traverses
+        the collective as block-scaled int8, entirely inside the
+        compiled program — quantize ONCE over the batch instead of once
+        per tensor (the per-tensor quantize tax bench_int8.py measures,
+        amortized to one).
+
+        Recipe = traced.quantized_allreduce's two-stage shape applied
+        to this rank's [1, N] buffer row: block-quantize the row split
+        into per-peer chunks → all_to_all of int8 + block scales (the
+        scatter half of reduce-scatter) → dequant-sum the received
+        chunks at f32 → block-quantize the reduced shard → all_gather →
+        dequant. XLA fuses the quantize into the pack producer and the
+        dequant into the unpack consumers, so the batch still costs
+        exactly ONE dispatch; wire bytes drop ~4x for fp32 payloads
+        (block scales cost 4·(n+1)/n/block of the payload — <1% at
+        block=512).
+
+        ``prescale`` folds into the stage-1 wire scales (quantization
+        is scale-invariant — see traced.quantized_allreduce), so the
+        quantized path never pays a pre-multiply HBM pass. Bucket-tier
+        zero padding is excluded from the scales by construction (zeros
+        never raise a block absmax, quantize to zero, and leave a zero
+        residual). With ``hier_stages``, compression follows the
+        topology: bf16 psum on the intra-host (ICI) stage, the int8
+        recipe on the cross-host (DCN) stage only — EQuARX's placement.
+
+        ``want_res=True`` returns ``(out, residual)`` — the
+        error-feedback carry in INPUT units, per-entry slices of which
+        `_unpack` hands back so DistributedOptimizer-style EF composes
+        with fusion.
+
+        NOTE this body intentionally mirrors the ``block_size`` branch
+        of ``traced.quantized_allreduce`` (which lacks the mask/pset/
+        hier machinery but shares every numeric contract: wire-scale
+        prescale fold, Average×n and /prescale residual corrections,
+        prescale==0 zero carry). A change to either residual contract
+        must land in BOTH — tests/test_fusion_quantized.py's fused-vs-
+        unfused parity tests are the tripwire.
+        """
+        world = self.world
+        op = ReduceOp(op)
+        mask_arr = None if mask is None else np.asarray(mask, dtype=bool)
+        pset_arr = (
+            None if pset_mask is None else np.asarray(pset_mask, dtype=bool)
+        )
+        if mask_arr is not None and pset_arr is not None:
+            active_arr = mask_arr & pset_arr
+        else:
+            active_arr = mask_arr if mask_arr is not None else pset_arr
+        # divisor is static: the single controller knows the join mask
+        n_active = (
+            world if active_arr is None else max(int(active_arr.sum()), 1)
+        )
+        if hier_stages is not None and active_arr is not None:
+            hier_stages = None  # masked hierarchy degenerates to flat
+
+        from .traced import _block_dequant, _stochastic_round_blocks
+
+        def per_shard(x, seed):  # x: [1, N]; seed: replicated scalar
+            idx = lax.axis_index(WORLD_AXIS)
+            raw = x
+            row = x[0].astype(jnp.float32)
+            if active_arr is not None:
+                active = jnp.asarray(active_arr)[idx]
+                row = jnp.where(active, row, jnp.zeros_like(row))
+            if hier_stages is not None:
+                intra_groups, inter_groups = hier_stages
+                # intra-host stage at bf16: ICI is fast, spend 2 bytes
+                row = lax.psum(
+                    row.astype(jnp.bfloat16),
+                    WORLD_AXIS,
+                    axis_index_groups=intra_groups,
+                ).astype(jnp.float32)
+                n = len(inter_groups[0])
+                groups = inter_groups
+            else:
+                n = world
+                groups = None
+            m = row.shape[0]
+            chunk = -(-m // n)
+            flat = (
+                jnp.pad(row, (0, chunk * n - m))
+                if chunk * n != m
+                else row
+            )
+            chunks = flat.reshape(n, chunk)
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0), seed), idx
+            )
+            q, scales = _stochastic_round_blocks(chunks, block, key)
+            wire_scales = (
+                scales * jnp.asarray(prescale, scales.dtype)
+                if prescale != 1.0
+                else scales
+            )
+            recv = lax.all_to_all(
+                q, WORLD_AXIS, split_axis=0, concat_axis=0, tiled=True,
+                axis_index_groups=groups,
+            )
+            recv_s = lax.all_to_all(
+                wire_scales, WORLD_AXIS, split_axis=0, concat_axis=0,
+                tiled=True, axis_index_groups=groups,
+            )
+            shard = jnp.sum(_block_dequant(recv, recv_s), axis=0)  # [cpad]
+            if op == Average:
+                shard = shard / jnp.asarray(n_active, shard.dtype)
+            q2, s2 = _stochastic_round_blocks(
+                shard[None], block, jax.random.fold_in(key, 7919)
+            )
+            all_q = lax.all_gather(
+                q2[0], WORLD_AXIS, axis_index_groups=groups
+            )
+            all_s = lax.all_gather(
+                s2[0], WORLD_AXIS, axis_index_groups=groups
+            )
+            out = _block_dequant(all_q, all_s)[:, :chunk].reshape(-1)[:m]
+            if postscale != 1.0:
+                out = out * jnp.asarray(postscale, out.dtype)
+            out = out.astype(x.dtype)[None]
+            if pset_arr is not None:
+                out = jnp.where(jnp.asarray(pset_arr)[idx], out, raw)
+            if not want_res:
+                return out
+            if prescale == 0.0:
+                # nothing is transmitted: zero carry (see
+                # traced.quantized_allreduce) rather than 0/0 NaNs
+                return out, jnp.zeros_like(out)
+            # EF carry, both stages, input units (traced.
+            # quantized_allreduce's contract): stage-1 against the
+            # UNSCALED block scales; stage-2 on the owned chunk,
+            # un-Averaged and un-prescaled so a +res input correction
+            # cancels it exactly.
+            res1 = chunks - _block_dequant(q, scales)[:, :chunk]
+            res_flat = res1.reshape(-1)
+            e2 = (shard - _block_dequant(q2, s2)[0])[:chunk]
+            if op == Average:
+                e2 = e2 * jnp.asarray(n_active, e2.dtype)
+            if prescale != 1.0:
+                e2 = e2 / jnp.asarray(prescale, e2.dtype)
+            res_flat = lax.dynamic_update_slice(
+                res_flat,
+                lax.dynamic_slice(res_flat, (idx * chunk,), (chunk,)) + e2,
+                (idx * chunk,),
+            )
+            res = res_flat[:m].astype(x.dtype)[None]
+            if pset_arr is not None:
+                res = jnp.where(
+                    jnp.asarray(pset_arr)[idx], res, jnp.zeros_like(res)
+                )
+            return out, res
 
         return per_shard
 

@@ -175,6 +175,91 @@ def int8_dequantize(values: jax.Array, scale, out_dtype=jnp.float32):
     return scale_cast(values, scale, out_dtype)
 
 
+# --------------------------------------------------- block-scaled int8
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def int8_block_quantize(x: jax.Array, block_size: int = 512, seed=0):
+    """Block-scaled int8: one float32 scale per ``block_size`` elements
+    of the flattened tensor, stochastic rounding (unbiased).
+
+    Returns ``(values_int8, scales_f32)`` with ``values`` shaped like
+    ``x`` and ``scales`` shaped ``[ceil(n/block_size)]``;
+    ``x ≈ values * repeat(scales, block_size)[:n]``. The per-tensor
+    :func:`int8_quantize` forces every element to share one dynamic
+    range; block scales keep mixed-magnitude regions (a fused buffer
+    concatenating many gradients — ops/fusion.py's quantized wire, the
+    EQuARX wire format) each within their own, at 4 bytes of scale per
+    block on the wire. A short tail block is padded with zeros for the
+    absmax only — zeros never raise a block's scale, so padding cannot
+    leak into the quantization (the pad-exclusion contract the fused
+    bucket tier relies on).
+    """
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    nb = -(-n // block_size)
+    pad = nb * block_size - n
+    blocks = (jnp.pad(flat, (0, pad)) if pad else flat).reshape(
+        nb, block_size
+    )
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = jnp.maximum(absmax, 1e-30) / 127.0
+    scaled = (blocks / scales[:, None]).reshape(-1)[:n]
+    if _interpret():
+        floor = jnp.floor(scaled)
+        frac = scaled - floor
+        u = jax.random.uniform(jax.random.PRNGKey(seed), scaled.shape)
+        rounded = floor + (u < frac).astype(jnp.float32)
+        vals = jnp.clip(rounded, -128, 127).astype(jnp.int8)
+        return vals.reshape(shape), scales
+    tiles, _ = _as_tiles(scaled)
+    rows = tiles.shape[0]
+    grid = (pl.cdiv(rows, _TILE_ROWS),)
+    values = pl.pallas_call(
+        _quantize_int8_body,
+        out_shape=jax.ShapeDtypeStruct(tiles.shape, jnp.int8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (_TILE_ROWS, _LANES),
+                lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (_TILE_ROWS, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=_interpret(),
+    )(tiles, jnp.asarray([seed], jnp.int32))
+    return values.reshape(-1)[:n].reshape(shape), scales
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "out_dtype"))
+def int8_block_dequantize(
+    values: jax.Array, scales, block_size: int = 512,
+    out_dtype=jnp.float32,
+):
+    """Inverse of :func:`int8_block_quantize`. Plain jnp on purpose:
+    the production call sites are inside traced programs (the fused
+    wire's consumer side), where XLA fuses the broadcast-multiply into
+    the collective's consumer — a dedicated kernel would only fence
+    that fusion off."""
+    shape = values.shape
+    flat = values.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    nb = scales.shape[0]
+    pad = nb * block_size - n
+    blocks = (jnp.pad(flat, (0, pad)) if pad else flat).reshape(
+        nb, block_size
+    )
+    out = (blocks * scales[:, None].astype(jnp.float32)).reshape(-1)[:n]
+    return out.reshape(shape).astype(out_dtype)
+
+
 # ----------------------------------------------------------- adasum fuse
 
 

@@ -403,12 +403,52 @@ def _stochastic_round_rows(x2d, key):
     return q, scale
 
 
+def _stochastic_round_blocks(x2d, block: int, key):
+    """Block-scaled variant of :func:`_stochastic_round_rows`: one
+    absmax scale per ``block`` elements within each row, so
+    mixed-magnitude regions of a fused buffer never share a dynamic
+    range (the block-scaled wire of pallas_kernels.int8_block_quantize,
+    expressed as plain jnp for use inside traced programs where XLA
+    fuses it into the collective's producer).
+
+    Returns ``(q, scales)`` with ``q`` int8 ``[rows, nb, block]``
+    (tail block zero-padded — zeros quantize to zeros and never raise
+    a block's absmax, so padding is excluded from the scales by
+    construction) and ``scales`` float32 ``[rows, nb]``.
+    """
+    rows, cols = x2d.shape
+    nb = -(-cols // block)
+    pad = nb * block - cols
+    xb = (
+        jnp.pad(x2d, ((0, 0), (0, pad))) if pad else x2d
+    ).reshape(rows, nb, block)
+    absmax = jnp.max(jnp.abs(xb), axis=2)
+    scales = jnp.maximum(absmax, 1e-30) / 127.0
+    scaled = xb / scales[:, :, None]
+    floor = jnp.floor(scaled)
+    frac = scaled - floor
+    u = jax.random.uniform(key, scaled.shape)
+    q = jnp.clip(floor + (u < frac), -128, 127).astype(jnp.int8)
+    return q, scales
+
+
+def _block_dequant(q, scales):
+    """``[rows, nb, block]`` int8 × ``[rows, nb]`` scales → float32
+    ``[rows, nb*block]``."""
+    rows, nb, block = q.shape
+    return (q.astype(jnp.float32) * scales[:, :, None]).reshape(
+        rows, nb * block
+    )
+
+
 def quantized_allreduce(
     tensor,
     op=None,
     axis_name: str = WORLD_AXIS,
     seed=0,
     return_residual: bool = False,
+    prescale_factor: float = 1.0,
+    block_size: Optional[int] = None,
 ):
     """Allreduce moving int8 across ICI — the quantized-collective
     recipe of EQuARX (PAPERS.md), built from primitives the reference
@@ -434,6 +474,24 @@ def quantized_allreduce(
     (DistributedOptimizer(error_feedback=True)): adding it to the NEXT
     step's gradient keeps the cumulative transmitted signal within a
     constant number of quanta of the true sum instead of a random walk.
+
+    ``prescale_factor`` is FOLDED INTO the stage-1 wire scales rather
+    than multiplied through the tensor: quantization is scale-invariant
+    (``q = round(x/absmax(x)·127)`` is unchanged by ``x → c·x`` for
+    ``c > 0``), so scaling the per-chunk wire scale — n floats — after
+    the fact is bit-identical to pre-multiplying the payload, minus one
+    full HBM read-write pass over the tensor. The residual stays in
+    INPUT (unscaled) units: add it to the next step's raw tensor.
+
+    ``block_size`` switches both stages to block-wise scales (one per
+    ``block_size`` elements within each chunk — the wire format of
+    ``Compression.int8_block`` and the fused path), so mixed-magnitude
+    regions never share a dynamic range; ``None`` keeps the per-chunk
+    scale of ``Compression.int8``. The block branch intentionally
+    mirrors ``fusion.FusionManager._core_allreduce_q`` (same numeric
+    contracts, minus its mask/pset/hier machinery) — a residual-
+    contract change must land in both; the fused-vs-unfused parity
+    tests are the tripwire.
     """
     from .pallas_kernels import int8_quantize
 
@@ -450,38 +508,77 @@ def quantized_allreduce(
     chunks = flat.reshape(n, chunk)  # row j is destined for rank j
 
     key = jax.random.fold_in(jax.random.PRNGKey(seed), idx)
-    q, scales = _stochastic_round_rows(chunks, key)
-    # all_to_all = the scatter half of reduce-scatter: afterwards row r
-    # holds the chunk rank r quantized for us, with its scale.
-    recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
-                          tiled=True)
-    recv_scales = lax.all_to_all(
-        scales.reshape(n, 1), axis_name, split_axis=0, concat_axis=0,
-        tiled=True,
-    ).reshape(n)
-    shard = jnp.sum(recv.astype(jnp.float32) * recv_scales[:, None], axis=0)
-    if op == Average:
-        shard = shard / jnp.asarray(n, shard.dtype)
-    # Second stage: per-tensor Pallas quantizer on the reduced shard,
-    # decorrelated from stage one and from other ranks.
-    q2, s2 = int8_quantize(shard, seed=seed * 2 + 1 + idx * 7919)
-    all_q = lax.all_gather(q2, axis_name)    # [n, chunk] int8
-    all_s = lax.all_gather(s2, axis_name)    # [n] f32
-    out = (all_q.astype(jnp.float32) * all_s[:, None]).reshape(-1)[:m]
+    prescale = jnp.asarray(prescale_factor, jnp.float32)
+    if block_size:
+        q, scales = _stochastic_round_blocks(chunks, block_size, key)
+        wire_scales = scales * prescale if prescale_factor != 1.0 else scales
+        # all_to_all = the scatter half of reduce-scatter: afterwards
+        # row r holds the chunk rank r quantized for us, with its scales
+        recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)              # [n, nb, block]
+        recv_scales = lax.all_to_all(
+            wire_scales, axis_name, split_axis=0, concat_axis=0,
+            tiled=True,
+        )                                               # [n, nb]
+        shard = jnp.sum(_block_dequant(recv, recv_scales), axis=0)  # [cpad]
+        if op == Average:
+            shard = shard / jnp.asarray(n, shard.dtype)
+        q2, s2 = _stochastic_round_blocks(
+            shard[None], block_size, jax.random.fold_in(key, 7919)
+        )
+        all_q = lax.all_gather(q2[0], axis_name)   # [n, nb, block]
+        all_s = lax.all_gather(s2[0], axis_name)   # [n, nb]
+        out = _block_dequant(all_q, all_s)[:, :chunk].reshape(-1)[:m]
+        dequant_local = _block_dequant(q, scales)[:, :chunk]
+        e2 = (shard - _block_dequant(q2, s2)[0])[:chunk]
+    else:
+        q, scales = _stochastic_round_rows(chunks, key)
+        wire_scales = (
+            scales * prescale if prescale_factor != 1.0 else scales
+        )
+        # all_to_all = the scatter half of reduce-scatter: afterwards
+        # row r holds the chunk rank r quantized for us, with its scale.
+        recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+        recv_scales = lax.all_to_all(
+            wire_scales.reshape(n, 1), axis_name, split_axis=0,
+            concat_axis=0, tiled=True,
+        ).reshape(n)
+        shard = jnp.sum(
+            recv.astype(jnp.float32) * recv_scales[:, None], axis=0
+        )
+        if op == Average:
+            shard = shard / jnp.asarray(n, shard.dtype)
+        # Second stage: per-tensor Pallas quantizer on the reduced
+        # shard, decorrelated from stage one and from other ranks.
+        q2, s2 = int8_quantize(shard, seed=seed * 2 + 1 + idx * 7919)
+        all_q = lax.all_gather(q2, axis_name)    # [n, chunk] int8
+        all_s = lax.all_gather(s2, axis_name)    # [n] f32
+        out = (all_q.astype(jnp.float32) * all_s[:, None]).reshape(-1)[:m]
+        dequant_local = q.astype(jnp.float32) * scales[:, None]
+        e2 = shard - q2.astype(jnp.float32) * s2
     out = out.reshape(shape).astype(dtype)
     if not return_residual:
         return out
+    if prescale_factor == 0.0:
+        # a zero prescale transmits nothing, so no input correction
+        # could ever surface in the output — the carry is zero (the
+        # two-pass form's behavior: zeroed chunks quantize to zeros),
+        # and dividing e2 by the factor would manufacture NaNs
+        return out, jnp.zeros(shape, dtype)
     # Error-feedback carry, BOTH stages, in input units:
-    # * stage 1: this rank's local quantization error, elementwise;
+    # * stage 1: this rank's local quantization error, elementwise —
+    #   against the UNSCALED scales, since the output responds to an
+    #   input correction through the folded prescale already;
     # * stage 2: the reduced-shard quantization error of the chunk this
     #   rank owns — adding it to our next-step contribution restores it
-    #   in everyone's output (x n under Average, which divides by n).
-    res_flat = (
-        chunks - q.astype(jnp.float32) * scales[:, None]
-    ).reshape(-1)
-    e2 = shard - q2.astype(jnp.float32) * s2
+    #   in everyone's output (x n under Average, which divides by n;
+    #   / prescale, which the input correction will be re-multiplied by).
+    res_flat = (chunks - dequant_local).reshape(-1)
     if op == Average:
         e2 = e2 * jnp.asarray(n, jnp.float32)
+    if prescale_factor != 1.0:
+        e2 = e2 / jnp.asarray(prescale_factor, e2.dtype)
     res_flat = jax.lax.dynamic_update_slice(
         res_flat,
         jax.lax.dynamic_slice(res_flat, (idx * chunk,), (chunk,)) + e2,

@@ -24,6 +24,8 @@ backprop compute (latency hiding on ICI) with no hook machinery. So:
 
 from __future__ import annotations
 
+import itertools
+import warnings
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
@@ -71,18 +73,29 @@ def _allreduce_grads(
             feedback carry ``r`` (EF-SGD), last step's quantization
             error joins this step's wire signal and the new residual is
             returned alongside. One body for both paths so the
-            prescale/postscale handling can't diverge."""
-            if prescale_factor != 1.0:
-                g = g * jnp.asarray(prescale_factor, g.dtype)
+            prescale/postscale handling can't diverge.
+
+            ``prescale_factor`` is handed to the collective, which
+            folds it into the stage-1 wire scales — quantization is
+            scale-invariant, so scaling n floats replaces a full HBM
+            pre-multiply pass over the tensor (parity-tested against
+            the two-pass form in test_fusion_quantized.py). The
+            residual contract is input units: the carry joins the RAW
+            gradient, before any scaling. A compressor that defines
+            ``block_size`` (Compression.int8_block and descendants)
+            gets block-wise wire scales on this path too."""
+            block = getattr(compression, "block_size", None)
             if r is None:
                 out = traced.quantized_allreduce(
-                    g, op=op, axis_name=axis_name, seed=seed
+                    g, op=op, axis_name=axis_name, seed=seed,
+                    prescale_factor=prescale_factor, block_size=block,
                 )
                 new_r = None
             else:
                 out, new_r = traced.quantized_allreduce(
                     g + r.astype(g.dtype), op=op, axis_name=axis_name,
                     seed=seed, return_residual=True,
+                    prescale_factor=prescale_factor, block_size=block,
                 )
                 # carry keeps its init dtype: a flip (e.g. bf16 params,
                 # f32 grads) would change the state pytree mid-scan
@@ -302,16 +315,82 @@ def value_and_grad(
     wrapped function as ``hvd_step=`` (a traced scalar is fine): it seeds
     the stochastic rounding so quantization noise varies across steps and
     stays unbiased over time. ``DistributedOptimizer`` threads its own
-    step automatically; the tape API has no state, so the caller provides
-    it. Other compressors ignore it."""
+    step automatically; the tape API has no state, so when the caller
+    does not provide one an INTERNAL per-wrapper call counter is
+    threaded instead — correct in eager use, but constant-folded if the
+    caller jits the wrapped function, so a warning (once) nudges jit
+    users to thread a real step. Passing the SAME concrete seed twice
+    also warns once: a repeated seed re-applies the identical stochastic
+    rounding pattern every step, turning the unbiased quantizer into a
+    biased one. Other compressors ignore it."""
     op = resolve_op(op, average)
     vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux, **grad_kwargs)
+    auto_step = itertools.count()
+    seen = {"last": None, "warned": False}
+    quantized = getattr(compression, "quantized_wire", False)
 
-    def wrapped(*args, hvd_step=0, **kwargs):
+    def _resolve_seed(args, kwargs, hvd_step):
+        if not quantized:
+            return 0 if hvd_step is None else hvd_step
+        if hvd_step is None:
+            step = next(auto_step)
+            # Tracer detection: a cheap shallow scan on EVERY call —
+            # top-level args plus one level into dict/list/tuple args,
+            # which covers the params-pytree idiom — catches
+            # eager-calls-then-jit (trace at step > 0); a full pytree
+            # flatten runs on the FIRST call only, so deeply nested
+            # leaves are caught at jit-from-the-start without paying
+            # O(n_leaves) per eager step forever.
+            def _shallow(objs):
+                for a in objs:
+                    if isinstance(a, dict):
+                        yield from a.values()
+                    elif isinstance(a, (list, tuple)):
+                        yield from a
+                    else:
+                        yield a
+
+            traced_call = any(
+                isinstance(a, jax.core.Tracer)
+                for a in _shallow(list(args) + list(kwargs.values()))
+            )
+            if not traced_call and step == 0:
+                traced_call = any(
+                    isinstance(leaf, jax.core.Tracer)
+                    for leaf in jax.tree_util.tree_leaves((args, kwargs))
+                )
+            if not seen["warned"] and traced_call:
+                seen["warned"] = True
+                warnings.warn(
+                    "hvd.value_and_grad(compression=int8) is being traced "
+                    "(jit) without hvd_step=; the auto-threaded step "
+                    "counter constant-folds into the compiled program, so "
+                    "every step reuses one stochastic-rounding pattern. "
+                    "Pass your step counter as hvd_step= (a traced scalar "
+                    "is fine).",
+                    stacklevel=3,
+                )
+            return step
+        if isinstance(hvd_step, int):
+            if not seen["warned"] and seen["last"] == hvd_step:
+                seen["warned"] = True
+                warnings.warn(
+                    f"hvd.value_and_grad(compression=int8) received the "
+                    f"same hvd_step={hvd_step} twice: a constant seed "
+                    f"repeats the stochastic-rounding pattern every step "
+                    f"(biased over time). Thread an incrementing step "
+                    f"counter.",
+                    stacklevel=3,
+                )
+            seen["last"] = hvd_step
+        return hvd_step
+
+    def wrapped(*args, hvd_step=None, **kwargs):
+        seed = _resolve_seed(args, kwargs, hvd_step)
         val, grads = vg(*args, **kwargs)
         grads = _allreduce_grads(
             grads, op, compression, 1.0, 1.0, process_set, axis_name,
-            seed=hvd_step,
+            seed=seed,
         )
         return val, grads
 

@@ -1,0 +1,846 @@
+"""Quantized fused wire (ISSUE 2): the fused buffer traverses the
+collective as block-scaled int8 inside ONE compiled executable.
+
+Acceptance surface:
+* one fused quantized batch = one dispatch, served by the executor
+  cache exactly like the fp32 path;
+* wire-byte counter shows ~4x reduction vs the fp32 fused wire;
+* numerical parity with the unfused `traced.quantized_allreduce`
+  within the quantization error budget (process-set and join-mask
+  cases included);
+* bucket-tier pad bytes never leak into block scales or residuals;
+* error-feedback carry stays bounded across a bucket→exact promotion;
+* `HOROVOD_FUSION_WIRE=auto` picks fp32/bf16 for tiny buckets and
+  int8 for large ones;
+* prescale folding (satellite): `quantized_allreduce(prescale_factor=)`
+  is bit-exact vs the two-pass pre-multiply form.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.ops import fusion as fusion_mod
+from horovod_tpu.ops.compression import Compression
+
+WORLD = 8
+
+
+def rank_major(fn, dtype=np.float32):
+    return np.stack([np.asarray(fn(r), dtype=dtype) for r in range(WORLD)])
+
+
+def _fusion():
+    return hvd_mod.common.basics.state().fusion
+
+
+def _freeze_cycle(fusion):
+    fusion.cycle_time_ms = 1e6
+    fusion.threshold_bytes = 1 << 30
+
+
+def _shmap(mesh, fn, n_out=1):
+    out_specs = (
+        P(hvd_mod.WORLD_AXIS)
+        if n_out == 1
+        else tuple(P(hvd_mod.WORLD_AXIS) for _ in range(n_out))
+    )
+    return jax.jit(
+        partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=P(hvd_mod.WORLD_AXIS),
+            out_specs=out_specs,
+            check_vma=False,
+        )(fn)
+    )
+
+
+def _quantum_bound(rows, n=WORLD):
+    """Worst-case error of the two-stage quantized recipe for a batch
+    whose per-rank rows are `rows`: one quantum per rank at stage 1
+    plus one at stage 2, each quantum <= absmax/127 of its source."""
+    q1 = sum(np.abs(np.asarray(r)).max() for r in rows) / 127.0
+    total = np.sum(np.stack([np.asarray(r) for r in rows]), axis=0)
+    q2 = np.abs(total).max() / 127.0
+    return q1 + q2
+
+
+def _batch_bound(tensors):
+    """Quantum bound for a FUSED batch: block boundaries follow the
+    concatenated buffer, not the entries, so an entry's error budget is
+    set by the absmax of whatever shares its blocks — bound it by the
+    per-rank concatenated row."""
+    rows = [
+        np.concatenate([np.asarray(t[r]).ravel() for t in tensors])
+        for r in range(WORLD)
+    ]
+    return _quantum_bound(rows)
+
+
+# ------------------------------------------------ single dispatch + bytes
+
+
+def test_fused_quantized_batch_is_one_cached_dispatch(hvd):
+    """A quantized fused batch compiles to ONE executable, dispatches
+    once per cycle, and repeats hit the exact-tier cache — same
+    contract as the fp32 path (PR 1)."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    sizes = [600, 300, 100]
+
+    def run():
+        handles = [
+            hvd.allreduce_async(
+                rank_major(lambda r, n=n: np.arange(n, dtype=np.float32) + r),
+                op=hvd_mod.Sum,
+                name=f"q{i}",
+                compression=Compression.int8,
+            )
+            for i, n in enumerate(sizes)
+        ]
+        return [np.asarray(h.wait()) for h in handles]
+
+    run()  # warm: compiles the fused quantized executable
+    d0, h0 = fusion.dispatches, fusion.cache_hits
+    outs = run()
+    assert fusion.dispatches == d0 + 1
+    assert fusion.cache_hits == h0 + 1
+    bound = _batch_bound(
+        [
+            rank_major(lambda r, n=n: np.arange(n, dtype=np.float32) + r)
+            for n in sizes
+        ]
+    )
+    for n, out in zip(sizes, outs):
+        exact = 8 * np.arange(n) + 28.0
+        assert np.abs(out[0] - exact).max() <= bound * 1.01
+
+
+def test_wire_byte_counter_shows_4x_reduction(hvd):
+    """For fp32 payloads the int8 wire's saved-bytes counter must show
+    >= 3.5x reduction (4x minus the block-scale overhead)."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    n = 4096  # bucket == useful == 4096, nb = 8/rank-chunk at block 512
+    s0 = fusion.wire_bytes_saved_total
+    b0 = fusion.quant_blocks_total
+    h = hvd.allreduce_async(
+        rank_major(lambda r: np.ones(n, np.float32) * (r + 1)),
+        op=hvd_mod.Sum,
+        compression=Compression.int8,
+    )
+    h.wait()
+    saved = fusion.wire_bytes_saved_total - s0
+    fp32_wire = n * 4 * 8  # bucket elems x itemsize x world rows
+    actual = fp32_wire - saved
+    assert fp32_wire / actual >= 3.5
+    assert fusion.quant_blocks_total > b0
+    assert fusion.last_wire_format == "int8"
+    stats = fusion.cache_stats()
+    for key in ("wire_bytes_saved", "quant_blocks", "wire_format"):
+        assert key in stats, key
+    from horovod_tpu.common.metrics import WIRE_FORMAT_CODES
+
+    assert stats["wire_format"] == WIRE_FORMAT_CODES["int8"]
+
+
+# -------------------------------------------- parity vs unfused recipe
+
+
+def test_parity_fused_vs_unfused_quantized_allreduce(hvd):
+    """The fused quantized batch must land within the same quantization
+    error budget as per-tensor `traced.quantized_allreduce` — both are
+    two-stage stochastic quantizers, so each sits within the two-stage
+    quantum bound of the exact result and within twice that of each
+    other."""
+    from horovod_tpu.ops import traced
+
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    mesh = hvd_mod.mesh()
+    rng = np.random.default_rng(3)
+    sizes = [700, 260]
+    tensors = [
+        rank_major(lambda r, n=n: rng.normal(size=n) * (r + 1))
+        for n in sizes
+    ]
+
+    handles = [
+        hvd.allreduce_async(
+            t, op=hvd_mod.Sum, name=f"p{i}", compression=Compression.int8
+        )
+        for i, t in enumerate(tensors)
+    ]
+    fused = [np.asarray(h.wait()) for h in handles]
+
+    batch_bound = _batch_bound(tensors)
+    for t, out in zip(tensors, fused):
+        unfused = _shmap(
+            mesh,
+            lambda x: traced.quantized_allreduce(x[0], op=hvd_mod.Sum)[None],
+        )(jnp.asarray(t))
+        exact = np.asarray(t).sum(0)
+        bound = _quantum_bound(list(t))
+        assert np.abs(out[0] - exact).max() <= batch_bound * 1.01
+        assert np.abs(np.asarray(unfused)[0] - exact).max() <= bound * 1.01
+        assert (
+            np.abs(out[0] - np.asarray(unfused)[0]).max()
+            <= batch_bound + bound
+        )
+
+
+def test_parity_quantized_with_join_mask_and_process_set(hvd):
+    """Masked participation composes with the quantized wire: joined
+    ranks drop out of the average, non-members of a process set keep
+    their input, and the result stays within the quantum budget of the
+    exact masked reduction."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    n = 600
+
+    with hvd.join_ranks([2]):
+        h = hvd.allreduce_async(
+            rank_major(lambda r: np.full(n, float(r))),
+            op=hvd_mod.Average,
+            compression=Compression.int8,
+        )
+    out = np.asarray(h.wait())
+    true = np.mean([r for r in range(8) if r != 2])
+    quantum = 7.0 / 127.0  # absmax of any contributing row / 127
+    assert np.abs(out[0] - true).max() <= 9 * quantum
+
+    ps = hvd.add_process_set([1, 3, 5])
+    h = hvd.allreduce_async(
+        rank_major(lambda r: np.full(n, float(r))),
+        op=hvd_mod.Average,
+        process_set=ps,
+        compression=Compression.int8,
+    )
+    out = np.asarray(h.wait())
+    assert np.abs(out[1] - 3.0).max() <= 9 * quantum  # member: mean{1,3,5}
+    np.testing.assert_allclose(out[0], 0.0)  # non-member keeps input
+    np.testing.assert_allclose(out[6], 6.0)
+
+
+def test_quantized_wire_rejects_nonfloat_and_nonlinear_ops(hvd):
+    """Min/Max/Product and integer payloads silently ride the fp32 wire
+    (quantization commutes with neither), keeping results exact."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    h = hvd.allreduce_async(
+        rank_major(lambda r: np.arange(1.0, 6.0) + r),
+        op=hvd_mod.Min,
+        compression=Compression.int8,
+    )
+    out = np.asarray(h.wait())
+    np.testing.assert_allclose(out[0], np.arange(1.0, 6.0))
+    assert fusion.last_wire_format == "fp32"
+
+
+# ------------------------------------------------------- pad exclusion
+
+
+def test_bucket_pad_does_not_leak_into_scales_or_residuals(hvd):
+    """On the padded bucket tier, the zero tail must not raise any
+    block scale (the result of the valid region matches the unpadded
+    exact-tier result to the shared quantum budget) and the residual
+    of the pad region must be exactly zero."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    rng = np.random.default_rng(7)
+    base = rank_major(lambda r: rng.normal(size=300) * (r + 1))
+    tail = rank_major(lambda r: rng.normal(size=100))
+
+    # composition A claims the 512-bucket's exact tier (same core key:
+    # int8 wire + residuals); composition B (300+100 elems, same
+    # bucket) then rides the PADDED bucket core.
+    hvd.allreduce_async(
+        rank_major(lambda r: np.ones(500, np.float32)),
+        op=hvd_mod.Sum, name="warm", compression=Compression.int8,
+        return_residual=True,
+    ).wait()
+    b0 = fusion.bucket_hits
+    hs = hvd.grouped_allreduce_async(
+        [base, tail],
+        op=hvd_mod.Sum,
+        compression=Compression.int8,
+        return_residual=True,
+    )
+    (out, res), (_out2, _res2) = [h.wait() for h in hs]
+    assert fusion.bucket_hits == b0 + 1  # padded bucket-tier dispatch
+    assert fusion.last_cycle_pad_bytes > 0
+    exact = np.asarray(base).sum(0)
+    # pad contributes nothing to the bound: a leaked pad scale would
+    # show up as error/residual far beyond this budget
+    bound = _batch_bound([base, tail])
+    assert np.abs(np.asarray(out)[0] - exact).max() <= bound * 1.01
+    # residual = local - wire value: bounded by the per-rank quantum of
+    # the CONCATENATED row (+ the owned shard's), pad excluded
+    total_row = np.concatenate([exact, np.asarray(tail).sum(0)])
+    shard_quantum = np.abs(total_row).max() / 127.0
+    for r in range(8):
+        row = np.concatenate([np.asarray(base[r]), np.asarray(tail[r])])
+        local_quantum = np.abs(row).max() / 127.0
+        assert (
+            np.abs(np.asarray(res)[r]).max()
+            <= (local_quantum + shard_quantum) * 1.01
+        )
+
+
+def test_pad_blocks_quantize_to_exact_zero():
+    """Unit check on the kernel contract the bucket tier relies on:
+    zero pad elements quantize to zero values, contribute a minimal
+    scale, and dequantize to exactly zero."""
+    from horovod_tpu.ops.pallas_kernels import (
+        int8_block_dequantize,
+        int8_block_quantize,
+    )
+
+    x = np.zeros(1024, np.float32)
+    x[:100] = np.linspace(-3, 3, 100)
+    vals, scales = jax.jit(
+        partial(int8_block_quantize, block_size=512)
+    )(jnp.asarray(x))
+    vals, scales = np.asarray(vals), np.asarray(scales)
+    assert vals.shape == (1024,) and scales.shape == (2,)
+    assert np.all(vals[512:] == 0)  # pure-pad block: all-zero values
+    assert scales[1] <= 1e-30 / 127.0 * 1.01  # floor scale, not leaked
+    back = np.asarray(
+        int8_block_dequantize(jnp.asarray(vals), jnp.asarray(scales),
+                              block_size=512)
+    )
+    assert np.all(back[512:] == 0.0)
+    assert np.abs(back[:100] - x[:100]).max() <= 6 / 127.0 * 1.01
+
+
+# ------------------------------------------- error feedback + promotion
+
+
+def test_error_feedback_carry_across_bucket_to_exact_promotion(hvd):
+    """EF keeps the cumulative transmitted signal within a constant
+    number of quanta of the truth, INCLUDING across the dispatch-path
+    change when a composition is promoted from the padded bucket tier
+    to its own exact executable mid-run."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    assert fusion.promote_after == 2
+    g = rank_major(lambda r: np.full(300, 0.01) * (r + 1))
+    exact_step = np.asarray(g).sum(0)
+
+    # claim the bucket with a different composition (same core key:
+    # int8 + residuals) so `g`'s composition starts on the padded
+    # bucket tier
+    hvd.allreduce_async(
+        rank_major(lambda r: np.ones(480, np.float32)),
+        op=hvd_mod.Sum,
+        compression=Compression.int8,
+        return_residual=True,
+    ).wait()
+
+    steps = 6
+    res = np.zeros_like(np.asarray(g))
+    cumulative = np.zeros_like(exact_step)
+    p0 = fusion.promotions
+    for _ in range(steps):
+        h = hvd.allreduce_async(
+            np.asarray(g) + res,
+            op=hvd_mod.Sum,
+            compression=Compression.int8,
+            return_residual=True,
+        )
+        out, new_res = h.wait()
+        cumulative += np.asarray(out)[0]
+        res = np.asarray(new_res)
+    assert fusion.promotions == p0 + 1  # the path DID change mid-run
+    per_step_quantum = _quantum_bound(list(g))
+    err = np.abs(cumulative - steps * exact_step).max()
+    # EF: bounded by ~one step's budget, not steps x budget
+    assert err <= 2 * per_step_quantum + 1e-5
+
+
+def test_residual_reconstructs_wire_value_fused(hvd):
+    """Fused EF contract matches traced.quantized_allreduce's: the
+    residual is bounded by local + shard quanta, per entry."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    rng = np.random.default_rng(0)
+    t = rank_major(lambda r: rng.normal(size=256))
+    h = hvd.allreduce_async(
+        t, op=hvd_mod.Sum, compression=Compression.int8,
+        return_residual=True,
+    )
+    out, res = h.wait()
+    res = np.asarray(res)
+    total = np.asarray(t).sum(0)
+    q2 = np.abs(total).max() / 127.0
+    for r in range(8):
+        q1 = np.abs(np.asarray(t[r])).max() / 127.0
+        assert np.abs(res[r]).max() <= (q1 + q2) * 1.01
+
+
+def test_ef_residual_norm_gauge_when_observability_on(hvd, tmp_path):
+    """fusion.ef_residual_norm lands in the metrics registry when a
+    sink is configured (and only then — it costs a host sync)."""
+    from horovod_tpu.common.metrics import registry
+
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    registry.configure_export(str(tmp_path / "metrics.jsonl"))
+    try:
+        h = hvd.allreduce_async(
+            rank_major(lambda r: np.ones(128) * (r + 1)),
+            op=hvd_mod.Sum,
+            compression=Compression.int8,
+            return_residual=True,
+        )
+        h.wait()
+        snap = registry.snapshot()
+        assert "fusion.ef_residual_norm" in snap
+        assert snap["fusion.ef_residual_norm"] == fusion.ef_residual_norm
+        assert np.isfinite(fusion.ef_residual_norm)
+    finally:
+        registry._path = None  # restore: no sink outside this test
+
+
+def test_return_residual_requires_int8_wire(hvd):
+    with pytest.raises(ValueError, match="int8"):
+        hvd.allreduce_async(
+            rank_major(lambda r: np.ones(8)),
+            op=hvd_mod.Sum,
+            compression=Compression.bf16,
+            return_residual=True,
+        )
+
+
+def test_bad_residual_request_raises_at_enqueue_not_flush(hvd):
+    """An ineligible return_residual request (op/dtype) must fail AT
+    ENQUEUE — a flush-time failure would abort the cycle and strand
+    every other pending entry's handle."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    healthy = hvd.allreduce_async(
+        rank_major(lambda r: np.ones(16)), op=hvd_mod.Sum, name="healthy"
+    )
+    with pytest.raises(ValueError, match="Sum/Average"):
+        hvd.allreduce_async(
+            rank_major(lambda r: np.ones(8)),
+            op=hvd_mod.Min,
+            return_residual=True,
+        )
+    with pytest.raises(ValueError, match="floating"):
+        hvd.allreduce_async(
+            rank_major(lambda r: np.ones(8, np.int32), dtype=np.int32),
+            op=hvd_mod.Sum,
+            return_residual=True,
+        )
+    with pytest.raises(ValueError, match="Sum/Average"):
+        hvd.allreduce_async(
+            rank_major(lambda r: np.ones(8)),
+            op=hvd_mod.Adasum,
+            process_set=hvd.add_process_set([0, 1]),
+            return_residual=True,
+        )
+    # the healthy entry's cycle was never poisoned
+    np.testing.assert_allclose(np.asarray(healthy.wait())[0], 8.0)
+
+
+# ----------------------------------------------------- wire knob + auto
+
+
+def test_bf16_wire_halves_and_int8_quarters_wire_bytes(hvd):
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    n = 2048
+    t = rank_major(lambda r: np.ones(n, np.float32))
+    s0 = fusion.wire_bytes_saved_total
+    hvd.allreduce(t, op=hvd_mod.Sum, compression=Compression.bf16)
+    bf16_saved = fusion.wire_bytes_saved_total - s0
+    assert bf16_saved == n * 2 * 8  # half of fp32's 4 bytes/elem
+    assert fusion.last_wire_format == "bf16"
+
+
+def test_manager_wire_knob_applies_without_per_call_compression(hvd):
+    """HOROVOD_FUSION_WIRE=int8 quantizes plain hvd.allreduce calls."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    fusion.wire = "int8"
+    try:
+        s0 = fusion.wire_bytes_saved_total
+        out = hvd.allreduce(
+            rank_major(lambda r: np.full(1024, float(r + 1))),
+            op=hvd_mod.Sum,
+        )
+        assert fusion.wire_bytes_saved_total > s0
+        assert np.abs(np.asarray(out)[0] - 36.0).max() <= 9 * 8 / 127.0
+    finally:
+        fusion.wire = "fp32"
+
+
+def test_auto_wire_picks_fp32_small_int8_large(hvd):
+    """The WireTuner contract the auto mode rides: tiny buckets never
+    try int8 (static floor); large buckets explore, then exploit the
+    goodput argmax."""
+    from horovod_tpu.common.autotune import WireTuner
+
+    tuner = WireTuner(min_int8_bytes=64 * 1024, trials=2)
+    tiny = ("allreduce", 256, "float32")
+    # tiny bucket: int8 is never even explored
+    for _ in range(10):
+        assert tuner.choose(tiny, payload_bytes=256 * 4) != "int8"
+    big = ("allreduce", 1 << 20, "float32")
+    useful = (1 << 20) * 4 * 8
+    seen = []
+    for _ in range(3 * tuner.trials):
+        w = tuner.choose(big, payload_bytes=(1 << 20) * 4)
+        seen.append(w)
+        # synthetic goodput: int8 moves 4x fewer bytes -> 3x faster
+        tuner.record(big, w, useful, 1.0 if w != "int8" else 1 / 3.0)
+    assert set(seen) == {"fp32", "bf16", "int8"}  # explored everything
+    for _ in range(5):
+        assert tuner.choose(big, payload_bytes=(1 << 20) * 4) == "int8"
+
+
+def test_auto_wire_end_to_end_in_fusion(hvd):
+    """auto mode wired through the manager: tiny batches dispatch on a
+    non-int8 wire, large batches reach int8 once explored, and every
+    dispatch feeds the tuner an observation."""
+    from horovod_tpu.common.autotune import WireTuner
+
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    fusion.wire = "auto"
+    fusion.wire_tuner = WireTuner(min_int8_bytes=16 * 1024, trials=1)
+    try:
+        hvd.allreduce(
+            rank_major(lambda r: np.ones(64, np.float32)), op=hvd_mod.Sum
+        )
+        assert fusion.last_wire_format != "int8"  # under the floor
+        seen = set()
+        # compile-time dispatches are excluded from tuner observations,
+        # so each format takes up to 2 calls (compile, then record) to
+        # finish its single trial
+        for _ in range(8):
+            hvd.allreduce(
+                rank_major(lambda r: np.ones(8192, np.float32)),
+                op=hvd_mod.Sum,
+            )
+            seen.add(fusion.last_wire_format)
+        assert "int8" in seen  # explored within 2 x trials x candidates
+        key = ("allreduce", 8192, "float32")
+        assert any(
+            fusion.wire_tuner._obs.get((key, w), [0, 0, 0])[2] > 0
+            for w in ("fp32", "bf16", "int8")
+        )
+    finally:
+        fusion.wire = "fp32"
+        fusion.wire_tuner = None
+
+
+# ------------------------------------------------ hierarchical placement
+
+
+def test_hierarchical_int8_wire_with_synthetic_stages(hvd):
+    """bf16-intra + int8-inter placement on a synthetic 4-host x
+    2-chip split of the 8-device test mesh: result within the (now
+    host-count-sized) quantum budget."""
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    fusion._hier_stages = lambda: fusion_mod.hierarchical_stage_groups(8, 2)
+    h = hvd.allreduce_async(
+        rank_major(lambda r: np.full(600, float(r))),
+        op=hvd_mod.Average,
+        compression=Compression.hier_int8,
+    )
+    out = np.asarray(h.wait())
+    assert np.abs(out[0] - 3.5).max() <= 0.5  # coarse: two int8 stages
+    np.testing.assert_allclose(out[0], out[5])  # all ranks agree
+
+
+def test_hier_degenerates_to_flat_int8_on_single_host(hvd):
+    fusion = _fusion()
+    _freeze_cycle(fusion)
+    # default topology: one host -> hierarchical_stage_groups is None
+    h = hvd.allreduce_async(
+        rank_major(lambda r: np.full(600, float(r))),
+        op=hvd_mod.Sum,
+        compression=Compression.hier_int8,
+    )
+    out = np.asarray(h.wait())
+    assert np.abs(out[0] - 28.0).max() <= 9 * 7 / 127.0
+    assert fusion.last_wire_format == "int8"
+
+
+# -------------------------------------------------- satellite: prescale
+
+
+def test_prescale_folds_into_wire_scales_bit_exact(hvd):
+    """quantized_allreduce(prescale_factor=c) vs quantized_allreduce of
+    c*x: quantization is scale-invariant for c > 0, so the folded form
+    (which skips a full HBM pass) must be BIT-exact, residual included
+    (in input units: two-pass residual / c)."""
+    from horovod_tpu.ops import traced
+
+    mesh = hvd_mod.mesh()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 130)).astype(np.float32))
+    c = 0.125
+
+    two_pass = _shmap(
+        mesh,
+        lambda t: traced.quantized_allreduce(
+            t[0] * c, op=hvd_mod.Sum, seed=5, return_residual=True
+        ),
+        n_out=2,
+    )
+    folded = _shmap(
+        mesh,
+        lambda t: traced.quantized_allreduce(
+            t[0], op=hvd_mod.Sum, seed=5, return_residual=True,
+            prescale_factor=c,
+        ),
+        n_out=2,
+    )
+    out_a, res_a = two_pass(x)
+    out_b, res_b = folded(x)
+    assert np.array_equal(np.asarray(out_a), np.asarray(out_b))
+    # two-pass residual is in PRESCALED units; folded is input units
+    np.testing.assert_allclose(
+        np.asarray(res_a) / c, np.asarray(res_b), rtol=1e-5, atol=1e-7
+    )
+
+
+def test_quantized_allreduce_block_size_traced_path(hvd):
+    """block_size= on the traced recipe (the Compression.int8_block
+    optimizer path): mixed-magnitude rows stay within their own
+    block's quantum instead of the chunk absmax, and the residual
+    contract holds."""
+    from horovod_tpu.ops import traced
+
+    mesh = hvd_mod.mesh()
+    # one huge block next to tiny ones: per-chunk scaling would cost
+    # the tiny region quanta of ~1000/127; block scaling must not
+    n = 1024
+    row = np.ones(n, np.float32) * 0.01
+    row[:256] = 1000.0
+    x = jnp.asarray(np.stack([row] * 8))
+
+    out, res = _shmap(
+        mesh,
+        lambda t: tuple(
+            a[None]
+            for a in traced.quantized_allreduce(
+                t[0], op=hvd_mod.Sum, seed=3, return_residual=True,
+                block_size=128,
+            )
+        ),
+        n_out=2,
+    )(x)
+    out, res = np.asarray(out)[0], np.asarray(res)
+    exact = row * 8
+    # the tiny region's error budget is its own blocks' quanta
+    # (0.08/127 per stage x (8+1) contributions), nowhere near the
+    # ~63 quanta a shared chunk scale would allow
+    assert np.abs(out[256:] - exact[256:]).max() <= 9 * 0.08 / 127 + 1e-5
+    assert np.abs(out[:256] - exact[:256]).max() <= 9 * 8000 / 127
+    for r in range(8):
+        assert np.abs(res[r][-128:]).max() <= 2 * 0.08 / 127 + 1e-6
+
+
+def test_prescale_zero_residual_is_zero_not_nan(hvd):
+    from horovod_tpu.ops import traced
+
+    mesh = hvd_mod.mesh()
+    x = jnp.asarray(np.ones((8, 130), np.float32))
+    out, res = _shmap(
+        mesh,
+        lambda t: traced.quantized_allreduce(
+            t[0], op=hvd_mod.Sum, return_residual=True,
+            prescale_factor=0.0,
+        ),
+        n_out=2,
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+    np.testing.assert_allclose(np.asarray(res), 0.0)  # not NaN
+
+
+def test_optimizer_int8_block_uses_block_scales(hvd):
+    """Compression.int8_block through DistributedOptimizer: the tiny
+    region of a mixed-magnitude gradient survives (per-chunk scaling
+    would flush 0.01-sized entries quantized against a 1000 absmax)."""
+    import optax
+
+    mesh = hvd_mod.mesh()
+    opt = hvd_mod.DistributedOptimizer(
+        optax.sgd(1.0), compression=Compression.int8_block,
+        op=hvd_mod.Average,
+    )
+    g_row = np.ones(1024, np.float32) * 0.01
+    g_row[:512] = 500.0
+    g = jnp.asarray(np.stack([g_row] * 8))
+    params = {"w": jnp.zeros(1024, jnp.float32)}
+    state = opt.init(params)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(hvd_mod.WORLD_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def step(p, s, grads):
+        u, _ = opt.update({"w": grads[0]}, s, p)
+        return u["w"]
+
+    upd = np.asarray(jax.jit(step)(params, state, g))
+    # tail blocks (all 0.01): error within their own block quanta
+    assert np.abs(upd[512:] + 0.01).max() <= 9 * 0.01 / 127 + 1e-6
+
+
+def test_optimizer_predivide_uses_folded_prescale(hvd):
+    """DistributedOptimizer(gradient_predivide_factor=) on the int8
+    wire still averages correctly with the folded prescale."""
+    import optax
+
+    mesh = hvd_mod.mesh()
+    opt = hvd_mod.DistributedOptimizer(
+        optax.sgd(1.0),
+        compression=Compression.int8,
+        op=hvd_mod.Average,
+        gradient_predivide_factor=2.0,
+    )
+    g = jnp.asarray(
+        np.stack([np.full(64, float(r + 1), np.float32) for r in range(8)])
+    )
+    params = {"w": jnp.zeros(64, jnp.float32)}
+    state = opt.init(params)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(hvd_mod.WORLD_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def step(p, s, grads):
+        updates, _ = opt.update({"w": grads[0]}, s, p)
+        return updates["w"]
+
+    upd = np.asarray(jax.jit(step)(params, state, g))
+    # average of 1..8 = 4.5; sgd(1.0) update = -reduced
+    assert np.abs(upd + 4.5).max() <= 2 * 8 / 127.0 + 1e-3
+
+
+# ------------------------------------------- satellite: seed threading
+
+
+@pytest.mark.filterwarnings("ignore:hvd.value_and_grad")
+def test_value_and_grad_auto_threads_step_counter(hvd):
+    """Two eager calls without hvd_step= must produce DIFFERENT
+    stochastic-rounding patterns (the internal counter advanced).
+    shard_map re-traces per call, so the auto counter genuinely
+    advances here (the tracer warning is the jit heads-up; ignored)."""
+    mesh = hvd_mod.mesh()
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(8, 257)).astype(np.float32))
+
+    vg = hvd_mod.value_and_grad(
+        lambda t: jnp.sum(t * t) / 2, compression=Compression.int8,
+        op=hvd_mod.Sum,
+    )
+
+    def run():
+        @partial(
+            jax.shard_map, mesh=mesh, in_specs=P(hvd_mod.WORLD_AXIS),
+            out_specs=P(hvd_mod.WORLD_AXIS), check_vma=False,
+        )
+        def body(t):
+            _, g = vg(t[0])
+            return g[None]
+
+        return np.asarray(body(x))
+
+    a, b = run(), run()
+    assert not np.array_equal(a, b)  # different rounding pattern
+
+
+def test_value_and_grad_warns_once_on_constant_seed(hvd):
+    import warnings
+
+    mesh = hvd_mod.mesh()
+    x = jnp.asarray(np.ones((8, 130), np.float32))
+    vg = hvd_mod.value_and_grad(
+        lambda t: jnp.sum(t * t), compression=Compression.int8,
+        op=hvd_mod.Sum,
+    )
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=P(hvd_mod.WORLD_AXIS),
+        out_specs=P(hvd_mod.WORLD_AXIS), check_vma=False,
+    )
+    def body(t):
+        _, g = vg(t[0], hvd_step=7)
+        return g[None]
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        body(x)
+        body(x)  # same constant seed again -> warn
+        body(x)  # warned already -> silent
+    msgs = [str(x.message) for x in w if "hvd_step" in str(x.message)]
+    assert len(msgs) == 1
+
+
+def test_value_and_grad_warns_under_jit_without_step(hvd):
+    import warnings
+
+    mesh = hvd_mod.mesh()
+    x = jnp.asarray(np.ones((8, 130), np.float32))
+    vg = hvd_mod.value_and_grad(
+        lambda t: jnp.sum(t * t), compression=Compression.int8,
+        op=hvd_mod.Sum,
+    )
+
+    @jax.jit
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=P(hvd_mod.WORLD_AXIS),
+        out_specs=P(hvd_mod.WORLD_AXIS), check_vma=False,
+    )
+    def body(t):
+        _, g = vg(t[0])  # traced, no hvd_step: pattern would freeze
+        return g[None]
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        body(x)
+    assert any("constant-folds" in str(x.message) for x in w)
+
+
+def test_value_and_grad_warns_under_jit_with_pytree_args(hvd):
+    """Tracers hiding inside dict args (the params-pytree case) must
+    still trigger the frozen-seed warning."""
+    import warnings
+
+    mesh = hvd_mod.mesh()
+    x = jnp.asarray(np.ones((8, 130), np.float32))
+    vg = hvd_mod.value_and_grad(
+        lambda d: jnp.sum(d["t"] * d["t"]), compression=Compression.int8,
+        op=hvd_mod.Sum,
+    )
+
+    @jax.jit
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=P(hvd_mod.WORLD_AXIS),
+        out_specs=P(hvd_mod.WORLD_AXIS), check_vma=False,
+    )
+    def body(t):
+        _, g = vg({"t": t[0]})  # tracer is a dict LEAF, not an arg
+        return g["t"][None]
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        body(x)
+    assert any("constant-folds" in str(x.message) for x in w)
