@@ -1,0 +1,244 @@
+"""Backward-interleaved gradient exchange A/B (ops/overlap.py).
+
+Measures whether bucketing the gradient exchange — N independent
+collectives at their backward dataflow frontiers instead of one
+terminal exchange — buys wall-clock on a real backend, the measured
+form of the reference's autograd-hook overlap claim (arXiv 1802.05799
+§3; the pre-registered exposed-time model is in docs/perf.md
+§"Backward-interleaved gradient exchange").
+
+Three legs over the SAME deep-MLP training step (many equal layers, so
+backward compute exists to hide wire time behind), each appending one
+JSON artifact under BENCH_ARTIFACT_DIR (default bench_results/overlap/):
+
+* ``ab_monolithic``   — hvd.value_and_grad, post-hoc exchange (the
+  barrier baseline: every collective waits for the full grad tree).
+* ``ab_bucketed``     — hvd.value_and_grad(overlap_buckets=N): the
+  in-backprop bucketed exchange via the overlap_boundary custom_vjp.
+* ``ab_bucketed_rs``  — ShardedDistributedOptimizer(overlap_buckets=N):
+  bucketed reduce-scatter feeding the ZeRO-1 shard update, bucketed
+  all-gather of the updates.
+
+Each artifact records ms/step plus the compiled-program evidence the
+wall clock alone can't carry on CPU: the count of independent
+collective ops in the lowered step (all_reduce / reduce_scatter /
+all_gather) and the schedule's bucket byte split. BENCH_DRYRUN=1 is
+the CI smoke shape (tiny model, 2 iters; `./ci.sh bench-smoke` gates
+on the artifacts existing). CPU lines carry the quarantine note —
+overlap is a scheduler property, so only the on-chip capture decides
+the wall-clock claim; the dryrun validates harness + HLO shape.
+
+Env: BENCH_LAYERS / BENCH_WIDTH / BENCH_BUCKETS / BENCH_ITERS.
+"""
+
+import json
+import os
+import time
+from functools import partial
+
+_SIM_NOTE = (
+    "logic-validation only (CPU simulation); overlap is an XLA "
+    "scheduler property — NOT a TPU wall-clock number"
+)
+
+
+def _collective_counts(lowered_text: str) -> dict:
+    return {
+        "all_reduce": lowered_text.count('"stablehlo.all_reduce"'),
+        "reduce_scatter": lowered_text.count(
+            '"stablehlo.reduce_scatter"'
+        ),
+        "all_gather": lowered_text.count('"stablehlo.all_gather"'),
+    }
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from _benchlib import sync as _sync
+    from horovod_tpu.ops import overlap
+
+    dryrun = os.environ.get("BENCH_DRYRUN", "").strip() in ("1", "true")
+    iters = int(os.environ.get("BENCH_ITERS", "2" if dryrun else "30"))
+    layers = int(os.environ.get("BENCH_LAYERS", "4" if dryrun else "24"))
+    width = int(os.environ.get("BENCH_WIDTH", "32" if dryrun else "1024"))
+    n_buckets = int(os.environ.get("BENCH_BUCKETS", "4"))
+    batch = 8 if dryrun else 64
+
+    artifact_dir = os.environ.get(
+        "BENCH_ARTIFACT_DIR", os.path.join("bench_results", "overlap")
+    )
+    os.makedirs(artifact_dir, exist_ok=True)
+
+    hvd.init()
+    mesh = hvd.mesh()
+    world = hvd.size()
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    # host arrays: every leg builds its own device copies, so the
+    # donated carries can never consume a buffer another leg reuses
+    # (the bench_fusion.py discipline)
+    params_host = {
+        f"w{i:02d}": (
+            rng.normal(size=(width, width)) / np.sqrt(width)
+        ).astype(np.float32)
+        for i in range(layers)
+    }
+    x = jnp.asarray(
+        rng.normal(size=(world, batch, width)), jnp.float32
+    )
+    y = jnp.asarray(rng.normal(size=(world, batch, width)), jnp.float32)
+    grad_bytes = sum(
+        int(np.prod(p.shape)) * 4 for p in params_host.values()
+    )
+
+    def fresh_params():
+        return {k: jnp.asarray(v) for k, v in params_host.items()}
+
+    def loss_fn(p, xb, yb):
+        h = xb
+        for k in sorted(p):
+            h = jnp.tanh(h @ p[k])
+        return jnp.mean((h - yb) ** 2)
+
+    def emit(leg, ms, counts, extra=None):
+        line = {
+            "metric": "overlap_ab",
+            "leg": leg,
+            "world": world,
+            "layers": layers,
+            "width": width,
+            "grad_bytes": grad_bytes,
+            "n_buckets": n_buckets,
+            "value": round(ms, 3),
+            "unit": "ms/step",
+            "platform": platform,
+            "collectives": counts,
+        }
+        if extra:
+            line.update(extra)
+        if platform != "tpu":
+            line["note"] = _SIM_NOTE
+        print(json.dumps(line), flush=True)
+        with open(
+            os.path.join(artifact_dir, f"overlap_{leg}.json"), "a"
+        ) as f:
+            f.write(json.dumps(line) + "\n")
+
+    def timed(step, carry):
+        carry = step(carry)  # compile + warm
+        _sync(carry)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            carry = step(carry)
+        _sync(carry)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    # ---- legs 1+2: tape exchange, monolithic vs in-backprop bucketed
+    def make_tape_step(buckets):
+        vg = hvd.value_and_grad(
+            loss_fn, op=hvd.Average, overlap_buckets=buckets,
+            overlap_min_bytes=0,
+        )
+        opt = optax.sgd(1e-3)
+
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=((P(), P()), P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        def step(carry, xb, yb):
+            p, ost = carry
+            _, g = vg(p, xb[0], yb[0])
+            u, ost = opt.update(g, ost, p)
+            return optax.apply_updates(p, u), ost
+
+        return jax.jit(step, donate_argnums=0), opt
+
+    leg_ms = {}
+    for leg, buckets in (
+        ("ab_monolithic", 0),
+        ("ab_bucketed", n_buckets),
+    ):
+        step, opt = make_tape_step(buckets)
+        p0 = fresh_params()
+        carry = (p0, optax.sgd(1e-3).init(p0))
+        counts = _collective_counts(
+            step.lower(carry, x, y).as_text()
+        )
+        ms = timed(lambda c: step(c, x, y), carry)
+        leg_ms[max(buckets, 1)] = ms
+        emit(leg, ms, counts)
+
+    # the OverlapTuner consumes exactly these whole-step observations:
+    # feed it the two tape legs and report its verdict (the harness IS
+    # the tuner's driver — a bucket count is a compile-time property,
+    # so candidates are separate jitted steps)
+    from horovod_tpu.common.autotune import OverlapTuner
+
+    tuner = OverlapTuner(
+        min_bucket_bytes=0, trials=1, candidates=(1, n_buckets)
+    )
+    for n, ms in leg_ms.items():
+        tuner.record("bench", n, grad_bytes, ms / 1e3)
+    choice = tuner.choose("bench", grad_bytes)
+    print(
+        json.dumps(
+            {
+                "metric": "overlap_tuner",
+                "candidates": sorted(leg_ms),
+                "choice": choice,
+                "goodputs": {
+                    str(n): round(tuner.goodput("bench", n), 1)
+                    for n in leg_ms
+                },
+            }
+        ),
+        flush=True,
+    )
+
+    # ---- leg 3: bucketed reduce-scatter into the ZeRO-1 shard update
+    sopt = hvd.ShardedDistributedOptimizer(
+        optax.sgd(1e-3), overlap_buckets=n_buckets, overlap_min_bytes=0
+    )
+    p0 = fresh_params()
+    sstate = sopt.init(p0)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(
+            (P(), sopt.state_spec()),
+            P(hvd.WORLD_AXIS),
+            P(hvd.WORLD_AXIS),
+        ),
+        out_specs=(P(), sopt.state_spec()),
+        check_vma=False,
+    )
+    def zstep(carry, xb, yb):
+        p, st = carry
+        g = jax.grad(loss_fn)(p, xb[0], yb[0])
+        u, st = sopt.update(g, st, p)
+        return optax.apply_updates(p, u), st
+
+    zstep = jax.jit(zstep, donate_argnums=0)
+    carry = (p0, sstate)
+    counts = _collective_counts(zstep.lower(carry, x, y).as_text())
+    ms = timed(lambda c: zstep(c, x, y), carry)
+    emit(
+        "ab_bucketed_rs", ms, counts,
+        extra={"schedule_cache": overlap.schedule_cache_stats()},
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,14 @@ bench_smoke() {
     python bench_int8.py
   test -s "$art_dir/int8_ab_fused.json" \
     || { echo "missing artifact: int8_ab_fused.json" >&2; exit 1; }
+  step "bench-smoke: bench_overlap.py dryrun (bucketed-exchange A/B)"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
+    python bench_overlap.py
+  for leg in ab_monolithic ab_bucketed ab_bucketed_rs; do
+    test -s "$art_dir/overlap_${leg}.json" \
+      || { echo "missing artifact: overlap_${leg}.json" >&2; exit 1; }
+  done
   echo "bench-smoke artifacts OK: $art_dir"
 }
 

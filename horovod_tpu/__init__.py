@@ -122,6 +122,12 @@ from .sharded_optimizer import (  # noqa: F401
 )
 from . import ops  # noqa: F401
 from .ops import traced  # noqa: F401
+from .ops import overlap  # noqa: F401
+from .ops.overlap import (  # noqa: F401
+    bucketed_allreduce,
+    build_bucket_schedule,
+    overlap_boundary,
+)
 from .ops.fused_xent import fused_linear_cross_entropy  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.run / State, ref [V])
 from . import callbacks  # noqa: F401  (Keras-callback parity, ref [V])

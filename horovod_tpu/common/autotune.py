@@ -245,17 +245,64 @@ class ParameterManager:
             )
 
 
-class WireTuner:
+class _GoodputBandit:
+    """Shared explore-then-exploit core of the discrete tuners: per
+    (key, candidate) goodput accounting (useful bytes per second),
+    ``trials`` exploration visits round-robin, then argmax. A bandit,
+    not a GP: these decisions are small discrete menus, where the GP's
+    machinery buys nothing (it remains the right tool for the
+    continuous (threshold, cycle) box above)."""
+
+    def __init__(self, trials: int = 3):
+        self.trials = max(int(trials), 1)
+        # (key, candidate) -> [useful_bytes_total, seconds_total, n]
+        self._obs = {}
+
+    def _stats(self, key, cand):
+        return self._obs.setdefault((key, cand), [0.0, 0.0, 0])
+
+    def needs_trial(self, key, cand) -> bool:
+        """True while this (key, candidate) is still under-explored."""
+        return self._obs.get((key, cand), (0, 0, 0))[2] < self.trials
+
+    def record(self, key, cand, useful_bytes: int, seconds: float) -> None:
+        s = self._stats(key, cand)
+        s[0] += float(useful_bytes)
+        s[1] += float(seconds)
+        s[2] += 1
+
+    def goodput(self, key, cand) -> float:
+        s = self._obs.get((key, cand))
+        if not s or s[2] == 0:
+            return 0.0
+        return s[0] / max(s[1], 1e-9)
+
+    def _choose_among(self, key, cands):
+        """Single-candidate shortcut (marked fully trialed so callers
+        never pay trial synchronization for a decision with one
+        possible answer), else explore round-robin, else exploit the
+        goodput argmax."""
+        if len(cands) == 1:
+            s = self._stats(key, cands[0])
+            s[2] = max(s[2], self.trials)
+            return cands[0]
+        for c in cands:
+            if self.needs_trial(key, c):
+                return c
+        return max(cands, key=lambda c: self.goodput(key, c))
+
+
+class WireTuner(_GoodputBandit):
     """Per-bucket-tier online choice of the fused wire format
     (``HOROVOD_FUSION_WIRE=auto``) by goodput — useful bytes per second
     of dispatch wall time, so the measurement naturally charges each
     format its own quant tax and credits it for the wire bytes it
-    removes.
+    removes. The fusion manager BLOCKS on the dispatch result for
+    exactly the ``needs_trial`` observations — async dispatch wall time
+    is format-independent and would teach the tuner nothing — and stops
+    recording once the trials are in (explore-then-freeze).
 
-    A bandit, not a GP: the decision is a small discrete choice per
-    bucket tier (the fused-buffer geometry the executor cache is keyed
-    on), so the mechanism is explore-each-candidate-``trials``-times
-    then exploit the argmax. Two static priors bound the exploration:
+    Two static priors bound the exploration:
 
     * buckets under ``min_int8_bytes`` never try int8 — the per-dispatch
       quantize tax is O(payload)+fixed while the wire saving is
@@ -268,35 +315,8 @@ class WireTuner:
     CANDIDATES = ("fp32", "bf16", "int8")
 
     def __init__(self, min_int8_bytes: int = 64 * 1024, trials: int = 3):
+        super().__init__(trials=trials)
         self.min_int8_bytes = int(min_int8_bytes)
-        self.trials = max(int(trials), 1)
-        # (bucket_key, wire) -> [useful_bytes_total, seconds_total, n]
-        self._obs = {}
-
-    def _stats(self, bucket_key, wire):
-        return self._obs.setdefault((bucket_key, wire), [0.0, 0.0, 0])
-
-    def needs_trial(self, bucket_key, wire: str) -> bool:
-        """True while this (bucket, wire) is still under-explored.
-        The fusion manager BLOCKS on the dispatch result for exactly
-        these observations — async dispatch wall time is
-        format-independent and would teach the tuner nothing — and
-        stops recording once the trials are in (explore-then-freeze)."""
-        return self._obs.get((bucket_key, wire), (0, 0, 0))[2] < self.trials
-
-    def record(
-        self, bucket_key, wire: str, useful_bytes: int, seconds: float
-    ) -> None:
-        s = self._stats(bucket_key, wire)
-        s[0] += float(useful_bytes)
-        s[1] += float(seconds)
-        s[2] += 1
-
-    def goodput(self, bucket_key, wire: str) -> float:
-        s = self._obs.get((bucket_key, wire))
-        if not s or s[2] == 0:
-            return 0.0
-        return s[0] / max(s[1], 1e-9)
 
     def choose(
         self, bucket_key, payload_bytes: int, candidates=None,
@@ -316,14 +336,58 @@ class WireTuner:
             cands = [c for c in cands if c != "bf16"]
         if not cands:
             return "fp32"
-        if len(cands) == 1:
-            # nothing to compare: mark the sole candidate fully trialed
-            # so the dispatcher never pays trial synchronization for a
-            # decision with one possible answer
-            s = self._stats(bucket_key, cands[0])
-            s[2] = max(s[2], self.trials)
-            return cands[0]
-        for c in cands:
-            if self.needs_trial(bucket_key, c):
-                return c
-        return max(cands, key=lambda c: self.goodput(bucket_key, c))
+        return self._choose_among(bucket_key, cands)
+
+
+class OverlapTuner(_GoodputBandit):
+    """Choice of the backward-interleaved exchange's bucket count
+    (``ops/overlap.py``) by WHOLE-STEP goodput — useful gradient bytes
+    per second of step wall time. The bucket schedule trades two
+    opposing costs the byte model cannot rank a priori: more buckets
+    expose more backward compute to hide wire time behind (win), but
+    each bucket pays a collective launch + a smaller message's worse
+    bandwidth utilization (loss). Scoring the STEP, not the collective,
+    lets the measurement settle it — the same reasoning that moved the
+    ParameterManager's score to goodput.
+
+    Driven by the STEP HARNESS, not from inside the compiled step: a
+    bucket-count change changes the compiled program, so each candidate
+    is its own jitted step — the training loop (or bench:
+    ``bench_overlap.py`` runs exactly this loop) times a few chained,
+    honestly-synced steps per candidate, feeds ``record``, and rebuilds
+    its step with ``choose``'s answer once exploration drains. The
+    caller owns the timing discipline (docs/perf.md §measurement
+    integrity) or the tuner learns dispatch overhead, not overlap.
+
+    ``min_bucket_bytes`` is the static prior bounding the explore set:
+    a candidate whose per-bucket size would fall under the floor can
+    only lose (launch overhead is O(1) per bucket while the hidden
+    wire time is O(bucket bytes)), so it is never tried — the
+    ``HOROVOD_OVERLAP_MIN_BYTES`` knob, autotuned-path edition.
+    """
+
+    CANDIDATES = (1, 2, 4, 8, 16)
+
+    def __init__(
+        self,
+        min_bucket_bytes: int = 1 << 20,
+        trials: int = 3,
+        candidates=None,
+    ):
+        super().__init__(trials=trials)
+        self.min_bucket_bytes = int(min_bucket_bytes)
+        self.candidates = tuple(
+            candidates if candidates is not None else self.CANDIDATES
+        )
+
+    def viable(self, total_bytes: int):
+        """Candidates whose balanced bucket size clears the byte floor
+        (1 always qualifies — the monolithic schedule is the control)."""
+        return tuple(
+            c
+            for c in self.candidates
+            if c == 1 or total_bytes // c >= self.min_bucket_bytes
+        )
+
+    def choose(self, step_key, total_bytes: int) -> int:
+        return self._choose_among(step_key, self.viable(total_bytes))

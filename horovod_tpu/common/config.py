@@ -111,6 +111,23 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
 
+    # --- backward-interleaved gradient exchange (ops/overlap.py) ---
+    # master switch: when on, DistributedOptimizer / value_and_grad /
+    # ShardedDistributedOptimizer default to the bucketed exchange
+    # (N independent per-bucket collectives XLA overlaps with backprop)
+    # unless the caller passes overlap_buckets= explicitly
+    overlap: bool = False
+    # bucket count of the default schedule (explicit overlap_buckets=
+    # always wins). For a measured choice, the step harness can sweep
+    # candidates through common/autotune.py's OverlapTuner — a bucket
+    # count is a compile-time property of the step, so tuning happens
+    # across recompiles at the loop level (bench_overlap.py shows the
+    # pattern), never inside one compiled step
+    overlap_buckets: int = 4
+    # buckets below this byte size merge forward: per-collective launch
+    # overhead outweighs any overlap win under the floor
+    overlap_min_bytes: int = 1 << 20
+
     # --- autotune ---
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -191,6 +208,11 @@ class Config:
             ),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            overlap=_env_bool("HOROVOD_OVERLAP"),
+            overlap_buckets=_env_int("HOROVOD_OVERLAP_BUCKETS", 4),
+            overlap_min_bytes=_env_int(
+                "HOROVOD_OVERLAP_MIN_BYTES", 1 << 20
+            ),
             autotune=_env_bool("HOROVOD_AUTOTUNE"),
             autotune_log=env.get("HOROVOD_AUTOTUNE_LOG"),
             autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),

@@ -114,3 +114,31 @@ class MetricsRegistry:
 
 
 registry = MetricsRegistry()
+
+
+def publish_overlap(
+    n_buckets: int,
+    bucket_bytes,
+    total_bytes: Optional[int] = None,
+) -> None:
+    """Publish the bucketed-gradient-exchange schedule shape
+    (``overlap.*`` gauges — ops/overlap.py). One call per schedule
+    build/lookup; values are static host-side ints, so this costs no
+    device sync. The exposed/hidden collective-time estimate rides the
+    same prefix but is produced by the traced timeline
+    (``traced_timeline.collective_overlap_stats``), which owns the
+    device spans it is computed from."""
+    bucket_bytes = list(bucket_bytes)
+    registry.update(
+        "overlap",
+        {
+            "buckets": n_buckets,
+            "bucket_bytes_total": (
+                total_bytes
+                if total_bytes is not None
+                else sum(bucket_bytes)
+            ),
+            "bucket_bytes_max": max(bucket_bytes, default=0),
+            "bucket_bytes_min": min(bucket_bytes, default=0),
+        },
+    )

@@ -107,6 +107,39 @@ class Timeline:
                 }
             )
 
+    def now_us(self) -> float:
+        """Current trace-relative timestamp — for callers that measure
+        a span themselves and stamp it via :meth:`span`."""
+        return self._now_us()
+
+    def span(
+        self, tensor_name: str, phase: str, start_us: float, dur_us: float
+    ) -> None:
+        """Complete ('X') event with EXPLICIT timestamps. Used for the
+        device-completion stamp on fused flushes (ops/fusion.py): the
+        dispatch-side begin/end pairs record when the eager runtime
+        QUEUED and launched the collective — the phase it owns — while
+        this span carries the dispatch→`block_until_ready` delta, i.e.
+        when the device actually finished. The traced path gets the
+        same truth from the profiler (traced_timeline); this closes the
+        eager half of SURVEY §7's device-completion checklist row.
+        Caveat carried from docs/perf.md: on the sandbox's remote PJRT
+        tunnel `block_until_ready` is advisory, so on that backend the
+        span bounds dispatch, not device time — on real local backends
+        it is the honest device-completion delta."""
+        if not self._active:
+            return
+        with self._lock:
+            self._emit(
+                {
+                    "name": phase,
+                    "ph": "X",
+                    "pid": self._pid(tensor_name),
+                    "ts": float(start_us),
+                    "dur": float(dur_us),
+                }
+            )
+
     def end(self, tensor_name: str, phase: str) -> None:
         if not self._active:
             return

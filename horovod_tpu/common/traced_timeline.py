@@ -136,9 +136,45 @@ class TracedTimeline:
         # synthetic pid one stride past the last host's remapped range
         # (host pids are assumed < stride, as the remap above already
         # requires) so it can never collide with a real process
-        events.extend(
-            _collective_spans(events, max(len(files), 1) * pid_stride)
-        )
+        synth_pid = max(len(files), 1) * pid_stride
+        # exposed-vs-hidden collective time: the overlap ledger the
+        # bucketed gradient exchange (ops/overlap.py) is tuned against.
+        # Computed on the REAL device events only — the synthetic twin
+        # track below would double-count every span.
+        stats = collective_overlap_stats(events)
+        events.extend(_collective_spans(events, synth_pid))
+        if stats["spans"]:
+            from . import metrics as _metrics
+
+            _metrics.registry.update(
+                "overlap",
+                {
+                    "collective_ms": stats["collective_us"] / 1e3,
+                    "exposed_collective_ms": stats["exposed_us"] / 1e3,
+                    "hidden_collective_ms": stats["hidden_us"] / 1e3,
+                },
+            )
+            last_ts = max(
+                (
+                    ev.get("ts", 0) + ev.get("dur", 0)
+                    for ev in events
+                    if ev.get("ph") == "X"
+                ),
+                default=0,
+            )
+            for name, val in (
+                ("hvd.exposed_collective_ms", stats["exposed_us"] / 1e3),
+                ("hvd.hidden_collective_ms", stats["hidden_us"] / 1e3),
+            ):
+                events.append(
+                    {
+                        "ph": "C",
+                        "pid": synth_pid,
+                        "name": name,
+                        "ts": last_ts,
+                        "args": {"ms": round(val, 3)},
+                    }
+                )
         tmp = f"{self._path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"traceEvents": events}, f)
@@ -165,6 +201,111 @@ _COLLECTIVE_PHASES = (
 )
 
 
+def _classify_collective(name: str):
+    """The shared event classifier: phase string for a collective
+    device event, None for compute (or skipped async-start/end-marker
+    halves, returned as the sentinel ``"skip"``)."""
+    low = name.lower()
+    if low.startswith("end:"):
+        return "skip"
+    if "-start" in low:
+        return "skip"
+    for needle, ph in _COLLECTIVE_PHASES:
+        if needle in low:
+            return ph
+    return None
+
+
+def _interval_overlap(span, intervals):
+    """Microseconds of ``span=(t0, t1)`` covered by the UNION of the
+    sorted, merged ``intervals``."""
+    t0, t1 = span
+    covered = 0.0
+    for a, b in intervals:
+        if b <= t0:
+            continue
+        if a >= t1:
+            break
+        covered += min(b, t1) - max(a, t0)
+    return covered
+
+
+def _merge_intervals(intervals):
+    out = []
+    for a, b in sorted(intervals):
+        if out and a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return [(a, b) for a, b in out]
+
+
+def collective_overlap_stats(events):
+    """Exposed-vs-hidden collective device time, distilled from the
+    profiler's device spans — the measurement the bucketed gradient
+    exchange (ops/overlap.py) exists to move: a MONOLITHIC exchange
+    shows its whole collective time exposed (nothing left to run
+    against it); a bucketed schedule hides bucket k's wire time behind
+    buckets k+1..N-1's remaining backward compute.
+
+    Per device pid, a collective span's HIDDEN time is the part of its
+    duration during which some compute (non-collective) device event on
+    the same pid is also running — concurrency across the pid's rows
+    (tids) is exactly how XLA's async collectives appear in the trace;
+    the rest is EXPOSED (the step was waiting on the wire). Returns
+    totals in microseconds plus the span count. Cost-model caveat: a
+    compute op that itself waits on the collective's result cannot
+    overlap in reality, so this is an upper bound on hiding — but the
+    MONOLITHIC-vs-bucketed DELTA is honest, since both sides carry the
+    same bias.
+
+    CONTAINER rows are excluded from the compute side: the profiler
+    exports step/module/scope annotation rows ("Steps", "XLA Modules",
+    "Framework Name Scope", ...) as sibling tids of the SAME device
+    pid, and a whole-step container span would blanket every
+    collective as "hidden" regardless of schedule. Rows are identified
+    by their ``thread_name`` metadata; rows without metadata (unit
+    traces, thunk exports) are kept."""
+    _container = ("step", "module", "scope", "framework", "source")
+    skip_rows = set()
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            tname = str(ev.get("args", {}).get("name", "")).lower()
+            if any(n in tname for n in _container):
+                skip_rows.add((ev.get("pid", 0), ev.get("tid", 0)))
+    per_pid: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X" or not ev.get("dur"):
+            continue
+        phase = _classify_collective(str(ev.get("name", "")))
+        if phase == "skip":
+            continue
+        pid = ev.get("pid", 0)
+        if phase is None and (pid, ev.get("tid", 0)) in skip_rows:
+            continue
+        coll, comp = per_pid.setdefault(pid, ([], []))
+        t0 = float(ev.get("ts", 0))
+        span = (t0, t0 + float(ev["dur"]))
+        (coll if phase is not None else comp).append(span)
+    total = hidden = 0.0
+    spans = 0
+    for coll, comp in per_pid.values():
+        if not coll:
+            continue
+        merged = _merge_intervals(comp)
+        for span in coll:
+            dur = span[1] - span[0]
+            total += dur
+            hidden += _interval_overlap(span, merged)
+            spans += 1
+    return {
+        "collective_us": total,
+        "hidden_us": hidden,
+        "exposed_us": total - hidden,
+        "spans": spans,
+    }
+
+
 def _collective_spans(events, pid):
     """Per-collective DEVICE spans distilled from the profiler events —
     the traced-path analog of the eager timeline's per-op phase ranges
@@ -184,17 +325,11 @@ def _collective_spans(events, pid):
         if ev.get("ph") != "X":
             continue
         name = str(ev.get("name", ""))
-        low = name.lower()
-        if low.startswith("end:"):
-            continue  # CPU thunk end-markers duplicate the span
-        if "-start" in low:
-            continue  # async pair: keep only the completion half
-        phase = None
-        for needle, ph in _COLLECTIVE_PHASES:
-            if needle in low:
-                phase = ph
-                break
-        if phase is None:
+        # one classifier for this track AND the exposed/hidden ledger
+        # (collective_overlap_stats) — they must never disagree about
+        # what counts as a collective
+        phase = _classify_collective(name)
+        if phase is None or phase == "skip":
             continue
         row = ev.get("pid", 0)
         rows.setdefault(row, 0)

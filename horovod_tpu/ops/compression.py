@@ -141,9 +141,34 @@ class Int8BlockCompressor(Int8Compressor):
     instead of one per tensor, so mixed-magnitude regions (a fused
     buffer, a tensor with outlier rows) never share a dynamic range —
     the wire format the fused quantized path (ops/fusion.py) uses
-    internally, exposed for manual compress/decompress use."""
+    internally, exposed for manual compress/decompress use.
+
+    ``block_size`` is also the granularity contract the BUCKETED
+    exchange honors (ops/overlap.py): a bucket buffer concatenating
+    several gradients is quantized with these block-wise scales, so
+    bucketing never merges two tensors' dynamic ranges — the per-bucket
+    edition of the fused wire's pad/outlier isolation."""
 
     block_size = 512
+
+    @classmethod
+    def with_block_size(cls, block_size: int) -> type:
+        """A variant of this compressor with a custom scale granularity
+        — e.g. a finer block for an outlier-heavy bucket, a coarser one
+        to shave scale overhead on a smooth one. The returned class is
+        a full Compressor (same quantized_wire routing), so it slots
+        into ``DistributedOptimizer(compression=...)`` / the bucketed
+        exchange / the eager fused path unchanged."""
+        block_size = int(block_size)
+        if block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {block_size}"
+            )
+        return type(
+            f"{cls.__name__}_b{block_size}",
+            (cls,),
+            {"block_size": block_size},
+        )
 
     @classmethod
     def compress(cls, tensor, seed=0):

@@ -1013,6 +1013,35 @@ class FusionManager:
         # lifetime)
         self._anchor_ttl = max(self._anchor_ttl - 1, 0)
         self._prev_outs = outs if self._anchor_ttl > 0 else None
+        if self.timeline is not None and self.timeline.active:
+            # device-completion stamp (SURVEY §7 checklist, eager half):
+            # one block_until_ready per flush while someone is WATCHING
+            # — the dispatch→completion delta is the device-side span
+            # the dispatch-lifecycle begin/end pairs cannot see. The
+            # sync is an observability cost the timeline explicitly
+            # opts into (same gate as the EF-norm metrics); `active`
+            # matters: after stop_timeline() the Timeline object stays
+            # attached, and paying a sync per flush for spans the
+            # writer would drop would serialize dispatch forever. The
+            # span anchors at dispatch time ONLY when this flush
+            # compiled nothing — on a cache-miss flush the executor
+            # build/JIT ran after t_disp, and back-dating would report
+            # host compile seconds as device collective time (the same
+            # poisoning the WireTuner guards its goodput against), so
+            # those spans anchor post-dispatch and measure the
+            # remaining completion wait only.
+            if self.cache_misses == misses_before:
+                t0_us = self.timeline.now_us() - (
+                    time.monotonic() - t_disp
+                ) * 1e6
+            else:
+                t0_us = self.timeline.now_us()
+            jax.block_until_ready(outs)
+            dur_us = self.timeline.now_us() - t0_us
+            for e in batch:
+                self.timeline.span(
+                    e.name, f"{phase}_DEVICE", t0_us, dur_us
+                )
         resids = None
         if spec.want_res:
             outs, resids = outs

@@ -1,0 +1,483 @@
+"""Backward-interleaved gradient exchange: bucketed in-backprop
+collectives.
+
+The reference hides communication by firing per-tensor allreduces from
+autograd hooks *during* backprop (ref: horovod/torch/optimizer.py
+`_DistributedOptimizer` hook machinery [V], Sergeev & Del Balso,
+arXiv 1802.05799 §3). Under XLA the equivalent lever is dataflow, not
+hooks: the compiler overlaps a collective with remaining backward
+compute exactly when the collective's operands do not depend on that
+compute. A single exchange over the whole gradient tree (or one fused
+buffer concatenating it) is data-dependent on the LAST gradient
+produced, so there is structurally nothing to overlap — the exchange
+becomes a terminal barrier after backprop.
+
+This module re-creates the hook-style overlap inside one jitted step:
+
+* :func:`build_bucket_schedule` partitions the gradient pytree into
+  size-balanced, dtype-homogeneous buckets ordered by REVERSE flatten
+  order — the DDP heuristic for backprop production order (the last
+  layers' gradients materialize first, so their bucket's collective
+  can launch while earlier layers are still differentiating).
+* :func:`bucketed_allreduce` emits ONE independent collective per
+  bucket (concat members → collective → split), so the compiled HLO
+  contains N collectives whose operands are disjoint slices of the
+  gradient tree — each launches at its own dataflow frontier, and the
+  XLA scheduler runs bucket k's wire time against bucket k-1..0's
+  remaining backward compute. Composes with everything the fused wire
+  stack built: per-bucket wire format (``Compression.*`` including
+  block-scaled int8 with per-bucket stochastic-rounding seeds),
+  error-feedback residuals sliced per bucket, the prescale fold,
+  process sets, and join masks.
+* :func:`overlap_boundary` is the `jax.custom_vjp` marker: identity on
+  the forward, bucketed exchange on the cotangents in the backward —
+  so ``value_and_grad(..., overlap_buckets=N)`` returns gradients that
+  were ALREADY reduced inside backprop, the reference's hook semantics
+  with the compiler doing the scheduling (pattern ref: Xu et al.,
+  arXiv 2004.13336 — per-shard decomposition is how XLA-era stacks
+  recover the overlap).
+
+Why bit-exactness holds for ``op=Sum`` fp32: `psum` over a
+concatenation is elementwise identical to per-leaf `psum` (same
+cross-replica addition order per element), so bucketing changes the
+schedule, never the sum. Quantized wires change block geometry with
+bucket geometry; parity there is within the two-stage quantum bound
+(tests/test_overlap.py asserts both).
+
+Schedules are cached per (treedef, leaf shapes/dtypes, knobs) with
+hit/miss counters — the compile-churn tripwire: a training loop that
+rebuilds its schedule (or retraces its step) every iteration shows up
+as cache misses, not silence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.topology import WORLD_AXIS
+from ..common.process_sets import ProcessSet
+from ..ops.reduction_ops import Average, Sum, resolve_op
+from . import traced
+from .compression import Compression, Compressor
+
+
+class BucketSchedule(NamedTuple):
+    """A static partition of the gradient tree's leaves into buckets.
+
+    ``buckets`` holds leaf indices (into the flattened tree) per
+    bucket, in EMISSION order — bucket 0's members are produced first
+    in backprop (reverse flatten order), so its collective launches
+    first. ``passthrough`` are leaves excluded from the exchange
+    (float0 cotangents of non-differentiable leaves)."""
+
+    buckets: Tuple[Tuple[int, ...], ...]
+    bucket_bytes: Tuple[int, ...]
+    total_bytes: int
+    passthrough: Tuple[int, ...] = ()
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def _leaf_key(leaf) -> Tuple:
+    return (tuple(np.shape(leaf)), str(jnp.result_type(leaf)))
+
+
+def _is_float0(leaf) -> bool:
+    return jnp.result_type(leaf) == jax.dtypes.float0
+
+
+# -- schedule cache ----------------------------------------------------
+# One schedule per (structure, geometry, knobs): rebuilt schedules are
+# the symptom of retrace churn, so the cache is instrumented. Bounded
+# LRU-ish (dict insertion order) so a pathological caller can't grow it.
+
+_CACHE: dict = {}
+_CACHE_CAP = 256
+_STATS = {"hits": 0, "misses": 0}
+
+
+def schedule_cache_stats() -> dict:
+    return dict(_STATS, size=len(_CACHE))
+
+
+def reset_schedule_cache() -> None:
+    _CACHE.clear()
+    _STATS["hits"] = 0
+    _STATS["misses"] = 0
+
+
+def build_bucket_schedule(
+    leaves: Sequence[Any],
+    n_buckets: int,
+    min_bucket_bytes: int = 0,
+) -> BucketSchedule:
+    """Partition ``leaves`` into at most ``n_buckets`` size-balanced
+    buckets in reverse flatten order (DDP-style backprop production
+    order). Buckets are dtype-homogeneous — a concat buffer carries one
+    dtype, so a dtype flip forces a bucket boundary (like DDP's
+    per-dtype buckets). Buckets smaller than ``min_bucket_bytes`` are
+    merged forward where the dtype allows: below the floor the
+    per-collective launch overhead outweighs any overlap win (the
+    ``HOROVOD_OVERLAP_MIN_BYTES`` knob)."""
+    if n_buckets < 1:
+        raise ValueError(f"n_buckets must be >= 1, got {n_buckets}")
+    passthrough = tuple(
+        i for i, l in enumerate(leaves) if _is_float0(l)
+    )
+    order = [
+        i for i in reversed(range(len(leaves))) if i not in passthrough
+    ]
+    if not order:
+        return BucketSchedule((), (), 0, passthrough)
+    nbytes = {
+        i: int(np.prod(np.shape(leaves[i]), dtype=np.int64))
+        * jnp.result_type(leaves[i]).itemsize
+        for i in order
+    }
+    total = sum(nbytes.values())
+    # balanced linear partition: close bucket k before adding a leaf
+    # whose MIDPOINT crosses the k-th ideal boundary (k+1)·total/N —
+    # the closest-boundary rule, so a large leaf lands on whichever
+    # side of the boundary most of it lies
+    target = total / n_buckets
+    buckets, cur = [], []
+    cum, cur_bytes, closed = 0, 0, 0
+    cur_dtype = None
+    for i in order:
+        d = jnp.result_type(leaves[i])
+        if cur and (
+            cur_dtype != d
+            or (
+                closed < n_buckets - 1
+                and cum + nbytes[i] / 2 >= (closed + 1) * target
+            )
+        ):
+            buckets.append((tuple(cur), cur_bytes))
+            closed += 1
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes[i]
+        cum += nbytes[i]
+        cur_dtype = d
+    if cur:
+        buckets.append((tuple(cur), cur_bytes))
+    if min_bucket_bytes > 0:
+        # forward pass: a bucket still under the floor absorbs the
+        # next same-dtype bucket (once it clears the floor it stops —
+        # no cascade past the target)
+        merged = []
+        for idxs, b in buckets:
+            if (
+                merged
+                and merged[-1][1] < min_bucket_bytes
+                and jnp.result_type(leaves[merged[-1][0][0]])
+                == jnp.result_type(leaves[idxs[0]])
+            ):
+                pi, pb = merged[-1]
+                merged[-1] = (pi + idxs, pb + b)
+            else:
+                merged.append((idxs, b))
+        # an under-floor TAIL bucket merges backward
+        if (
+            len(merged) > 1
+            and merged[-1][1] < min_bucket_bytes
+            and jnp.result_type(leaves[merged[-2][0][0]])
+            == jnp.result_type(leaves[merged[-1][0][0]])
+        ):
+            pi, pb = merged[-2]
+            ti, tb = merged[-1]
+            merged[-2:] = [(pi + ti, pb + tb)]
+        buckets = merged
+    return BucketSchedule(
+        buckets=tuple(i for i, _ in buckets),
+        bucket_bytes=tuple(b for _, b in buckets),
+        total_bytes=total,
+        passthrough=passthrough,
+    )
+
+
+def schedule_for(
+    leaves: Sequence[Any],
+    treedef,
+    n_buckets: int,
+    min_bucket_bytes: int = 0,
+) -> BucketSchedule:
+    """Cached :func:`build_bucket_schedule` keyed on tree structure +
+    leaf geometry + knobs."""
+    key = (
+        str(treedef),
+        tuple(_leaf_key(l) for l in leaves),
+        int(n_buckets),
+        int(min_bucket_bytes),
+    )
+    sched = _CACHE.get(key)
+    if sched is not None:
+        _STATS["hits"] += 1
+        return sched
+    _STATS["misses"] += 1
+    sched = build_bucket_schedule(leaves, n_buckets, min_bucket_bytes)
+    if len(_CACHE) >= _CACHE_CAP:
+        _CACHE.pop(next(iter(_CACHE)))
+    _CACHE[key] = sched
+    return sched
+
+
+def default_buckets() -> int:
+    """The config-driven default bucket count: ``HOROVOD_OVERLAP_BUCKETS``
+    when ``HOROVOD_OVERLAP`` is enabled, else 0 (monolithic path).
+    Reads the initialized runtime's config snapshot when there is one."""
+    from ..common import basics
+    from ..common.config import Config
+
+    cfg = (
+        basics._state.config
+        if basics.is_initialized() and basics._state.config is not None
+        else Config.from_env()
+    )
+    return cfg.overlap_buckets if cfg.overlap else 0
+
+
+def default_min_bytes() -> int:
+    from ..common import basics
+    from ..common.config import Config
+
+    cfg = (
+        basics._state.config
+        if basics.is_initialized() and basics._state.config is not None
+        else Config.from_env()
+    )
+    return cfg.overlap_min_bytes
+
+
+def _publish(schedule: BucketSchedule) -> None:
+    from ..common import metrics
+
+    metrics.publish_overlap(
+        schedule.n_buckets, schedule.bucket_bytes, schedule.total_bytes
+    )
+
+
+def bucketed_allreduce(
+    grads,
+    op=None,
+    average: Optional[bool] = None,
+    n_buckets: Optional[int] = None,
+    compression: Compressor = Compression.none,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: str = WORLD_AXIS,
+    seed=0,
+    residuals=None,
+    mask=None,
+    min_bucket_bytes: Optional[int] = None,
+    schedule: Optional[BucketSchedule] = None,
+):
+    """Allreduce a gradient pytree as N independent per-bucket
+    collectives (module docstring).
+
+    Each bucket: concat its members' flattened leaves → ONE collective
+    → split back. For the fp32/bf16 wires the collective is
+    :func:`traced.allreduce` (process sets, join ``mask``, pre/post
+    scale all compose); for a quantized-wire compression
+    (``Compression.int8`` / ``int8_block`` / descendants) it is
+    :func:`traced.quantized_allreduce` over the bucket buffer — block
+    scales at the compressor's granularity, the prescale fold, and a
+    per-bucket-decorrelated stochastic-rounding seed, exactly the PR-2
+    monolithic recipe applied per bucket.
+
+    ``residuals`` (error-feedback carry, quantized wires only): each
+    bucket's carry joins its wire signal and the new per-bucket
+    residual is sliced back to the member leaves; returns
+    ``(reduced, new_residuals)``.
+
+    ``mask`` is a [world] bool participation vector (the traced join
+    mask): masked-out ranks contribute the identity and ``Average``
+    divides by the live count. Sum/Average only — bucketing relies on
+    reduction elementwise-ness over the concat (Adasum's whole-tensor
+    dot products do not commute with concatenation; use the monolithic
+    path for it).
+    """
+    op = resolve_op(op, average)
+    if op not in (Sum, Average):
+        raise ValueError(
+            "bucketed_allreduce supports op=Sum/Average only (Adasum "
+            "and min/max/product do not commute with bucket concat); "
+            "use the monolithic path for other ops"
+        )
+    if n_buckets is None:
+        n_buckets = default_buckets() or 1
+    if min_bucket_bytes is None:
+        # same config deferral as n_buckets: the public surface and the
+        # optimizer wrappers must build the SAME schedule for the same
+        # tree (HOROVOD_OVERLAP_MIN_BYTES; pass 0 to disable merging)
+        min_bucket_bytes = default_min_bytes()
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if schedule is None:
+        schedule = schedule_for(
+            leaves, treedef, n_buckets, min_bucket_bytes
+        )
+    _publish(schedule)
+
+    quantized = getattr(compression, "quantized_wire", False)
+    if quantized:
+        if process_set is not None and process_set.process_set_id != 0:
+            raise NotImplementedError(
+                "quantized-wire bucketed exchange over a process set is "
+                "not supported (same restriction as the monolithic "
+                "path); use fp32/bf16 compression or the global set"
+            )
+        if mask is not None:
+            raise NotImplementedError(
+                "join mask over the quantized bucketed wire is not "
+                "supported; use fp32/bf16 compression under join"
+            )
+    elif residuals is not None:
+        raise ValueError(
+            "error_feedback requires a quantized-wire compression "
+            "(Compression.int8); lossless/fp16 wires have no residual"
+        )
+
+    r_leaves = (
+        treedef.flatten_up_to(residuals) if residuals is not None else None
+    )
+    out_leaves: list = [None] * len(leaves)
+    res_leaves: list = [None] * len(leaves)
+    for i in schedule.passthrough:
+        out_leaves[i] = leaves[i]
+        if r_leaves is not None:
+            res_leaves[i] = r_leaves[i]
+
+    block = getattr(compression, "block_size", None)
+    for b, idxs in enumerate(schedule.buckets):
+        members = [leaves[i] for i in idxs]
+        sizes = [int(np.prod(np.shape(m), dtype=np.int64)) for m in members]
+        flat = (
+            members[0].reshape(-1)
+            if len(members) == 1
+            else jnp.concatenate([m.reshape(-1) for m in members])
+        )
+        if quantized:
+            # decorrelate rounding across buckets AND steps: stride the
+            # caller's step seed by the bucket count (unique per
+            # (step, bucket), monotone in the step like the monolithic
+            # path's per-step seed)
+            bseed = seed * schedule.n_buckets + b
+            if r_leaves is not None:
+                parts = [
+                    r_leaves[i].reshape(-1).astype(flat.dtype)
+                    for i in idxs
+                ]
+                r_flat = (
+                    parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                )
+                out_flat, new_r = traced.quantized_allreduce(
+                    flat + r_flat, op=op, axis_name=axis_name,
+                    seed=bseed, return_residual=True,
+                    prescale_factor=prescale_factor, block_size=block,
+                )
+            else:
+                out_flat = traced.quantized_allreduce(
+                    flat, op=op, axis_name=axis_name, seed=bseed,
+                    prescale_factor=prescale_factor, block_size=block,
+                )
+                new_r = None
+            if postscale_factor != 1.0:
+                out_flat = out_flat * jnp.asarray(
+                    postscale_factor, out_flat.dtype
+                )
+        else:
+            wire, ctx = compression.compress(flat)
+            red = traced.allreduce(
+                wire,
+                op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set,
+                axis_name=axis_name,
+                mask=mask,
+            )
+            out_flat = compression.decompress(red, ctx)
+            new_r = None
+        off = 0
+        for i, sz in zip(idxs, sizes):
+            out_leaves[i] = out_flat[off : off + sz].reshape(
+                np.shape(leaves[i])
+            )
+            if r_leaves is not None:
+                # carry keeps its init dtype (see optimizer.one_q)
+                res_leaves[i] = (
+                    new_r[off : off + sz]
+                    .reshape(np.shape(leaves[i]))
+                    .astype(r_leaves[i].dtype)
+                )
+            off += sz
+    reduced = jax.tree_util.tree_unflatten(treedef, out_leaves)
+    if residuals is None:
+        return reduced
+    return reduced, jax.tree_util.tree_unflatten(treedef, res_leaves)
+
+
+def overlap_boundary(
+    tree,
+    op=Average,
+    average: Optional[bool] = None,
+    n_buckets: Optional[int] = None,
+    compression: Compressor = Compression.none,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: str = WORLD_AXIS,
+    seed=0,
+    mask=None,
+    min_bucket_bytes: Optional[int] = None,
+):
+    """The in-backprop boundary marker: identity on the forward; on the
+    backward, the cotangent pytree leaves through
+    :func:`bucketed_allreduce`.
+
+    Pass the model parameters through this before using them::
+
+        def loss(params, batch):
+            params = hvd.overlap_boundary(params, overlap_buckets=4)
+            ...
+
+    ``jax.grad`` of such a loss returns gradients that were ALREADY
+    reduced during backprop — each bucket's collective sits in the
+    backward dataflow at the point its last member gradient
+    materializes, which is the reference's autograd-hook overlap
+    expressed as compiler-visible dataflow. The custom_vjp body is
+    inlined at trace time, so XLA sees N independent collectives, not
+    an opaque call."""
+    kw = dict(
+        op=op,
+        average=average,
+        n_buckets=n_buckets,
+        compression=compression,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        process_set=process_set,
+        axis_name=axis_name,
+        seed=seed,
+        mask=mask,
+        min_bucket_bytes=min_bucket_bytes,
+    )
+
+    @jax.custom_vjp
+    def _boundary(t):
+        return t
+
+    def _fwd(t):
+        return t, None
+
+    def _bwd(_, ct):
+        return (bucketed_allreduce(ct, **kw),)
+
+    _boundary.defvjp(_fwd, _bwd)
+    return _boundary(tree)

@@ -96,6 +96,7 @@ def allreduce(
     postscale_factor: float = 1.0,
     process_set: Optional[ProcessSet] = None,
     axis_name: str = WORLD_AXIS,
+    mask=None,
 ):
     """Allreduce across the mesh axis (ref: hvd.allreduce,
     horovod/torch/mpi_ops.py + MPI/NCCL Allreduce ops [V]).
@@ -108,8 +109,22 @@ def allreduce(
     With a process set, members reduce among themselves (masked
     full-axis collective — see module docstring) and non-members return
     their input unchanged.
+
+    ``mask`` is the traced join mask (ref: hvd.join / JoinOp [V] —
+    the eager layer's `join_ranks` semantics inside a jitted step): a
+    [world] bool vector, static numpy or traced, where ``mask[r] ==
+    False`` means rank r ran out of data. Masked-out ranks contribute
+    the reduction identity, ``Average`` divides by the LIVE count (a
+    traced scalar — the mask may change step to step without a
+    retrace), and every participating rank receives the live
+    reduction. Sum/Average only (a dynamic live-count has no analog
+    for min/max/product); composes with a process set by intersection.
     """
     op = resolve_op(op, average)
+    if mask is not None and op not in (Average, Sum):
+        raise ValueError(
+            "allreduce(mask=) supports op=Sum/Average only"
+        )
     info = _set_info(process_set, axis_name)
     n = info.size if info is not None else lax.axis_size(axis_name)
     raw = tensor
@@ -140,15 +155,31 @@ def allreduce(
     member = None
     if info is not None:
         member, _ = _member(info, axis_name)
+    live = None
+    if mask is not None:
+        live = jnp.asarray(mask)[lax.axis_index(axis_name)]
     if op in (Average, Sum):
+        gate = member
+        if live is not None:
+            gate = live if gate is None else jnp.logical_and(gate, live)
         contrib = (
             tensor
-            if member is None
-            else jnp.where(member, tensor, jnp.zeros_like(tensor))
+            if gate is None
+            else jnp.where(gate, tensor, jnp.zeros_like(tensor))
         )
         out = lax.psum(contrib, axis_name)
         if op == Average:
-            out = out / jnp.asarray(n, dtype=out.dtype)
+            if live is None:
+                out = out / jnp.asarray(n, dtype=out.dtype)
+            else:
+                # live count is traced: the join mask may differ step
+                # to step without forcing a retrace
+                n_live = lax.psum(
+                    jnp.where(gate, 1.0, 0.0).astype(out.dtype), axis_name
+                )
+                out = out / jnp.maximum(
+                    n_live, jnp.ones((), out.dtype)
+                )
     elif op == Min:
         contrib = (
             tensor

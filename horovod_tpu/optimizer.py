@@ -34,9 +34,9 @@ import optax
 
 from .common.process_sets import ProcessSet
 from .common.topology import WORLD_AXIS
-from .ops import traced
+from .ops import overlap, traced
 from .ops.compression import Compression, Compressor
-from .ops.reduction_ops import Adasum, Average, ReduceOp, resolve_op
+from .ops.reduction_ops import Adasum, Average, ReduceOp, Sum, resolve_op
 
 
 def _allreduce_grads(
@@ -165,6 +165,8 @@ def DistributedOptimizer(
     axis_name: str = WORLD_AXIS,
     average_aggregated_gradients: bool = False,
     error_feedback: bool = False,
+    overlap_buckets: Optional[int] = None,
+    overlap_min_bytes: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transform with distributed gradient reduction
     (ref: hvd.DistributedOptimizer [V]).
@@ -180,6 +182,23 @@ def DistributedOptimizer(
     quantization error into the next step's wire signal — EF-SGD, so
     the int8 wire's cumulative error stays bounded by a constant number
     of quanta instead of growing with the step count.
+
+    ``overlap_buckets=N`` routes the exchange through the bucketed
+    layer (``ops/overlap.py``): the gradient tree is partitioned into N
+    size-balanced buckets in reverse production order and each bucket
+    gets its OWN collective, so the compiled step carries N independent
+    collectives XLA can schedule against remaining backward compute
+    instead of one terminal exchange — the reference's autograd-hook
+    overlap, recovered as compiler-visible dataflow. Bit-exact with the
+    monolithic path for op=Sum fp32; within the per-bucket quantum
+    bound for quantized wires (EF residuals, the prescale fold and
+    block granularity are applied per bucket). ``None`` defers to
+    ``HOROVOD_OVERLAP``/``HOROVOD_OVERLAP_BUCKETS``; 0 forces the
+    monolithic path. Sum/Average only (Adasum's whole-tensor combine
+    does not commute with bucket concat). For overlap of the exchange
+    with the backward itself, prefer ``hvd.value_and_grad(...,
+    overlap_buckets=N)`` — this wrapper only sees gradients after
+    autodiff, so its buckets overlap each other and the update math.
     """
     op = resolve_op(op, average)
     if gradient_predivide_factor != 1.0 and op != Average:
@@ -191,6 +210,22 @@ def DistributedOptimizer(
             "error_feedback=True requires a quantized-wire compression "
             "(Compression.int8)"
         )
+    explicit_overlap = overlap_buckets is not None
+    if overlap_buckets is None:
+        overlap_buckets = overlap.default_buckets()
+    overlap_buckets = int(overlap_buckets)
+    if overlap_min_bytes is None:
+        overlap_min_bytes = overlap.default_min_bytes()
+    if overlap_buckets and op not in (Sum, Average):
+        if explicit_overlap:
+            raise ValueError(
+                "overlap_buckets requires op=Sum/Average (Adasum/min/"
+                "max/product do not commute with bucket concatenation)"
+            )
+        # HOROVOD_OVERLAP is a fleet-wide default: a job running an op
+        # the bucketed layer can't carry keeps its monolithic path
+        # instead of breaking
+        overlap_buckets = 0
     k = int(backward_passes_per_step)
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
@@ -210,6 +245,14 @@ def DistributedOptimizer(
             else jax.lax.axis_size(axis_name)
         )
         eff_op, pre, post = reduce_op_factors(n)
+        if overlap_buckets:
+            return overlap.bucketed_allreduce(
+                grads, op=eff_op, n_buckets=overlap_buckets,
+                compression=compression, prescale_factor=pre,
+                postscale_factor=post, process_set=process_set,
+                axis_name=axis_name, seed=seed, residuals=residuals,
+                min_bucket_bytes=overlap_min_bytes,
+            )
         return _allreduce_grads(
             grads, eff_op, compression, pre, post, process_set, axis_name,
             seed=seed, residuals=residuals,
@@ -305,11 +348,24 @@ def value_and_grad(
     compression: Compressor = Compression.none,
     process_set: Optional[ProcessSet] = None,
     axis_name: str = WORLD_AXIS,
+    overlap_buckets: Optional[int] = None,
+    overlap_min_bytes: Optional[int] = None,
     **grad_kwargs,
 ):
     """jax.value_and_grad + gradient allreduce: the DistributedGradientTape
     equivalent (ref: horovod/tensorflow/__init__.py
     DistributedGradientTape._allreduce_grads [V], SURVEY.md §3.5).
+
+    ``overlap_buckets=N`` is the in-backprop path: the differentiated
+    argument passes through :func:`hvd.overlap_boundary` before use, so
+    its cotangents leave through N independent per-bucket collectives
+    DURING backprop — the returned gradients are already reduced, and
+    the compiled step's collectives sit at their buckets' dataflow
+    frontiers where XLA overlaps them with the remaining backward
+    compute (the reference's autograd-hook latency hiding,
+    arXiv 1802.05799 §3, as static dataflow). ``None`` defers to
+    ``HOROVOD_OVERLAP``/``HOROVOD_OVERLAP_BUCKETS``; requires a single
+    int ``argnums`` and op=Sum/Average.
 
     With ``compression=Compression.int8``, pass your step counter to the
     wrapped function as ``hvd_step=`` (a traced scalar is fine): it seeds
@@ -324,6 +380,28 @@ def value_and_grad(
     rounding pattern every step, turning the unbiased quantizer into a
     biased one. Other compressors ignore it."""
     op = resolve_op(op, average)
+    explicit_overlap = overlap_buckets is not None
+    if overlap_buckets is None:
+        overlap_buckets = overlap.default_buckets()
+    overlap_buckets = int(overlap_buckets)
+    if overlap_min_bytes is None:
+        overlap_min_bytes = overlap.default_min_bytes()
+    if overlap_buckets and (
+        op not in (Sum, Average) or not isinstance(argnums, int)
+    ):
+        if explicit_overlap:
+            if not isinstance(argnums, int):
+                raise ValueError(
+                    "overlap_buckets requires a single int argnums "
+                    "(the boundary wraps one argument's pytree)"
+                )
+            raise ValueError(
+                "overlap_buckets requires op=Sum/Average (Adasum/min/"
+                "max/product do not commute with bucket concatenation)"
+            )
+        # env-default overlap: unsupported shapes keep the monolithic
+        # path instead of breaking (same rationale as the optimizer)
+        overlap_buckets = 0
     vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux, **grad_kwargs)
     auto_step = itertools.count()
     seen = {"last": None, "warned": False}
@@ -387,6 +465,24 @@ def value_and_grad(
 
     def wrapped(*args, hvd_step=None, **kwargs):
         seed = _resolve_seed(args, kwargs, hvd_step)
+        if overlap_buckets:
+            # in-backprop exchange: grads come back ALREADY reduced —
+            # the boundary's custom_vjp emitted the per-bucket
+            # collectives inside the backward pass
+            def fun2(*a, **k):
+                a = list(a)
+                a[argnums] = overlap.overlap_boundary(
+                    a[argnums], op=op, n_buckets=overlap_buckets,
+                    compression=compression, process_set=process_set,
+                    axis_name=axis_name, seed=seed,
+                    min_bucket_bytes=overlap_min_bytes,
+                )
+                return fun(*a, **k)
+
+            vg2 = jax.value_and_grad(
+                fun2, argnums=argnums, has_aux=has_aux, **grad_kwargs
+            )
+            return vg2(*args, **kwargs)
         val, grads = vg(*args, **kwargs)
         grads = _allreduce_grads(
             grads, op, compression, 1.0, 1.0, process_set, axis_name,

@@ -262,6 +262,7 @@ def pipeline_1f1b(
     return_dx: bool = False,
     virtual_stages: int = 1,
     max_in_flight: int = None,
+    loss_collective_free: bool = False,
 ):
     """1F1B pipeline TRAINING step: returns ``(loss, grads)`` directly.
 
@@ -306,6 +307,23 @@ def pipeline_1f1b(
     max_in_flight: per-global-stage microbatch window (default
         2·pp+1 — the full-throughput window, see _default_in_flight;
         set pp to trade ~35%% throughput for the minimal stash).
+    loss_collective_free: DECLARE that ``loss_fn`` contains no
+        collectives (no psum/all_gather/ppermute over ANY mesh axis —
+        a plain elementwise/softmax loss, or a tail whose tp
+        collectives were hoisted out). The tail evaluation then runs
+        under a real ``lax.cond`` on the per-device schedule bit
+        instead of compute-then-mask: only the device holding the
+        FINAL global stage, and only on ticks where it actually
+        finishes a microbatch, pays the loss forward+backward. This
+        deletes the T·(pp-1) redundant tail evaluations of the
+        uniform-tick model (advisor r5 finding; at n_micro = pp the
+        masked tail burned ~4x the useful tail FLOPs) — see
+        docs/perf.md §"1F1B tail FLOPs". The declaration is a
+        CONTRACT, not detected: a collective inside ``loss_fn`` under
+        this flag makes devices diverge on a collective call and the
+        step deadlocks/miscompiles; leave False (the mesh-uniform
+        default) whenever in doubt. ``stage_fn`` is unaffected — its
+        tp/dp collectives stay legal either way.
     return_dx: also return d(loss)/d(x_micro) — the input cotangents,
         [n_micro, ...], valid on STAGE 0 only (zeros elsewhere; psum
         over the axis masked to stage 0 to broadcast) — for a
@@ -461,13 +479,38 @@ def pipeline_1f1b(
         y = stage_fn(chunk_of(chunked_params, f_c), x_in)
         tgt = idx(y_micro, row["f_idx"])
         if loss_params is None:
-            l_m, dy_m = jax.value_and_grad(
-                lambda yy: loss_fn(yy, tgt)
-            )(y)
+            def _tail(yy, tg):
+                return jax.value_and_grad(
+                    lambda q: loss_fn(q, tg)
+                )(yy)
         else:
-            l_m, (dlp_m, dy_m) = jax.value_and_grad(
-                lambda lp, yy: loss_fn(lp, yy, tgt), argnums=(0, 1)
-            )(loss_params, y)
+            def _tail(yy, tg):
+                l, (dlp, dy) = jax.value_and_grad(
+                    lambda lp, q: loss_fn(lp, q, tg), argnums=(0, 1)
+                )(loss_params, yy)
+                return l, (dlp, dy)
+        if loss_collective_free:
+            # collective-free declaration: a REAL per-device branch —
+            # non-final stages (and fill/drain ticks) skip the tail
+            # fwd+bwd instead of computing it and masking the result.
+            # Legal only because cond branches with no collectives may
+            # diverge across devices under shard_map.
+            tail_shapes = jax.eval_shape(_tail, y, tgt)
+            tail_out = lax.cond(
+                jnp.logical_and(do_f, last_f),
+                _tail,
+                lambda yy, tg: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), tail_shapes
+                ),
+                y,
+                tgt,
+            )
+        else:
+            tail_out = _tail(y, tgt)
+        if loss_params is None:
+            l_m, dy_m = tail_out
+        else:
+            l_m, (dlp_m, dy_m) = tail_out
         carry_lacc = carry.get("lacc")
         if loss_params is not None:
             take = jnp.logical_and(do_f, last_f)

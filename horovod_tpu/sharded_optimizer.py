@@ -188,7 +188,23 @@ class ShardedDistributedOptimizer:
         average: Optional[bool] = None,
         axis_name: str = WORLD_AXIS,
         world: Optional[int] = None,
+        overlap_buckets: Optional[int] = None,
+        overlap_min_bytes: Optional[int] = None,
     ):
+        """``overlap_buckets=N`` buckets the exchange (ops/overlap.py):
+        gradients reduce-scatter as N independent per-bucket collectives
+        (member leaves' padded [n, ·] panes concatenated column-wise —
+        elementwise identical to the per-leaf scatter, so the shard
+        values are bit-exact) and parameter updates all-gather the same
+        way. Because the inner transform is ELEMENTWISE (the probe
+        enforces it), the single ``inner.update`` call decomposes into
+        per-leaf dataflow: bucket k's update math depends only on
+        bucket k's reduce-scatter output, so XLA overlaps the update
+        compute with the tail of the exchange — the ZeRO-1 shard-by-
+        shard interleave of arXiv 2004.13336, with state/checkpoint
+        layout unchanged. ``None`` defers to ``HOROVOD_OVERLAP``/
+        ``HOROVOD_OVERLAP_BUCKETS``; 0 keeps the per-leaf collectives.
+        """
         self._inner = optimizer
         self._op = resolve_op(op, average)
         if self._op not in (Sum, Average):
@@ -198,6 +214,16 @@ class ShardedDistributedOptimizer:
             )
         self._axis = axis_name
         self._world = world
+        from .ops import overlap as _overlap
+
+        if overlap_buckets is None:
+            overlap_buckets = _overlap.default_buckets()
+        self._overlap_buckets = int(overlap_buckets)
+        self._overlap_min_bytes = (
+            _overlap.default_min_bytes()
+            if overlap_min_bytes is None
+            else int(overlap_min_bytes)
+        )
         import os
 
         if os.environ.get(
@@ -270,7 +296,21 @@ class ShardedDistributedOptimizer:
                 red = red / n
             return red
 
-        g_sh = jax.tree_util.tree_map(rs, grads)
+        sched = None
+        if self._overlap_buckets:
+            from .ops import overlap as _overlap
+
+            g_leaves, g_def = jax.tree_util.tree_flatten(grads)
+            nonscalar = [i for i, g in enumerate(g_leaves) if g.ndim > 0]
+            sched = _overlap.schedule_for(
+                [g_leaves[i] for i in nonscalar], g_def,
+                self._overlap_buckets, self._overlap_min_bytes,
+            )
+            g_sh = self._bucketed_rs(
+                g_leaves, g_def, nonscalar, sched, n
+            )
+        else:
+            g_sh = jax.tree_util.tree_map(rs, grads)
         p_sh = jax.tree_util.tree_map(
             lambda p: p if p.ndim == 0 else _shard_dyn(p, n, idx), params
         )
@@ -282,11 +322,82 @@ class ShardedDistributedOptimizer:
             full = jax.lax.all_gather(u, self._axis, axis=0).reshape(-1)
             return full[: p.size].reshape(p.shape).astype(u.dtype)
 
-        upd = jax.tree_util.tree_map(gather, upd_sh, params)
+        if sched is not None:
+            upd = self._bucketed_ag(upd_sh, params, nonscalar, sched, gather)
+        else:
+            upd = jax.tree_util.tree_map(gather, upd_sh, params)
         new_state = jax.tree_util.tree_map(
             lambda x: x[None], new_local
         )
         return upd, new_state
+
+    # -- bucketed exchange (overlap_buckets) -------------------------------
+    def _bucketed_rs(self, g_leaves, g_def, nonscalar, sched, n):
+        """Per-bucket reduce-scatter: member leaves' padded [n, cols]
+        panes concat column-wise, ONE psum_scatter per bucket, shard
+        split back per leaf. Elementwise identical to the per-leaf
+        scatter (same per-element cross-replica sums), but the compiled
+        program carries len(sched.buckets) INDEPENDENT collectives."""
+        out = [None] * len(g_leaves)
+        for i, g in enumerate(g_leaves):
+            if g.ndim == 0:
+                red = jax.lax.psum(g, self._axis)
+                out[i] = red / n if self._op == Average else red
+        for idxs in sched.buckets:
+            panes = [
+                _pad_to(g_leaves[nonscalar[j]].reshape(-1), n).reshape(n, -1)
+                for j in idxs
+            ]
+            cols = [p.shape[1] for p in panes]
+            buf = panes[0] if len(panes) == 1 else jnp.concatenate(
+                panes, axis=1
+            )
+            red = jax.lax.psum_scatter(
+                buf, self._axis, scatter_dimension=0, tiled=False
+            )
+            if self._op == Average:
+                red = red / n
+            off = 0
+            for j, c in zip(idxs, cols):
+                out[nonscalar[j]] = red[off : off + c]
+                off += c
+        return jax.tree_util.tree_unflatten(g_def, out)
+
+    def _bucketed_ag(self, upd_sh, params, nonscalar, sched, gather):
+        """Per-bucket all-gather of the update shards: the dual of
+        :meth:`_bucketed_rs` (concat shards → ONE all_gather per bucket
+        → per-leaf columns → unpad/reshape). Falls back to the per-leaf
+        gather for a bucket whose update dtypes diverged (an inner
+        transform that changes dtype per leaf)."""
+        u_leaves, u_def = jax.tree_util.tree_flatten(upd_sh)
+        p_leaves = u_def.flatten_up_to(params)
+        out = [None] * len(u_leaves)
+        for i, (u, p) in enumerate(zip(u_leaves, p_leaves)):
+            if p.ndim == 0:
+                out[i] = u
+        for idxs in sched.buckets:
+            mem = [u_leaves[nonscalar[j]] for j in idxs]
+            if len({m.dtype for m in mem}) > 1:
+                for j in idxs:
+                    out[nonscalar[j]] = gather(
+                        u_leaves[nonscalar[j]], p_leaves[nonscalar[j]]
+                    )
+                continue
+            cols = [m.shape[0] for m in mem]
+            buf = mem[0] if len(mem) == 1 else jnp.concatenate(mem)
+            full = jax.lax.all_gather(buf, self._axis, axis=0)  # [n, L]
+            off = 0
+            for j, c in zip(idxs, cols):
+                i = nonscalar[j]
+                p = p_leaves[i]
+                flat = full[:, off : off + c].reshape(-1)
+                out[i] = (
+                    flat[: p.size]
+                    .reshape(p.shape)
+                    .astype(u_leaves[i].dtype)
+                )
+                off += c
+        return jax.tree_util.tree_unflatten(u_def, out)
 
     def state_spec(self):
         """The single PartitionSpec for the whole state pytree in

@@ -300,6 +300,43 @@ def test_bench_int8_harness_smoke():
 
 
 @pytest.mark.slow
+def test_bench_overlap_harness_smoke():
+    import tempfile
+
+    art = tempfile.mkdtemp()
+    lines = _run_harness(
+        "bench_overlap.py",
+        {
+            "BENCH_DRYRUN": "1",
+            "BENCH_ITERS": "2",
+            "BENCH_ARTIFACT_DIR": art,
+        },
+    )
+    legs = {l["leg"] for l in lines if l["metric"] == "overlap_ab"}
+    assert legs == {"ab_monolithic", "ab_bucketed", "ab_bucketed_rs"}
+    rs = next(
+        l
+        for l in lines
+        if l["metric"] == "overlap_ab" and l["leg"] == "ab_bucketed_rs"
+    )
+    tuner = next(l for l in lines if l["metric"] == "overlap_tuner")
+    assert tuner["choice"] in tuner["candidates"]
+    # compiled-program evidence rides the artifact: bucketed ZeRO-1 leg
+    # must carry N independent rs + ag collectives
+    assert rs["collectives"]["reduce_scatter"] == rs["n_buckets"]
+    assert rs["collectives"]["all_gather"] == rs["n_buckets"]
+    # CPU A/B lines carry the quarantine note (the tuner verdict line
+    # is a derived summary, not a measurement claim)
+    assert all(
+        "note" in l for l in lines if l["metric"] == "overlap_ab"
+    )
+    for leg in legs:
+        assert os.path.getsize(
+            os.path.join(art, f"overlap_{leg}.json")
+        ) > 0
+
+
+@pytest.mark.slow
 def test_bench_seq_harness_smoke():
     lines = _run_harness(
         "bench_seq.py",

@@ -378,3 +378,181 @@ class TestDriverIntegration:
             assert result["rc"] == 0  # epoch-1 relaunch exited clean
         finally:
             d.shutdown()
+
+
+@pytest.mark.slow
+class TestComposedElasticPath:
+    """The composed elastic story as ONE scenario (VERDICT r5 item 6):
+    the pieces — gang restart on SIGKILL, ZeRO-1 ``reshard_state``
+    across a world change, ``DurableJaxState`` restore from the Orbax
+    checkpoint — are individually tested elsewhere; this chains them
+    the way a real preempted job experiences them (the reference's
+    elastic integration tests tell the same end-to-end story,
+    test/integration/test_elastic_torch.py [V])."""
+
+    def test_sigkill_reshard_restore_chain(self, monkeypatch, tmp_path,
+                                           hvd):
+        import signal as _signal
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from functools import partial
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from horovod_tpu.checkpoint import DurableJaxState
+
+        rng = np.random.default_rng(0)
+        w0 = rng.normal(size=(5, 3)).astype(np.float32)
+        params = {
+            "w": jnp.asarray(w0),
+            "b": jnp.zeros((3,), jnp.float32),
+        }
+        x = jnp.asarray(rng.normal(size=(8, 16, 5)), jnp.float32)
+        y = jnp.asarray(
+            np.einsum("wbi,io->wbo", np.asarray(x), w0), jnp.float32
+        )
+
+        def _loss(p, xb, yb):
+            return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+        def make_step(opt, mesh):
+            @partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P(), opt.state_spec(),
+                          P(hvd_mod.WORLD_AXIS), P(hvd_mod.WORLD_AXIS)),
+                out_specs=(P(), opt.state_spec(), P()),
+                check_vma=False,
+            )
+            def step(p, st, xb, yb):
+                loss, g = jax.value_and_grad(_loss)(p, xb[0], yb[0])
+                u, st = opt.update(g, st, p)
+                return optax.apply_updates(p, u), st, jax.lax.pmean(
+                    loss, hvd_mod.WORLD_AXIS
+                )
+
+            return jax.jit(step)
+
+        # ---- phase A: epoch-0 training at world 8, durable commits
+        ckdir = str(tmp_path / "ck")
+        opt = hvd_mod.ShardedDistributedOptimizer(optax.adam(1e-2))
+        ostate = opt.init(params)
+        state = DurableJaxState(
+            checkpoint_dir=ckdir, params=params, opt_state=ostate,
+            step=0,
+        )
+        step8 = make_step(opt, hvd_mod.mesh())
+        losses = []
+        for i in range(3):
+            state.params, state.opt_state, loss = step8(
+                state.params, state.opt_state, x, y
+            )
+            state.step = i + 1
+            losses.append(float(loss))
+        state.commit()
+        state.wait_until_finished()
+        moments_before = [
+            np.concatenate(
+                [np.asarray(l).reshape(-1)]
+            )
+            for l in jax.tree_util.tree_leaves(
+                jax.device_get(state.opt_state)
+            )
+        ]
+        state.close()
+
+        # ---- phase B: the gang dies (SIGKILL), membership shrinks to
+        # 6 slots, the driver restarts; epoch-1 workers report their
+        # world size — the size phase C reshards to
+        for k, v in _clean_env().items():
+            monkeypatch.setenv(k, v)
+        flag = tmp_path / "epoch0_up"
+        size_file = tmp_path / "epoch1_size"
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys, time, pathlib\n"
+            f"flag = pathlib.Path({str(flag)!r})\n"
+            f"size_file = pathlib.Path({str(size_file)!r})\n"
+            "if int(os.environ.get('HOROVOD_ELASTIC_EPOCH', '0')) >= 1:\n"
+            "    if os.environ.get('HOROVOD_RANK') == '0':\n"
+            "        size_file.write_text(os.environ['HOROVOD_SIZE'])\n"
+            "    sys.exit(0)\n"
+            "flag.write_text('up')\n"
+            "time.sleep(120)\n"
+        )
+        d = ElasticDriver(
+            FakeDiscovery(
+                [HostInfo("127.0.0.1", 2), HostInfo("localhost", 6)]
+            ),
+            [sys.executable, str(script)],
+            min_np=1,
+            discovery_interval=0.2,
+        )
+        try:
+            d.host_manager.refresh()
+            result = {}
+            t = threading.Thread(target=lambda: result.update(rc=d.run()))
+            t.start()
+            deadline = time.monotonic() + 20
+            while not flag.exists() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert flag.exists(), "epoch-0 gang never came up"
+            with d._lock:
+                procs = list(d._procs)
+            procs[0].send_signal(_signal.SIGKILL)
+            t.join(timeout=60)
+            assert not t.is_alive(), "driver did not recover"
+            assert result["rc"] == 0
+        finally:
+            d.shutdown()
+        new_world = int(size_file.read_text())
+        assert new_world == 6  # the blacklisted host's 2 slots are gone
+
+        # ---- phase C: the restarted job restores from the durable
+        # checkpoint and reshards the ZeRO-1 state 8 -> new_world,
+        # carrying the Adam moments exactly, then keeps learning
+        fresh = DurableJaxState(
+            checkpoint_dir=ckdir,
+            params=jax.tree_util.tree_map(jnp.zeros_like, params),
+            opt_state=jax.tree_util.tree_map(jnp.zeros_like, ostate),
+            step=0,
+        )
+        assert fresh.resume_latest()
+        assert fresh.step == 3
+        r_params = jax.tree_util.tree_map(
+            np.asarray, jax.device_get(fresh.params)
+        )
+        r_ostate = opt.reshard_state(
+            jax.device_get(fresh.opt_state), r_params, new_world
+        )
+        fresh.close()
+        moments_after = [
+            np.asarray(l).reshape(-1)
+            for l in jax.tree_util.tree_leaves(jax.device_get(r_ostate))
+        ]
+        # moment mass is carried exactly (reshard moves, never resets):
+        # sharded leaves keep every nonzero entry, replicated scalars
+        # (Adam's count) re-broadcast to the new world unchanged
+        for b, a in zip(moments_before, moments_after):
+            if np.unique(b).size == 1:
+                assert np.unique(a).size == 1 and a.flat[0] == b.flat[0]
+            else:
+                np.testing.assert_allclose(
+                    np.sort(b[np.abs(b) > 0]),
+                    np.sort(a[np.abs(a) > 0]),
+                    rtol=0, atol=0,
+                )
+
+        mesh6 = Mesh(
+            np.asarray(jax.devices()[:new_world]),
+            (hvd_mod.WORLD_AXIS,),
+        )
+        step6 = make_step(opt, mesh6)
+        p6 = jax.tree_util.tree_map(jnp.asarray, r_params)
+        s6 = jax.tree_util.tree_map(jnp.asarray, r_ostate)
+        x6, y6 = x[:new_world], y[:new_world]
+        for _ in range(5):
+            p6, s6, loss = step6(p6, s6, x6, y6)
+            losses.append(float(loss))
+        assert losses[-1] < losses[2], losses  # still learning post-chain

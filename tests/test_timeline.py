@@ -92,3 +92,31 @@ def test_timeline_step_noop_without_session(hvd):
     running (training loops keep the annotation unconditionally)."""
     with hvd_mod.timeline_step("train", 0):
         pass
+
+
+def test_eager_timeline_device_completion_span(hvd, tmp_path):
+    """The fused flush stamps a device-completion span per entry: a
+    complete 'X' event named <PHASE>_DEVICE whose duration is the
+    dispatch→block_until_ready delta (SURVEY §7 checklist row, eager
+    half — see docs/design.md for the semantics and the remote-tunnel
+    caveat)."""
+    path = str(tmp_path / "tl.json")
+    hvd_mod.start_timeline(path)
+    x = np.stack([np.full((4,), float(r), np.float32) for r in range(8)])
+    hvd.allreduce(x, op=hvd_mod.Sum, name="devtensor")
+    hvd_mod.stop_timeline()
+    hvd_mod.common.basics.state().timeline.close()
+    events = _chrome_events(path)
+    spans = [
+        e
+        for e in events
+        if e.get("ph") == "X" and e.get("name") == "ALLREDUCE_DEVICE"
+    ]
+    assert spans, "no device-completion span stamped"
+    assert all(e.get("dur", 0) >= 0 for e in spans)
+    # the device span belongs to the same tensor row as the dispatch
+    # lifecycle events (shared pid ⇒ one process row per tensor)
+    queue_pids = {
+        e.get("pid") for e in events if e.get("name") == "QUEUE"
+    }
+    assert {e.get("pid") for e in spans} <= queue_pids
