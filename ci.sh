@@ -11,8 +11,13 @@
 #                    harness (host-pack vs in-JIT, bucketing, gather
 #                    fusion) must run green and emit per-leg artifacts,
 #                    so the engine's premise-measurement can't rot
+#   5. telemetry-smoke — 5-step CPU loop with the live /metrics
+#                    endpoint on an ephemeral port: Prometheus scrape
+#                    (step p50/p95 + registry gauges) and the
+#                    flight-recorder JSON-lines dump must both work
 #
-# Usage: ./ci.sh [lint|native|tests|bench-smoke|all]   (default: all)
+# Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|all]
+# (default: all)
 
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -82,11 +87,18 @@ bench_smoke() {
   echo "bench-smoke artifacts OK: $art_dir"
 }
 
+telemetry_smoke() {
+  step "telemetry-smoke: /metrics scrape + flight-recorder dump"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/telemetry_smoke.py
+}
+
 case "${1:-all}" in
   lint)        lint ;;
   native)      native ;;
   tests)       tests ;;
   bench-smoke) bench_smoke ;;
-  all)         lint; native; tests; bench_smoke ;;
-  *) echo "usage: $0 [lint|native|tests|bench-smoke|all]" >&2; exit 2 ;;
+  telemetry-smoke) telemetry_smoke ;;
+  all)         lint; native; tests; bench_smoke; telemetry_smoke ;;
+  *) echo "usage: $0 [lint|native|tests|bench-smoke|telemetry-smoke|all]" >&2; exit 2 ;;
 esac

@@ -135,6 +135,11 @@ from . import data  # noqa: F401  (DistributedSampler analog + prefetch)
 from . import executor  # noqa: F401  (RayExecutor / spark.run parity, ref [V])
 from . import checkpoint  # noqa: F401  (durable ckpt — fills ref gap, SURVEY §5.4)
 from . import preemption  # noqa: F401  (TPU preemption → durable commit)
+from .common import telemetry  # noqa: F401  (flight recorder + /metrics)
+from .common.telemetry import (  # noqa: F401
+    step_begin,
+    step_end,
+)
 
 
 def __getattr__(name):
@@ -176,6 +181,12 @@ def start_timeline(
     if st.timeline is None:
         st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
         st.fusion.timeline = st.timeline
+        # keep the telemetry hub's step-boundary counter track on the
+        # SAME timeline, whether it came from env at init or from this
+        # runtime call (common/telemetry.py)
+        from .common import telemetry as _telemetry
+
+        _telemetry.hub().timeline = st.timeline
     st.timeline.start()
 
 
@@ -192,11 +203,31 @@ def stop_timeline() -> None:
 def timeline_step(name: str = "step", step_num=None):
     """Context manager marking one traced training step in the profiler
     timeline (the NVTX-range analog, nvtx_op_range.h [V]). No-op when no
-    traced timeline is active."""
+    traced timeline is active.
+
+    When telemetry is enabled (flight recorder / /metrics scraper /
+    HOROVOD_TELEMETRY=1) the same boundary also opens and closes a
+    flight-recorder StepStats record, so profiler steps and telemetry
+    steps share ids."""
     from .common import basics as _basics
+    from .common import telemetry as _telemetry
     from .common.traced_timeline import TracedTimeline
 
     st = _basics._require_init()
     if st.traced_timeline is None:
         st.traced_timeline = TracedTimeline("horovod_timeline.json")
-    return st.traced_timeline.step(name, step_num)
+    ctx = st.traced_timeline.step(name, step_num)
+    if not _telemetry.auto_enabled():
+        return ctx
+    import contextlib
+
+    @contextlib.contextmanager
+    def _with_telemetry():
+        _telemetry.hub().step_begin(step_num)
+        try:
+            with ctx:
+                yield
+        finally:
+            _telemetry.hub().step_end()
+
+    return _with_telemetry()

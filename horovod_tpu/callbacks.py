@@ -191,6 +191,35 @@ class LearningRateScheduleCallback(Callback):
         return state
 
 
+class TelemetryCallback(Callback):
+    """Epoch-level bridge to the flight recorder (common/telemetry.py):
+    merges the ring's step-time percentiles into the epoch ``logs`` (so
+    whatever logger consumes them — the reference's pattern is
+    TensorBoard — sees step p50/p95 next to loss/accuracy) and, when a
+    flight-recorder path is configured, persists the ring each epoch —
+    a periodic dump point between the SIGTERM/atexit ones.
+
+    No reference analog: the reference's callbacks stop at metric
+    averaging; this is the observability layer's loop hook."""
+
+    def __init__(self, dump: bool = True, prefix: str = "step_ms"):
+        self._dump = dump
+        self._prefix = prefix
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None,
+                     state=None):
+        from .common import telemetry
+
+        h = telemetry.hub()
+        pct = h.percentiles()
+        if logs is not None and pct:
+            logs[f"{self._prefix}_p50"] = pct["p50"]
+            logs[f"{self._prefix}_p95"] = pct["p95"]
+        if self._dump:
+            h.dump()  # no-op without a flight-recorder path
+        return state
+
+
 # ------------------------------------------------------- optax schedules
 
 

@@ -56,6 +56,7 @@ class _GlobalState:
         self.traced_timeline = None  # TracedTimeline (jax.profiler wrapper)
         self.parameter_manager = None  # autotune, attached when enabled
         self.stall_inspector = None
+        self.telemetry_server = None  # MetricsServer (HOROVOD_METRICS_PORT)
 
 
 _state = _GlobalState()
@@ -165,8 +166,28 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
             _state.stall_inspector = StallInspector(
                 warning_seconds=cfg.stall_warning_seconds,
                 shutdown_seconds=cfg.stall_shutdown_seconds,
+                straggler_factor=cfg.straggler_factor,
             )
             _state.fusion.stall_inspector = _state.stall_inspector
+        # Telemetry hub (flight recorder) + optional live scrape
+        # endpoint. The hub is process-wide and outlives init/shutdown
+        # cycles (the flight recorder must survive a teardown to be a
+        # post-mortem tool); init only refreshes its knobs and wires
+        # the current timeline/inspector into it.
+        from . import telemetry as telemetry_mod
+
+        _hub = telemetry_mod.hub()
+        _hub.configure(
+            capacity=cfg.telemetry_steps,
+            flight_path=cfg.flight_recorder,
+        )
+        _hub.timeline = _state.timeline
+        _hub.stall_inspector = _state.stall_inspector
+        if cfg.metrics_port:
+            _state.telemetry_server = telemetry_mod.MetricsServer(
+                port=cfg.metrics_port
+            )
+            _state.telemetry_server.start()
         if cfg.autotune:
             from .autotune import ParameterManager
 
@@ -196,6 +217,19 @@ def shutdown() -> None:
             _state.timeline.close()
         if _state.traced_timeline is not None:
             _state.traced_timeline.close()
+        if _state.telemetry_server is not None:
+            _state.telemetry_server.stop()
+        from . import telemetry as telemetry_mod
+
+        _hub = telemetry_mod.hub()
+        _hub.timeline = None
+        _hub.stall_inspector = None
+        try:
+            # the ring survives shutdown (post-mortem tool), but a
+            # clean teardown is a natural dump point for the recorder
+            _hub.dump()
+        except OSError:
+            pass
         _state.initialized = False
         _state.config = None
         _state.topology = None
@@ -206,6 +240,7 @@ def shutdown() -> None:
         _state.traced_timeline = None
         _state.parameter_manager = None
         _state.stall_inspector = None
+        _state.telemetry_server = None
 
 
 def is_initialized() -> bool:

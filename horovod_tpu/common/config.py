@@ -140,6 +140,17 @@ class Config:
     timeline: Optional[str] = None
     timeline_mark_cycles: bool = False
 
+    # --- telemetry (common/telemetry.py) ---
+    # flight-recorder ring size: the last N closed StepStats records
+    telemetry_steps: int = 256
+    # JSON-lines path the ring is dumped to on exit/SIGTERM (None = off)
+    flight_recorder: Optional[str] = None
+    # per-worker /metrics + /telemetry scrape port (0 = no server)
+    metrics_port: int = 0
+    # straggler threshold: flag ranks whose heartbeat-reported step_ms
+    # p50 exceeds this multiple of the gang median
+    straggler_factor: float = 3.0
+
     # --- stall inspector ---
     stall_check_disable: bool = False
     stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
@@ -227,6 +238,10 @@ class Config:
             ),
             timeline=env.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+            telemetry_steps=_env_int("HOROVOD_TELEMETRY_STEPS", 256),
+            flight_recorder=env.get("HOROVOD_FLIGHT_RECORDER") or None,
+            metrics_port=_env_int("HOROVOD_METRICS_PORT", 0),
+            straggler_factor=_env_float("HOROVOD_STRAGGLER_FACTOR", 3.0),
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
             stall_warning_seconds=_env_float(
                 "HOROVOD_STALL_CHECK_TIME_SECONDS", DEFAULT_STALL_WARNING_SECONDS

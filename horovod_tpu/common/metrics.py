@@ -8,7 +8,10 @@ numbers). One process-wide registry; subsystems register or bump
 metrics by dotted name, and ``HOROVOD_METRICS_FILE`` (or an explicit
 ``dump``/``start_export`` call) writes JSON lines:
 
-    {"ts": <unix>, "name": "fusion.cycles", "value": 17}
+    {"ts": <unix>, "seq": <monotonic>, "name": "fusion.cycles", "value": 17}
+
+Dumps are delta-aware: after the first full snapshot, only changed
+values are appended (``dump(force=True)`` re-emits everything).
 
 The fusion manager publishes its cycle/cache counters after every
 flush; anything else (user code included) can publish through
@@ -37,6 +40,11 @@ class MetricsRegistry:
         self._values: Dict[str, float] = {}
         self._path: Optional[str] = None
         self._last_dump = 0.0
+        # delta-aware export state: what the sink last saw, plus a
+        # monotonic per-line sequence number so readers can totally
+        # order lines even when ts collides
+        self._last_dumped: Optional[Dict[str, float]] = None
+        self._seq = 0
 
     # -- write side ---------------------------------------------------
 
@@ -64,6 +72,9 @@ class MetricsRegistry:
     def reset(self) -> None:
         with self._lock:
             self._values.clear()
+            # the sink's view is stale too: next dump re-baselines with
+            # a full snapshot (seq stays monotonic across resets)
+            self._last_dumped = None
 
     # -- export -------------------------------------------------------
 
@@ -79,7 +90,11 @@ class MetricsRegistry:
         HOROVOD_METRICS_FILE; explicit path wins."""
         if path is None:
             path = os.environ.get("HOROVOD_METRICS_FILE") or None
-        self._path = path
+        with self._lock:
+            if path != self._path:
+                # a fresh sink has seen nothing: first write is full
+                self._last_dumped = None
+            self._path = path
 
     def maybe_dump(self, min_interval: float = 1.0) -> Optional[str]:
         """Rate-limited dump for hot paths (the fusion flush calls
@@ -94,22 +109,52 @@ class MetricsRegistry:
             self._last_dump = now
         return self.dump()
 
-    def dump(self, path: Optional[str] = None) -> Optional[str]:
-        """Append one line per metric to the sink; returns the path
-        written (None when no sink is configured)."""
+    def dump(
+        self, path: Optional[str] = None, force: bool = False
+    ) -> Optional[str]:
+        """Append metric lines to the sink; returns the path written
+        (None when no sink is configured).
+
+        Delta-aware: only metrics whose value CHANGED since the last
+        dump are appended — a long run's periodic export stops paying
+        O(total metrics) lines per interval. The first write to a sink
+        and ``dump(force=True)`` emit the full snapshot (so a reader can
+        always reconstruct state from the last full snapshot forward);
+        an explicit ``path`` different from the configured sink also
+        gets a full snapshot, without disturbing the sink's delta state.
+        Every line carries a monotonic ``seq``."""
+        explicit = path is not None and path != self._path
         path = path or self._path
         if not path:
             return None
         now = time.time()
         snap = self.snapshot()
-        with open(path, "a") as f:
-            for name in sorted(snap):
-                f.write(
-                    json.dumps(
-                        {"ts": now, "name": name, "value": snap[name]}
-                    )
-                    + "\n"
+        with self._lock:
+            prev = self._last_dumped
+            if force or explicit or prev is None:
+                items = sorted(snap.items())
+            else:
+                items = sorted(
+                    (k, v) for k, v in snap.items() if prev.get(k) != v
                 )
+            if not explicit:
+                self._last_dumped = dict(snap)
+            lines = []
+            for name, value in items:
+                lines.append(
+                    json.dumps(
+                        {
+                            "ts": now,
+                            "seq": self._seq,
+                            "name": name,
+                            "value": value,
+                        }
+                    )
+                )
+                self._seq += 1
+        if lines:
+            with open(path, "a") as f:
+                f.write("\n".join(lines) + "\n")
         return path
 
 

@@ -3,8 +3,8 @@
 TPU-native rebuild of horovod/common/stall_inspector.cc/.h [V]
 (SURVEY.md §2.1). The reference warns when some ranks have submitted a
 tensor and others haven't for >60s. Under a single controller that
-exact skew cannot happen, so this inspector watches the two signals
-that CAN:
+exact skew cannot happen, so this inspector watches the signals that
+CAN:
 
 1. **Cycle-latency watchdog** (intra-process): an entry enqueued but
    never synchronized/flushed past the warning age — a leaked handle
@@ -17,29 +17,54 @@ that CAN:
    silent past the warning age — the true analog of the reference's
    "some ranks are absent" report, rebuilt on the rendezvous channel
    the TPU runner actually has.
+3. **Stragglers** (cross-rank, the telemetry upgrade): heartbeats now
+   piggyback ``{step, step_ms_p50, last_step_ts}`` from each worker's
+   flight-recorder ring (common/telemetry.py), so the driver can tell
+   a SLOW rank from a SILENT one: :meth:`straggler_ranks` flags ranks
+   whose step time is a configurable multiple
+   (``HOROVOD_STRAGGLER_FACTOR``) of the gang median, or whose step
+   counter lags the gang.
+
+`check()` also publishes its view through the metrics registry
+(``stall.pending``, ``stall.stale_ranks``, ``stall.straggler.*``), so
+stalls are visible in JSON-lines dumps and on the live ``/metrics``
+endpoint, not only in logs.
 """
 
 from __future__ import annotations
 
+import statistics
 import time
-from typing import Dict
+from typing import Dict, List, Optional
 
 from .basics import HorovodInternalError
 from .logging import get_logger
 
 logger = get_logger("stall")
 
+DEFAULT_STRAGGLER_FACTOR = 3.0
+# step-counter lag (vs the gang median) that flags a straggler even
+# when its per-step time looks healthy — catches a rank that is
+# silently re-doing work (e.g. recompiling every step)
+DEFAULT_STRAGGLER_LAG_STEPS = 25
+
 
 class StallInspector:
     def __init__(
-        self, warning_seconds: float = 60.0, shutdown_seconds: float = 0.0
+        self,
+        warning_seconds: float = 60.0,
+        shutdown_seconds: float = 0.0,
+        straggler_factor: float = DEFAULT_STRAGGLER_FACTOR,
     ):
         self.warning_seconds = warning_seconds
         self.shutdown_seconds = shutdown_seconds
+        self.straggler_factor = float(straggler_factor)
         self._pending: Dict[str, float] = {}
         self._warned: set = set()
         self._heartbeats: Dict[int, float] = {}
+        self._hb_stats: Dict[int, dict] = {}
         self._hb_warned: set = set()
+        self._straggler_warned: set = set()
 
     def record_enqueue(self, name: str) -> None:
         self._pending.setdefault(name, time.monotonic())
@@ -53,17 +78,35 @@ class StallInspector:
         changes (gang restart): departed ranks must not read as
         stalled."""
         self._heartbeats.clear()
+        self._hb_stats.clear()
         self._hb_warned.clear()
+        self._straggler_warned.clear()
 
-    def record_heartbeat(self, rank: int, ts: float = None) -> None:
+    def record_heartbeat(
+        self,
+        rank: int,
+        ts: float = None,
+        step: Optional[int] = None,
+        step_ms_p50: Optional[float] = None,
+        last_step_ts: Optional[float] = None,
+    ) -> None:
         """Feed a worker heartbeat (driver side of signal #2). ``ts`` is
         a unix epoch stamp (``time.time()`` — the domain
         ``runner.rendezvous.put_heartbeat`` writes, chosen because the
-        stamps cross machines); defaults to now."""
-        self._heartbeats[int(rank)] = (
-            time.time() if ts is None else float(ts)
-        )
-        self._hb_warned.discard(int(rank))
+        stamps cross machines); defaults to now. The optional telemetry
+        fields are the straggler-ledger payload the worker piggybacks
+        from its flight recorder (signal #3); absent fields keep the
+        rank's previous values."""
+        rank = int(rank)
+        self._heartbeats[rank] = time.time() if ts is None else float(ts)
+        self._hb_warned.discard(rank)
+        stats = self._hb_stats.setdefault(rank, {})
+        if step is not None:
+            stats["step"] = int(step)
+        if step_ms_p50 is not None:
+            stats["step_ms_p50"] = float(step_ms_p50)
+        if last_step_ts is not None:
+            stats["last_step_ts"] = float(last_step_ts)
 
     def stale_ranks(self, now: float = None):
         """Ranks whose last heartbeat is older than warning_seconds.
@@ -78,10 +121,84 @@ class StallInspector:
             if now - t > self.warning_seconds
         )
 
+    def straggler_ranks(
+        self,
+        factor: Optional[float] = None,
+        lag_steps: int = DEFAULT_STRAGGLER_LAG_STEPS,
+    ) -> List[int]:
+        """Ranks that are SLOW rather than silent — the upgrade over
+        :meth:`stale_ranks`, possible because heartbeats now carry each
+        worker's step telemetry. A rank is a straggler when:
+
+        * its ``step_ms_p50`` exceeds ``factor`` × the gang median
+          (``factor`` defaults to ``HOROVOD_STRAGGLER_FACTOR``), or
+        * its step counter trails the gang's median step by more than
+          ``lag_steps`` — it heartbeats fine but isn't making progress.
+
+        Needs at least two reporting ranks (a median of one is the rank
+        itself); returns a sorted rank list."""
+        factor = self.straggler_factor if factor is None else float(factor)
+        out = set()
+        p50s = {
+            r: s["step_ms_p50"]
+            for r, s in self._hb_stats.items()
+            if s.get("step_ms_p50", 0) > 0
+        }
+        if len(p50s) >= 2:
+            median = statistics.median(p50s.values())
+            if median > 0:
+                out.update(
+                    r for r, v in p50s.items() if v > factor * median
+                )
+        steps = {
+            r: s["step"]
+            for r, s in self._hb_stats.items()
+            if s.get("step") is not None
+        }
+        if len(steps) >= 2 and lag_steps > 0:
+            median_step = statistics.median(steps.values())
+            out.update(
+                r for r, v in steps.items() if median_step - v > lag_steps
+            )
+        return sorted(out)
+
+    def heartbeat_stats(self) -> Dict[int, dict]:
+        """Driver-side view of the per-rank straggler ledger."""
+        return {r: dict(s) for r, s in self._hb_stats.items()}
+
+    def _publish(self, stale, stragglers) -> None:
+        """Registry gauges so stalls show up in metrics dumps and on
+        the /metrics scrape, not only in logs. p50s are re-read so the
+        worst-ratio gauge tracks the same data straggler_ranks used."""
+        from .metrics import registry as _metrics
+
+        p50s = [
+            s["step_ms_p50"]
+            for s in self._hb_stats.values()
+            if s.get("step_ms_p50", 0) > 0
+        ]
+        worst_ratio = 0.0
+        if len(p50s) >= 2:
+            median = statistics.median(p50s)
+            if median > 0:
+                worst_ratio = max(p50s) / median
+        _metrics.update(
+            "stall",
+            {
+                "pending": len(self._pending),
+                "stale_ranks": len(stale),
+                "straggler.count": len(stragglers),
+                "straggler.factor": self.straggler_factor,
+                "straggler.worst_ratio": worst_ratio,
+            },
+        )
+
     def check(self) -> None:
-        """Called once per fusion cycle (the reference checks once per
+        """Called once per eager fusion cycle AND per traced-collective
+        dispatch / telemetry step close (the reference checks once per
         background-loop cycle, stall_inspector.cc::CheckForStalledTensors
-        [V])."""
+        [V]; the traced path has no background loop, so its dispatch
+        sites stand in)."""
         now = time.monotonic()
         for name, t in list(self._pending.items()):
             age = now - t
@@ -102,7 +219,25 @@ class StallInspector:
                     name,
                 )
         wall = time.time()  # heartbeats live in the epoch domain
-        for rank in self.stale_ranks(wall):
+        stale = self.stale_ranks(wall)
+        stragglers = self.straggler_ranks()
+        self._publish(stale, stragglers)
+        for rank in stragglers:
+            if rank not in self._straggler_warned:
+                self._straggler_warned.add(rank)
+                stats = self._hb_stats.get(rank, {})
+                logger.warning(
+                    "Rank %d is straggling: step_ms_p50=%.1f step=%s "
+                    "(gang flags ranks past %.1fx the median). The "
+                    "worker is alive but slow.",
+                    rank,
+                    stats.get("step_ms_p50", 0.0),
+                    stats.get("step", "?"),
+                    self.straggler_factor,
+                )
+        # a rank that left the straggler set may warn again on relapse
+        self._straggler_warned.intersection_update(stragglers)
+        for rank in stale:
             age = wall - self._heartbeats[rank]
             # Shutdown escalation re-checks EVERY cycle (like the
             # pending-entry path) — it must fire even after the

@@ -61,8 +61,18 @@ class Timeline:
         can open the trace immediately — waiting for shutdown() to
         materialize it would silently diverge (timeline.cc [V]).
         start() may still resume recording; close() re-writes with any
-        further events."""
-        self._active = False
+        further events.
+
+        The deactivation happens UNDER the emit lock: every emit path
+        re-checks ``_active`` after acquiring the lock, so an emitter
+        that raced past the cheap pre-check either lands its event
+        before the flip (and the final ``_write`` below includes it) or
+        observes the flip and drops the event entirely. Without this, a
+        counter()/span() blocked on the lock could append its event
+        AFTER stop()'s write — present in memory, silently missing from
+        the file the user just opened."""
+        with self._lock:
+            self._active = False
         self._write()
 
     @property
@@ -98,6 +108,8 @@ class Timeline:
         if not self._active:
             return
         with self._lock:
+            if not self._active:  # lost the race with stop()'s flush
+                return
             self._emit(
                 {
                     "name": phase,
@@ -130,6 +142,8 @@ class Timeline:
         if not self._active:
             return
         with self._lock:
+            if not self._active:
+                return
             self._emit(
                 {
                     "name": phase,
@@ -144,6 +158,8 @@ class Timeline:
         if not self._active:
             return
         with self._lock:
+            if not self._active:
+                return
             self._emit(
                 {
                     "name": phase,
@@ -157,6 +173,8 @@ class Timeline:
         if not self._active:
             return
         with self._lock:
+            if not self._active:
+                return
             self._emit(
                 {
                     "name": phase,
@@ -171,10 +189,14 @@ class Timeline:
         """Chrome-trace counter track (ph "C") — the fusion manager
         feeds per-cycle gauges (bucket pad bytes, fused dispatches)
         here so padding/dispatch cost lines up with the per-tensor
-        lifecycle rows in the same trace."""
+        lifecycle rows in the same trace. The telemetry hub feeds its
+        ``telemetry.step`` track through here at every step boundary so
+        traces align with StepStats records (common/telemetry.py)."""
         if not self._active:
             return
         with self._lock:
+            if not self._active:
+                return
             self._emit(
                 {
                     "name": name,
@@ -189,6 +211,8 @@ class Timeline:
         """One eager fusion-cycle boundary (HOROVOD_TIMELINE_MARK_CYCLES)."""
         if self._mark_cycles and self._active:
             with self._lock:
+                if not self._active:
+                    return
                 self._emit(
                     {
                         "name": CYCLE_MARKER,

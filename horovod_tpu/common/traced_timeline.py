@@ -40,6 +40,10 @@ class TracedTimeline:
         # TB logdir kept alongside the requested JSON for the full UI.
         self._logdir = self._path + ".profile"
         self._active = False
+        # the last session's exposed/hidden collective ledger (set by
+        # stop() → _export_chrome_trace); telemetry StepStats read the
+        # same numbers through the overlap.* registry gauges
+        self.last_overlap_stats = None
 
     @property
     def active(self) -> bool:
@@ -142,6 +146,7 @@ class TracedTimeline:
         # Computed on the REAL device events only — the synthetic twin
         # track below would double-count every span.
         stats = collective_overlap_stats(events)
+        self.last_overlap_stats = stats
         events.extend(_collective_spans(events, synth_pid))
         if stats["spans"]:
             from . import metrics as _metrics
@@ -152,6 +157,7 @@ class TracedTimeline:
                     "collective_ms": stats["collective_us"] / 1e3,
                     "exposed_collective_ms": stats["exposed_us"] / 1e3,
                     "hidden_collective_ms": stats["hidden_us"] / 1e3,
+                    "collective_spans": stats["spans"],
                 },
             )
             last_ts = max(

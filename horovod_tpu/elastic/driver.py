@@ -113,8 +113,10 @@ class ElasticDriver:
         self.stall_inspector = StallInspector(
             warning_seconds=_cfg.stall_warning_seconds,
             shutdown_seconds=_cfg.stall_shutdown_seconds,
+            straggler_factor=_cfg.straggler_factor,
         )
         self._last_hb_poll = 0.0
+        self._last_stragglers: tuple = ()
 
     # ---------------------------------------------------------- planning
 
@@ -355,22 +357,43 @@ class ElasticDriver:
             return False
         self._last_hb_poll = now
         from ..common.basics import HorovodInternalError
-        from ..runner.rendezvous import read_heartbeats
+        from ..runner.rendezvous import read_heartbeat_stats
 
         try:
-            heartbeats = read_heartbeats(self._server.store)
+            heartbeats = read_heartbeat_stats(self._server.store)
         except Exception:
             _log.debug("heartbeat poll failed", exc_info=True)
             return False
-        for rank, ts in heartbeats.items():
-            self.stall_inspector.record_heartbeat(rank, ts)
+        for rank, payload in heartbeats.items():
+            self.stall_inspector.record_heartbeat(
+                rank,
+                payload["ts"],
+                step=payload.get("step"),
+                step_ms_p50=payload.get("step_ms_p50"),
+                last_step_ts=payload.get("last_step_ts"),
+            )
         try:
+            # check() publishes stall.pending / stall.stale_ranks /
+            # stall.straggler.* through the metrics registry, so the
+            # driver's /metrics or JSON-lines sink carries the gang view
             self.stall_inspector.check()
         except HorovodInternalError as e:
             # NOT swallowed: silence past the shutdown threshold is a
             # worker failure; escalate to the gang-restart path.
             _log.error("stall escalation: %s", e)
             return True
+        stragglers = tuple(self.stall_inspector.straggler_ranks())
+        if stragglers != self._last_stragglers:
+            # log on CHANGE only (check() already warns once per rank):
+            # the driver loop polls every interval and must not spam
+            if stragglers:
+                _log.warning(
+                    "straggler ranks (slow, not silent): %s",
+                    ",".join(map(str, stragglers)),
+                )
+            elif self._last_stragglers:
+                _log.info("straggler ranks recovered")
+            self._last_stragglers = stragglers
         return False
 
     def _reset(self, reason: str) -> bool:

@@ -91,9 +91,18 @@ class WorkerNotificationManager:
             self._hb_stop = stop
 
             def _beat():
+                from ..common import telemetry as _telemetry
+
                 while not stop.is_set():
                     try:
-                        put_heartbeat(client, rank)
+                        # piggyback the straggler ledger: this worker's
+                        # last step id + ring p50 ride the liveness
+                        # stamp, so the driver can tell slow from
+                        # silent ({} before the first recorded step)
+                        put_heartbeat(
+                            client, rank,
+                            stats=_telemetry.heartbeat_stats(),
+                        )
                     except Exception:
                         pass  # rendezvous going away = job ending
                     stop.wait(10.0)

@@ -404,6 +404,10 @@ class FusionManager:
         self.last_cycle_dispatches = 0
         self.pad_bytes_total = 0  # cumulative bucket padding on the wire
         self.last_cycle_pad_bytes = 0
+        # cumulative payload bytes flushed — with pad/saved totals this
+        # lets the telemetry hub reconstruct per-step wire bytes as a
+        # snapshot delta (common/telemetry.py StepStats)
+        self.flushed_bytes_total = 0
         self.donated_bytes_total = 0
         # quantized-wire observability (payload-width byte model: the
         # fused buffer's wire footprint at the chosen format vs fp32)
@@ -486,6 +490,7 @@ class FusionManager:
         t0 = time.monotonic()
         entries, self.pending = self.pending, []
         flushed_bytes, self.pending_bytes = self.pending_bytes, 0
+        self.flushed_bytes_total += flushed_bytes
         self.cycle_start = None
         self.cycles += 1
         self.last_cycle_dispatches = 0
@@ -705,6 +710,7 @@ class FusionManager:
             "recompiles": self.cache_misses,
             "dispatches": self.dispatches,
             "bucket_pad_bytes": self.pad_bytes_total,
+            "flushed_bytes": self.flushed_bytes_total,
             "donated_bytes": self.donated_bytes_total,
             "wire_bytes_saved": self.wire_bytes_saved_total,
             "quant_blocks": self.quant_blocks_total,

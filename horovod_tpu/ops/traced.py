@@ -32,6 +32,31 @@ from ..common.topology import WORLD_AXIS
 from ..common.process_sets import ProcessSet
 from .reduction_ops import Average, Sum, Adasum, Min, Max, Product, resolve_op
 
+# The stall inspector used to run only on EAGER fusion cycles, so a
+# purely-traced job (the TPU fast path) could stall silently: leaked
+# eager handles aged unobserved and stale worker heartbeats never got
+# re-checked. Traced collectives have no background loop to hook, but
+# their Python entry points ARE the dispatch path (they run at trace /
+# retrace time on the host), and the telemetry hub re-checks at every
+# step close (common/telemetry.py) for steady state. Rate-limited so a
+# per-leaf optimizer trace doesn't pay a check per gradient tensor.
+_STALL_CHECK_INTERVAL_S = 0.5
+_last_stall_check = [0.0]
+
+
+def _stall_check() -> None:
+    import time as _time
+
+    now = _time.monotonic()
+    if now - _last_stall_check[0] < _STALL_CHECK_INTERVAL_S:
+        return
+    _last_stall_check[0] = now
+    from ..common import basics as _basics
+
+    insp = _basics.state().stall_inspector
+    if insp is not None:
+        insp.check()  # may raise the shutdown escalation — intended
+
 
 class _SetInfo(NamedTuple):
     """Static per-world lookup tables for a proper-subset process set."""
@@ -120,6 +145,7 @@ def allreduce(
     reduction. Sum/Average only (a dynamic live-count has no analog
     for min/max/product); composes with a process set by intersection.
     """
+    _stall_check()
     op = resolve_op(op, average)
     if mask is not None and op not in (Average, Sum):
         raise ValueError(
@@ -237,6 +263,7 @@ def grouped_allreduce(
     / group_table.cc [V]). In traced mode the group contract — all members
     reduced atomically in one fused collective — is expressed by a single
     psum over the tuple; XLA emits one fused all-reduce."""
+    _stall_check()
     op = resolve_op(op, average)
     info = _set_info(process_set, axis_name)
     n = info.size if info is not None else lax.axis_size(axis_name)
@@ -304,6 +331,7 @@ def allgather(
     With a process set, the result is the concatenation of the members'
     tensors in set order — every rank (members and outsiders alike)
     receives it; outsiders contribute nothing."""
+    _stall_check()
     info = _set_info(process_set, axis_name)
     if info is None:
         return lax.all_gather(tensor, axis_name, axis=0, tiled=True)
@@ -321,6 +349,7 @@ def broadcast(
     NCCLBroadcast [V]). Implemented as a masked psum — XLA lowers this to a
     broadcast-from-source collective on ICI. With a process set, members
     receive the root's value and outsiders keep their own input."""
+    _stall_check()
     info = _set_info(process_set, axis_name)
     idx = lax.axis_index(axis_name)
     contribution = jnp.where(idx == root_rank, tensor, jnp.zeros_like(tensor))
@@ -344,6 +373,7 @@ def alltoall(
     the members only — k-1 hops of one block each, the wire-optimal
     (k-1)/k·P, with no replica-group size constraint. Non-members return
     their input unchanged."""
+    _stall_check()
     info = _set_info(process_set, axis_name)
     if info is None:
         return lax.all_to_all(
@@ -390,6 +420,7 @@ def reducescatter(
     zeros and get the set-position-0 shard — their output, like the
     reference's, is meaningless; its shape must still be uniform under
     SPMD)."""
+    _stall_check()
     op = resolve_op(op, None)
     info = _set_info(process_set, axis_name)
     if prescale_factor != 1.0:
@@ -524,6 +555,7 @@ def quantized_allreduce(
     contract change must land in both; the fused-vs-unfused parity
     tests are the tripwire.
     """
+    _stall_check()
     from .pallas_kernels import int8_quantize
 
     op = resolve_op(op, None)

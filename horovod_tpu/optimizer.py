@@ -24,6 +24,7 @@ backprop compute (latency hiding on ICI) with no hook machinery. So:
 
 from __future__ import annotations
 
+import functools
 import itertools
 import warnings
 from typing import Any, Callable, NamedTuple, Optional
@@ -32,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .common import telemetry as _telemetry
 from .common.process_sets import ProcessSet
 from .common.topology import WORLD_AXIS
 from .ops import overlap, traced
@@ -278,6 +280,16 @@ def DistributedOptimizer(
         )
 
     def update_fn(grads, state: _AccumulationState, params=None):
+        # Flight-recorder auto-threading (common/telemetry.py): one
+        # step-boundary tick per compiled update, riding the SAME
+        # internal step counter that seeds stochastic rounding — this
+        # is how fully-jitted loops (where no host code runs per step)
+        # still produce StepStats records. Gated at TRACE time: when
+        # telemetry is off the compiled program carries nothing, and
+        # enabling telemetry after compile needs a retrace (documented
+        # in docs/observability.md).
+        if _telemetry.auto_enabled():
+            jax.debug.callback(_telemetry.device_step_tick, state.step)
         if k == 1:
             if error_feedback:
                 reduced, residual = communicate(
@@ -463,8 +475,59 @@ def value_and_grad(
             seen["last"] = hvd_step
         return hvd_step
 
+    def _auto_telemetry_begin(hvd_step) -> bool:
+        """Open a flight-recorder step around this (host-side) call —
+        the tape-API half of telemetry auto-threading. Skipped under
+        tracing (a jitted wrapper runs this body once, at trace time —
+        the optimizer's debug-callback tick owns that case) and when a
+        step is already open (explicit hvd.step_begin wins)."""
+        if not _telemetry.auto_enabled():
+            return False
+        try:
+            if not jax.core.trace_state_clean():
+                return False
+        except Exception:
+            pass
+        step = hvd_step if isinstance(hvd_step, int) else None
+        return _telemetry.hub().auto_step_begin(step)
+
     def wrapped(*args, hvd_step=None, **kwargs):
         seed = _resolve_seed(args, kwargs, hvd_step)
+        opened = _auto_telemetry_begin(hvd_step)
+        if (
+            not opened
+            and hvd_step is not None
+            and _telemetry.auto_enabled()
+        ):
+            # Traced call (the usual shape: vg inside jit/shard_map): a
+            # host-side record is impossible — this body runs ONCE, at
+            # trace time — but a THREADED step counter lets the
+            # compiled program tick the flight recorder instead, same
+            # mechanism as the optimizer's auto-threading. A concrete
+            # constant hvd_step under jit collapses to one record (the
+            # quantized-seed warning above covers that misuse).
+            try:
+                under_trace = not jax.core.trace_state_clean()
+            except Exception:
+                under_trace = False
+            if under_trace:
+                # source "tape": these ids are the CALLER's step
+                # counter, so they outrank the optimizer's internal
+                # ticks — when both fire in one program only one
+                # source drives the recorder (hub.tick dedup)
+                jax.debug.callback(
+                    functools.partial(
+                        _telemetry.device_step_tick, source="tape"
+                    ),
+                    hvd_step,
+                )
+        try:
+            return _wrapped_body(args, kwargs, seed)
+        finally:
+            if opened:
+                _telemetry.hub().auto_step_end()
+
+    def _wrapped_body(args, kwargs, seed):
         if overlap_buckets:
             # in-backprop exchange: grads come back ALREADY reduced —
             # the boundary's custom_vjp emitted the per-bucket

@@ -100,6 +100,16 @@ class GracefulShutdown:
 
     def _drain_and_exit(self) -> None:
         try:
+            # Flight recorder first (common/telemetry.py): the ring dump
+            # is a bounded tmp+rename write, so it cannot eat the grace
+            # window the checkpoint needs — and a failed checkpoint
+            # still leaves the last-N-steps post-mortem on disk.
+            try:
+                from .common import telemetry as _telemetry
+
+                _telemetry.hub().dump()
+            except Exception:
+                pass
             # Prefer the unconditional durable path: commit() may batch
             # (save_interval) or raise HostsUpdatedInterrupt before the
             # write — either loses the grace window's whole purpose.

@@ -313,28 +313,75 @@ def broadcast_via_kv(obj, root_rank: int = 0, name: Optional[str] = None):
 HEARTBEAT_SCOPE = "heartbeat"
 
 
-def put_heartbeat(client: "RendezvousClient", rank: int) -> None:
+def put_heartbeat(
+    client: "RendezvousClient", rank: int, stats: Optional[dict] = None
+) -> None:
     """Stamp this worker's liveness. Call on a timer (the elastic worker
-    loop does; any long-running worker can)."""
+    loop does; any long-running worker can).
+
+    ``stats`` piggybacks the straggler-ledger payload from the worker's
+    flight recorder (``common.telemetry.heartbeat_stats()``: ``step``,
+    ``step_ms_p50``, ``last_step_ts``) onto the same KV write — the
+    driver-side StallInspector uses it to tell SLOW ranks from SILENT
+    ones. The payload is JSON ``{"ts": ..., **stats}``; readers still
+    accept the legacy bare-float form."""
     import time as _time
 
+    payload = {"ts": _time.time()}
+    if stats:
+        payload.update(stats)
     client.put(
-        HEARTBEAT_SCOPE, str(int(rank)), repr(_time.time()).encode()
+        HEARTBEAT_SCOPE, str(int(rank)), json.dumps(payload).encode()
     )
+
+
+def _parse_heartbeat(raw: bytes) -> Optional[dict]:
+    """One heartbeat value → dict with at least ``ts``. Accepts the
+    JSON payload and the legacy ``repr(time.time())`` float."""
+    try:
+        text = raw.decode()
+    except UnicodeDecodeError:
+        return None
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict) and "ts" in obj:
+        try:
+            obj["ts"] = float(obj["ts"])
+        except (TypeError, ValueError):
+            return None
+        return obj
+    try:
+        return {"ts": float(text)}
+    except ValueError:
+        return None
 
 
 def read_heartbeats(store_or_client) -> Dict[int, float]:
     """Driver side: {rank: unix_ts} of every heartbeat present. Accepts
     the in-process KVStore or a RendezvousClient."""
-    out: Dict[int, float] = {}
+    return {
+        r: s["ts"] for r, s in read_heartbeat_stats(store_or_client).items()
+    }
+
+
+def read_heartbeat_stats(store_or_client) -> Dict[int, dict]:
+    """Driver side of the straggler ledger: {rank: payload} with at
+    least ``ts``, plus whatever telemetry the worker piggybacked
+    (``step``, ``step_ms_p50``, ``last_step_ts``)."""
+    out: Dict[int, dict] = {}
     for key in store_or_client.keys(HEARTBEAT_SCOPE):
         raw = store_or_client.get(HEARTBEAT_SCOPE, key)
         if raw is None:
             continue
         try:
-            out[int(key)] = float(raw.decode())
-        except (ValueError, UnicodeDecodeError):
+            rank = int(key)
+        except ValueError:
             continue
+        parsed = _parse_heartbeat(raw)
+        if parsed is not None:
+            out[rank] = parsed
     return out
 
 

@@ -1,0 +1,109 @@
+"""Telemetry smoke gate (ci.sh): the observability acceptance loop.
+
+Runs a 5-step CPU training loop with the live scrape endpoint on an
+ephemeral port (the HOROVOD_METRICS_PORT env path, exactly as a launch
+script would set it), scrapes ``/metrics`` via urllib (no curl), and
+asserts:
+
+* Prometheus text exposition with the step-time p50/p95 summary and
+  registry gauges, correct content type, no NaN;
+* ``/telemetry`` JSON carries one record per step;
+* the flight-recorder JSON-lines file is written with <= ring-size
+  records, monotonically increasing step ids, and the per-step
+  exposed/hidden collective + wire-byte fields.
+
+Exit 0 on success; any assertion failure is a CI failure.
+"""
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import urllib.request
+
+# runnable as `python scripts/telemetry_smoke.py` from the repo root
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main() -> int:
+    port = _free_port()
+    flight = os.path.join(
+        tempfile.mkdtemp(prefix="hvd-telemetry-smoke-"), "flight.jsonl"
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["HOROVOD_METRICS_PORT"] = str(port)
+    os.environ["HOROVOD_FLIGHT_RECORDER"] = flight
+    os.environ["HOROVOD_TELEMETRY_STEPS"] = "64"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    x = np.stack(
+        [np.full((128,), float(r), np.float32) for r in range(hvd.size())]
+    )
+    for _ in range(5):
+        hvd.step_begin()
+        hvd.allreduce(x, op=hvd.Sum, name="smoke")
+        hvd.step_end()
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        text = resp.read().decode()
+    assert ctype.startswith("text/plain"), f"content-type: {ctype}"
+    assert 'telemetry_step_ms{quantile="0.5"}' in text, text[:400]
+    assert 'telemetry_step_ms{quantile="0.95"}' in text, text[:400]
+    assert "telemetry_step_ms_count 5" in text, text[:400]
+    assert "hvd_fusion_cycles" in text, "registry gauges missing"
+    assert "# TYPE hvd_fusion_cycles gauge" in text
+    assert "NaN" not in text
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/telemetry", timeout=10
+    ) as resp:
+        tele = json.load(resp)
+    assert len(tele["steps"]) == 5, tele["steps"]
+
+    hvd.shutdown()  # stops the server and dumps the flight recorder
+    with open(flight) as f:
+        records = [json.loads(line) for line in f]
+    assert 0 < len(records) <= 64, len(records)
+    steps = [r["step"] for r in records]
+    assert steps == sorted(steps), steps
+    for rec in records:
+        for key in (
+            "wall_ms",
+            "exposed_collective_ms",
+            "hidden_collective_ms",
+            "wire_bytes",
+            "wire_format",
+        ):
+            assert key in rec, (key, rec)
+    print(f"telemetry-smoke OK: {len(records)} records, port {port}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
