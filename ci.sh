@@ -15,8 +15,15 @@
 #                    endpoint on an ephemeral port: Prometheus scrape
 #                    (step p50/p95 + registry gauges) and the
 #                    flight-recorder JSON-lines dump must both work
+#   6. chaos-smoke — scripts/chaos_smoke.py: a short multi-process
+#                    elastic job under a seeded FaultPlan (one KV
+#                    connection reset per worker + one mid-run worker
+#                    SIGKILL) must complete with exactly one gang
+#                    restart and nonzero retry.* counters scraped
+#                    from the live /metrics endpoint — the chaos
+#                    hardening can't silently rot
 #
-# Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|all]
+# Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|chaos-smoke|all]
 # (default: all)
 
 set -euo pipefail
@@ -93,12 +100,18 @@ telemetry_smoke() {
     python scripts/telemetry_smoke.py
 }
 
+chaos_smoke() {
+  step "chaos-smoke: seeded FaultPlan gang drill (KV reset + SIGKILL)"
+  python scripts/chaos_smoke.py
+}
+
 case "${1:-all}" in
   lint)        lint ;;
   native)      native ;;
   tests)       tests ;;
   bench-smoke) bench_smoke ;;
   telemetry-smoke) telemetry_smoke ;;
-  all)         lint; native; tests; bench_smoke; telemetry_smoke ;;
-  *) echo "usage: $0 [lint|native|tests|bench-smoke|telemetry-smoke|all]" >&2; exit 2 ;;
+  chaos-smoke) chaos_smoke ;;
+  all)         lint; native; tests; bench_smoke; telemetry_smoke; chaos_smoke ;;
+  *) echo "usage: $0 [lint|native|tests|bench-smoke|telemetry-smoke|chaos-smoke|all]" >&2; exit 2 ;;
 esac

@@ -21,14 +21,28 @@ provides what the reference papered over, with Horovod's idioms:
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
+from .common.logging import get_logger
+from .testing import chaos as _chaos
+
+_log = get_logger("checkpoint")
+
 
 class CheckpointManager:
-    """Async sharded checkpoints (Orbax engine, Horovod-shaped API)."""
+    """Async sharded checkpoints (Orbax engine, Horovod-shaped API).
+
+    Degradation-aware by design: saves are atomic (Orbax finalizes a
+    step directory with a commit marker only after every artifact write
+    lands, so a SIGKILL mid-save leaves an *uncommitted* directory the
+    step listing ignores, never a truncated file the restore path
+    trusts), and :meth:`restore_latest_good` walks the retained steps
+    newest-first past any corrupt/partial checkpoint — counting each
+    skip as ``checkpoint.fallback`` — instead of crashing the resume.
+    """
 
     def __init__(
         self,
@@ -53,6 +67,7 @@ class CheckpointManager:
         a save was started (Orbax dedupes repeated steps)."""
         import orbax.checkpoint as ocp
 
+        _chaos.inject("checkpoint.save")
         return self._mgr.save(
             step, args=ocp.args.StandardSave(tree), force=force
         )
@@ -63,6 +78,7 @@ class CheckpointManager:
         sharded), leaves are restored directly onto matching devices."""
         import orbax.checkpoint as ocp
 
+        _chaos.inject("checkpoint.restore")
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -75,6 +91,46 @@ class CheckpointManager:
                 step, args=ocp.args.StandardRestore(target)
             )
         return self._mgr.restore(step)
+
+    def restore_latest_good(
+        self, like: Any = None
+    ) -> Tuple[int, Any]:
+        """Restore the newest checkpoint that actually loads.
+
+        Walks the retained steps newest-first; a step that fails to
+        restore (corrupt array file, half-written metadata — anything
+        the atomic-commit marker didn't guard, e.g. post-commit disk
+        damage) is logged, counted as ``checkpoint.fallback``, and
+        skipped in favor of the next older one. Raises
+        ``FileNotFoundError`` when no checkpoints exist, and a
+        ``RuntimeError`` (chained to the last failure) when every
+        retained checkpoint is bad — losing the whole retention window
+        is a real failure the job must surface, not silently train
+        from scratch over, so the all-corrupt case deliberately cannot
+        collide with the fresh-start ``FileNotFoundError`` even when
+        the underlying damage IS a missing file."""
+        steps = sorted(self.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint under {self._dir}")
+        last_exc: Optional[BaseException] = None
+        for step in steps:
+            try:
+                return step, self.restore(step, like=like)
+            except Exception as e:  # noqa: BLE001 — any load failure
+                from .common.metrics import registry as _metrics
+
+                _metrics.counter("checkpoint.fallback")
+                _log.warning(
+                    "checkpoint step %d failed to restore (%s: %s); "
+                    "falling back to the previous one",
+                    step, type(e).__name__, e,
+                )
+                last_exc = e
+        assert last_exc is not None
+        raise RuntimeError(
+            f"all {len(steps)} retained checkpoint(s) under "
+            f"{self._dir} failed to restore"
+        ) from last_exc
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -172,12 +228,18 @@ class DurableJaxState(JaxState):
         self._ckpt.save(self._step_counter, self._durable_tree(), force=True)
 
     def resume_latest(self) -> bool:
-        """Load the newest durable checkpoint into this state. Returns
-        False when none exists (fresh start)."""
-        step = self._ckpt.latest_step()
-        if step is None:
+        """Load the newest *good* durable checkpoint into this state.
+        Returns False when none exists (fresh start). A corrupt or
+        partially-damaged newest checkpoint does not crash the resume:
+        the manager falls back through the retention window
+        (``checkpoint.fallback`` counts each skip) and only raises when
+        every retained checkpoint is bad."""
+        try:
+            step, restored = self._ckpt.restore_latest_good(
+                like=self._durable_tree()
+            )
+        except FileNotFoundError:
             return False
-        restored = self._ckpt.restore(step, like=self._durable_tree())
         for key, value in restored["trees"].items():
             self._trees[key] = self._replicate(value)
         for key, value in restored["scalars"].items():

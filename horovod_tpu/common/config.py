@@ -26,6 +26,18 @@ DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_WARNING_SECONDS = 60.0
 DEFAULT_STALL_SHUTDOWN_SECONDS = 0.0  # 0 = never shut down
 DEFAULT_ELASTIC_DISCOVERY_INTERVAL = 1.0
+# Control-plane retry/backoff defaults — the ONE home for these
+# numbers: the Config fields below and RetryPolicy.from_env
+# (common/retry.py) both read them, so the typed mirror and the
+# pre-init env path cannot drift apart.
+DEFAULT_RETRY_ATTEMPTS = 3
+DEFAULT_RETRY_BACKOFF_MS = 100.0
+DEFAULT_RETRY_BACKOFF_MAX_MS = 2000.0
+DEFAULT_RETRY_DEADLINE_S = 60.0
+DEFAULT_RETRY_ATTEMPT_TIMEOUT_S = 30.0
+DEFAULT_RETRY_CIRCUIT_THRESHOLD = 3
+DEFAULT_RETRY_CIRCUIT_COOLDOWN_S = 30.0
+DEFAULT_STRAGGLER_QUARANTINE_POLLS = 3
 
 
 def _env_bool(name: str, default: bool = False) -> bool:
@@ -156,6 +168,34 @@ class Config:
     stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
     stall_shutdown_seconds: float = DEFAULT_STALL_SHUTDOWN_SECONDS
 
+    # --- control-plane retry/backoff (common/retry.py) ---
+    # Typed mirror of the HOROVOD_RETRY_* contract; the live consumer
+    # is RetryPolicy.from_env, which shares these defaults and parsers
+    # (policies are built before hvd.init(), so they cannot depend on
+    # an initialized Config instance).
+    # attempts per cross-host hop (rendezvous KV, signed RPC,
+    # heartbeats, discovery); 1 = the old single-attempt behavior
+    retry_attempts: int = DEFAULT_RETRY_ATTEMPTS
+    # first backoff delay, doubled per retry with +/-25% jitter
+    retry_backoff_ms: float = DEFAULT_RETRY_BACKOFF_MS
+    retry_backoff_max_ms: float = DEFAULT_RETRY_BACKOFF_MAX_MS
+    # overall deadline across one hop's attempts (0 = unbounded)
+    retry_deadline_s: float = DEFAULT_RETRY_DEADLINE_S
+    # per-attempt socket/urlopen timeout hint
+    retry_attempt_timeout_s: float = DEFAULT_RETRY_ATTEMPT_TIMEOUT_S
+    # consecutive exhausted rounds against one peer before its circuit
+    # opens (fail-fast CircuitOpenError instead of a full backoff
+    # ladder per touch); 0 disables the breaker
+    retry_circuit_threshold: int = DEFAULT_RETRY_CIRCUIT_THRESHOLD
+    retry_circuit_cooldown_s: float = DEFAULT_RETRY_CIRCUIT_COOLDOWN_S
+    # deterministic fault-injection plan (testing/chaos.py syntax, or
+    # @/path/to/file); None = chaos off
+    fault_plan: Optional[str] = None
+    # self-healing driver: quarantine a host after its rank is flagged
+    # as a straggler for this many CONSECUTIVE fresh heartbeat
+    # observations (proactive gang-restart excluding it); 0 disables
+    straggler_quarantine_polls: int = DEFAULT_STRAGGLER_QUARANTINE_POLLS
+
     # --- logging ---
     log_level: str = "warning"
     log_timestamp: bool = True
@@ -248,6 +288,35 @@ class Config:
             ),
             stall_shutdown_seconds=_env_float(
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", DEFAULT_STALL_SHUTDOWN_SECONDS
+            ),
+            retry_attempts=_env_int(
+                "HOROVOD_RETRY_ATTEMPTS", DEFAULT_RETRY_ATTEMPTS
+            ),
+            retry_backoff_ms=_env_float(
+                "HOROVOD_RETRY_BACKOFF_MS", DEFAULT_RETRY_BACKOFF_MS
+            ),
+            retry_backoff_max_ms=_env_float(
+                "HOROVOD_RETRY_BACKOFF_MAX_MS", DEFAULT_RETRY_BACKOFF_MAX_MS
+            ),
+            retry_deadline_s=_env_float(
+                "HOROVOD_RETRY_DEADLINE_S", DEFAULT_RETRY_DEADLINE_S
+            ),
+            retry_attempt_timeout_s=_env_float(
+                "HOROVOD_RETRY_ATTEMPT_TIMEOUT_S",
+                DEFAULT_RETRY_ATTEMPT_TIMEOUT_S,
+            ),
+            retry_circuit_threshold=_env_int(
+                "HOROVOD_RETRY_CIRCUIT_THRESHOLD",
+                DEFAULT_RETRY_CIRCUIT_THRESHOLD,
+            ),
+            retry_circuit_cooldown_s=_env_float(
+                "HOROVOD_RETRY_CIRCUIT_COOLDOWN_S",
+                DEFAULT_RETRY_CIRCUIT_COOLDOWN_S,
+            ),
+            fault_plan=env.get("HOROVOD_FAULT_PLAN") or None,
+            straggler_quarantine_polls=_env_int(
+                "HOROVOD_STRAGGLER_QUARANTINE_POLLS",
+                DEFAULT_STRAGGLER_QUARANTINE_POLLS,
             ),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_timestamp=_env_bool("HOROVOD_LOG_TIMESTAMP", True),

@@ -153,8 +153,19 @@ class MetricsRegistry:
                 )
                 self._seq += 1
         if lines:
-            with open(path, "a") as f:
-                f.write("\n".join(lines) + "\n")
+            # One O_APPEND write per dump (audited for the chaos
+            # drill, docs/robustness.md): the JSON-lines sink is an
+            # append log, so tmp+rename doesn't apply — instead the
+            # whole batch lands in a single atomic append, and a
+            # SIGKILL can at worst tear the final line of the final
+            # batch, which any JSON-lines reader skips. Never a
+            # half-interleaved record from two processes either.
+            payload = ("\n".join(lines) + "\n").encode()
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, payload)
+            finally:
+                os.close(fd)
         return path
 
 

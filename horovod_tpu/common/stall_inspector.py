@@ -65,6 +65,15 @@ class StallInspector:
         self._hb_stats: Dict[int, dict] = {}
         self._hb_warned: set = set()
         self._straggler_warned: set = set()
+        # hysteresis for the self-healing driver: rank -> number of
+        # consecutive FRESH heartbeat observations it has been flagged
+        # a straggler (one blip must not quarantine a host; K-in-a-row
+        # does). Streaks advance at most once per new heartbeat stamp —
+        # the driver polls far more often than workers beat (1s vs
+        # 10s), and re-judging the same stale payload K times would
+        # turn one noisy sample into a quarantine.
+        self._straggler_streaks: Dict[int, int] = {}
+        self._streak_stamp: Dict[int, Optional[float]] = {}
 
     def record_enqueue(self, name: str) -> None:
         self._pending.setdefault(name, time.monotonic())
@@ -81,6 +90,8 @@ class StallInspector:
         self._hb_stats.clear()
         self._hb_warned.clear()
         self._straggler_warned.clear()
+        self._straggler_streaks.clear()
+        self._streak_stamp.clear()
 
     def record_heartbeat(
         self,
@@ -166,6 +177,22 @@ class StallInspector:
         """Driver-side view of the per-rank straggler ledger."""
         return {r: dict(s) for r, s in self._hb_stats.items()}
 
+    def straggler_streaks(self) -> Dict[int, int]:
+        """rank -> consecutive fresh-heartbeat observations flagged."""
+        return dict(self._straggler_streaks)
+
+    def quarantine_candidates(self, polls: int) -> List[int]:
+        """Ranks a straggler for at least ``polls`` CONSECUTIVE fresh
+        heartbeat observations — the hysteresis gate the self-healing
+        elastic driver uses before quarantining a host (one noisy
+        sample must not cost a gang restart, however often the driver
+        re-reads it). Empty when ``polls`` <= 0."""
+        if polls <= 0:
+            return []
+        return sorted(
+            r for r, n in self._straggler_streaks.items() if n >= polls
+        )
+
     def _publish(self, stale, stragglers) -> None:
         """Registry gauges so stalls show up in metrics dumps and on
         the /metrics scrape, not only in logs. p50s are re-read so the
@@ -190,6 +217,9 @@ class StallInspector:
                 "straggler.count": len(stragglers),
                 "straggler.factor": self.straggler_factor,
                 "straggler.worst_ratio": worst_ratio,
+                "straggler.max_streak": max(
+                    self._straggler_streaks.values(), default=0
+                ),
             },
         )
 
@@ -221,6 +251,23 @@ class StallInspector:
         wall = time.time()  # heartbeats live in the epoch domain
         stale = self.stale_ranks(wall)
         stragglers = self.straggler_ranks()
+        # hysteresis ledger: streaks grow while a rank STAYS flagged
+        # across fresh heartbeat stamps and reset the moment it
+        # recovers; an unchanged stamp (driver polls outpace worker
+        # beats) neither grows nor resets the streak
+        streaks: Dict[int, int] = {}
+        stamps: Dict[int, Optional[float]] = {}
+        for r in stragglers:
+            stamp = self._heartbeats.get(r)
+            prev = self._straggler_streaks.get(r, 0)
+            if prev == 0 or stamp is None or stamp != self._streak_stamp.get(r):
+                streaks[r] = prev + 1
+                stamps[r] = stamp
+            else:
+                streaks[r] = prev
+                stamps[r] = self._streak_stamp.get(r)
+        self._straggler_streaks = streaks
+        self._streak_stamp = stamps
         self._publish(stale, stragglers)
         for rank in stragglers:
             if rank not in self._straggler_warned:

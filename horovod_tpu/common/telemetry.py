@@ -72,6 +72,13 @@ _COUNTER_KEYS = (
     "fusion.bucket_pad_bytes",
     "fusion.wire_bytes_saved",
     "fusion.quant_blocks",
+    # chaos-hardened control plane (common/retry.py, testing/chaos.py):
+    # per-step deltas let a postmortem correlate a slow step with the
+    # hop that was retrying under it (attempts_total is deliberately
+    # absent — the record emits only the fields it carries)
+    "retry.retries_total",
+    "retry.exhausted_total",
+    "faults_injected",
 )
 
 # Gauges copied into the record's ``tuner`` dict — the autotune /
@@ -319,6 +326,12 @@ class TelemetryHub:
                 "fusion_cache_hits": deltas["fusion.hits"]
                 + deltas["fusion.bucket_hits"],
                 "fusion_cycles": deltas["fusion.cycles"],
+                # control-plane weather during THIS step: retries the
+                # transports absorbed, rounds that exhausted, and any
+                # chaos-layer faults injected (0s on a healthy step)
+                "retries": deltas["retry.retries_total"],
+                "retry_exhausted": deltas["retry.exhausted_total"],
+                "faults_injected": deltas["faults_injected"],
                 "tuner": tuner,
             }
         )

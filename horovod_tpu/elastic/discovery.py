@@ -33,23 +33,56 @@ class HostDiscovery:
 
 class HostDiscoveryScript(HostDiscovery):
     """Runs the user's discovery script; stdout = one host[:slots] per
-    line. Non-zero exit or empty output means "no hosts right now"."""
+    line. Empty output means "no hosts right now".
 
-    def __init__(self, script: str, default_slots: int = 1) -> None:
+    A non-zero exit / timeout is retried under the shared
+    ``RetryPolicy`` (site ``discovery``) before being treated as "no
+    hosts": without the retry, ONE transient script failure (NFS blip,
+    API rate-limit) read as a membership collapse and cost a full gang
+    restart. Empty-but-successful output stays authoritative — the
+    script said there is genuinely nothing."""
+
+    def __init__(
+        self, script: str, default_slots: int = 1, retry=None
+    ) -> None:
+        from ..common.retry import RetryPolicy
+
         self._script = script
         self._default_slots = default_slots
+        # no deadline override: HOROVOD_RETRY_DEADLINE_S (default 60s)
+        # applies, so a HUNG script still costs at most one 60s
+        # subprocess timeout before the deadline stops the ladder —
+        # refresh() runs synchronously in the driver loop, and a longer
+        # stall here would starve heartbeat polling / failure detection.
+        # Fast failures (the actual retry target) still get all
+        # attempts.
+        self._retry = retry or RetryPolicy.from_env("discovery")
 
-    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+    def _run_script(self) -> str:
         try:
             out = subprocess.run(
                 self._script, shell=True, capture_output=True, timeout=60
             )
-        except subprocess.TimeoutExpired:
-            return []
+        except subprocess.TimeoutExpired as e:
+            raise TimeoutError(
+                f"discovery script timed out: {self._script!r}"
+            ) from e
         if out.returncode != 0:
+            raise ConnectionError(
+                f"discovery script exited {out.returncode}: "
+                f"{self._script!r}"
+            )
+        return out.stdout.decode()
+
+    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+        from ..common.retry import RetryError
+
+        try:
+            stdout = self._retry.call(self._run_script)
+        except (RetryError, ConnectionError, TimeoutError):
             return []
         hosts: List[HostInfo] = []
-        for line in out.stdout.decode().splitlines():
+        for line in stdout.splitlines():
             line = line.strip()
             if not line:
                 continue

@@ -117,6 +117,11 @@ class ElasticDriver:
         )
         self._last_hb_poll = 0.0
         self._last_stragglers: tuple = ()
+        # self-healing: a rank flagged a straggler for this many
+        # CONSECUTIVE heartbeat polls gets its host quarantined
+        # (blacklist + proactive gang restart); 0 = observe only
+        self._quarantine_polls = _cfg.straggler_quarantine_polls
+        self._quarantine_capacity_warned = False
 
     # ---------------------------------------------------------- planning
 
@@ -267,19 +272,30 @@ class ElasticDriver:
         WorkerNotificationService HTTP ping [V]). Worker addresses come
         from the rendezvous KV, where each notification manager
         registers itself."""
+        from ..common.retry import RetryPolicy
+
         server = self._rendezvous()
         scope = f"workers.{self._epoch}"
+        # short, bounded policy: notification is best-effort fan-out —
+        # retry a flaky worker endpoint twice, but never let one dead
+        # peer stall the notify sweep (its circuit opens after repeated
+        # exhaustions and later sweeps skip it in one fast error)
+        retry = RetryPolicy.from_env(
+            "driver.notify", attempts=2, deadline_s=10.0
+        )
         for key in server.store.keys(scope):
             value = server.store.get(scope, key)
             if value is None:
                 continue
             host, _, port = value.decode().partition(":")
             try:
-                BasicClient(host, int(port), self._secret, timeout=5).request(
-                    {"type": message_type, "epoch": self._epoch}
-                )
+                BasicClient(
+                    host, int(port), self._secret, timeout=5, retry=retry
+                ).request({"type": message_type, "epoch": self._epoch})
             except OSError:
-                pass  # worker already gone; its exit will be collected
+                # worker already gone (incl. RetryError/CircuitOpen
+                # after exhaustion); its exit will be collected
+                pass
 
     # ---------------------------------------------------------- main loop
 
@@ -312,9 +328,10 @@ class ElasticDriver:
         last_refresh = 0.0
         while not self._stop.is_set():
             now = time.monotonic()
-            if self._poll_heartbeats(now):
+            restart_reason = self._poll_heartbeats(now)
+            if restart_reason:
                 self._terminate_gang()
-                if not self._reset(reason="worker heartbeat silence"):
+                if not self._reset(reason=restart_reason):
                     return 1
                 continue
             if now - last_refresh >= self._interval:
@@ -347,14 +364,17 @@ class ElasticDriver:
         self._terminate_gang()
         return 0
 
-    def _poll_heartbeats(self, now: float) -> bool:
+    def _poll_heartbeats(self, now: float) -> Optional[str]:
         """Relay worker heartbeats from the rendezvous KV into the
         stall inspector (rate-limited to once per discovery interval).
-        Returns True when the inspector escalated past
-        HOROVOD_STALL_SHUTDOWN_TIME_SECONDS — the elastic-native
-        response is a gang restart, decided by the caller."""
+        Returns a restart *reason* when the gang should be proactively
+        torn down — either the inspector escalated past
+        HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, or the straggler ledger
+        held a rank flagged for ``HOROVOD_STRAGGLER_QUARANTINE_POLLS``
+        consecutive polls and its host got quarantined. None while the
+        gang looks healthy; the caller owns the actual restart."""
         if self._server is None or now - self._last_hb_poll < self._interval:
-            return False
+            return None
         self._last_hb_poll = now
         from ..common.basics import HorovodInternalError
         from ..runner.rendezvous import read_heartbeat_stats
@@ -363,7 +383,7 @@ class ElasticDriver:
             heartbeats = read_heartbeat_stats(self._server.store)
         except Exception:
             _log.debug("heartbeat poll failed", exc_info=True)
-            return False
+            return None
         for rank, payload in heartbeats.items():
             self.stall_inspector.record_heartbeat(
                 rank,
@@ -381,7 +401,7 @@ class ElasticDriver:
             # NOT swallowed: silence past the shutdown threshold is a
             # worker failure; escalate to the gang-restart path.
             _log.error("stall escalation: %s", e)
-            return True
+            return "worker heartbeat silence"
         stragglers = tuple(self.stall_inspector.straggler_ranks())
         if stragglers != self._last_stragglers:
             # log on CHANGE only (check() already warns once per rank):
@@ -394,7 +414,70 @@ class ElasticDriver:
             elif self._last_stragglers:
                 _log.info("straggler ranks recovered")
             self._last_stragglers = stragglers
-        return False
+        return self._maybe_quarantine()
+
+    def _maybe_quarantine(self) -> Optional[str]:
+        """Self-healing half of ROADMAP Open item 3: consume the
+        straggler ledger instead of only logging it. A rank flagged for
+        K CONSECUTIVE polls (hysteresis — one noisy poll is not a
+        scheduling signal) quarantines its host through the existing
+        blacklist machinery and returns a restart reason, so the gang
+        relaunches WITHOUT the slow host. Skipped — with a one-time
+        warning — when losing those hosts would drop capacity below
+        min_np: a slow gang beats no gang."""
+        if self._quarantine_polls <= 0:
+            return None
+        ranks = self.stall_inspector.quarantine_candidates(
+            self._quarantine_polls
+        )
+        if not ranks:
+            return None
+        with self._lock:
+            rank_to_host = {
+                int(b["HOROVOD_RANK"]): b["HOROVOD_HOSTNAME"]
+                for b in self._blocks
+            }
+        hosts = sorted(
+            {rank_to_host[r] for r in ranks if r in rank_to_host}
+        )
+        if not hosts:
+            return None
+        hosts_info = self.host_manager.current_hosts()
+        slots = {
+            h.hostname: (
+                self._slots_per_host
+                if self._slots_per_host is not None
+                else h.slots
+            )
+            for h in hosts_info
+        }
+        remaining = sum(
+            s for hn, s in slots.items() if hn not in hosts
+        )
+        if remaining < self._min_np:
+            if not self._quarantine_capacity_warned:
+                self._quarantine_capacity_warned = True
+                _log.warning(
+                    "straggler quarantine of %s would drop capacity to "
+                    "%d (< min_np=%d); keeping the slow host(s)",
+                    ",".join(hosts), remaining, self._min_np,
+                )
+            return None
+        from ..common.metrics import registry as _metrics
+
+        for hostname in hosts:
+            self.host_manager.blacklist(hostname)
+            _metrics.counter("driver.quarantined_hosts")
+        _log.warning(
+            "quarantining straggler host(s) %s (ranks %s flagged for "
+            "%d consecutive polls); restarting gang without them",
+            ",".join(hosts), ",".join(map(str, ranks)),
+            self._quarantine_polls,
+        )
+        return (
+            f"straggler quarantine: hosts {','.join(hosts)} "
+            f"(ranks {','.join(map(str, ranks))})"
+        )
 
     def _reset(self, reason: str) -> bool:
         """Bump epoch and clear the assignment so the loop relaunches.
@@ -407,7 +490,11 @@ class ElasticDriver:
             )
             return False
         _log.info("gang reset #%d: %s", self._resets, reason)
+        from ..common.metrics import registry as _metrics
+
+        _metrics.counter("driver.gang_restarts")
         self._epoch += 1
+        _metrics.gauge("driver.epoch", self._epoch)
         with self._lock:
             self._assignment = None
             self._procs = []

@@ -51,7 +51,11 @@ class WorkerNotificationManager:
             if self._service is not None:
                 return
             from ..common import config as config_mod
+            from ..runner import rendezvous as _rdv
 
+            # a previous teardown may have latched the KV-poll abort;
+            # this process is (re)joining a gang, so re-arm the pollers
+            _rdv.reset_poll_shutdown()
             cfg = config_mod.Config.from_env()
             if not (
                 cfg.rendezvous_addr
@@ -92,9 +96,15 @@ class WorkerNotificationManager:
 
             def _beat():
                 from ..common import telemetry as _telemetry
+                from ..testing import chaos as _chaos
 
                 while not stop.is_set():
                     try:
+                        # ``heartbeat`` injection site: a delayed or
+                        # dropped stamp must read as ONE late beat (the
+                        # KV client's RetryPolicy underneath absorbs
+                        # transport flakes), never kill the thread
+                        _chaos.inject("heartbeat")
                         # piggyback the straggler ledger: this worker's
                         # last step id + ring p50 ride the liveness
                         # stamp, so the driver can tell slow from
@@ -132,6 +142,12 @@ class WorkerNotificationManager:
             if self._service is not None:
                 self._service.stop()
                 self._service = None
+        # abort any KV poll loop still in flight (broadcast/allgather
+        # waits): a worker tearing down must not spin against the
+        # driver's KV until its timeout expires
+        from ..runner import rendezvous as _rdv
+
+        _rdv.request_poll_shutdown()
 
 
 notification_manager = WorkerNotificationManager()

@@ -487,6 +487,21 @@ class FusionManager:
     def flush(self) -> None:
         if not self.pending:
             return
+        # ``fusion.dispatch`` injection site: a transport-shaped fault
+        # here models a peer dying under a collective. It surfaces as
+        # HorovodInternalError — the exception the elastic contract
+        # (hvd.elastic.run -> state.restore) is built to absorb — so
+        # chaos tests can drive the rollback path deterministically.
+        from ..testing import chaos as _chaos
+
+        try:
+            _chaos.inject("fusion.dispatch")
+        except (
+            ConnectionResetError, TimeoutError, _chaos.InjectedServerError
+        ) as e:
+            from ..common.basics import HorovodInternalError
+
+            raise HorovodInternalError(str(e)) from e
         t0 = time.monotonic()
         entries, self.pending = self.pending, []
         flushed_bytes, self.pending_bytes = self.pending_bytes, 0

@@ -54,6 +54,15 @@ class PreemptionHandler:
 
     def _handle(self, signum, frame) -> None:
         self._event.set()
+        # Unstick any KV poll loop first: a preempted worker blocked in
+        # a rendezvous wait() must notice the shutdown at its next poll
+        # instead of spending the grace window spinning on HTTP.
+        try:
+            from .runner import rendezvous as _rdv
+
+            _rdv.request_poll_shutdown()
+        except Exception:
+            pass
         if self._on_preempt is not None:
             self._on_preempt()
         prev = self._previous.get(signum)
@@ -110,6 +119,17 @@ class GracefulShutdown:
                 _telemetry.hub().dump()
             except Exception:
                 pass
+            # ``preemption.drain`` injection site: the deterministic
+            # mid-save kill window — a chaos plan SIGKILLs here to
+            # prove a kill landing between the flight-recorder dump and
+            # the durable persist can never leave a truncated artifact
+            # the restore path later trusts (tests/test_chaos.py).
+            try:
+                from .testing import chaos as _chaos
+
+                _chaos.inject("preemption.drain")
+            except Exception:
+                pass  # injected transport faults don't fit this site
             # Prefer the unconditional durable path: commit() may batch
             # (save_interval) or raise HostsUpdatedInterrupt before the
             # write — either loses the grace window's whole purpose.

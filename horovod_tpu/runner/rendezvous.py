@@ -33,6 +33,8 @@ from typing import Dict, List, Optional
 
 from .secret import sign
 from ..common.logging import TRACE as _TRACE, get_logger
+from ..common.retry import RetryPolicy, backoff_delays
+from ..testing import chaos as _chaos
 
 _log = get_logger("rendezvous")
 
@@ -92,7 +94,30 @@ def _make_handler(store: KVStore, secret_key: Optional[bytes]):
             self.end_headers()
             self.wfile.write(body)
 
+        def _inject_chaos(self) -> bool:
+            """``kv.server`` injection site. Returns True when the
+            request was consumed by a fault (503 answered, or the
+            connection torn down mid-exchange). (Named so it cannot
+            shadow the module's ``_chaos`` import inside the class.)"""
+            try:
+                _chaos.inject("kv.server")
+            except _chaos.InjectedServerError:
+                self._reply(503)
+                return True
+            except (ConnectionResetError, TimeoutError):
+                # abrupt teardown: the client sees a dropped/short
+                # response and must absorb it with its RetryPolicy
+                self.close_connection = True
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return True
+            return False
+
         def do_GET(self):
+            if self._inject_chaos():
+                return
             if not self._authed(b""):
                 return self._reply(403)
             parts = self.path.strip("/").split("/")
@@ -108,6 +133,8 @@ def _make_handler(store: KVStore, secret_key: Optional[bytes]):
             return self._reply(404)
 
         def do_PUT(self):
+            if self._inject_chaos():
+                return
             body = self._body()
             if not self._authed(body):
                 return self._reply(403)
@@ -201,18 +228,38 @@ class RendezvousServer:
 
 
 class RendezvousClient:
-    """Worker-side accessor for the driver's KV store."""
+    """Worker-side accessor for the driver's KV store.
+
+    Every HTTP exchange runs under the shared ``RetryPolicy`` (site
+    ``kv.request``): transient connection resets / timeouts / 5xx are
+    absorbed with jittered backoff, a dead driver trips the per-peer
+    circuit breaker so callers fail fast instead of stalling the gang,
+    and every absorbed flake is a ``retry.kv.request.*`` counter on
+    ``/metrics``. All KV verbs are idempotent (GET, last-write-wins
+    PUT, scope DELETE), so re-sending after an ambiguous failure is
+    safe by construction."""
+
+    # polling backoff cap for wait(): a worker parked on a slow key
+    # settles at ~1 req/s instead of 20/s (12k hits per worker over a
+    # 600s start_timeout was the pre-retry behavior)
+    WAIT_BACKOFF_CAP_S = 1.0
 
     def __init__(
-        self, addr: str, port: int, secret_key: Optional[bytes] = None
+        self,
+        addr: str,
+        port: int,
+        secret_key: Optional[bytes] = None,
+        retry: Optional[RetryPolicy] = None,
     ) -> None:
         self._base = f"http://{addr}:{port}"
         self._secret_key = secret_key
+        self._retry = retry or RetryPolicy.from_env("kv.request")
 
-    def _request(self, method: str, path: str, body: bytes = b""):
+    def _request_once(self, method: str, path: str, body: bytes = b""):
         import urllib.error
         import urllib.request
 
+        _chaos.inject("kv.request")
         req = urllib.request.Request(
             self._base + path, data=body if method == "PUT" else None,
             method=method,
@@ -225,10 +272,23 @@ class RendezvousClient:
                 ).hex(),
             )
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self._retry.attempt_timeout_s
+            ) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
+            if e.code == 429 or 500 <= e.code <= 599:
+                raise  # transient server-side failure: retryable
             return e.code, b""
+
+    def _request(self, method: str, path: str, body: bytes = b""):
+        """One KV exchange under the retry policy. Raises
+        ``RetryError`` (a ``ConnectionError``) on exhaustion and
+        ``CircuitOpenError`` once the driver's endpoint is known-dead —
+        both land in the callers' existing ``except OSError`` paths."""
+        return self._retry.call(
+            self._request_once, method, path, body, peer=self._base
+        )
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         status, _ = self._request("PUT", f"/kv/{scope}/{key}", value)
@@ -240,25 +300,71 @@ class RendezvousClient:
         return body if status == 200 else None
 
     def wait(
-        self, scope: str, key: str, timeout: float = 30.0, interval: float = 0.05
+        self,
+        scope: str,
+        key: str,
+        timeout: float = 30.0,
+        interval: Optional[float] = None,
+        should_stop=None,
     ) -> bytes:
-        """Poll until the key appears — the worker-side rendezvous loop."""
+        """Poll until the key appears — the worker-side rendezvous loop.
+
+        The poll interval follows the shared jittered-doubling backoff
+        (``interval`` seeds it, default 0.05s, capped at ~1s), so a
+        worker parked behind a 600s ``start_timeout`` costs the driver
+        ~O(600) requests instead of ~12k. ``should_stop`` (a callable)
+        aborts the wait early — the elastic worker passes its shutdown
+        event so a driver teardown doesn't leave pollers spinning to
+        their full deadline; a tripped KV circuit (driver gone) aborts
+        it the same way."""
         import time
 
         deadline = time.monotonic() + timeout
+        delays = backoff_delays(
+            0.05 if interval is None else float(interval),
+            self.WAIT_BACKOFF_CAP_S,
+        )
         while True:
+            # shutdown first: a latched abort must not pay one more
+            # KV exchange (against a hung driver that is a full retry
+            # ladder of the preemption grace window)
+            if should_stop is not None and should_stop():
+                raise RuntimeError(
+                    f"rendezvous wait for {scope}/{key} aborted: "
+                    f"shutdown requested"
+                )
+            # per-POLL injection site (a plan can flake iteration N of
+            # a long wait, not just the call as a whole)
+            _chaos.inject("kv.wait")
             value = self.get(scope, key)
             if value is not None:
                 return value
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if now >= deadline:
                 raise TimeoutError(
                     f"rendezvous key {scope}/{key} not published in {timeout}s"
                 )
-            time.sleep(interval)
+            time.sleep(min(next(delays), deadline - now))
 
     def keys(self, scope: str) -> List[str]:
         status, body = self._request("GET", f"/scope/{scope}")
         return json.loads(body) if status == 200 else []
+
+
+# Worker-side shutdown latch: once set (elastic worker teardown, or the
+# preemption handler's SIGTERM), every in-flight KV poll loop aborts at
+# its next iteration instead of spinning to its full deadline — a dying
+# process must not keep hammering the driver's KV for up to 600s.
+_poll_shutdown = threading.Event()
+
+
+def request_poll_shutdown() -> None:
+    _poll_shutdown.set()
+
+
+def reset_poll_shutdown() -> None:
+    """Re-arm after an elastic re-init (the process lives on)."""
+    _poll_shutdown.clear()
 
 
 _broadcast_counts: Dict[str, int] = {}
@@ -300,7 +406,8 @@ def broadcast_via_kv(obj, root_rank: int = 0, name: Optional[str] = None):
         client.put("broadcast", name, pickle.dumps(obj))
         return obj
     payload = client.wait(
-        "broadcast", name, timeout=cfg.gloo_timeout_seconds
+        "broadcast", name, timeout=cfg.gloo_timeout_seconds,
+        should_stop=_poll_shutdown.is_set,
     )
     return pickle.loads(payload)
 
@@ -432,6 +539,7 @@ def check_version_consistency(cfg, topology, log=None) -> None:
         raw = client.wait(
             scope, "0",
             timeout=min(30.0, float(cfg.gloo_timeout_seconds)),
+            should_stop=_poll_shutdown.is_set,
         )
     except TimeoutError:
         if log is not None:
@@ -485,7 +593,8 @@ def allgather_via_kv(obj, name: Optional[str] = None):
     for r in range(topo.cross_size):
         lead = r * topo.local_size
         payload = client.wait(
-            scope, str(lead), timeout=cfg.gloo_timeout_seconds
+            scope, str(lead), timeout=cfg.gloo_timeout_seconds,
+            should_stop=_poll_shutdown.is_set,
         )
         # One entry PER RANK (size, not cross_size): each controller
         # speaks for local_size ranks, so its payload repeats — the

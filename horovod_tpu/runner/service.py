@@ -23,6 +23,8 @@ import threading
 from typing import Any, Callable, Dict, Optional
 
 from .secret import DIGEST_BYTES, sign, verify
+from ..common.retry import RetryPolicy
+from ..testing import chaos as _chaos
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -69,6 +71,24 @@ class BasicService:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
+                try:
+                    # ``service.server`` injection site: reset/timeout
+                    # tear the connection down before the frame is read
+                    # (the client's RetryPolicy must absorb it); a 5xx
+                    # is answered as a structured transient error below.
+                    _chaos.inject("service.server")
+                except _chaos.InjectedServerError as e:
+                    try:
+                        request = _recv_frame(self.request, outer._key)
+                        _send_frame(
+                            self.request, outer._key,
+                            {"ok": False, "error": str(e), "retryable": True},
+                        )
+                    except (PermissionError, ValueError, ConnectionError):
+                        pass
+                    return
+                except (ConnectionResetError, TimeoutError):
+                    return  # abrupt close: client sees a dropped frame
                 try:
                     request = _recv_frame(self.request, outer._key)
                 except (PermissionError, ValueError, ConnectionError):
@@ -123,19 +143,52 @@ class BasicService:
 
 class BasicClient:
     """One-request-per-connection client, mirroring the reference's
-    ``network.BasicClient`` [V]."""
+    ``network.BasicClient`` [V].
+
+    Requests run under the shared ``RetryPolicy`` (site
+    ``service.client``): connection resets, timeouts, and transient
+    server errors (a response carrying ``retryable: true``) are
+    re-sent with jittered backoff; a peer whose rounds keep exhausting
+    trips the circuit breaker and subsequent requests fail fast with
+    ``CircuitOpenError``. Callers must only send idempotent requests
+    through this client — every service in the repo (notifications,
+    heartbeats, shutdown pings) is."""
 
     def __init__(
-        self, addr: str, port: int, secret_key: bytes, timeout: float = 30.0
+        self,
+        addr: str,
+        port: int,
+        secret_key: bytes,
+        timeout: float = 30.0,
+        retry: Optional[RetryPolicy] = None,
     ) -> None:
         self._addr = addr
         self._port = port
         self._key = secret_key
         self._timeout = timeout
+        self._retry = retry or RetryPolicy.from_env(
+            "service.client", attempt_timeout_s=timeout
+        )
 
-    def request(self, obj: dict) -> dict:
+    def _request_once(self, obj: dict) -> dict:
+        _chaos.inject("service.client")
         with socket.create_connection(
             (self._addr, self._port), timeout=self._timeout
         ) as sock:
             _send_frame(sock, self._key, obj)
-            return _recv_frame(sock, self._key)
+            response = _recv_frame(sock, self._key)
+        if isinstance(response, dict) and response.get("retryable"):
+            raise _TransientServiceError(response.get("error", "transient"))
+        return response
+
+    def request(self, obj: dict) -> dict:
+        return self._retry.call(
+            self._request_once, obj, peer=f"{self._addr}:{self._port}"
+        )
+
+
+class _TransientServiceError(ConnectionError):
+    """A structured 'try again' from the server (``retryable: true`` in
+    the response) — the RPC analog of an HTTP 503."""
+
+    retryable = True

@@ -1,0 +1,225 @@
+"""Chaos smoke gate (ci.sh): the control plane survives its own medicine.
+
+Runs a short multi-process elastic job under a seeded ``FaultPlan``:
+
+* every worker's FIRST rendezvous-KV request eats an injected
+  connection reset (``kv.request@1:reset``) and must absorb it through
+  the shared ``RetryPolicy``;
+* ONE worker (local rank 0 of the ``127.0.0.1`` "host") SIGKILLs
+  itself at training step 3 of epoch 0 (``train.step@3:kill``), so the
+  driver must blacklist that host and gang-restart the 8-worker job
+  down to 6;
+* the restarted gang completes, and rank 0 of the final epoch serves
+  ``/metrics`` so this gate asserts — over the live scrape endpoint —
+  nonzero ``hvd_retry_*`` counters and ``hvd_faults_injected`` >= 1.
+
+Asserts: driver exit code 0, EXACTLY one gang restart (8 -> 6), the
+expected per-epoch result files, and the scraped counters. Exit 0 on
+success; any assertion failure is a CI failure.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+# runnable as `python scripts/chaos_smoke.py` from the repo root
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+WORKER = """\
+import json, os, sys, time
+sys.path.insert(0, os.getcwd())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+rank = int(os.environ["HOROVOD_RANK"])
+epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+host = os.environ.get("HOROVOD_HOSTNAME", "")
+workdir = os.environ["CHAOS_SMOKE_DIR"]
+
+from horovod_tpu.common import telemetry
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.metrics import registry
+from horovod_tpu.runner.rendezvous import _client_from_cfg
+from horovod_tpu.testing import chaos
+
+# exactly ONE victim: per-slot placement makes every process its own
+# "host" (local_rank 0), so the 127.0.0.1 workers elect the victim
+# through an exclusive lock file instead
+victim = False
+if epoch == 0 and host == "127.0.0.1":
+    try:
+        fd = os.open(
+            os.path.join(workdir, "victim.lock"),
+            os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+        )
+        os.close(fd)
+        victim = True
+    except FileExistsError:
+        pass
+if victim:
+    # the victim: same seeded plan PLUS a mid-run SIGKILL at step 3.
+    # It holds its fire until every sibling has written its epoch-0
+    # result, so the driver's gang-reap after the kill can never race
+    # the survivors' dumps (8 concurrent interpreter starts skew by
+    # seconds on a loaded CI box).
+    chaos.configure("seed=11;kv.request@1:reset;train.step@3:kill")
+    world = int(os.environ["HOROVOD_SIZE"])
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        done = [
+            n for n in os.listdir(workdir) if n.startswith("result.e0.")
+        ]
+        if len(done) >= world - 1:
+            break
+        time.sleep(0.05)
+else:
+    assert chaos.active() is not None, "fault plan env did not load"
+
+cfg = Config.from_env()
+client = _client_from_cfg(cfg)
+# rendezvous traffic: hit 1 eats the injected reset; RetryPolicy absorbs
+client.put("smoke", str(rank), b"hello")
+assert client.get("smoke", str(rank)) == b"hello"
+
+hub = telemetry.hub()
+for step in range(5):
+    hub.step_begin(step)
+    chaos.inject("train.step")  # the victim dies here at step 3
+    time.sleep(0.02)            # "training"
+    hub.step_end()
+
+out = os.path.join(workdir, f"result.e{epoch}.r{rank}.json")
+with open(out + ".tmp", "w") as f:
+    json.dump(
+        {"epoch": epoch, "rank": rank, "metrics": registry.snapshot()}, f
+    )
+os.replace(out + ".tmp", out)
+
+if epoch >= 1 and rank == 0:
+    # serve the live scrape endpoint until the gate has read it
+    server = telemetry.MetricsServer(port=0)
+    port = server.start()
+    port_file = os.path.join(workdir, "scrape_port")
+    with open(port_file + ".tmp", "w") as f:
+        f.write(str(port))
+    os.replace(port_file + ".tmp", port_file)
+    ack = os.path.join(workdir, "scraped.ok")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not os.path.exists(ack):
+        time.sleep(0.1)
+if epoch == 0:
+    time.sleep(120)  # park; the gang restart reaps us
+sys.exit(0)
+"""
+
+
+def _prom_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    raise AssertionError(f"metric {name} not in scrape:\n{text[:600]}")
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="hvd-chaos-smoke-")
+    script = os.path.join(workdir, "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+
+    os.environ.pop("XLA_FLAGS", None)
+    os.environ.pop("JAX_PLATFORMS", None)
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["HOROVOD_STRAGGLER_QUARANTINE_POLLS"] = "3"
+
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import HostInfo
+
+    driver = ElasticDriver(
+        FixedHosts([HostInfo("127.0.0.1", 2), HostInfo("localhost", 6)]),
+        [sys.executable, script],
+        min_np=1,
+        discovery_interval=0.2,
+        # CHAOS_SMOKE_LOGS=1 keeps per-rank worker logs for debugging
+        output_filename=(
+            os.path.join(workdir, "logs")
+            if os.environ.get("CHAOS_SMOKE_LOGS")
+            else None
+        ),
+        extra_env={
+            "CHAOS_SMOKE_DIR": workdir,
+            # the seeded plan: one KV reset per process, absorbed
+            "HOROVOD_FAULT_PLAN": "seed=11;kv.request@1:reset",
+            "HOROVOD_RETRY_BACKOFF_MS": "10",
+        },
+    )
+    result = {}
+    try:
+        driver.host_manager.refresh()
+        t = threading.Thread(target=lambda: result.update(rc=driver.run()))
+        t.start()
+
+        # the post-restart rank 0 publishes its ephemeral scrape port
+        port_file = os.path.join(workdir, "scrape_port")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not os.path.exists(port_file):
+            time.sleep(0.1)
+        assert os.path.exists(port_file), "post-restart gang never served"
+        with open(port_file) as f:
+            port = int(f.read().strip())
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+
+        # the acceptance counters, read over the LIVE endpoint
+        assert _prom_value(text, "hvd_retry_kv_request_attempts") > 0
+        assert _prom_value(text, "hvd_retry_kv_request_retries") > 0, (
+            "no absorbed retries on the scraped worker"
+        )
+        assert _prom_value(text, "hvd_faults_injected") >= 1
+        assert _prom_value(text, "telemetry_step_ms_count") == 5
+
+        # release the serving worker, then collect the driver
+        ack = os.path.join(workdir, "scraped.ok")
+        with open(ack + ".tmp", "w") as f:
+            f.write("ok")
+        os.replace(ack + ".tmp", ack)
+        t.join(timeout=90)
+        assert not t.is_alive(), "driver did not converge"
+    finally:
+        driver.shutdown()
+
+    assert result.get("rc") == 0, f"driver exit {result.get('rc')}"
+    assert driver._resets == 1, (
+        f"expected exactly one gang restart, got {driver._resets}"
+    )
+    assert driver.host_manager.is_blacklisted("127.0.0.1")
+
+    # epoch 0: the victim died at step 3 -> 7 of 8 results; epoch 1:
+    # all 6 surviving slots (the victim's host lost BOTH) completed
+    e0 = [n for n in os.listdir(workdir) if n.startswith("result.e0.")]
+    e1 = [n for n in os.listdir(workdir) if n.startswith("result.e1.")]
+    assert len(e0) == 7, e0
+    assert len(e1) == 6, e1
+    # every surviving worker absorbed its injected KV reset
+    for name in e0 + e1:
+        with open(os.path.join(workdir, name)) as f:
+            snap = json.load(f)["metrics"]
+        assert snap.get("retry.kv.request.retries", 0) > 0, name
+        assert snap.get("faults_injected", 0) >= 1, name
+
+    print(
+        f"chaos-smoke OK: 1 gang restart (8->6), "
+        f"{len(e0) + len(e1)} workers absorbed their KV flake, "
+        f"scrape port {port}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

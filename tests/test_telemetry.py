@@ -793,7 +793,9 @@ class TestStallMetricsAndStragglers:
             driver = ElasticDriver(_Disc(), ["true"], min_np=1)
             driver._server = server
             driver._last_hb_poll = -1e9
-            assert driver._poll_heartbeats(time.monotonic()) is False
+            # no restart reason: stragglers are flagged but one poll is
+            # under the quarantine hysteresis (K consecutive polls)
+            assert driver._poll_heartbeats(time.monotonic()) is None
             assert driver.stall_inspector.straggler_ranks() == [2]
             stats = driver.stall_inspector.heartbeat_stats()
             assert stats[2]["step_ms_p50"] == 95.0
