@@ -15,13 +15,19 @@
 #                    endpoint on an ephemeral port: Prometheus scrape
 #                    (step p50/p95 + registry gauges) and the
 #                    flight-recorder JSON-lines dump must both work
-#   6. chaos-smoke — scripts/chaos_smoke.py: a short multi-process
-#                    elastic job under a seeded FaultPlan (one KV
-#                    connection reset per worker + one mid-run worker
-#                    SIGKILL) must complete with exactly one gang
-#                    restart and nonzero retry.* counters scraped
-#                    from the live /metrics endpoint — the chaos
-#                    hardening can't silently rot
+#   6. chaos-smoke — scripts/chaos_smoke.py: an integrity drill (one
+#                    injected NaN training step that the grad guard
+#                    must SKIP and count, one injected checkpoint
+#                    bitflip that digest verification must bypass via
+#                    fallback restore, both asserted over the live
+#                    /metrics scrape) followed by a short
+#                    multi-process elastic job under a seeded
+#                    FaultPlan (one KV connection reset per worker +
+#                    one mid-run worker SIGKILL) that must complete
+#                    with exactly one gang restart and nonzero
+#                    retry.* counters scraped from the live /metrics
+#                    endpoint — neither the chaos hardening nor the
+#                    integrity plane can silently rot
 #
 # Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|chaos-smoke|all]
 # (default: all)
@@ -101,7 +107,7 @@ telemetry_smoke() {
 }
 
 chaos_smoke() {
-  step "chaos-smoke: seeded FaultPlan gang drill (KV reset + SIGKILL)"
+  step "chaos-smoke: integrity drill (NaN skip + ckpt bitflip) + seeded FaultPlan gang drill (KV reset + SIGKILL)"
   python scripts/chaos_smoke.py
 }
 

@@ -140,6 +140,15 @@ from .common.telemetry import (  # noqa: F401
     step_begin,
     step_end,
 )
+from .common.guard import (  # noqa: F401  (non-finite sentinel)
+    check as guard_check,
+    status as guard_status,
+)
+from .audit import (  # noqa: F401  (cross-rank parameter audit)
+    audit,
+    maybe_audit,
+    tree_digest,
+)
 
 
 def __getattr__(name):

@@ -1,0 +1,210 @@
+"""Cross-rank parameter audit: prove the replicas agree.
+
+The Horovod premise is that data-parallel replicas stay BIT-IDENTICAL
+after every allreduce (arXiv 1802.05799) — the whole stack (fused
+wire, EF residuals, elastic restore, ZeRO reshard) is built on it, and
+nothing so far ever *verified* it. A replica that diverges (memory
+corruption, a non-deterministic kernel, a desynced RNG stream, a
+checkpoint restored on one host but not another) keeps training
+quietly wrong forever: every rank's loss looks plausible, and the
+collectives happily average garbage with gold.
+
+This module is the verification plane:
+
+* :func:`tree_digest` — a canonical SHA-256 over a pytree (structure +
+  per-leaf dtype/shape/bytes), cheap enough to run every few hundred
+  steps on host copies.
+* :func:`audit` — digest the tree, stamp ``audit.last_digest_step`` /
+  ``audit.digests`` metrics, and — when running under the elastic
+  runner — publish ``{step, digest}`` to the rendezvous KV
+  (``runner/rendezvous.py`` ``put_audit``), where the driver compares
+  the gang's digests.
+* :func:`maybe_audit` — the rate-limited form: runs every
+  ``HOROVOD_AUDIT_STEPS`` steps (0 = off), so a training loop can call
+  it unconditionally per step.
+* :func:`find_divergent` — the driver-side comparison: for the newest
+  step reported by at least two ranks, the majority digest wins (ties
+  break toward the LOWEST rank — the same root-wins arbitration as
+  ``ObjectState.sync``); ranks holding any other digest are divergent.
+  ``ElasticDriver`` quarantines their hosts and gang-restarts with
+  reason ``divergence`` — the restore re-replicates state from the
+  root, which IS the repair.
+
+Single-controller jobs have one process speaking for every rank, so a
+cross-rank mismatch cannot arise there; the audit still stamps its
+metrics (so drills can assert cadence) and the driver-side comparison
+is exercised by multi-process elastic jobs and by tests driving
+``find_divergent`` directly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .common.logging import get_logger
+
+_log = get_logger("audit")
+
+_lock = threading.Lock()
+_kv_client = None  # cached RendezvousClient (None until first publish)
+_kv_unavailable = False
+
+
+def digest_host_leaves(treedef, host_leaves) -> str:
+    """The hashing core of :func:`tree_digest`, over already-fetched
+    host leaves — split out so the checkpoint manager can pay the
+    (donation-safe) device→host copy synchronously but run the SHA-256
+    on a background thread."""
+    h = hashlib.sha256(str(treedef).encode())
+    for leaf in host_leaves:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def tree_digest(tree: Any) -> str:
+    """Canonical SHA-256 of a pytree: the treedef string, then each
+    leaf's dtype, shape, and raw bytes (host-fetched; device arrays
+    are pulled once per call — run this at an audit cadence, not per
+    step). Scalars/np/jax arrays all normalize through ``np.asarray``,
+    so a restored-from-checkpoint tree and its live twin digest
+    identically when (and only when) they are bit-identical."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return digest_host_leaves(treedef, jax.device_get(leaves))
+
+
+def tree_meta_digest(tree: Any) -> str:
+    """SHA-256 of a pytree's SHAPE ONLY — treedef + per-leaf
+    dtype/shape, no values, no device transfer. Two trees share a meta
+    digest exactly when :func:`tree_digest` could meaningfully compare
+    them; the checkpoint verifier uses it to tell 'the caller restored
+    with a different dtype/structure on purpose' (verification
+    inapplicable) apart from 'the bytes changed under the same shape'
+    (corruption)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    h = hashlib.sha256(str(treedef).encode())
+    for leaf in leaves:
+        if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+            dt, shape = np.dtype(leaf.dtype), tuple(leaf.shape)
+        else:
+            a = np.asarray(leaf)
+            dt, shape = a.dtype, a.shape
+        h.update(str(dt).encode())
+        h.update(str(tuple(shape)).encode())
+    return h.hexdigest()
+
+
+def _publish(rank: int, step: int, digest: str) -> bool:
+    """Best-effort KV publication; False when there is no rendezvous
+    to publish to (single-process runs, tests)."""
+    global _kv_client, _kv_unavailable
+    from .common.config import Config
+    from .runner.rendezvous import _client_from_cfg, put_audit
+
+    with _lock:
+        if _kv_unavailable:
+            return False
+        if _kv_client is None:
+            cfg = Config.from_env()
+            if not (cfg.rendezvous_addr and cfg.rendezvous_port):
+                _kv_unavailable = True
+                return False
+            _kv_client = _client_from_cfg(cfg)
+        client = _kv_client
+    try:
+        put_audit(client, rank, step, digest)
+        return True
+    except Exception:
+        _log.debug("audit publish failed", exc_info=True)
+        return False
+
+
+def _reset_client() -> None:
+    """Test hook / elastic reinit: drop the cached KV client so the
+    next publish re-reads the (new gang's) rendezvous env."""
+    global _kv_client, _kv_unavailable
+    with _lock:
+        _kv_client = None
+        _kv_unavailable = False
+
+
+def audit(tree: Any, step: int = 0, rank: Optional[int] = None) -> str:
+    """``hvd.audit(params, step=...)`` — digest ``tree``, record the
+    ``audit.*`` metrics, publish to the gang's rendezvous KV when one
+    is configured. Returns the hex digest (callers can log or compare
+    it themselves)."""
+    from .common import basics
+    from .common.metrics import registry as _metrics
+
+    digest = tree_digest(tree)
+    step = int(step)
+    if rank is None:
+        rank = basics.rank() if basics.is_initialized() else 0
+    _metrics.counter("audit.digests")
+    _metrics.gauge("audit.last_digest_step", step)
+    _publish(int(rank), step, digest)
+    _log.debug("audit step %d: %s", step, digest[:16])
+    return digest
+
+
+def default_audit_steps() -> int:
+    from .common import basics
+
+    return int(basics.live_config().audit_steps)
+
+
+def maybe_audit(
+    tree: Any, step: int, every: Optional[int] = None,
+    rank: Optional[int] = None,
+) -> Optional[str]:
+    """Rate-limited :func:`audit`: runs when ``step`` lands on the
+    ``HOROVOD_AUDIT_STEPS`` cadence (``every`` overrides; 0 = never).
+    Call it unconditionally once per host-side step."""
+    every = default_audit_steps() if every is None else int(every)
+    if every <= 0 or int(step) % every != 0:
+        return None
+    return audit(tree, step=step, rank=rank)
+
+
+def find_divergent(
+    digests: Dict[int, dict],
+) -> Optional[Tuple[int, Tuple[int, ...]]]:
+    """Driver-side comparison over ``{rank: {"step", "digest"}}`` (the
+    shape ``read_audit_digests`` returns). Looks at the NEWEST step
+    reported by >= 2 ranks; if their digests disagree, returns
+    ``(step, divergent_ranks)`` where the majority digest wins and a
+    tie breaks toward the lowest-rank holder (root-wins, matching the
+    elastic ``sync()`` broadcast direction). ``None`` = no quorum or
+    full agreement."""
+    by_step: Dict[int, Dict[int, str]] = {}
+    for rank, payload in digests.items():
+        try:
+            step = int(payload["step"])
+            digest = str(payload["digest"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        by_step.setdefault(step, {})[int(rank)] = digest
+    for step in sorted(by_step, reverse=True):
+        ranks = by_step[step]
+        if len(ranks) < 2:
+            continue
+        counts: Dict[str, list] = {}
+        for r, d in sorted(ranks.items()):
+            counts.setdefault(d, []).append(r)
+        if len(counts) == 1:
+            return None  # newest quorum step agrees — healthy
+        majority = max(
+            counts.items(), key=lambda kv: (len(kv[1]), -min(kv[1]))
+        )[0]
+        divergent = tuple(
+            sorted(r for d, rs in counts.items() if d != majority for r in rs)
+        )
+        return step, divergent
+    return None

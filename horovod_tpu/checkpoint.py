@@ -20,6 +20,8 @@ provides what the reference papered over, with Horovod's idioms:
 
 from __future__ import annotations
 
+import glob
+import json
 import os
 from typing import Any, Dict, Optional, Tuple
 
@@ -30,6 +32,16 @@ from .common.logging import get_logger
 from .testing import chaos as _chaos
 
 _log = get_logger("checkpoint")
+
+
+class CheckpointStructureError(ValueError):
+    """``restore(like=)`` was handed a tree whose STRUCTURE disagrees
+    with what the checkpoint holds — a deterministic caller bug (wrong
+    state class, renamed field, a sampler registered after old saves),
+    not storage corruption. Raised with the tree-path diff in the
+    message instead of the raw Orbax traceback, and re-raised
+    immediately by ``restore_latest_good`` (falling back through the
+    retention window cannot fix a structure mismatch)."""
 
 
 class CheckpointManager:
@@ -61,16 +73,174 @@ class CheckpointManager:
                 enable_async_checkpointing=async_save,
             ),
         )
+        self._digest_threads: list = []
 
     def save(self, step: int, tree: Any, force: bool = False) -> bool:
         """Queue an async save of ``tree`` at ``step``. Returns whether
-        a save was started (Orbax dedupes repeated steps)."""
+        a save was started (Orbax dedupes repeated steps).
+
+        A content digest (audit.tree_digest over the IN-MEMORY tree) is
+        written beside the step as ``digest-<step>.json``;
+        :meth:`restore_latest_good` re-digests what it restored and
+        treats a mismatch as corruption — so post-commit disk damage
+        that still PARSES (a flipped byte in an array chunk) falls back
+        too, not just unreadable checkpoints."""
         import orbax.checkpoint as ocp
 
-        _chaos.inject("checkpoint.save")
-        return self._mgr.save(
+        chaos_kind = _chaos.inject("checkpoint.save")
+        from .audit import tree_meta_digest
+
+        # The device→host copy happens HERE, synchronously: the caller
+        # may donate these buffers to its next step the moment save()
+        # returns (the same reason Orbax's async save copies before
+        # returning). The SHA-256 over the host bytes — the CPU-heavy
+        # half — runs on a background thread joined by
+        # wait_until_finished(), so the training loop does not stall
+        # on hashing a multi-GB tree.
+        digestible = _fully_addressable(tree)
+        if digestible:
+            meta = tree_meta_digest(tree)
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            host_leaves = jax.device_get(leaves)
+        saved = self._mgr.save(
             step, args=ocp.args.StandardSave(tree), force=force
         )
+        if saved and digestible:
+            import threading
+
+            # drop finished threads so a long async job's list stays
+            # at in-flight size, not one entry per commit forever
+            self._digest_threads = [
+                t for t in self._digest_threads if t.is_alive()
+            ]
+            t = threading.Thread(
+                target=self._hash_and_write,
+                args=(step, treedef, host_leaves, meta),
+                daemon=True,
+            )
+            t.start()
+            self._digest_threads.append(t)
+            self._prune_digests(keep_also=step)
+        if saved and chaos_kind == "bitflip":
+            # corruption drill: land the commit, then flip one byte of
+            # a committed artifact — exactly the damage the digest
+            # verification exists to catch
+            self.wait_until_finished()
+            self._bitflip_step(step)
+        return saved
+
+    # ---------------------------------------------- digest sidecars
+
+    def _digest_path(self, step: int) -> str:
+        return os.path.join(self._dir, f"digest-{int(step)}.json")
+
+    def _hash_and_write(self, step, treedef, host_leaves, meta) -> None:
+        from .audit import digest_host_leaves
+
+        try:
+            self._write_digest(
+                step, digest_host_leaves(treedef, host_leaves), meta
+            )
+        except Exception:
+            _log.warning("digest sidecar write failed", exc_info=True)
+
+    def _write_digest(self, step: int, digest: str, meta: str) -> None:
+        path = self._digest_path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"step": int(step), "digest": digest, "meta": meta}, f
+            )
+        os.replace(tmp, path)
+
+    def _read_digest(self, step: int) -> Optional[dict]:
+        try:
+            with open(self._digest_path(step)) as f:
+                info = json.load(f)
+            return info if "digest" in info else None
+        except (OSError, ValueError):
+            return None
+
+    def _prune_digests(self, keep_also: Optional[int] = None) -> None:
+        """Drop sidecars for steps outside the retention window (the
+        async save may not list ``keep_also`` yet — always keep it)."""
+        keep = set(int(s) for s in self.all_steps())
+        if keep_also is not None:
+            keep.add(int(keep_also))
+        for path in glob.glob(os.path.join(self._dir, "digest-*.json")):
+            try:
+                step = int(
+                    os.path.basename(path)[len("digest-"): -len(".json")]
+                )
+            except ValueError:
+                continue
+            if step not in keep:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def _bitflip_step(self, step: int) -> None:
+        """Chaos helper: flip one byte in the largest artifact of a
+        COMMITTED step directory (post-commit damage — the atomic
+        marker cannot guard it; only content verification can)."""
+        step_dir = os.path.join(self._dir, str(int(step)))
+        candidates = [
+            p
+            for p in glob.glob(os.path.join(step_dir, "**"), recursive=True)
+            if os.path.isfile(p) and os.path.getsize(p) > 0
+        ]
+        if not candidates:
+            return
+        # prefer ARRAY DATA (ocdbt `d/` payload files) over metadata:
+        # metadata damage fails the parse outright (the easy case);
+        # payload damage is what the content digest exists to catch
+        data = [
+            p for p in candidates
+            if os.path.basename(os.path.dirname(p)) == "d"
+        ]
+        target = max(data or candidates, key=os.path.getsize)
+        with open(target, "r+b") as f:
+            f.seek(os.path.getsize(target) // 2)
+            byte = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        _log.warning("chaos: flipped one byte of %s", target)
+
+    def _verify_digest(self, step: int, restored: Any) -> None:
+        """Compare the restored tree against the save-time digest; no
+        sidecar (pre-digest checkpoints) verifies vacuously, and so
+        does a restore whose META digest (structure/dtype/shape)
+        differs from the saved one — the caller restored through a
+        re-typed ``like`` (e.g. bf16 over an fp32 checkpoint) ON
+        PURPOSE, and re-hashing casted bytes would misread every
+        retained checkpoint as corrupt."""
+        info = self._read_digest(step)
+        if info is None:
+            return
+        if not _fully_addressable(restored):
+            return  # multi-controller restore: cannot hash globally
+        expect = str(info["digest"])
+        from .audit import tree_digest, tree_meta_digest
+
+        saved_meta = info.get("meta")
+        if saved_meta and tree_meta_digest(restored) != saved_meta:
+            _log.debug(
+                "checkpoint step %d restored with a different "
+                "dtype/structure than saved; digest verification "
+                "skipped", step,
+            )
+            return
+        actual = tree_digest(restored)
+        if actual != expect:
+            from .common.metrics import registry as _metrics
+
+            _metrics.counter("checkpoint.digest_mismatch")
+            raise RuntimeError(
+                f"checkpoint step {step} digest mismatch: restored "
+                f"{actual[:16]}, saved {expect[:16]} — content damaged "
+                "after commit"
+            )
 
     def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
         """Restore the checkpoint at ``step`` (default: latest). With
@@ -87,10 +257,60 @@ class CheckpointManager:
                 )
         if like is not None:
             target = jax.tree_util.tree_map(_as_restore_spec, like)
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(target)
-            )
+            try:
+                return self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(target)
+                )
+            except Exception as e:
+                diff = self._structure_diff(step, like)
+                if diff:
+                    raise CheckpointStructureError(
+                        f"checkpoint step {step} does not match the "
+                        f"`like` tree's structure: {diff}. This is a "
+                        "caller/state-definition mismatch (not "
+                        "corruption) — restore with the state class "
+                        "that wrote the checkpoint, or migrate it."
+                    ) from e
+                raise
         return self._mgr.restore(step)
+
+    def _structure_diff(self, step: int, like: Any) -> Optional[str]:
+        """Tree-path prefix diff between the checkpoint's metadata and
+        ``like``; None when the structures agree (the failure was
+        something else) or metadata is unavailable."""
+        try:
+            meta = self._mgr.item_metadata(step)
+        except Exception:
+            return None
+        if meta is None:
+            return None
+
+        def _paths(tree) -> set:
+            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+            return {jax.tree_util.keystr(p) for p, _ in flat}
+
+        try:
+            saved, want = _paths(meta), _paths(like)
+        except Exception:
+            return None
+        missing = sorted(want - saved)
+        extra = sorted(saved - want)
+        if not missing and not extra:
+            return None
+        parts = []
+        if missing:
+            parts.append(
+                "expected-but-not-saved "
+                + ", ".join(missing[:8])
+                + ("…" if len(missing) > 8 else "")
+            )
+        if extra:
+            parts.append(
+                "saved-but-not-expected "
+                + ", ".join(extra[:8])
+                + ("…" if len(extra) > 8 else "")
+            )
+        return "; ".join(parts)
 
     def restore_latest_good(
         self, like: Any = None
@@ -100,22 +320,30 @@ class CheckpointManager:
         Walks the retained steps newest-first; a step that fails to
         restore (corrupt array file, half-written metadata — anything
         the atomic-commit marker didn't guard, e.g. post-commit disk
-        damage) is logged, counted as ``checkpoint.fallback``, and
-        skipped in favor of the next older one. Raises
-        ``FileNotFoundError`` when no checkpoints exist, and a
-        ``RuntimeError`` (chained to the last failure) when every
-        retained checkpoint is bad — losing the whole retention window
-        is a real failure the job must surface, not silently train
-        from scratch over, so the all-corrupt case deliberately cannot
-        collide with the fresh-start ``FileNotFoundError`` even when
-        the underlying damage IS a missing file."""
+        damage) OR that restores but fails its saved content digest
+        (corrupt-but-parseable — a flipped byte that still decodes) is
+        logged, counted as ``checkpoint.fallback``, and skipped in
+        favor of the next older one. Raises ``FileNotFoundError`` when
+        no checkpoints exist, ``CheckpointStructureError`` immediately
+        on a ``like``-structure mismatch (deterministic — older
+        checkpoints cannot fix it), and a ``RuntimeError`` (chained to
+        the last failure) when every retained checkpoint is bad —
+        losing the whole retention window is a real failure the job
+        must surface, not silently train from scratch over, so the
+        all-corrupt case deliberately cannot collide with the
+        fresh-start ``FileNotFoundError`` even when the underlying
+        damage IS a missing file."""
         steps = sorted(self.all_steps(), reverse=True)
         if not steps:
             raise FileNotFoundError(f"no checkpoint under {self._dir}")
         last_exc: Optional[BaseException] = None
         for step in steps:
             try:
-                return step, self.restore(step, like=like)
+                restored = self.restore(step, like=like)
+                self._verify_digest(step, restored)
+                return step, restored
+            except CheckpointStructureError:
+                raise
             except Exception as e:  # noqa: BLE001 — any load failure
                 from .common.metrics import registry as _metrics
 
@@ -139,10 +367,13 @@ class CheckpointManager:
         return sorted(self._mgr.all_steps())
 
     def wait_until_finished(self) -> None:
-        """Block until queued async saves are durable — call before
-        letting a preempted VM die (the TPU preemption-notice handler's
-        job)."""
+        """Block until queued async saves — AND their digest-sidecar
+        hashing threads — are durable; call before letting a preempted
+        VM die (the TPU preemption-notice handler's job)."""
         self._mgr.wait_until_finished()
+        threads, self._digest_threads = self._digest_threads, []
+        for t in threads:
+            t.join(timeout=60)
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
@@ -153,6 +384,18 @@ class CheckpointManager:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def _fully_addressable(tree) -> bool:
+    """True when every jax.Array leaf is fully addressable from THIS
+    process. Multi-controller jobs hold arrays spanning processes;
+    ``jax.device_get`` on those raises, so the digest machinery (a
+    per-process whole-tree hash) steps aside and leaves corruption
+    detection to Orbax's own sharded-save handling there."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return False
+    return True
 
 
 def _as_restore_spec(leaf):
@@ -182,7 +425,14 @@ class DurableJaxState(JaxState):
 
     The pytree attributes are saved; plain-object attributes ride along
     pickled into a side leaf only if numpy-representable (scalars/ints),
-    mirroring what JaxState snapshots.
+    mirroring what JaxState snapshots. Data cursors registered via
+    :meth:`~horovod_tpu.elastic.state.JaxState.register_data` are
+    persisted beside the model tree and loaded back by
+    :meth:`resume_latest`, so a full-job restart resumes the sample
+    stream at the exact next global index — exactly-once delivery
+    across the durable boundary, including a world-size change (the
+    cursor is global; the restored sampler re-stripes the remainder
+    over the new replica count).
     """
 
     def __init__(
@@ -207,7 +457,20 @@ class DurableJaxState(JaxState):
             for k, v in self._attrs().items()
             if isinstance(v, (int, float, bool, np.integer, np.floating))
         }
-        return {"trees": tree, "scalars": scalars}
+        out: Dict[str, Any] = {"trees": tree, "scalars": scalars}
+        if self._data:
+            # registered sampler/dataset cursors (epoch + global
+            # position, plain int leaves — the scalar type Orbax's
+            # StandardSave accepts). The key exists only when
+            # something is registered, so unregistered jobs keep
+            # their checkpoint structure byte-for-byte.
+            out["data"] = {
+                name: {
+                    k: int(v) for k, v in obj.state_dict().items()
+                }
+                for name, obj in self._data.items()
+            }
+        return out
 
     def commit(self) -> None:
         super().commit()
@@ -242,6 +505,15 @@ class DurableJaxState(JaxState):
             return False
         for key, value in restored["trees"].items():
             self._trees[key] = self._replicate(value)
+        for name, snap in restored.get("data", {}).items():
+            obj = self._data.get(name)
+            if obj is None:
+                _log.warning(
+                    "checkpoint carries data cursor %r but nothing is "
+                    "registered under that name; skipping", name,
+                )
+                continue
+            obj.load_state_dict({k: int(v) for k, v in snap.items()})
         for key, value in restored["scalars"].items():
             current = getattr(self, key, None)
             if isinstance(current, bool) or isinstance(value, np.bool_):

@@ -154,6 +154,7 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
             wire_block=cfg.fusion_wire_block,
             wire_hier=cfg.fusion_wire_hier,
             wire_min_bytes=cfg.fusion_wire_min_bytes,
+            guard=cfg.guard,
         )
         if cfg.timeline:
             from .timeline import Timeline
@@ -280,6 +281,15 @@ def mesh():
 
 def topology() -> topo_mod.Topology:
     return _require_init().topology
+
+
+def live_config() -> config_mod.Config:
+    """The initialized runtime's config snapshot when there is one,
+    else a fresh env parse — the resolution every config-deferring
+    default (overlap buckets, guard, audit cadence) shares."""
+    if _state.initialized and _state.config is not None:
+        return _state.config
+    return config_mod.Config.from_env()
 
 
 def get_config() -> config_mod.Config:

@@ -38,6 +38,12 @@ DEFAULT_RETRY_ATTEMPT_TIMEOUT_S = 30.0
 DEFAULT_RETRY_CIRCUIT_THRESHOLD = 3
 DEFAULT_RETRY_CIRCUIT_COOLDOWN_S = 30.0
 DEFAULT_STRAGGLER_QUARANTINE_POLLS = 3
+# Training-state integrity plane (common/guard.py, audit.py): the
+# non-finite skip-step guard escalates to HorovodInternalError after
+# this many CONSECUTIVE skipped steps (the elastic restore contract),
+# and the parameter audit runs every N optimizer steps (0 = off).
+DEFAULT_GUARD_MAX_SKIPS = 3
+DEFAULT_AUDIT_STEPS = 0
 
 
 def _env_bool(name: str, default: bool = False) -> bool:
@@ -196,6 +202,23 @@ class Config:
     # observations (proactive gang-restart excluding it); 0 disables
     straggler_quarantine_polls: int = DEFAULT_STRAGGLER_QUARANTINE_POLLS
 
+    # --- training-state integrity (common/guard.py, audit.py) ---
+    # non-finite sentinel: when on, DistributedOptimizer /
+    # ShardedDistributedOptimizer fold a per-bucket finiteness
+    # reduction into the compiled update and SKIP the step (zero
+    # update, optimizer state and EF residuals untouched) when the
+    # reduced gradients carry a NaN/Inf, instead of silently poisoning
+    # every parameter. Explicit grad_guard= per optimizer always wins.
+    guard: bool = False
+    # consecutive skipped steps before the guard escalates to
+    # HorovodInternalError (-> hvd.elastic.run restores the last
+    # commit); 0 = skip forever, never escalate
+    guard_max_skips: int = DEFAULT_GUARD_MAX_SKIPS
+    # cross-rank parameter audit cadence: hvd.audit_maybe(tree, step)
+    # digests every N steps (0 = off). Digest mismatches across ranks
+    # surface through the rendezvous KV as a `divergence` restart.
+    audit_steps: int = DEFAULT_AUDIT_STEPS
+
     # --- logging ---
     log_level: str = "warning"
     log_timestamp: bool = True
@@ -317,6 +340,13 @@ class Config:
             straggler_quarantine_polls=_env_int(
                 "HOROVOD_STRAGGLER_QUARANTINE_POLLS",
                 DEFAULT_STRAGGLER_QUARANTINE_POLLS,
+            ),
+            guard=_env_bool("HOROVOD_GUARD"),
+            guard_max_skips=_env_int(
+                "HOROVOD_GUARD_MAX_SKIPS", DEFAULT_GUARD_MAX_SKIPS
+            ),
+            audit_steps=_env_int(
+                "HOROVOD_AUDIT_STEPS", DEFAULT_AUDIT_STEPS
             ),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_timestamp=_env_bool("HOROVOD_LOG_TIMESTAMP", True),

@@ -1,0 +1,208 @@
+"""Non-finite sentinel: the host half of the GradGuard skip-step plane.
+
+The data plane's silent killer is a single NaN/Inf: one non-finite
+value in a fused gradient bucket poisons every tensor in the batch at
+the next update, and the Horovod contract — bit-identical replicas
+after every allreduce (arXiv 1802.05799) — means the poison is
+perfectly replicated, so nothing ever *disagrees* loudly. The guard
+closes that hole in two halves:
+
+* **In-JIT half** (``ops/traced.finite_scalar`` / ``tree_finite``,
+  folded into ``ops/overlap.bucketed_allreduce`` and the fused eager
+  dispatch): one boolean ``all(isfinite(bucket))`` reduction per
+  bucket, computed on the already-reduced values — a psum's output is
+  replicated, so the flag agrees across ranks with NO extra
+  collective and the skip decision stays inside ``lax.cond`` with no
+  host sync on the healthy path.
+* **Host half** (this module): the skip branch fires a
+  ``jax.debug.callback`` — only when taken, so a healthy run never
+  pays a host transfer — which counts ``guard.nonfinite_steps``,
+  logs, and, after ``HOROVOD_GUARD_MAX_SKIPS`` CONSECUTIVE skips,
+  LATCHES an escalation. The latch is raised as
+  :class:`~horovod_tpu.common.basics.HorovodInternalError` at the
+  next host touchpoint — ``State.commit()`` (so the elastic restore
+  contract fires: ``hvd.elastic.run`` rolls back to the last commit
+  instead of the job skipping forever against a poisoned input) or an
+  explicit :func:`check`. Raising *inside* the callback would surface
+  as ``XlaRuntimeError`` and sail past the elastic wrapper's
+  ``except HorovodInternalError`` — the latch exists because the
+  exception type must survive the device boundary.
+
+Enable with ``HOROVOD_GUARD=1`` fleet-wide or ``grad_guard=True`` per
+optimizer. Skipped steps keep the optimizer state, the step counter
+advance, and the error-feedback residuals of the LAST APPLIED step —
+the quantization-error carry stays coherent with what was actually
+transmitted.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+from .logging import get_logger
+
+_log = get_logger("guard")
+
+# per-optimizer identity for the skip-callback dedup: two guarded
+# optimizers in one process can both be "at step 7", and deduping on
+# the bare step id would silently drop the second one's skip (and its
+# escalation check)
+_source_ids = itertools.count()
+
+
+def new_source() -> int:
+    return next(_source_ids)
+
+
+def default_enabled() -> bool:
+    """The config-driven default for ``grad_guard=None`` optimizers."""
+    from . import basics
+
+    return bool(basics.live_config().guard)
+
+
+def default_max_skips() -> int:
+    from . import basics
+
+    return int(basics.live_config().guard_max_skips)
+
+
+class GradGuard:
+    """Process-wide skip-step ledger (one per process, like the
+    telemetry hub — the guard must survive an elastic reinit so its
+    counters tell the whole job's story)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.nonfinite_steps = 0  # total skipped updates
+        self.max_streak = 0  # worst consecutive run seen
+        self._escalated: Optional[str] = None  # pending escalation msg
+        self._last_step: Optional[tuple] = None  # (source, step) dedup
+
+    def record_skip(
+        self, streak: int, step: int, max_skips: int, source: int = 0
+    ) -> None:
+        """``jax.debug.callback`` target, fired from the SKIP branch of
+        the guarded update only. ``streak`` is the traced consecutive-
+        skip counter carried in the optimizer state (host-side counting
+        can't see the GOOD steps that reset it — they never call back).
+        An update running under the user's ``shard_map`` fires one
+        callback PER SHARD; duplicates are deduped by (optimizer
+        ``source``, step id) — the telemetry tick's trick, with the
+        source added so two guarded optimizers sharing a step count
+        cannot swallow each other's skips — so one skipped step
+        counts once.
+        At the escalation threshold the failure is LATCHED, not raised
+        (module docstring: the exception type must survive the device
+        boundary); :func:`check` / ``State.commit()`` raise it."""
+        streak = int(streak)
+        step = int(step)
+        with self._lock:
+            if self._last_step == (source, step):
+                return
+            self._last_step = (source, step)
+            self.nonfinite_steps += 1
+            self.max_streak = max(self.max_streak, streak)
+        from .metrics import registry as _metrics
+
+        _metrics.counter("guard.nonfinite_steps")
+        _metrics.gauge("guard.skip_streak", streak)
+        _log.warning(
+            "non-finite gradients at step %d: update SKIPPED "
+            "(consecutive skips: %d)", step, streak,
+        )
+        if max_skips > 0 and streak >= max_skips:
+            _log.error(
+                "guard escalation: %d consecutive non-finite steps "
+                "(HOROVOD_GUARD_MAX_SKIPS=%d) — latched for the "
+                "elastic restore contract", streak, max_skips,
+            )
+            with self._lock:
+                self._escalated = (
+                    f"{streak} consecutive non-finite gradient steps "
+                    f"(threshold {max_skips}); training state is "
+                    "suspect — restore from the last commit"
+                )
+
+    def raise_if_escalated(self) -> None:
+        """Host-side escalation point: raises HorovodInternalError when
+        the callback latched past the threshold. Cleared on raise so
+        the retry (post-restore) starts with a clean slate."""
+        with self._lock:
+            msg, self._escalated = self._escalated, None
+        if msg is not None:
+            from .basics import HorovodInternalError
+
+            raise HorovodInternalError(f"grad guard: {msg}")
+
+    def reset(self) -> None:
+        """Clear the streak view and any pending escalation after an
+        elastic restore (the restored state predates the poison, so the
+        streak is moot); cumulative ``nonfinite_steps`` is preserved —
+        it is job history."""
+        with self._lock:
+            self.max_streak = 0
+            self._escalated = None
+            self._last_step = None  # restored step ids may repeat
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "nonfinite_steps": self.nonfinite_steps,
+                "max_streak": self.max_streak,
+                "escalated": self._escalated is not None,
+            }
+
+
+_guard: Optional[GradGuard] = None
+_guard_lock = threading.Lock()
+
+
+def guard() -> GradGuard:
+    global _guard
+    with _guard_lock:
+        if _guard is None:
+            _guard = GradGuard()
+        return _guard
+
+
+def _reset_guard() -> None:
+    """Test hook: drop the singleton."""
+    global _guard
+    with _guard_lock:
+        _guard = None
+
+
+def record_skip(streak, step, max_skips, source=0) -> None:
+    """Module-level callback target (stable identity for
+    ``jax.debug.callback``). Never raises: an exception here would
+    surface as XlaRuntimeError mid-dispatch; escalation rides the
+    latch + :func:`check` instead."""
+    try:
+        guard().record_skip(
+            int(streak), int(step), int(max_skips), source=int(source)
+        )
+    except Exception:
+        _log.debug("guard skip callback failed", exc_info=True)
+
+
+def check() -> None:
+    """``hvd.guard_check()`` — raise the latched escalation (if any) as
+    HorovodInternalError. ``State.commit()`` calls this, so elastic
+    loops get it for free at every commit boundary; bare loops can
+    call it themselves once per step (cheap: one lock, plus the eager
+    fusion sentinel's flag sync when that guard is on)."""
+    from . import basics
+
+    if basics.is_initialized():
+        fusion = basics._state.fusion
+        if fusion is not None and getattr(fusion, "guard", False):
+            fusion.guard_poll()
+    guard().raise_if_escalated()
+
+
+def status() -> dict:
+    """``hvd.guard_status()`` — the skip ledger as a plain dict."""
+    return guard().status()

@@ -33,6 +33,27 @@ from typing import Dict, Optional
 WIRE_FORMAT_CODES = {"fp32": 0, "bf16": 1, "int8": 2}
 WIRE_FORMAT_NAMES = {v: k for k, v in WIRE_FORMAT_CODES.items()}
 
+# Training-state integrity metric families (PR 7 — the names the
+# runbook in docs/robustness.md documents; emitters: common/guard.py,
+# audit.py, checkpoint.py, elastic/driver.py). Kept here as the single
+# legend so dashboards and tests never re-derive the spelling:
+#   guard.nonfinite_steps    skipped optimizer updates (counter)
+#   guard.nonfinite_batches  non-finite fused eager batches (counter)
+#   guard.skip_streak        consecutive skips at last skip (gauge)
+#   audit.digests            parameter digests computed (counter)
+#   audit.last_digest_step   step of the newest digest (gauge)
+#   checkpoint.digest_mismatch  corrupt-but-parseable restores (counter)
+#   driver.divergence_restarts  gang restarts for replica divergence
+INTEGRITY_METRICS = (
+    "guard.nonfinite_steps",
+    "guard.nonfinite_batches",
+    "guard.skip_streak",
+    "audit.digests",
+    "audit.last_digest_step",
+    "checkpoint.digest_mismatch",
+    "driver.divergence_restarts",
+)
+
 
 class MetricsRegistry:
     def __init__(self) -> None:

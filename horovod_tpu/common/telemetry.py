@@ -79,6 +79,12 @@ _COUNTER_KEYS = (
     "retry.retries_total",
     "retry.exhausted_total",
     "faults_injected",
+    # training-state integrity plane (common/guard.py, audit.py): a
+    # step whose record shows a nonzero guard delta SKIPPED its
+    # update; an audit.digests delta marks the digest cadence, so the
+    # flight recorder pins integrity events to exact steps
+    "guard.nonfinite_steps",
+    "audit.digests",
 )
 
 # Gauges copied into the record's ``tuner`` dict — the autotune /
@@ -332,6 +338,16 @@ class TelemetryHub:
                 "retries": deltas["retry.retries_total"],
                 "retry_exhausted": deltas["retry.exhausted_total"],
                 "faults_injected": deltas["faults_injected"],
+                # integrity plane (PR 7): a nonzero guard delta means
+                # THIS step's update was skipped for non-finite
+                # gradients; audit_ran marks the digest cadence
+                # landing on this step, and audit.last_digest_step is
+                # the GAUGE (the newest digest's step id), not a delta
+                "guard.nonfinite_steps": deltas["guard.nonfinite_steps"],
+                "audit_ran": 1.0 if deltas["audit.digests"] else 0.0,
+                "audit.last_digest_step": snap.get(
+                    "audit.last_digest_step", 0.0
+                ),
                 "tuner": tuner,
             }
         )

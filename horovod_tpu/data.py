@@ -29,7 +29,21 @@ import numpy as np
 class ShardedIndexSampler:
     """Per-rank index sampler with epoch shuffling (ref:
     DistributedSampler semantics [V]: equal-length shards, optional
-    shuffle keyed by (seed, epoch), padding by wrap-around)."""
+    shuffle keyed by (seed, epoch), padding by wrap-around).
+
+    **Reshard determinism + exactly-once resume** (the elastic data
+    contract): the epoch's global order is keyed by ``(seed, epoch)``
+    ONLY — never by the world size — and each rank takes the
+    ``rank::num_replicas`` stripe of it, so an 8→6 reshard mid-run
+    walks a suffix of the *same* global permutation instead of a fresh
+    one. :meth:`state_dict` captures a GLOBAL cursor (the SPMD
+    contract — every rank has consumed equally — makes
+    ``consumed_per_rank × num_replicas`` exact); :meth:`load_state_dict`
+    seeks the epoch to it, under any world size: the remaining indices
+    are re-striped over the new replica count, so across a
+    save/kill/restore cycle no sample inside the epoch is replayed or
+    dropped (up to the usual wrap-around padding on ragged tails).
+    """
 
     def __init__(
         self,
@@ -56,6 +70,11 @@ class ShardedIndexSampler:
         self.seed = seed
         self.drop_last = drop_last
         self.epoch = 0
+        # exactly-once cursor: global offset into the epoch's order
+        # (samples consumed across ALL ranks) + this iteration's
+        # per-rank progress
+        self._start = 0
+        self._consumed = 0
         if drop_last:
             self.num_samples = self.n // self.num_replicas
         else:
@@ -63,29 +82,83 @@ class ShardedIndexSampler:
 
     def set_epoch(self, epoch: int) -> None:
         """Reshuffle differently each epoch (same contract as the
-        torch sampler — call before iterating)."""
+        torch sampler — call before iterating). Resets the mid-epoch
+        cursor: a new epoch starts from its beginning."""
         self.epoch = int(epoch)
+        self._start = 0
+        self._consumed = 0
 
-    def __len__(self) -> int:
-        return self.num_samples
-
-    def __iter__(self) -> Iterator[int]:
+    def _epoch_order(self) -> np.ndarray:
+        """The epoch's GLOBAL sample order — a function of
+        ``(seed, epoch)`` alone, so every world size walks the same
+        permutation (reshard determinism)."""
         if self.shuffle:
             rng = np.random.default_rng((self.seed, self.epoch))
-            order = rng.permutation(self.n)
-        else:
-            order = np.arange(self.n)
-        total = self.num_samples * self.num_replicas
+            return rng.permutation(self.n)
+        return np.arange(self.n)
+
+    def _per_rank_remaining(self) -> int:
+        remaining = max(self.n - self._start, 0)
         if self.drop_last:
-            order = order[:total]
+            return remaining // self.num_replicas
+        return -(-remaining // self.num_replicas)  # ceil
+
+    def __len__(self) -> int:
+        """Per-rank items the NEXT iteration will yield — the full
+        epoch from a fresh sampler, the remainder after a mid-epoch
+        :meth:`load_state_dict` seek."""
+        return self._per_rank_remaining()
+
+    def state_dict(self) -> dict:
+        """The resumable cursor: epoch + GLOBAL position. Capture it at
+        a commit boundary (DurableJaxState does); loading it into a
+        fresh sampler — of ANY world size — continues the epoch at the
+        exact next unseen sample."""
+        return {
+            "epoch": int(self.epoch),
+            "cursor": int(
+                self._start + self._consumed * self.num_replicas
+            ),
+            "seed": int(self.seed),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Seek to a :meth:`state_dict` cursor. A cursor at/past ``n``
+        means the epoch was fully consumed (the tail the saver saw was
+        wrap-around padding): the next iteration yields nothing and
+        the caller advances the epoch as usual."""
+        if int(state.get("seed", self.seed)) != self.seed:
+            raise ValueError(
+                f"sampler state has seed {state.get('seed')} but this "
+                f"sampler uses {self.seed}; the epoch orders would "
+                "disagree and the cursor would be meaningless"
+            )
+        self.epoch = int(state["epoch"])
+        self._start = min(max(int(state["cursor"]), 0), self.n)
+        self._consumed = 0
+
+    def __iter__(self) -> Iterator[int]:
+        rem = self._epoch_order()[self._start:]
+        per = self._per_rank_remaining()
+        total = per * self.num_replicas
+        if self.drop_last:
+            rem = rem[:total]
         else:
-            # wrap-around padding so every rank sees num_samples items;
-            # np.resize repeats the permutation as many times as needed
-            # (n < num_replicas included — a single order[:pad] slice
+            # wrap-around padding so every rank sees ``per`` items;
+            # np.resize repeats the remainder as many times as needed
+            # (n < num_replicas included — a single rem[:pad] slice
             # would underfill the high ranks and deadlock SPMD loops).
-            if total > self.n:
-                order = np.resize(order, total)
-        return iter(order[self.rank :: self.num_replicas].tolist())
+            if total > len(rem):
+                rem = np.resize(rem, total)
+        mine = rem[self.rank :: self.num_replicas].tolist()
+        self._consumed = 0
+
+        def _gen():
+            for i, idx in enumerate(mine):
+                self._consumed = i + 1
+                yield idx
+
+        return _gen()
 
 
 def shard_array(x, num_replicas: Optional[int] = None,
@@ -275,15 +348,41 @@ class ShardedFileDataset:
             collections.OrderedDict()
         )
         self._cache_files = max(int(cache_files), 1)
+        self._batches_done = 0  # this iteration's progress (resume)
 
     # -- epoch control (DistributedSampler parity) ---------------------
     def set_epoch(self, epoch: int) -> None:
         self._sampler.set_epoch(epoch)
+        self._batches_done = 0
 
     def __len__(self) -> int:
-        """Batches per epoch per rank (ragged tail dropped: every jitted
-        step needs one static shape)."""
-        return self._sampler.num_samples // self.batch_size
+        """Batches the NEXT iteration yields per rank (ragged tail
+        dropped: every jitted step needs one static shape); reflects a
+        mid-epoch seek."""
+        return len(self._sampler) // self.batch_size
+
+    # -- exactly-once resume (elastic data contract) -------------------
+    def state_dict(self) -> dict:
+        """Epoch + GLOBAL sample cursor at batch granularity: batches
+        already YIELDED this iteration are counted consumed (the saver
+        commits after stepping on a batch, so the in-flight batch is
+        behind the cursor, never replayed)."""
+        st = self._sampler.state_dict()
+        st["cursor"] = int(
+            self._sampler._start
+            + self._batches_done
+            * self.batch_size
+            * self._sampler.num_replicas
+        )
+        return st
+
+    def load_state_dict(self, state: dict) -> None:
+        """Seek so the next ``__iter__`` starts at the exact next
+        global index — across a save/SIGKILL/restore cycle AND across
+        a world-size change (the remaining global order is re-striped
+        over the new replica count)."""
+        self._sampler.load_state_dict(state)
+        self._batches_done = 0
 
     def _open_column(self, path: str):
         """One shard column: the native mmap row-gather when available
@@ -393,8 +492,11 @@ class ShardedFileDataset:
         return (x_out, y_out) if self.has_labels else x_out
 
     def __iter__(self):
+        self._batches_done = 0
         idx = np.fromiter(iter(self._sampler), dtype=np.int64)
-        steps = len(self)
+        steps = len(idx) // self.batch_size
         for b in range(steps):
             sl = idx[b * self.batch_size: (b + 1) * self.batch_size]
-            yield self._rows(sl)
+            rows = self._rows(sl)
+            self._batches_done = b + 1
+            yield rows

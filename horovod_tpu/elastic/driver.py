@@ -122,6 +122,11 @@ class ElasticDriver:
         # (blacklist + proactive gang restart); 0 = observe only
         self._quarantine_polls = _cfg.straggler_quarantine_polls
         self._quarantine_capacity_warned = False
+        # divergence audit (audit.py): workers publish parameter-tree
+        # digests into the rendezvous KV; the driver compares them and
+        # quarantines replicas that disagree with the majority
+        self._last_audit_poll = 0.0
+        self._last_audit_step: Optional[int] = None
 
     # ---------------------------------------------------------- planning
 
@@ -161,13 +166,15 @@ class ElasticDriver:
             ",".join(sorted(set(assignment.hostnames))),
         )
         server = self._rendezvous()
-        from ..runner.rendezvous import HEARTBEAT_SCOPE
+        from ..runner.rendezvous import AUDIT_SCOPE, HEARTBEAT_SCOPE
 
         self.stall_inspector.reset_heartbeats()
         try:
             server.store.drop_scope(HEARTBEAT_SCOPE)
+            server.store.drop_scope(AUDIT_SCOPE)
         except Exception:
             pass
+        self._last_audit_step = None
         placement = self._placement
         if placement == "auto":
             placement = (
@@ -328,7 +335,9 @@ class ElasticDriver:
         last_refresh = 0.0
         while not self._stop.is_set():
             now = time.monotonic()
-            restart_reason = self._poll_heartbeats(now)
+            restart_reason = self._poll_heartbeats(now) or self._poll_audit(
+                now
+            )
             if restart_reason:
                 self._terminate_gang()
                 if not self._reset(reason=restart_reason):
@@ -432,16 +441,39 @@ class ElasticDriver:
         )
         if not ranks:
             return None
+        hosts = self._hosts_of_ranks(ranks)
+        if not hosts:
+            return None
+        if not self._try_blacklist(hosts, "straggler quarantine"):
+            return None
+        _log.warning(
+            "quarantining straggler host(s) %s (ranks %s flagged for "
+            "%d consecutive polls); restarting gang without them",
+            ",".join(hosts), ",".join(map(str, ranks)),
+            self._quarantine_polls,
+        )
+        return (
+            f"straggler quarantine: hosts {','.join(hosts)} "
+            f"(ranks {','.join(map(str, ranks))})"
+        )
+
+    def _hosts_of_ranks(self, ranks) -> List[str]:
+        """Hostnames currently running the given ranks (empty when the
+        gang layout no longer knows them)."""
         with self._lock:
             rank_to_host = {
                 int(b["HOROVOD_RANK"]): b["HOROVOD_HOSTNAME"]
                 for b in self._blocks
             }
-        hosts = sorted(
+        return sorted(
             {rank_to_host[r] for r in ranks if r in rank_to_host}
         )
-        if not hosts:
-            return None
+
+    def _try_blacklist(self, hosts, why: str) -> bool:
+        """Shared quarantine gate (stragglers AND divergence): refuse —
+        with a one-time warning — when losing ``hosts`` would drop
+        capacity below min_np; otherwise blacklist them and count
+        ``driver.quarantined_hosts``."""
         hosts_info = self.host_manager.current_hosts()
         slots = {
             h.hostname: (
@@ -458,25 +490,63 @@ class ElasticDriver:
             if not self._quarantine_capacity_warned:
                 self._quarantine_capacity_warned = True
                 _log.warning(
-                    "straggler quarantine of %s would drop capacity to "
-                    "%d (< min_np=%d); keeping the slow host(s)",
-                    ",".join(hosts), remaining, self._min_np,
+                    "%s of %s would drop capacity to %d (< min_np=%d); "
+                    "keeping the host(s)",
+                    why, ",".join(hosts), remaining, self._min_np,
                 )
-            return None
+            return False
         from ..common.metrics import registry as _metrics
 
         for hostname in hosts:
             self.host_manager.blacklist(hostname)
             _metrics.counter("driver.quarantined_hosts")
-        _log.warning(
-            "quarantining straggler host(s) %s (ranks %s flagged for "
-            "%d consecutive polls); restarting gang without them",
-            ",".join(hosts), ",".join(map(str, ranks)),
-            self._quarantine_polls,
+        return True
+
+    def _poll_audit(self, now: float) -> Optional[str]:
+        """Divergence detection (audit.py): compare the gang's
+        published parameter digests once per discovery interval. A
+        replica disagreeing with the majority gets its host
+        quarantined and the gang restarts with reason ``divergence`` —
+        the restore re-replicates state from the root, which repairs
+        the divergence even when the capacity guard keeps the host."""
+        if self._server is None or now - self._last_audit_poll < self._interval:
+            return None
+        self._last_audit_poll = now
+        from ..audit import find_divergent
+        from ..runner.rendezvous import read_audit_digests
+
+        try:
+            digests = read_audit_digests(self._server.store)
+        except Exception:
+            _log.debug("audit poll failed", exc_info=True)
+            return None
+        found = find_divergent(digests)
+        if found is None:
+            return None
+        step, bad_ranks = found
+        if step == self._last_audit_step:
+            return None  # this round was already judged
+        self._last_audit_step = step
+        from ..common.metrics import registry as _metrics
+
+        _metrics.counter("driver.divergence_restarts")
+        hosts = self._hosts_of_ranks(bad_ranks)
+        quarantined = hosts and self._try_blacklist(
+            hosts, "divergence quarantine"
+        )
+        _log.error(
+            "replica divergence at audit step %d: ranks %s disagree "
+            "with the gang majority%s; restarting gang",
+            step, ",".join(map(str, bad_ranks)),
+            (
+                f" (hosts {','.join(hosts)} quarantined)"
+                if quarantined
+                else " (hosts kept: capacity guard — restore re-syncs)"
+            ),
         )
         return (
-            f"straggler quarantine: hosts {','.join(hosts)} "
-            f"(ranks {','.join(map(str, ranks))})"
+            f"divergence: ranks {','.join(map(str, bad_ranks))} at "
+            f"audit step {step}"
         )
 
     def _reset(self, reason: str) -> bool:

@@ -46,6 +46,13 @@ class State:
             callback()
 
     def commit(self) -> None:
+        # The guard escalation fires BEFORE save: a commit is the act
+        # of blessing the current state as a rollback point, and a job
+        # that just skipped K consecutive non-finite steps must restore
+        # to the PREVIOUS blessing, not mint a new one mid-incident.
+        from ..common import guard as _guard
+
+        _guard.check()
         self.save()
         self.check_host_updates()
 
@@ -127,6 +134,13 @@ class JaxState(ObjectState):
         scalars = {k: v for k, v in kwargs.items() if k not in trees}
         self._trees: Dict[str, Any] = {}
         self._trees_saved: Dict[str, Any] = {}
+        # registered data cursors (samplers/datasets with
+        # state_dict/load_state_dict): committed and rolled back WITH
+        # the model state, so an elastic restore rewinds the sample
+        # stream to the same point as the parameters — exactly-once
+        # delivery under the commit/restore contract
+        self._data: Dict[str, Any] = {}
+        self._data_saved: Dict[str, Dict] = {}
         super().__init__(**scalars)
         for key, value in trees.items():
             self._trees[key] = value
@@ -152,11 +166,38 @@ class JaxState(ObjectState):
         else:
             object.__setattr__(self, name, value)
 
+    def register_data(self, name: str, obj: Any) -> "JaxState":
+        """Attach a data-cursor carrier (``ShardedIndexSampler`` /
+        ``ShardedFileDataset`` — anything with ``state_dict()`` /
+        ``load_state_dict()``): its cursor is snapshotted at every
+        ``save()``/``commit()`` and rewound on ``restore()``, and
+        ``DurableJaxState`` persists it beside the model tree so a
+        full-job restart resumes the epoch at the exact next sample.
+
+        Use a WORLD-SIZE-INDEPENDENT name set (one name per logical
+        stream — e.g. ``"train"`` — not one per rank): the cursor is
+        global, every rank's sampler reports the same one, and the
+        durable tree's structure must match across a gang resize for
+        the restore to land. Returns self for chaining."""
+        if not (
+            hasattr(obj, "state_dict") and hasattr(obj, "load_state_dict")
+        ):
+            raise TypeError(
+                f"register_data({name!r}): object has no "
+                "state_dict/load_state_dict"
+            )
+        self._data[name] = obj
+        self._data_saved[name] = dict(obj.state_dict())
+        return self
+
     def save(self) -> None:
         super().save()
         self._trees_saved = {
             key: jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
             for key, tree in self._trees.items()
+        }
+        self._data_saved = {
+            key: dict(obj.state_dict()) for key, obj in self._data.items()
         }
 
     def _replicate(self, tree):
@@ -174,6 +215,10 @@ class JaxState(ObjectState):
         super().restore()
         for key, host_tree in self._trees_saved.items():
             self._trees[key] = self._replicate(host_tree)
+        for key, snap in self._data_saved.items():
+            obj = self._data.get(key)
+            if obj is not None:
+                obj.load_state_dict(dict(snap))
 
     def sync(self) -> None:
         super().sync()

@@ -56,6 +56,14 @@ class WorkerNotificationManager:
             # a previous teardown may have latched the KV-poll abort;
             # this process is (re)joining a gang, so re-arm the pollers
             _rdv.reset_poll_shutdown()
+            # the audit publisher caches its KV client; a rejoining
+            # worker must re-dial the (possibly new) rendezvous.
+            # NB: ``from .. import audit`` would pick up the
+            # ``hvd.audit`` FUNCTION (the package re-export shadows
+            # the module attribute); import from the module directly.
+            from ..audit import _reset_client as _audit_reset
+
+            _audit_reset()
             cfg = config_mod.Config.from_env()
             if not (
                 cfg.rendezvous_addr
@@ -179,7 +187,14 @@ def run(func):
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
-                # a peer died mid-collective: roll back to last commit
+                # a peer died mid-collective (or the grad guard
+                # escalated past K consecutive non-finite steps): roll
+                # back to last commit. The guard ledger's streak view
+                # is cleared — the restored state predates the poison,
+                # so the retry must not re-escalate on stale counters.
+                from ..common import guard as _guard
+
+                _guard.guard().reset()
                 state.restore()
                 skip_sync = False
             except HostsUpdatedInterrupt:

@@ -321,6 +321,7 @@ class FusionManager:
         wire_block: Optional[int] = None,
         wire_hier: Optional[bool] = None,
         wire_min_bytes: Optional[int] = None,
+        guard: Optional[bool] = None,
     ):
         self.mesh = mesh
         self.threshold_bytes = threshold_bytes
@@ -343,10 +344,13 @@ class FusionManager:
             or wire_block is None
             or wire_hier is None
             or wire_min_bytes is None
+            or guard is None
         ):
             from ..common.config import Config
 
             cfg = Config.from_env()
+            if guard is None:
+                guard = cfg.guard
             if cache_capacity is None:
                 cache_capacity = cfg.cache_capacity
             if injit_pack is None:
@@ -366,6 +370,17 @@ class FusionManager:
             if wire_min_bytes is None:
                 wire_min_bytes = cfg.fusion_wire_min_bytes
         self.injit_pack = bool(injit_pack)
+        # Non-finite sentinel on the eager data plane (HOROVOD_GUARD /
+        # common/guard.py): float allreduce batches fold ONE
+        # all(isfinite) scalar over the fused output buffer into the
+        # SAME compiled executable. Flags are device scalars collected
+        # without syncing; guard_poll() (called from hvd.guard_check /
+        # State.commit) is the explicit sync point that counts
+        # guard.nonfinite_batches. Detection-only here — eager handles
+        # are already fulfilled by flush time, so skip-step semantics
+        # belong to the optimizers, not the dispatcher.
+        self.guard = bool(guard)
+        self._guard_flags: List = []
         self.wire = str(wire)
         self.wire_block = max(int(wire_block), 1)
         self.wire_hier = bool(wire_hier)
@@ -495,7 +510,7 @@ class FusionManager:
         from ..testing import chaos as _chaos
 
         try:
-            _chaos.inject("fusion.dispatch")
+            chaos_kind = _chaos.inject("fusion.dispatch")
         except (
             ConnectionResetError, TimeoutError, _chaos.InjectedServerError
         ) as e:
@@ -504,6 +519,17 @@ class FusionManager:
             raise HorovodInternalError(str(e)) from e
         t0 = time.monotonic()
         entries, self.pending = self.pending, []
+        if chaos_kind == "nan":
+            # data-plane corruption drill: poison ONE element of the
+            # first float payload in the batch — exactly what a flipped
+            # gradient bit looks like to the guard's isfinite sentinel
+            for e in entries:
+                if jnp.issubdtype(e.payload.dtype, jnp.floating):
+                    e.payload = jnp.reshape(
+                        jnp.reshape(e.payload, (-1,)).at[0].set(jnp.nan),
+                        e.payload.shape,
+                    )
+                    break
         flushed_bytes, self.pending_bytes = self.pending_bytes, 0
         self.flushed_bytes_total += flushed_bytes
         self.cycle_start = None
@@ -924,6 +950,13 @@ class FusionManager:
     def _execute_batch(self, batch: List[_Entry]) -> None:
         spec = self._classify(batch)
         plan, core_key = spec.plan, spec.core_key
+        # the non-finite sentinel rides only float batches (integer
+        # payloads are finite by construction); the flag is an extra
+        # executor output, so it is part of what the cache key already
+        # pins (guard is fixed per manager, dtype is in every key)
+        guarded = self.guard and jnp.issubdtype(
+            jnp.dtype(plan.dtype), jnp.floating
+        )
         exact_key = core_key + ("x", plan.shapes)
         # The exact tier is keyed on the full per-entry shape tuple, so
         # bucket padding buys it zero cache stability — it would only
@@ -969,18 +1002,26 @@ class FusionManager:
             # cycle on top of an uncacheable core
             if self.injit_pack and self.cache_capacity == 0:
                 self.cache_misses += 1
-                fn = self._build_fused(exact_plan, spec.builder(), spec)
-                outs = self._dispatch_fused(fn, batch, exact_plan, keep, seed)
+                fn = self._build_fused(
+                    exact_plan, spec.builder(), spec, guarded
+                )
+                outs = self._dispatch_fused(
+                    fn, batch, exact_plan, keep, seed, guarded
+                )
                 used_plan = exact_plan
             else:
                 fn = self._executor(core_key, lambda: self._build_core(
-                    plan, spec.builder(), spec))
-                outs = self._dispatch_core(fn, batch, plan, keep, seed, spec)
+                    plan, spec.builder(), spec, guarded))
+                outs = self._dispatch_core(
+                    fn, batch, plan, keep, seed, spec, guarded
+                )
         else:
             fn = self._cache_get(exact_key)
             if fn is not None:
                 self.cache_hits += 1
-                outs = self._dispatch_fused(fn, batch, exact_plan, keep, seed)
+                outs = self._dispatch_fused(
+                    fn, batch, exact_plan, keep, seed, guarded
+                )
                 used_plan = exact_plan
             else:
                 seen = self._note_composition(exact_key)
@@ -992,9 +1033,13 @@ class FusionManager:
                     self.cache_misses += 1
                     if not fresh_bucket:
                         self.promotions += 1
-                    fn = self._build_fused(exact_plan, spec.builder(), spec)
+                    fn = self._build_fused(
+                        exact_plan, spec.builder(), spec, guarded
+                    )
                     self._cache_put(exact_key, fn)
-                    outs = self._dispatch_fused(fn, batch, exact_plan, keep, seed)
+                    outs = self._dispatch_fused(
+                        fn, batch, exact_plan, keep, seed, guarded
+                    )
                     used_plan = exact_plan
                 else:
                     # composition churn inside a known bucket: reuse (or
@@ -1002,11 +1047,13 @@ class FusionManager:
                     # compiling per composition
                     if core is None:
                         self.cache_misses += 1
-                        core = self._build_core(plan, spec.builder(), spec)
+                        core = self._build_core(
+                            plan, spec.builder(), spec, guarded
+                        )
                         self._cache_put(core_key, core)
                     self.bucket_hits += 1
                     outs = self._dispatch_core(
-                        core, batch, plan, keep, seed, spec
+                        core, batch, plan, keep, seed, spec, guarded
                     )
 
         self.pad_bytes_total += used_plan.pad_bytes
@@ -1158,7 +1205,39 @@ class FusionManager:
             extra.append(jnp.int32(seed))
         return extra
 
-    def _dispatch_fused(self, fn, batch, plan, keep, seed=None):
+    def _note_guard_flag(self, ok) -> None:
+        """Collect a device-scalar finite flag WITHOUT syncing; the
+        list is bounded so an unpolled guard cannot pin buffers
+        forever (old flags drop oldest-first — the poll is a
+        rate-limited health check, not an exact ledger)."""
+        self._guard_flags.append(ok)
+        if len(self._guard_flags) > 256:
+            del self._guard_flags[: len(self._guard_flags) - 256]
+
+    def guard_poll(self) -> int:
+        """Sync point for the eager sentinel: resolve the collected
+        flags (this is where the host pays the transfer — call it from
+        commit-boundary code, not per dispatch), count non-finite
+        batches into ``guard.nonfinite_batches``, return the count."""
+        flags, self._guard_flags = self._guard_flags, []
+        bad = 0
+        for f in flags:
+            try:
+                if not bool(f):
+                    bad += 1
+            except Exception:  # deleted/donated buffer: unknowable
+                continue
+        if bad:
+            from ..common.metrics import registry as _metrics
+
+            _metrics.counter("guard.nonfinite_batches", bad)
+            _log.warning(
+                "non-finite values in %d fused batch(es) since the "
+                "last guard poll", bad,
+            )
+        return bad
+
+    def _dispatch_fused(self, fn, batch, plan, keep, seed=None, guarded=False):
         """One executor invocation covering pack + collective + unpack
         (and, on the quantized wire, quantize + dequantize)."""
         args = [e.payload for e in batch] + self._extra_args(keep, seed)
@@ -1168,9 +1247,15 @@ class FusionManager:
             self.donated_bytes_total += sum(
                 int(e.payload.nbytes) for e in batch
             )
-        return fn(*args)
+        out = fn(*args)
+        if guarded:
+            out, ok = out
+            self._note_guard_flag(ok)
+        return out
 
-    def _dispatch_core(self, fn, batch, plan, keep, seed=None, spec=None):
+    def _dispatch_core(
+        self, fn, batch, plan, keep, seed=None, spec=None, guarded=False
+    ):
         """Bucket-tier dispatch: host-side pack into the padded buffer,
         one collective invocation, host-side unpack. This is the
         pre-rework dispatch path, kept as the composition-independent
@@ -1185,6 +1270,9 @@ class FusionManager:
         self.dispatches += 1
         self.last_cycle_dispatches += 1
         out = fn(buf, *self._extra_args(keep, seed))
+        if guarded:
+            out, ok = out
+            self._note_guard_flag(ok)
         if spec is not None and spec.want_res:
             out, res = out
             return _unpack(out, plan), _unpack(res, plan)
@@ -1207,20 +1295,37 @@ class FusionManager:
         )
 
     def _build_core(
-        self, plan: _BatchPlan, per_shard, spec: "_ExecSpec"
+        self, plan: _BatchPlan, per_shard, spec: "_ExecSpec",
+        guarded: bool = False,
     ) -> Callable:
-        """Compile the composition-independent padded-buffer program."""
-        return jax.jit(self._mapped_core(per_shard, spec))
+        """Compile the composition-independent padded-buffer program.
+        ``guarded`` appends the non-finite sentinel — one
+        ``all(isfinite)`` scalar over the output buffer, inside the
+        same executable."""
+        mapped = self._mapped_core(per_shard, spec)
+        if not guarded:
+            return jax.jit(mapped)
+        want_res = spec.want_res
+
+        def core(*args):
+            out = mapped(*args)
+            buf = out[0] if want_res else out
+            return out, jnp.all(jnp.isfinite(buf))
+
+        return jax.jit(core)
 
     def _build_fused(
-        self, plan: _BatchPlan, per_shard, spec: "_ExecSpec"
+        self, plan: _BatchPlan, per_shard, spec: "_ExecSpec",
+        guarded: bool = False,
     ) -> Callable:
         """Compile the whole batch — in-JIT pack, (quantize,)
         collective, (dequantize,) in-JIT unpack — as ONE donated
         executable. XLA sees the reshape/concat producers and the
         slice/reshape consumers next to the collective and fuses them;
         donation lets the fusion buffer alias the argument storage
-        instead of doubling peak HBM."""
+        instead of doubling peak HBM. ``guarded`` folds the
+        non-finite sentinel (one scalar reduction over the fused
+        output buffer) into the same program."""
         mapped = self._mapped_core(per_shard, spec)
         n_tensors = len(plan.shapes)
         want_res = spec.want_res
@@ -1231,8 +1336,14 @@ class FusionManager:
             out = mapped(buf, *args[n_tensors:])
             if want_res:
                 out, res = out
-                return tuple(_unpack(out, plan)), tuple(_unpack(res, plan))
-            return tuple(_unpack(out, plan))
+                pieces = (
+                    tuple(_unpack(out, plan)), tuple(_unpack(res, plan))
+                )
+            else:
+                pieces = tuple(_unpack(out, plan))
+            if guarded:
+                return pieces, jnp.all(jnp.isfinite(out))
+            return pieces
 
         kwargs = {}
         if self.donate:

@@ -233,26 +233,15 @@ def default_buckets() -> int:
     when ``HOROVOD_OVERLAP`` is enabled, else 0 (monolithic path).
     Reads the initialized runtime's config snapshot when there is one."""
     from ..common import basics
-    from ..common.config import Config
 
-    cfg = (
-        basics._state.config
-        if basics.is_initialized() and basics._state.config is not None
-        else Config.from_env()
-    )
+    cfg = basics.live_config()
     return cfg.overlap_buckets if cfg.overlap else 0
 
 
 def default_min_bytes() -> int:
     from ..common import basics
-    from ..common.config import Config
 
-    cfg = (
-        basics._state.config
-        if basics.is_initialized() and basics._state.config is not None
-        else Config.from_env()
-    )
-    return cfg.overlap_min_bytes
+    return basics.live_config().overlap_min_bytes
 
 
 def _publish(schedule: BucketSchedule) -> None:
@@ -278,6 +267,7 @@ def bucketed_allreduce(
     mask=None,
     min_bucket_bytes: Optional[int] = None,
     schedule: Optional[BucketSchedule] = None,
+    return_finite: bool = False,
 ):
     """Allreduce a gradient pytree as N independent per-bucket
     collectives (module docstring).
@@ -303,6 +293,13 @@ def bucketed_allreduce(
     reduction elementwise-ness over the concat (Adasum's whole-tensor
     dot products do not commute with concatenation; use the monolithic
     path for it).
+
+    ``return_finite=True`` appends a scalar bool to the result: the
+    non-finite sentinel (common/guard.py), ONE ``all(isfinite)``
+    reduction per bucket buffer computed on the already-reduced values
+    (replicated, so the flag agrees across ranks with no extra
+    collective) AND'd across buckets. The guarded optimizers cond
+    their update on it.
     """
     op = resolve_op(op, average)
     if op not in (Sum, Average):
@@ -355,6 +352,7 @@ def bucketed_allreduce(
             res_leaves[i] = r_leaves[i]
 
     block = getattr(compression, "block_size", None)
+    finite = None
     for b, idxs in enumerate(schedule.buckets):
         members = [leaves[i] for i in idxs]
         sizes = [int(np.prod(np.shape(m), dtype=np.int64)) for m in members]
@@ -405,6 +403,11 @@ def bucketed_allreduce(
             )
             out_flat = compression.decompress(red, ctx)
             new_r = None
+        if return_finite:
+            # one scalar reduction over THIS bucket's reduced buffer —
+            # the whole guard cost; AND'd into the step flag
+            ok = traced.finite_scalar(out_flat)
+            finite = ok if finite is None else jnp.logical_and(finite, ok)
         off = 0
         for i, sz in zip(idxs, sizes):
             out_leaves[i] = out_flat[off : off + sz].reshape(
@@ -419,9 +422,14 @@ def bucketed_allreduce(
                 )
             off += sz
     reduced = jax.tree_util.tree_unflatten(treedef, out_leaves)
+    if return_finite and finite is None:  # schedule had no buckets
+        finite = jnp.asarray(True)
     if residuals is None:
-        return reduced
-    return reduced, jax.tree_util.tree_unflatten(treedef, res_leaves)
+        return (reduced, finite) if return_finite else reduced
+    new_res = jax.tree_util.tree_unflatten(treedef, res_leaves)
+    if return_finite:
+        return reduced, new_res, finite
+    return reduced, new_res
 
 
 def overlap_boundary(

@@ -250,6 +250,40 @@ def _identity(tensor, op):
     return iin.max if op == Min else iin.min
 
 
+# ------------------------------------------------- non-finite sentinel
+
+
+def finite_scalar(x):
+    """One in-JIT boolean: ``all(isfinite(x))`` — the per-bucket guard
+    reduction (common/guard.py). Non-float payloads are finite by
+    construction, so the flag folds to a constant and costs nothing.
+
+    Applied to ALREADY-REDUCED values the flag needs no collective: a
+    psum/all-gather output is replicated, so every rank computes the
+    identical bit and a ``lax.cond`` on it stays uniform across the
+    gang (the SPMD-safety requirement for skip-step semantics)."""
+    if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
+        return jnp.asarray(True)
+    return jnp.all(jnp.isfinite(x))
+
+
+def tree_finite(tree):
+    """``finite_scalar`` over a pytree, combined with logical AND —
+    one scalar reduction per leaf, one boolean out. Empty trees are
+    finite."""
+    flags = [
+        finite_scalar(leaf)
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.result_type(leaf), jnp.floating)
+    ]
+    if not flags:
+        return jnp.asarray(True)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
 def grouped_allreduce(
     tensors,
     average: Optional[bool] = None,

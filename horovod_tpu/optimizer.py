@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .common import guard as _guard
 from .common import telemetry as _telemetry
 from .common.process_sets import ProcessSet
 from .common.topology import WORLD_AXIS
@@ -151,6 +152,8 @@ class _AccumulationState(NamedTuple):
     counter: jnp.ndarray  # micro-steps since last communication
     step: jnp.ndarray  # monotone update count — seeds stochastic rounding
     residual: Any = None  # error-feedback carry (quantized wire only)
+    guard_skips: Any = None  # total non-finite skipped steps (guard on)
+    guard_streak: Any = None  # CONSECUTIVE skips — escalation trigger
 
 
 def DistributedOptimizer(
@@ -169,6 +172,8 @@ def DistributedOptimizer(
     error_feedback: bool = False,
     overlap_buckets: Optional[int] = None,
     overlap_min_bytes: Optional[int] = None,
+    grad_guard: Optional[bool] = None,
+    guard_max_skips: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transform with distributed gradient reduction
     (ref: hvd.DistributedOptimizer [V]).
@@ -201,6 +206,24 @@ def DistributedOptimizer(
     with the backward itself, prefer ``hvd.value_and_grad(...,
     overlap_buckets=N)`` — this wrapper only sees gradients after
     autodiff, so its buckets overlap each other and the update math.
+
+    ``grad_guard=True`` (``None`` defers to ``HOROVOD_GUARD``) folds
+    the non-finite sentinel into the compiled update
+    (common/guard.py): one ``all(isfinite)`` scalar reduction per
+    bucket (per leaf on the monolithic path) over the ALREADY-REDUCED
+    gradients — replicated values, so the flag agrees across ranks
+    with no extra collective — and a ``lax.cond`` that SKIPS the step
+    when the flag trips: zero updates, inner state untouched, EF
+    residuals kept at the last applied step's carry, the step counter
+    still advancing (stochastic-rounding seeds never repeat). Each
+    skip fires a callback counting ``guard.nonfinite_steps``; after
+    ``guard_max_skips`` (``HOROVOD_GUARD_MAX_SKIPS``) CONSECUTIVE
+    skips the escalation latches and ``State.commit()`` /
+    ``hvd.guard_check()`` raise ``HorovodInternalError`` so the
+    elastic restore contract fires. The no-skip path pays no host
+    sync — the callback lives inside the skip branch only. The guard
+    conds the whole inner update, so it requires a dtype-preserving
+    inner transform (every elementwise optax chain is).
     """
     op = resolve_op(op, average)
     if gradient_predivide_factor != 1.0 and op != Average:
@@ -231,6 +254,17 @@ def DistributedOptimizer(
     k = int(backward_passes_per_step)
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    guard_on = (
+        bool(grad_guard)
+        if grad_guard is not None
+        else _guard.default_enabled()
+    )
+    max_skips = int(
+        guard_max_skips
+        if guard_max_skips is not None
+        else _guard.default_max_skips()
+    )
+    guard_src = _guard.new_source() if guard_on else 0
 
     def reduce_op_factors(n: int):
         if gradient_predivide_factor != 1.0 and op == Average:
@@ -241,6 +275,9 @@ def DistributedOptimizer(
         return op, pre, post
 
     def communicate(grads, seed, residuals=None):
+        """Exchange + optional guard flag. Returns a uniform
+        ``(reduced, new_residuals_or_None, finite_or_None)`` triple so
+        the update paths never re-derive the unpacking rules."""
         n = (
             process_set.size
             if process_set is not None and process_set.process_set_id != 0
@@ -248,17 +285,65 @@ def DistributedOptimizer(
         )
         eff_op, pre, post = reduce_op_factors(n)
         if overlap_buckets:
-            return overlap.bucketed_allreduce(
+            out = overlap.bucketed_allreduce(
                 grads, op=eff_op, n_buckets=overlap_buckets,
                 compression=compression, prescale_factor=pre,
                 postscale_factor=post, process_set=process_set,
                 axis_name=axis_name, seed=seed, residuals=residuals,
                 min_bucket_bytes=overlap_min_bytes,
+                return_finite=guard_on,
             )
-        return _allreduce_grads(
+            if guard_on:
+                if residuals is not None:
+                    return out
+                reduced, finite = out
+                return reduced, None, finite
+            if residuals is not None:
+                reduced, new_r = out
+                return reduced, new_r, None
+            return out, None, None
+        out = _allreduce_grads(
             grads, eff_op, compression, pre, post, process_set, axis_name,
             seed=seed, residuals=residuals,
         )
+        if residuals is not None:
+            reduced, new_r = out
+        else:
+            reduced, new_r = out, None
+        finite = traced.tree_finite(reduced) if guard_on else None
+        return reduced, new_r, finite
+
+    def guarded_apply(reduced, new_residual, finite, state, params):
+        """The skip-step cond (common/guard.py): apply the inner
+        update only when the reduced gradients are finite; otherwise
+        zero updates, untouched inner state, the LAST APPLIED step's
+        EF carry, and a host callback (skip branch only — the healthy
+        path never reaches the host). Returns
+        ``(updates, inner, residual, skips, streak)``."""
+        streak_next = state.guard_streak + 1
+
+        def apply(_):
+            updates, inner = optimizer.update(reduced, state.inner, params)
+            return (
+                updates, inner, new_residual, state.guard_skips,
+                jnp.zeros((), jnp.int32),
+            )
+
+        def skip(_):
+            jax.debug.callback(
+                functools.partial(
+                    _guard.record_skip, max_skips=max_skips,
+                    source=guard_src,
+                ),
+                streak_next, state.step,
+            )
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, reduced)
+            return (
+                zeros, state.inner, state.residual,
+                state.guard_skips + 1, streak_next,
+            )
+
+        return jax.lax.cond(finite, apply, skip, operand=None)
 
     def init_fn(params):
         inner = optimizer.init(params)
@@ -268,15 +353,21 @@ def DistributedOptimizer(
             if error_feedback
             else None
         )
+        # guard counters ride the state pytree only when the guard is
+        # on — None leaves are empty subtrees, so unguarded jobs keep
+        # the exact state structure (and checkpoints) they had
+        gskips = zero if guard_on else None
+        gstreak = zero if guard_on else None
         if k == 1:
             return _AccumulationState(
                 inner=inner, accum=None, counter=zero, step=zero,
-                residual=residual,
+                residual=residual, guard_skips=gskips,
+                guard_streak=gstreak,
             )
         accum = jax.tree_util.tree_map(jnp.zeros_like, params)
         return _AccumulationState(
             inner=inner, accum=accum, counter=zero, step=zero,
-            residual=residual,
+            residual=residual, guard_skips=gskips, guard_streak=gstreak,
         )
 
     def update_fn(grads, state: _AccumulationState, params=None):
@@ -291,12 +382,19 @@ def DistributedOptimizer(
         if _telemetry.auto_enabled():
             jax.debug.callback(_telemetry.device_step_tick, state.step)
         if k == 1:
-            if error_feedback:
-                reduced, residual = communicate(
-                    grads, state.step, residuals=state.residual
+            reduced, residual, finite = communicate(
+                grads, state.step,
+                residuals=state.residual if error_feedback else None,
+            )
+            if guard_on:
+                updates, inner, residual, skips, streak = guarded_apply(
+                    reduced, residual, finite, state, params
                 )
-            else:
-                reduced, residual = communicate(grads, state.step), None
+                return updates, _AccumulationState(
+                    inner=inner, accum=None, counter=state.counter,
+                    step=state.step + 1, residual=residual,
+                    guard_skips=skips, guard_streak=streak,
+                )
             updates, inner = optimizer.update(reduced, state.inner, params)
             return updates, _AccumulationState(
                 inner=inner, accum=None, counter=state.counter,
@@ -321,28 +419,42 @@ def DistributedOptimizer(
                 if average_aggregated_gradients
                 else accum
             )
-            if error_feedback:
-                reduced, residual = communicate(
-                    agg, state.step, residuals=state.residual
-                )
-            else:
-                reduced, residual = communicate(agg, state.step), None
-            updates, inner = optimizer.update(reduced, state.inner, params)
+            reduced, residual, finite = communicate(
+                agg, state.step,
+                residuals=state.residual if error_feedback else None,
+            )
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            if guard_on:
+                # a skipped boundary still clears the accumulator: the
+                # poisoned micro-batch window is discarded, not replayed
+                updates, inner, residual, skips, streak = guarded_apply(
+                    reduced, residual, finite, state, params
+                )
+                return (
+                    updates, inner, zeroed, jnp.zeros((), jnp.int32),
+                    residual, skips, streak,
+                )
+            updates, inner = optimizer.update(reduced, state.inner, params)
             return (
-                updates, inner, zeroed, jnp.zeros((), jnp.int32), residual
+                updates, inner, zeroed, jnp.zeros((), jnp.int32),
+                residual, state.guard_skips, state.guard_streak,
             )
 
         def skip_step(_):
             zeros = jax.tree_util.tree_map(jnp.zeros_like, accum)
-            return zeros, state.inner, accum, counter, state.residual
+            return (
+                zeros, state.inner, accum, counter, state.residual,
+                state.guard_skips, state.guard_streak,
+            )
 
-        updates, inner, accum_out, counter_out, residual_out = jax.lax.cond(
-            boundary, do_step, skip_step, operand=None
-        )
+        (
+            updates, inner, accum_out, counter_out, residual_out,
+            skips_out, streak_out,
+        ) = jax.lax.cond(boundary, do_step, skip_step, operand=None)
         return updates, _AccumulationState(
             inner=inner, accum=accum_out, counter=counter_out,
             step=state.step + 1, residual=residual_out,
+            guard_skips=skips_out, guard_streak=streak_out,
         )
 
     return optax.GradientTransformation(init_fn, update_fn)

@@ -492,6 +492,40 @@ def read_heartbeat_stats(store_or_client) -> Dict[int, dict]:
     return out
 
 
+AUDIT_SCOPE = "audit"
+
+
+def put_audit(
+    client: "RendezvousClient", rank: int, step: int, digest: str
+) -> None:
+    """Worker side of the parameter-audit ledger (audit.py): publish
+    this rank's newest tree digest. One KV key per rank, overwritten
+    per audit — the driver only ever compares the latest round."""
+    import time as _time
+
+    payload = {"ts": _time.time(), "step": int(step), "digest": str(digest)}
+    client.put(AUDIT_SCOPE, str(int(rank)), json.dumps(payload).encode())
+
+
+def read_audit_digests(store_or_client) -> Dict[int, dict]:
+    """Driver side: ``{rank: {"ts", "step", "digest"}}`` of every
+    published audit entry. Malformed entries are skipped — a corrupt
+    audit record must not crash the auditor."""
+    out: Dict[int, dict] = {}
+    for key in store_or_client.keys(AUDIT_SCOPE):
+        raw = store_or_client.get(AUDIT_SCOPE, key)
+        if raw is None:
+            continue
+        try:
+            rank = int(key)
+            obj = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if isinstance(obj, dict) and "digest" in obj and "step" in obj:
+            out[rank] = obj
+    return out
+
+
 def _client_from_cfg(cfg) -> "RendezvousClient":
     """Shared construction of the worker-side KV client from config
     (secret decode + endpoint) — used by the object collectives and the

@@ -190,6 +190,8 @@ class ShardedDistributedOptimizer:
         world: Optional[int] = None,
         overlap_buckets: Optional[int] = None,
         overlap_min_bytes: Optional[int] = None,
+        grad_guard: Optional[bool] = None,
+        guard_max_skips: Optional[int] = None,
     ):
         """``overlap_buckets=N`` buckets the exchange (ops/overlap.py):
         gradients reduce-scatter as N independent per-bucket collectives
@@ -204,7 +206,19 @@ class ShardedDistributedOptimizer:
         shard interleave of arXiv 2004.13336, with state/checkpoint
         layout unchanged. ``None`` defers to ``HOROVOD_OVERLAP``/
         ``HOROVOD_OVERLAP_BUCKETS``; 0 keeps the per-leaf collectives.
-        """
+
+        ``grad_guard=True`` (``None`` defers to ``HOROVOD_GUARD``)
+        adds the non-finite skip-step sentinel (common/guard.py).
+        Unlike the replicated optimizer the reduce-scattered shards
+        DIVERGE per rank — a NaN lands in exactly one rank's shard —
+        so the flag costs one extra 4-byte scalar ``psum`` per step
+        (DeepSpeed/AMP's overflow-flag allreduce) to keep the skip
+        decision uniform across the gang. Skip semantics are gated by
+        ``where`` selects: bad steps feed the inner transform zeroed
+        gradients, discard its state delta, and emit zero updates;
+        the guard counters ride the state under a ``"guard"`` key —
+        an OPT-IN layout change (``reshard_state`` carries it across
+        world changes; unguarded jobs keep the flat layout)."""
         self._inner = optimizer
         self._op = resolve_op(op, average)
         if self._op not in (Sum, Average):
@@ -224,6 +238,19 @@ class ShardedDistributedOptimizer:
             if overlap_min_bytes is None
             else int(overlap_min_bytes)
         )
+        from .common import guard as _guard
+
+        self._guard_on = (
+            bool(grad_guard)
+            if grad_guard is not None
+            else _guard.default_enabled()
+        )
+        self._max_skips = int(
+            guard_max_skips
+            if guard_max_skips is not None
+            else _guard.default_max_skips()
+        )
+        self._guard_src = _guard.new_source() if self._guard_on else 0
         import os
 
         if os.environ.get(
@@ -262,13 +289,48 @@ class ShardedDistributedOptimizer:
         ]
         # stack rank-major: every leaf gets a leading world axis, so the
         # state rides shard_map with ONE spec: P(axis_name)
-        return jax.tree_util.tree_map(
+        stacked = jax.tree_util.tree_map(
             lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
             *shard_states,
         )
+        if not self._guard_on:
+            return stacked
+        # guard counters ride the same rank-major convention ([world]
+        # rows of replicated scalars) so the whole state still threads
+        # through shard_map with the single P(axis) spec
+        z = jnp.zeros((n,), jnp.int32)
+        return {"state": stacked, "guard": {"skips": z, "streak": z, "step": z}}
 
     # -- update (inside shard_map over axis_name) --------------------------
+    @staticmethod
+    def _is_guarded_layout(state) -> bool:
+        return isinstance(state, dict) and set(state) == {
+            "state", "guard",
+        }
+
     def update(self, grads, state, params):
+        guard_rows = None
+        if self._guard_on:
+            if not self._is_guarded_layout(state):
+                raise ValueError(
+                    "grad_guard is on but the optimizer state has the "
+                    "flat (unguarded) layout — it was created before "
+                    "the guard was enabled. Migrate it once with "
+                    "reshard_state(state, params, world) (which "
+                    "synthesizes zero guard counters), or re-run "
+                    "init(params)."
+                )
+            guard_rows = state["guard"]
+            state = state["state"]
+        elif self._is_guarded_layout(state):
+            raise ValueError(
+                "the optimizer state carries guard counters "
+                "({'state','guard'} layout) but grad_guard is off — "
+                "it was checkpointed by a GUARDED run. Re-enable the "
+                "guard, or downgrade the state once with "
+                "reshard_state(state, params, world) (which strips "
+                "the counters when the guard is off)."
+            )
         n = jax.lax.axis_size(self._axis)
         if self._world is not None and n != self._world:
             raise ValueError(
@@ -311,10 +373,40 @@ class ShardedDistributedOptimizer:
             )
         else:
             g_sh = jax.tree_util.tree_map(rs, grads)
+        finite = None
+        if self._guard_on:
+            from .ops.traced import tree_finite
+
+            # the scattered shards DIVERGE per rank (a NaN lands in
+            # exactly one shard), so the flag must be agreed: one
+            # 4-byte scalar psum — the only collective the guard adds
+            ok_local = tree_finite(g_sh)
+            bad = jax.lax.psum(
+                jnp.where(ok_local, 0.0, 1.0).astype(jnp.float32),
+                self._axis,
+            )
+            finite = bad == 0
+            # feed the inner transform clean zeros on a bad step; its
+            # output and state delta are discarded below anyway, this
+            # just keeps NaNs out of user transforms entirely
+            g_sh = jax.tree_util.tree_map(
+                lambda g: jnp.where(finite, g, jnp.zeros_like(g)), g_sh
+            )
         p_sh = jax.tree_util.tree_map(
             lambda p: p if p.ndim == 0 else _shard_dyn(p, n, idx), params
         )
         upd_sh, new_local = self._inner.update(g_sh, local_state, p_sh)
+        if self._guard_on:
+            # skip-step semantics by selection: zero updates, state of
+            # the last APPLIED step (where, not multiply — selects are
+            # NaN-safe)
+            upd_sh = jax.tree_util.tree_map(
+                lambda u: jnp.where(finite, u, jnp.zeros_like(u)), upd_sh
+            )
+            new_local = jax.tree_util.tree_map(
+                lambda nl, ol: jnp.where(finite, nl, ol),
+                new_local, local_state,
+            )
 
         def gather(u, p):
             if p.ndim == 0:
@@ -329,7 +421,40 @@ class ShardedDistributedOptimizer:
         new_state = jax.tree_util.tree_map(
             lambda x: x[None], new_local
         )
-        return upd, new_state
+        if not self._guard_on:
+            return upd, new_state
+        import functools
+
+        from .common import guard as _guard
+
+        skips = guard_rows["skips"][0]
+        streak = guard_rows["streak"][0]
+        step = guard_rows["step"][0]
+        streak_next = streak + 1
+
+        def _quiet(_):
+            return jnp.int32(0)
+
+        def _fire(_):
+            # skip branch only: the healthy path never reaches the host
+            jax.debug.callback(
+                functools.partial(
+                    _guard.record_skip, max_skips=self._max_skips,
+                    source=self._guard_src,
+                ),
+                streak_next, step,
+            )
+            return jnp.int32(0)
+
+        jax.lax.cond(finite, _quiet, _fire, operand=None)
+        one = jnp.ones((), jnp.int32)
+        zero = jnp.zeros((), jnp.int32)
+        new_guard = {
+            "skips": jnp.where(finite, skips, skips + one)[None],
+            "streak": jnp.where(finite, zero, streak_next)[None],
+            "step": (step + one)[None],
+        }
+        return upd, {"state": new_state, "guard": new_guard}
 
     # -- bucketed exchange (overlap_buckets) -------------------------------
     def _bucketed_rs(self, g_leaves, g_def, nonscalar, sched, n):
@@ -425,6 +550,27 @@ class ShardedDistributedOptimizer:
         (scalars like Adam's ``count``; 0-d params) re-broadcast."""
         if new_world < 1:
             raise ValueError(f"new_world must be >= 1, got {new_world}")
+        guard_rows = None
+        if self._guard_on:
+            if self._is_guarded_layout(state):
+                # guarded layout: reshard the inner state, then
+                # re-stack the (replicated) guard counters at the new
+                # world size — skip totals and the escalation streak
+                # survive the gang change just like the Adam moments
+                guard_rows = state["guard"]
+                state = state["state"]
+            else:
+                # legacy flat state under a NEWLY-enabled guard:
+                # resharding is the migration point — synthesize zero
+                # counters so the resumed job starts guarded instead
+                # of crashing at its first update
+                zero = np.zeros((1,), np.int64)
+                guard_rows = {"skips": zero, "streak": zero, "step": zero}
+        elif self._is_guarded_layout(state):
+            # guard turned OFF against a guarded checkpoint: the same
+            # migration point downgrades — strip the counters and
+            # reshard the inner state alone
+            state = state["state"]
         template = self._inner.init(
             jax.tree_util.tree_map(
                 lambda p: _shard_host(p, new_world, 0), params
@@ -460,4 +606,14 @@ class ShardedDistributedOptimizer:
                 jnp.asarray(full.reshape(new_world, per_rank), t.dtype)
             )
         self._world = new_world
-        return jax.tree_util.tree_unflatten(treedef, out)
+        resharded = jax.tree_util.tree_unflatten(treedef, out)
+        if guard_rows is None:
+            return resharded
+        new_guard = {
+            key: jnp.broadcast_to(
+                jnp.asarray(np.asarray(val).reshape(-1)[0], jnp.int32),
+                (new_world,),
+            )
+            for key, val in guard_rows.items()
+        }
+        return {"state": resharded, "guard": new_guard}

@@ -43,6 +43,14 @@ same text): rules separated by ``;``, tokens within a rule by ``:``.
   ``timeout`` (TimeoutError), ``5xx`` (retryable server error; HTTP
   servers materialize it as a real 503), ``kill``
   (``SIGKILL`` to self — the process-death drill). Default: ``reset``.
+* DATA kinds — ``nan`` and ``bitflip`` — never raise: :func:`inject`
+  *returns* the fired kind and the site corrupts its own payload
+  (``fusion.dispatch`` poisons one float of the next fused batch;
+  ``checkpoint.save`` flips a byte of the just-written checkpoint so
+  digest verification has something real to catch). A data kind fired
+  at a site that cannot corrupt anything is counted and logged but
+  otherwise a no-op — the counter still fails a drill that expected
+  the corruption to surface.
 * ``ms=250`` — delay duration (kind ``delay``; default 100).
 * ``n=3`` — max fires for this rule (default: 1 for ``@N`` rules,
   unlimited for probabilistic/always rules).
@@ -66,7 +74,11 @@ from ..common.logging import get_logger
 
 _log = get_logger("chaos")
 
-KINDS = ("delay", "reset", "timeout", "5xx", "kill")
+KINDS = ("delay", "reset", "timeout", "5xx", "kill", "nan", "bitflip")
+
+# Kinds that corrupt DATA instead of transport: fire() RETURNS them to
+# the calling site (which owns the corruption) rather than raising.
+DATA_KINDS = ("nan", "bitflip")
 
 
 class InjectedServerError(RuntimeError):
@@ -197,10 +209,12 @@ class FaultPlan:
             rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
         return rng
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str) -> Optional[str]:
         """Count a hit at ``site`` and materialize any due fault.
         Raises the fault's exception (reset/timeout/5xx), sleeps
-        (delay), or SIGKILLs the process (kill)."""
+        (delay), or SIGKILLs the process (kill). DATA kinds
+        (nan/bitflip) are returned to the caller — the site owns the
+        corruption; returns None when nothing fired."""
         due: Optional[FaultRule] = None
         hit = 0
         with self._lock:
@@ -224,7 +238,7 @@ class FaultPlan:
                     {"site": site, "kind": due.kind, "hit": hit}
                 )
         if due is None:
-            return
+            return None
         from ..common.metrics import registry as _metrics
 
         _metrics.counter("faults_injected")
@@ -244,6 +258,9 @@ class FaultPlan:
             raise InjectedServerError(site)
         elif due.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
+        elif due.kind in DATA_KINDS:
+            return due.kind
+        return None
 
 
 # ------------------------------------------------------------- global plan
@@ -304,14 +321,17 @@ def reset() -> None:
         _loaded = False
 
 
-def inject(site: str) -> None:
+def inject(site: str) -> Optional[str]:
     """The hook every instrumented site calls. Near-zero cost when no
-    plan is configured (one global read + one branch)."""
+    plan is configured (one global read + one branch). Transport kinds
+    raise; DATA kinds (nan/bitflip) are returned so the site can
+    corrupt its own payload — callers that can't corrupt ignore the
+    return value."""
     p = _plan
     if p is None:
         if _loaded:
-            return
+            return None
         p = _load()
         if p is None:
-            return
-    p.fire(site)
+            return None
+    return p.fire(site)

@@ -16,6 +16,14 @@ Runs a short multi-process elastic job under a seeded ``FaultPlan``:
 Asserts: driver exit code 0, EXACTLY one gang restart (8 -> 6), the
 expected per-epoch result files, and the scraped counters. Exit 0 on
 success; any assertion failure is a CI failure.
+
+An **integrity drill** (PR 7) runs first, in its own subprocess: a
+guarded training loop on the 8-device CPU mesh eats one injected NaN
+step (``train.nan@3:nan`` — the update must be SKIPPED and
+``hvd_guard_nonfinite_steps`` counted) and one injected checkpoint
+bitflip (``checkpoint.save@2:bitflip`` — ``restore_latest_good`` must
+fall back past the digest mismatch), with every counter asserted over
+the worker's live ``/metrics`` scrape.
 """
 
 import json
@@ -124,7 +132,145 @@ def _prom_value(text: str, name: str) -> float:
     raise AssertionError(f"metric {name} not in scrape:\n{text[:600]}")
 
 
+INTEGRITY_WORKER = """\
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+workdir = os.environ["CHAOS_SMOKE_DIR"]
+
+import jax, jax.numpy as jnp, optax
+from jax.sharding import PartitionSpec as P
+import horovod_tpu as hvd
+from horovod_tpu.common.compat import shard_map
+from horovod_tpu.common.metrics import registry
+from horovod_tpu.checkpoint import CheckpointManager
+from horovod_tpu.common import telemetry
+from horovod_tpu.testing import chaos
+
+# the seeded integrity plan: NaN at training step 3, bitflip on the
+# SECOND checkpoint save
+chaos.configure("seed=11;train.nan@3:nan;checkpoint.save@2:bitflip")
+
+hvd.init()
+world = hvd.size()
+mesh = hvd.mesh()
+opt = hvd.DistributedOptimizer(
+    optax.sgd(0.1), op=hvd.Sum, grad_guard=True, guard_max_skips=0,
+    overlap_buckets=2,
+)
+# non-constant values: a constant array compresses to nothing and
+# the bitflip would land in container slack instead of payload
+params = {"w": jnp.linspace(1.0, 2.0, 4096, dtype=jnp.float32)}
+state = opt.init(params)
+
+@jax.jit
+def step(grads, state, params):
+    def body(g, s, p):
+        g = jax.tree_util.tree_map(lambda x: x[0], g)
+        u, s2 = opt.update(g, s, p)
+        return jax.tree_util.tree_map(lambda a, b: a + b, p, u), s2
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(hvd.WORLD_AXIS), P(), P()),
+        out_specs=(P(), P()), check_vma=False,
+    )(grads, state, params)
+
+ckpt = CheckpointManager(os.path.join(workdir, "ckpt"), async_save=False)
+losses = []
+for i in range(1, 7):
+    g = {"w": jnp.ones((world, 4096), jnp.float32)}
+    if chaos.inject("train.nan") == "nan":
+        g = {"w": g["w"].at[0, 0].set(jnp.nan)}
+    params, state = step(g, state, params)
+    jax.block_until_ready(params["w"])
+    losses.append(float(params["w"][0]))
+    if i in (2, 4):
+        # save hit 2 (i == 4) eats the bitflip
+        ckpt.save(i, {"params": params, "i": i})
+ckpt.wait_until_finished()
+
+# the NaN step was SKIPPED: params advanced 5 times, not 6
+assert int(state.guard_skips) == 1, int(state.guard_skips)
+assert abs(losses[-1] - (1.0 - 0.1 * 8 * 5)) < 1e-5, losses
+
+# the bitflipped newest checkpoint is bypassed via digest verification
+like = {"params": params, "i": 0}
+got_step, _ = ckpt.restore_latest_good(like=like)
+assert got_step == 2, f"expected fallback to step 2, got {got_step}"
+snap = registry.snapshot()
+assert snap.get("guard.nonfinite_steps", 0) >= 1, snap
+assert snap.get("checkpoint.digest_mismatch", 0) >= 1, snap
+assert snap.get("checkpoint.fallback", 0) >= 1, snap
+
+# serve the counters for the gate's live scrape
+server = telemetry.MetricsServer(port=0)
+port = server.start()
+port_file = os.path.join(workdir, "integrity_port")
+with open(port_file + ".tmp", "w") as f:
+    f.write(str(port))
+os.replace(port_file + ".tmp", port_file)
+import time
+ack = os.path.join(workdir, "integrity.ok")
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline and not os.path.exists(ack):
+    time.sleep(0.1)
+sys.exit(0)
+"""
+
+
+def integrity_drill() -> None:
+    """One injected NaN step + one injected checkpoint bitflip in a
+    guarded training loop; counters asserted over the live scrape."""
+    import subprocess
+
+    workdir = tempfile.mkdtemp(prefix="hvd-integrity-smoke-")
+    script = os.path.join(workdir, "integrity_worker.py")
+    with open(script, "w") as f:
+        f.write(INTEGRITY_WORKER)
+    env = dict(os.environ)
+    env["CHAOS_SMOKE_DIR"] = workdir
+    env.pop("HOROVOD_FAULT_PLAN", None)
+    proc = subprocess.Popen([sys.executable, script], env=env)
+    try:
+        port_file = os.path.join(workdir, "integrity_port")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"integrity worker died rc={proc.returncode}"
+                )
+            time.sleep(0.1)
+        assert os.path.exists(port_file), "integrity worker never served"
+        with open(port_file) as f:
+            port = int(f.read().strip())
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        assert _prom_value(text, "hvd_guard_nonfinite_steps") >= 1
+        assert _prom_value(text, "hvd_checkpoint_digest_mismatch") >= 1
+        assert _prom_value(text, "hvd_checkpoint_fallback") >= 1
+        assert _prom_value(text, "hvd_faults_injected") >= 2
+        ack = os.path.join(workdir, "integrity.ok")
+        with open(ack + ".tmp", "w") as f:
+            f.write("ok")
+        os.replace(ack + ".tmp", ack)
+        proc.wait(timeout=30)
+        assert proc.returncode == 0, f"integrity worker rc={proc.returncode}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    print(
+        "integrity-drill OK: NaN step skipped, bitflipped checkpoint "
+        "bypassed via digest, counters live on /metrics"
+    )
+
+
 def main() -> int:
+    integrity_drill()
     workdir = tempfile.mkdtemp(prefix="hvd-chaos-smoke-")
     script = os.path.join(workdir, "worker.py")
     with open(script, "w") as f:

@@ -16,6 +16,19 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True)
+def _rearm_kv_polls():
+    """The preemption handler latches the PROCESS-WIDE KV poll-shutdown
+    event (by design — a preempted worker must stop spinning against
+    the driver). Tests that fire it must re-arm the latch, or every
+    later KV wait() in the suite silently aborts on its first poll
+    (test_runner's version-consistency check was the victim)."""
+    yield
+    from horovod_tpu.runner import rendezvous as _rdv
+
+    _rdv.reset_poll_shutdown()
+
+
 def test_handler_latches_and_chains():
     from horovod_tpu.preemption import PreemptionHandler
 
