@@ -1,0 +1,174 @@
+"""Continuous-vs-static batching A/B (horovod_tpu/serving/).
+
+Measures what the continuous-batching scheduler actually buys over
+classic batch-barrier inference ON THE SAME engine — the serving
+analog of the Gemma-on-TPU paper's scheduling claim (PAPERS.md, arXiv
+2605.25645; the pre-registered prediction table is in docs/perf.md
+§"Serving: continuous vs static batching").
+
+Two legs over the SAME toy decoder, the SAME Poisson-ish staggered
+arrival trace, and the SAME per-request token budget, each appending
+one JSON artifact under BENCH_ARTIFACT_DIR (default
+bench_results/serve/):
+
+* ``ab_static``     — ``ContinuousBatcher(policy="static")``: requests
+  admitted only when the previous batch fully completed. A late
+  arrival waits for the whole in-flight batch (head-of-line blocking);
+  the batch's tail token rate decays as members finish.
+* ``ab_continuous`` — the default policy: arrivals admitted into freed
+  slots between decode steps, no flush, no barrier.
+
+Each artifact records per-request TTFT and per-token TPOT p50/p95 plus
+aggregate generated tokens/s. Both legs pay their compiles in an
+untimed warmup (prefill buckets + the decode step), so the measured
+delta is pure scheduling. BENCH_DRYRUN=1 is the CI smoke shape
+(`./ci.sh bench-smoke` gates on the artifacts existing); CPU lines
+carry the quarantine note — the decode step is milliseconds on CPU and
+microseconds of MXU on a chip, so only an on-chip capture decides the
+wall-clock claim, but the SCHEDULING effect (TTFT under load) is real
+in either domain.
+
+Env: BENCH_REQUESTS / BENCH_GEN_TOKENS / BENCH_SLOTS / BENCH_STAGGER_MS.
+"""
+
+import json
+import os
+import time
+
+_SIM_NOTE = (
+    "logic-validation only (CPU simulation); decode steps are ms on "
+    "CPU vs us on MXU — NOT a TPU wall-clock number, but the "
+    "scheduling deltas (TTFT under load) are structural"
+)
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+    from horovod_tpu.serving.batcher import ContinuousBatcher
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    dryrun = os.environ.get("BENCH_DRYRUN", "").strip() in ("1", "true")
+    n_requests = int(
+        os.environ.get("BENCH_REQUESTS", "6" if dryrun else "32")
+    )
+    gen_tokens = int(
+        os.environ.get("BENCH_GEN_TOKENS", "4" if dryrun else "32")
+    )
+    slots = int(os.environ.get("BENCH_SLOTS", "4" if dryrun else "8"))
+    stagger_ms = float(
+        os.environ.get("BENCH_STAGGER_MS", "5" if dryrun else "20")
+    )
+    platform = jax.devices()[0].platform
+
+    artifact_dir = os.environ.get(
+        "BENCH_ARTIFACT_DIR", os.path.join("bench_results", "serve")
+    )
+    os.makedirs(artifact_dir, exist_ok=True)
+
+    if dryrun:
+        cfg = TransformerConfig(
+            vocab_size=61, num_layers=1, d_model=16, num_heads=2,
+            d_ff=32, max_len=128, causal=True, dtype=jnp.float32,
+        )
+    else:
+        cfg = TransformerConfig(
+            vocab_size=1024, num_layers=4, d_model=256, num_heads=8,
+            d_ff=1024, max_len=512, causal=True, dtype=jnp.float32,
+        )
+    model = Transformer(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), train=False
+    )
+    rng = np.random.default_rng(0)
+    # mixed-length arrival trace, shared by both legs
+    lengths = rng.integers(4, 48 if dryrun else 128, size=n_requests)
+    prompts = [
+        list(rng.integers(1, cfg.vocab_size, size=int(n)))
+        for n in lengths
+    ]
+
+    def run_leg(policy: str) -> dict:
+        engine = InferenceEngine(
+            model, params, slots=slots, max_len=cfg.max_len
+        )
+        batcher = ContinuousBatcher(
+            engine,
+            policy=policy,
+            max_admit_per_step=max(slots // 2, 1),
+            default_max_new_tokens=gen_tokens,
+        )
+        # untimed warmup: pay every prefill-bucket + decode compile the
+        # trace will touch, so the timed region measures scheduling
+        warm = batcher.submit(prompts[0][: max(len(prompts[0]) // 2, 1)])
+        while not warm.finished():
+            batcher.step()
+        for _ in range(2):  # twice: the 2nd sighting promotes, so the
+            for p in prompts:  # exact-tier compiles land here, untimed
+                engine._get_prefill_exe(len(p))
+        batcher.start()
+        t0 = time.monotonic()
+        reqs = []
+        for p in prompts:
+            reqs.append(batcher.submit(p))
+            time.sleep(stagger_ms / 1e3)
+        for r in reqs:
+            r.wait(timeout=600)
+        wall_s = time.monotonic() - t0
+        batcher.stop()
+        assert all(r.status == "done" for r in reqs), [
+            r.status for r in reqs
+        ]
+        ttfts = sorted(r.ttft_ms for r in reqs)
+        slo = batcher.recorder.summaries()
+        total_tokens = sum(len(r.out_tokens) for r in reqs)
+
+        def pct(vals, q):
+            idx = min(
+                int(q * (len(vals) - 1) + 0.5), len(vals) - 1
+            )
+            return vals[idx]
+
+        return {
+            "metric": "serve_ab",
+            "leg": f"ab_{policy}",
+            "policy": policy,
+            "platform": platform,
+            "requests": n_requests,
+            "slots": slots,
+            "gen_tokens": gen_tokens,
+            "stagger_ms": stagger_ms,
+            "wall_s": round(wall_s, 4),
+            "tokens_out": total_tokens,
+            "tokens_per_s": round(total_tokens / wall_s, 3),
+            "ttft_ms_p50": round(pct(ttfts, 0.5), 3),
+            "ttft_ms_p95": round(pct(ttfts, 0.95), 3),
+            "tpot_ms_p50": round(slo["tpot_ms"]["p50"], 4),
+            "tpot_ms_p95": round(slo["tpot_ms"]["p95"], 4),
+            "decode_steps": engine.stats()["decode_steps"],
+            "decode_compiles": engine.stats()["decode_compiles"],
+            "dryrun": dryrun,
+            "note": _SIM_NOTE if platform == "cpu" else "on-chip",
+        }
+
+    for policy in ("static", "continuous"):
+        line = run_leg(policy)
+        path = os.path.join(artifact_dir, f"serve_ab_{policy}.json")
+        with open(path, "w") as f:
+            f.write(json.dumps(line) + "\n")
+        print(json.dumps(line))
+    print(f"bench_serve artifacts in {artifact_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,7 +15,15 @@
 #                    endpoint on an ephemeral port: Prometheus scrape
 #                    (step p50/p95 + registry gauges) and the
 #                    flight-recorder JSON-lines dump must both work
-#   6. chaos-smoke — scripts/chaos_smoke.py: an integrity drill (one
+#   6. serve-smoke — scripts/serve_smoke.py: a 2-worker inference
+#                    fleet on a toy transformer — concurrent
+#                    mixed-length prompts routed through the
+#                    rendezvous-KV capacity announcements, TTFT/TPOT
+#                    quantiles + slot gauges asserted on the live
+#                    /metrics scrape, then SIGTERM both workers and
+#                    assert the drain completed every accepted request
+#                    (exit 143) — the serving plane can't silently rot
+#   7. chaos-smoke — scripts/chaos_smoke.py: an integrity drill (one
 #                    injected NaN training step that the grad guard
 #                    must SKIP and count, one injected checkpoint
 #                    bitflip that digest verification must bypass via
@@ -29,7 +37,7 @@
 #                    endpoint — neither the chaos hardening nor the
 #                    integrity plane can silently rot
 #
-# Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|chaos-smoke|all]
+# Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|serve-smoke|chaos-smoke|all]
 # (default: all)
 
 set -euo pipefail
@@ -40,7 +48,7 @@ step() { printf '\n=== %s ===\n' "$*"; }
 lint() {
   step "lint: pyflakes-level check via python -m compileall + import"
   python -m compileall -q horovod_tpu tests bench.py bench_lm.py \
-    bench_allreduce.py __graft_entry__.py
+    bench_allreduce.py bench_serve.py __graft_entry__.py
   # ruff/flake8 aren't in the image; compile + import-sanity is the
   # supported floor. Import must succeed without TPU hardware.
   JAX_PLATFORMS=cpu python -c "import horovod_tpu"
@@ -97,7 +105,20 @@ bench_smoke() {
     test -s "$art_dir/overlap_${leg}.json" \
       || { echo "missing artifact: overlap_${leg}.json" >&2; exit 1; }
   done
+  step "bench-smoke: bench_serve.py dryrun (static-vs-continuous A/B)"
+  JAX_PLATFORMS=cpu \
+    BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
+    python bench_serve.py
+  for leg in static continuous; do
+    test -s "$art_dir/serve_ab_${leg}.json" \
+      || { echo "missing artifact: serve_ab_${leg}.json" >&2; exit 1; }
+  done
   echo "bench-smoke artifacts OK: $art_dir"
+}
+
+serve_smoke() {
+  step "serve-smoke: 2-worker fleet, routed mixed-length prompts, SLO scrape, SIGTERM drain"
+  python scripts/serve_smoke.py
 }
 
 telemetry_smoke() {
@@ -117,7 +138,8 @@ case "${1:-all}" in
   tests)       tests ;;
   bench-smoke) bench_smoke ;;
   telemetry-smoke) telemetry_smoke ;;
+  serve-smoke) serve_smoke ;;
   chaos-smoke) chaos_smoke ;;
-  all)         lint; native; tests; bench_smoke; telemetry_smoke; chaos_smoke ;;
-  *) echo "usage: $0 [lint|native|tests|bench-smoke|telemetry-smoke|chaos-smoke|all]" >&2; exit 2 ;;
+  all)         lint; native; tests; bench_smoke; telemetry_smoke; serve_smoke; chaos_smoke ;;
+  *) echo "usage: $0 [lint|native|tests|bench-smoke|telemetry-smoke|serve-smoke|chaos-smoke|all]" >&2; exit 2 ;;
 esac

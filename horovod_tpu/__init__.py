@@ -151,6 +151,17 @@ from .audit import (  # noqa: F401  (cross-rank parameter audit)
 )
 
 
+def serve(model, params, port=None, **kwargs):
+    """``hvd.serve(model, params, port=...)`` — start the inference
+    plane on this worker (horovod_tpu/serving/: continuous batching
+    over a compiled prefill/decode split, slot KV cache, SLO-metered
+    HTTP frontend, rendezvous-announced capacity, SIGTERM drain).
+    Returns a ``ServeHandle``; see docs/serving.md."""
+    from .serving import serve as _serve
+
+    return _serve(model, params, port=port, **kwargs)
+
+
 def __getattr__(name):
     # hvd.SyncBatchNorm parity (ref [V]) without making flax a hard
     # import-time dependency of the whole package — launcher-only hosts
@@ -159,6 +170,11 @@ def __getattr__(name):
         from .models.resnet import SyncBatchNorm
 
         return SyncBatchNorm
+    if name == "serving":
+        # lazy: the serving plane is worker-role code, not launcher code
+        from . import serving
+
+        return serving
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __version__ = "0.1.0"

@@ -44,6 +44,14 @@ DEFAULT_STRAGGLER_QUARANTINE_POLLS = 3
 # and the parameter audit runs every N optimizer steps (0 = off).
 DEFAULT_GUARD_MAX_SKIPS = 3
 DEFAULT_AUDIT_STEPS = 0
+# Serving plane (horovod_tpu/serving/): decode-slot count (concurrent
+# sequences), admissions per decode step, default per-request token
+# budget/deadline, and the frontend port (0 = ephemeral).
+DEFAULT_SERVE_PORT = 0
+DEFAULT_SERVE_KV_SLOTS = 8
+DEFAULT_SERVE_MAX_BATCH = 4
+DEFAULT_SERVE_MAX_TOKENS = 64
+DEFAULT_SERVE_DEADLINE_MS = 0.0  # 0 = no deadline
 
 
 def _env_bool(name: str, default: bool = False) -> bool:
@@ -219,6 +227,21 @@ class Config:
     # surface through the rendezvous KV as a `divergence` restart.
     audit_steps: int = DEFAULT_AUDIT_STEPS
 
+    # --- serving plane (horovod_tpu/serving/) ---
+    # hvd.serve frontend port (0 = ephemeral, announced over the
+    # rendezvous KV either way)
+    serve_port: int = DEFAULT_SERVE_PORT
+    # decode slots = concurrent in-flight sequences per worker (the
+    # fixed decode-batch shape; also the KV cache's batch dimension)
+    serve_kv_slots: int = DEFAULT_SERVE_KV_SLOTS
+    # prefill admissions between two decode steps — the TTFT-vs-TPOT
+    # interleaving policy knob (serving/batcher.py)
+    serve_max_batch: int = DEFAULT_SERVE_MAX_BATCH
+    # default per-request new-token budget (per-request max_tokens wins)
+    serve_max_tokens: int = DEFAULT_SERVE_MAX_TOKENS
+    # default per-request deadline in ms (0 = none; per-request wins)
+    serve_deadline_ms: float = DEFAULT_SERVE_DEADLINE_MS
+
     # --- logging ---
     log_level: str = "warning"
     log_timestamp: bool = True
@@ -347,6 +370,19 @@ class Config:
             ),
             audit_steps=_env_int(
                 "HOROVOD_AUDIT_STEPS", DEFAULT_AUDIT_STEPS
+            ),
+            serve_port=_env_int("HOROVOD_SERVE_PORT", DEFAULT_SERVE_PORT),
+            serve_kv_slots=_env_int(
+                "HOROVOD_SERVE_KV_SLOTS", DEFAULT_SERVE_KV_SLOTS
+            ),
+            serve_max_batch=_env_int(
+                "HOROVOD_SERVE_MAX_BATCH", DEFAULT_SERVE_MAX_BATCH
+            ),
+            serve_max_tokens=_env_int(
+                "HOROVOD_SERVE_MAX_TOKENS", DEFAULT_SERVE_MAX_TOKENS
+            ),
+            serve_deadline_ms=_env_float(
+                "HOROVOD_SERVE_DEADLINE_MS", DEFAULT_SERVE_DEADLINE_MS
             ),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_timestamp=_env_bool("HOROVOD_LOG_TIMESTAMP", True),

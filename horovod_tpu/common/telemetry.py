@@ -85,6 +85,12 @@ _COUNTER_KEYS = (
     # flight recorder pins integrity events to exact steps
     "guard.nonfinite_steps",
     "audit.digests",
+    # serving plane (horovod_tpu/serving/): a decode-step record's
+    # tokens-out delta is its realized batch occupancy, and a nonzero
+    # admitted_mid_decode delta pins a TPOT blip to the prefill that
+    # caused it
+    "serve.tokens_out",
+    "serve.admitted_mid_decode",
 )
 
 # Gauges copied into the record's ``tuner`` dict — the autotune /
@@ -348,6 +354,13 @@ class TelemetryHub:
                 "audit.last_digest_step": snap.get(
                     "audit.last_digest_step", 0.0
                 ),
+                # serving plane: tokens this record emitted and the
+                # mid-decode admissions that landed inside it (both 0
+                # on training steps)
+                "serve.tokens_out": deltas["serve.tokens_out"],
+                "serve.admitted_mid_decode": deltas[
+                    "serve.admitted_mid_decode"
+                ],
                 "tuner": tuner,
             }
         )

@@ -9,6 +9,10 @@ TPU-first in flax: bfloat16-friendly, static shapes, remat hooks."""
 from .inception import InceptionV3  # noqa: F401
 from .mnist import MNISTConvNet  # noqa: F401
 from .resnet import ResNet50, ResNet101  # noqa: F401
-from .transformer import Transformer, TransformerConfig  # noqa: F401
+from .transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+    init_cache,
+)
 from .vgg import VGG16  # noqa: F401
 from .vit import ViT, ViTConfig  # noqa: F401

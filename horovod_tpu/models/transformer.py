@@ -154,23 +154,28 @@ def apply_rope(x, base: float = 10000.0, offset=0):
     (RoFormer). Pairs are (x[..., :d/2], x[..., d/2:]) — the
     'rotate-half' convention — so the op is two multiplies and one
     concat, fully XLA-fusible. fp32 trig regardless of input dtype;
-    ``offset`` shifts positions (sequence-parallel shards pass their
-    global start — may be a traced value, e.g. axis_index·t_local)."""
+    ``offset`` shifts positions: a scalar (sequence-parallel shards
+    pass their global start — may be a traced value, e.g.
+    axis_index·t_local) or a ``[batch]`` array (incremental decode:
+    every cache slot sits at its own position)."""
     b, t, h, d = x.shape
     half = d // 2
     if d % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {d}")
     # offset + iota rather than arange(offset, ...) so traced offsets
-    # (SP shards) work
-    pos = jnp.asarray(offset, jnp.float32) + jnp.arange(
+    # (SP shards, decode cache indices) work
+    pos = jnp.asarray(offset, jnp.float32)[..., None] + jnp.arange(
         t, dtype=jnp.float32
-    )
+    )  # [t] for scalar offsets, [b, t] for per-slot offsets
     inv_freq = base ** (
         -jnp.arange(0, half, dtype=jnp.float32) / half
     )
-    angles = pos[:, None] * inv_freq[None, :]  # [t, half]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = pos[..., :, None] * inv_freq  # [(b,) t, half]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
+    if angles.ndim == 2:  # scalar offset: broadcast over batch
+        cos, sin = cos[None], sin[None]
+    cos, sin = cos[:, :, None, :], sin[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     return jnp.concatenate(
@@ -178,13 +183,59 @@ def apply_rope(x, base: float = 10000.0, offset=0):
     ).astype(x.dtype)
 
 
+def init_cache(cfg: TransformerConfig, batch: int, max_len=None, dtype=None):
+    """Allocate an empty decode KV cache: one ``{"k", "v"}`` dict per
+    layer, each ``[batch, max_len, num_kv_heads, head_dim]`` of zeros.
+
+    This is the model half of the serving contract
+    (horovod_tpu/serving/): the cache rides
+    ``Transformer.__call__(cache=, cache_index=)`` — written in place
+    (functionally) at each call's positions and returned updated, so a
+    jitted decode step can donate it through successive steps. Slots
+    never need re-zeroing on reuse: positions at or beyond a slot's
+    ``cache_index`` are masked out of attention and every attended
+    position is overwritten by prefill/decode before it first becomes
+    attendable."""
+    seq = int(max_len) if max_len is not None else cfg.max_len
+    if not cfg.rope and seq > cfg.max_len:
+        # the learned position table has cfg.max_len rows; a longer
+        # cache would let decode feed positions past it, and the jitted
+        # gather CLAMPS out-of-range indices instead of raising —
+        # silently wrong logits, so refuse here where it is loud
+        raise ValueError(
+            f"KV cache max_len ({seq}) exceeds the learned position "
+            f"table ({cfg.max_len}); raise cfg.max_len or use rope=True"
+        )
+    kv_heads = cfg.num_kv_heads or cfg.num_heads
+    head_dim = cfg.d_model // cfg.num_heads
+    dt = cfg.dtype if dtype is None else dtype
+    return [
+        {
+            "k": jnp.zeros((batch, seq, kv_heads, head_dim), dt),
+            "v": jnp.zeros((batch, seq, kv_heads, head_dim), dt),
+        }
+        for _ in range(cfg.num_layers)
+    ]
+
+
 class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, lengths=None):
+    def __call__(self, x, mask=None, lengths=None, cache=None,
+                 cache_index=None):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
+        if cache is not None:
+            if not cfg.causal:
+                raise ValueError(
+                    "incremental decode (cache=) requires causal=True"
+                )
+            if mask is not None or lengths is not None:
+                raise ValueError(
+                    "cache= does not compose with mask=/lengths=: the "
+                    "cache_index IS the per-slot length"
+                )
         if cfg.num_kv_heads:
             if cfg.num_heads % cfg.num_kv_heads:
                 raise ValueError(
@@ -207,8 +258,12 @@ class MultiHeadAttention(nn.Module):
                 qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
             )
         if cfg.rope:
-            q = apply_rope(q, cfg.rope_base)
-            k = apply_rope(k, cfg.rope_base)
+            rope_offset = 0 if cache is None else cache_index
+            q = apply_rope(q, cfg.rope_base, offset=rope_offset)
+            k = apply_rope(k, cfg.rope_base, offset=rope_offset)
+        if cache is not None:
+            return self._cached_attention(cfg, x, q, k, v, cache,
+                                          cache_index, head_dim)
         # lengths (right-padding) stays on the flash path — the kernels
         # take it natively; only ARBITRARY masks force dense.
         use_flash = cfg.uses_flash(mask, seq=x.shape[1])
@@ -282,15 +337,69 @@ class MultiHeadAttention(nn.Module):
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
         )(out)
 
+    def _cached_attention(self, cfg, x, q, k, v, cache, cache_index,
+                          head_dim):
+        """Incremental-decode attention: write this call's k/v into the
+        per-slot cache at ``cache_index`` (each batch row at its own
+        position — prefill passes t=prompt tokens at index 0, decode
+        passes t=1 at index=length), then attend q against the FULL
+        cache under the global causal mask ``key_pos <= query_pos``.
+        Positions at or beyond a slot's write frontier are masked to
+        exact −1e30 → exact-zero probabilities, so stale slot contents
+        (a reused slot, bucket padding) can never leak into the output
+        and the dense path stays bit-comparable with the full-sequence
+        forward. Returns ``(out, {"k", "v"})`` — the updated cache."""
+        b, t = x.shape[0], x.shape[1]
+        seq = cache["k"].shape[1]
+        idx = jnp.asarray(cache_index, jnp.int32)
+
+        def _write(buf, new, i):
+            return jax.lax.dynamic_update_slice(
+                buf, new.astype(buf.dtype), (i, 0, 0)
+            )
+
+        k_cache = jax.vmap(_write)(cache["k"], k, idx)
+        v_cache = jax.vmap(_write)(cache["v"], v, idx)
+        new_cache = {"k": k_cache, "v": v_cache}
+        kk, vv = k_cache, v_cache
+        if cfg.num_kv_heads and cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            kk = jnp.repeat(kk, rep, axis=2)
+            vv = jnp.repeat(vv, rep, axis=2)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kk, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(head_dim).astype(jnp.float32)
+        q_pos = idx[:, None] + jnp.arange(t)          # [b, t] global
+        key_pos = jnp.arange(seq)                     # [seq]
+        valid = key_pos[None, None, :] <= q_pos[:, :, None]  # [b, t, seq]
+        if cfg.sliding_window:
+            valid = valid & (
+                q_pos[:, :, None] - key_pos[None, None, :]
+                < cfg.sliding_window
+            )
+        scores = jnp.where(valid[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(out), new_cache
+
 
 class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = True, lengths=None):
+    def __call__(self, x, mask=None, train: bool = True, lengths=None,
+                 cache=None, cache_index=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = MultiHeadAttention(cfg)(h, mask, lengths)
+        new_cache = None
+        if cache is None:
+            h = MultiHeadAttention(cfg)(h, mask, lengths)
+        else:
+            h, new_cache = MultiHeadAttention(cfg)(
+                h, mask, lengths, cache=cache, cache_index=cache_index
+            )
         h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -298,7 +407,9 @@ class Block(nn.Module):
         h = nn.gelu(h)
         h = nn.Dense(cfg.d_model, dtype=cfg.dtype)(h)
         h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
-        return x + h
+        if cache is None:
+            return x + h
+        return x + h, new_cache
 
 
 class LMHead(nn.Module):
@@ -340,14 +451,41 @@ class Transformer(nn.Module):
     def __call__(
         self, tokens, mask=None, train: bool = True,
         return_hidden: bool = False, lengths=None,
+        cache=None, cache_index=None,
     ):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype)(tokens)
         if not cfg.rope:
+            if cache is None:
+                positions = jnp.arange(tokens.shape[1])[None]
+            else:
+                # incremental decode: each cache slot sits at its own
+                # absolute position (its current length)
+                positions = (
+                    jnp.asarray(cache_index, jnp.int32)[:, None]
+                    + jnp.arange(tokens.shape[1])
+                )
             pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype)(
-                jnp.arange(tokens.shape[1])[None]
+                positions
             )
             x = x + pos
+        if cache is not None:
+            # KV-cache-threaded forward (the serving engine's model
+            # contract, horovod_tpu/serving/engine.py): same param
+            # tree, same block stack, dense attention over the cache.
+            # remat is a backward-pass memory trade — inference-only
+            # path, so it never wraps here.
+            if return_hidden:
+                raise ValueError("return_hidden with cache= is not supported")
+            new_cache = []
+            for i in range(cfg.num_layers):
+                x, layer_cache = Block(cfg, name=f"block_{i}")(
+                    x, mask, train, lengths,
+                    cache=cache[i], cache_index=cache_index,
+                )
+                new_cache.append(layer_cache)
+            x = nn.LayerNorm(dtype=jnp.float32)(x)
+            return LMHead(cfg, name="lm_head")(x), new_cache
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(3,))

@@ -29,9 +29,42 @@ from __future__ import annotations
 import os
 import signal
 import threading
-from typing import Callable, Iterable, Optional
+from typing import Callable, Iterable, List, Optional
 
 _DEFAULT_SIGNALS = (signal.SIGTERM,)
+
+# Process-wide drain hooks: subsystems with in-flight work that must
+# finish BEFORE the flight-recorder dump and the durable checkpoint
+# (the serving frontend registers here so a SIGTERM completes every
+# accepted request before the worker leaves the gang). Run in
+# registration order by GracefulShutdown._drain(); exceptions in one
+# hook never block the next — the checkpoint must still happen.
+_drain_hooks: List[Callable[[], None]] = []
+_drain_lock = threading.Lock()
+
+
+def register_drain(fn: Callable[[], None]) -> Callable[[], None]:
+    """Register a shutdown drain hook (returns ``fn`` for decorator
+    use). Hooks run FIRST in the SIGTERM sequence: drains → flight
+    recorder → checkpoint — in-flight work, then observability, then
+    durability."""
+    with _drain_lock:
+        if fn not in _drain_hooks:
+            _drain_hooks.append(fn)
+    return fn
+
+
+def unregister_drain(fn: Callable[[], None]) -> None:
+    with _drain_lock:
+        try:
+            _drain_hooks.remove(fn)
+        except ValueError:
+            pass
+
+
+def drain_hooks() -> List[Callable[[], None]]:
+    with _drain_lock:
+        return list(_drain_hooks)
 
 
 class PreemptionHandler:
@@ -82,24 +115,56 @@ class PreemptionHandler:
 
 
 class GracefulShutdown:
-    """Context manager: on preemption, persist the state and exit.
+    """Context manager: on preemption, drain, persist the state, exit.
 
     ``state`` needs the DurableJaxState surface (``commit()`` +
-    ``wait_until_finished()``); any object with those methods works.
-    ``exit_code`` defaults to 143 (128+SIGTERM), which launchers read as
-    "killed by infrastructure", not a software fault.
+    ``wait_until_finished()``); any object with those methods works, and
+    ``state=None`` skips the durable step entirely (a serving-only
+    worker has no training state — the drain hooks ARE its shutdown
+    work). ``exit_code`` defaults to 143 (128+SIGTERM), which launchers
+    read as "killed by infrastructure", not a software fault.
+
+    SIGTERM ordering contract (regression-tested in
+    tests/test_preemption.py): **registered drains → flight recorder →
+    checkpoint** — instance hooks (:meth:`register_drain`) then module
+    hooks (:func:`register_drain`), each in registration order. Drains
+    run first because they hold user-visible in-flight work (the
+    serving frontend finishes every accepted request here); the flight
+    recorder is next because its bounded tmp+rename write cannot eat
+    the grace window the checkpoint needs.
+
+    ``state`` must be passed EXPLICITLY — ``GracefulShutdown(None)``
+    declares the stateless intent; ``GracefulShutdown()`` raises, so a
+    training script that forgot its state gets a loud TypeError today
+    instead of a silent no-checkpoint preemption later.
     """
+
+    _STATE_REQUIRED = object()
 
     def __init__(
         self,
-        state,
+        state=_STATE_REQUIRED,
         signals: Iterable[int] = _DEFAULT_SIGNALS,
         exit_code: int = 143,
     ) -> None:
+        if state is self._STATE_REQUIRED:
+            raise TypeError(
+                "GracefulShutdown requires a state argument: pass the "
+                "DurableJaxState to persist on SIGTERM, or an explicit "
+                "None for a stateless (drain-hooks-only) shutdown"
+            )
         self._state = state
         self._signals = tuple(signals)
         self._exit_code = exit_code
         self._handler: Optional[PreemptionHandler] = None
+        self._drains: List[Callable[[], None]] = []
+
+    def register_drain(self, fn: Callable[[], None]) -> Callable[[], None]:
+        """Instance-scoped drain hook: runs before the module-level
+        hooks, before the flight recorder, before the checkpoint."""
+        if fn not in self._drains:
+            self._drains.append(fn)
+        return fn
 
     def __enter__(self) -> "GracefulShutdown":
         self._handler = PreemptionHandler(
@@ -109,44 +174,60 @@ class GracefulShutdown:
 
     def _drain_and_exit(self) -> None:
         try:
-            # Flight recorder first (common/telemetry.py): the ring dump
-            # is a bounded tmp+rename write, so it cannot eat the grace
-            # window the checkpoint needs — and a failed checkpoint
-            # still leaves the last-N-steps post-mortem on disk.
-            try:
-                from .common import telemetry as _telemetry
-
-                _telemetry.hub().dump()
-            except Exception:
-                pass
-            # ``preemption.drain`` injection site: the deterministic
-            # mid-save kill window — a chaos plan SIGKILLs here to
-            # prove a kill landing between the flight-recorder dump and
-            # the durable persist can never leave a truncated artifact
-            # the restore path later trusts (tests/test_chaos.py).
-            try:
-                from .testing import chaos as _chaos
-
-                _chaos.inject("preemption.drain")
-            except Exception:
-                pass  # injected transport faults don't fit this site
-            # Prefer the unconditional durable path: commit() may batch
-            # (save_interval) or raise HostsUpdatedInterrupt before the
-            # write — either loses the grace window's whole purpose.
-            persist = getattr(self._state, "persist", None)
-            if persist is not None:
-                persist()
-            else:
-                self._state.commit()
-            wait = getattr(self._state, "wait_until_finished", None)
-            if wait is not None:
-                wait()
+            self._drain()
         finally:
             # os._exit: a signal can arrive mid-collective; running
             # normal interpreter teardown over wedged device state can
             # hang past the grace window, and the checkpoint is already
             # durable.
             os._exit(self._exit_code)
+
+    def _drain(self) -> None:
+        """The full shutdown sequence minus the exit (separable so the
+        ordering is testable in-process)."""
+        # Drain hooks first: in-flight user-visible work (e.g. the
+        # serving plane's accepted requests) finishes while the process
+        # is still fully alive. One failing hook never blocks the next
+        # — nor the recorder/checkpoint behind it.
+        for fn in list(self._drains) + drain_hooks():
+            try:
+                fn()
+            except Exception:
+                pass
+        # Flight recorder next (common/telemetry.py): the ring dump
+        # is a bounded tmp+rename write, so it cannot eat the grace
+        # window the checkpoint needs — and a failed checkpoint
+        # still leaves the last-N-steps post-mortem on disk.
+        try:
+            from .common import telemetry as _telemetry
+
+            _telemetry.hub().dump()
+        except Exception:
+            pass
+        # ``preemption.drain`` injection site: the deterministic
+        # mid-save kill window — a chaos plan SIGKILLs here to
+        # prove a kill landing between the flight-recorder dump and
+        # the durable persist can never leave a truncated artifact
+        # the restore path later trusts (tests/test_chaos.py).
+        try:
+            from .testing import chaos as _chaos
+
+            _chaos.inject("preemption.drain")
+        except Exception:
+            pass  # injected transport faults don't fit this site
+        if self._state is None:
+            return
+        # Prefer the unconditional durable path: commit() may batch
+        # (save_interval) or raise HostsUpdatedInterrupt before the
+        # write — either loses the grace window's whole purpose.
+        persist = getattr(self._state, "persist", None)
+        if persist is not None:
+            persist()
+        else:
+            self._state.commit()
+        wait = getattr(self._state, "wait_until_finished", None)
+        if wait is not None:
+            wait()
 
     def __exit__(self, *exc) -> None:
         if self._handler is not None:

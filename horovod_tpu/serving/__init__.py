@@ -1,0 +1,38 @@
+"""``hvd.serve`` — the elastic multi-host inference plane.
+
+Seven PRs of training substrate (gang rendezvous, elastic driver,
+donated fused executables, shape-bucketed executor caches, /metrics
+telemetry, straggler ledger) turned into an inference fleet: continuous
+batching over a fixed-shape donated decode step, a two-tier
+(exact/bucket) prefill executor cache on the prompt-length axis, a
+slot-based KV-cache manager, SLO-metered TTFT/TPOT on the existing
+scrape endpoint, capacity announcements + straggler-aware routing over
+the rendezvous KV, and a SIGTERM drain that finishes every accepted
+request before the worker leaves the gang.
+
+    import horovod_tpu as hvd
+
+    handle = hvd.serve(model, params, port=8500)
+    handle.wait()          # POST /generate, GET /healthz|/metrics|/stats
+
+Layers (docs/serving.md): models/transformer.py owns the incremental-
+decode model contract; `engine` the compiled prefill/decode split;
+`kv_cache` the slots; `batcher` the scheduler; `slo` the latency
+meters; `frontend` HTTP + fleet routing.
+"""
+
+from .batcher import (  # noqa: F401
+    ContinuousBatcher,
+    Rejected,
+    Request,
+)
+from .engine import InferenceEngine  # noqa: F401
+from .frontend import (  # noqa: F401
+    Router,
+    ServeFrontend,
+    ServeHandle,
+    read_announcements,
+    serve,
+)
+from .kv_cache import KVCacheManager  # noqa: F401
+from .slo import LatencyRecorder  # noqa: F401

@@ -1,0 +1,409 @@
+"""Continuous batching: admissions between decode steps, never flushes.
+
+The scheduler the Gemma-on-TPU serving paper centers on (PAPERS.md,
+arXiv 2605.25645): a queued request is admitted into a freed decode
+slot *between* decode steps — prefill it, write its KV rows, and the
+next fixed-shape decode step simply carries one more live slot. No
+retrace (shapes never change — engine.py), no flush (in-flight
+sequences keep their slots and their cache), no batch barrier (a long
+generation never holds short ones hostage, and vice versa).
+
+Policy knobs:
+
+* ``max_admit_per_step`` — prefills admitted between two decode steps
+  (``HOROVOD_SERVE_MAX_BATCH``). Prefill happens on the decode thread,
+  so each admission delays every in-flight token by one prefill: this
+  knob IS the TTFT-vs-TPOT interleaving trade (docs/serving.md).
+* ``policy="static"`` — the A/B baseline (bench_serve.py): admissions
+  only when the previous batch fully completed, i.e. classic batched
+  inference with its head-of-line blocking.
+* per-request deadlines — queued requests expire before wasting a
+  prefill; running requests are evicted at the deadline with their
+  partial output (status ``"deadline"``).
+
+Draining (``drain()``, wired to SIGTERM via
+``preemption.register_drain``) stops ADMISSION of new submissions but
+runs queue + in-flight to completion — every accepted request finishes
+before the worker leaves the gang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..common import telemetry as _telemetry
+from ..common.logging import get_logger
+from ..common.metrics import registry as _metrics
+from .slo import LatencyRecorder
+
+_log = get_logger("serve.batcher")
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+DEADLINE = "deadline"
+REJECTED = "rejected"
+ERROR = "error"
+
+
+class Rejected(RuntimeError):
+    """Request refused at submission (draining, or it can never fit)."""
+
+
+@dataclasses.dataclass
+class Request:
+    id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    deadline_ts: Optional[float]  # monotonic; None = no deadline
+    submitted: float = dataclasses.field(default_factory=time.monotonic)
+    status: str = QUEUED
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    ttft_ms: float = 0.0
+    gen_ms: float = 0.0
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def finished(self) -> bool:
+        return self._done.is_set()
+
+    def result(self) -> Dict:
+        return {
+            "id": self.id,
+            "status": self.status,
+            "tokens": list(self.out_tokens),
+            "prompt_len": int(self.prompt.size),
+            "ttft_ms": round(self.ttft_ms, 3),
+            "gen_ms": round(self.gen_ms, 3),
+        }
+
+
+class ContinuousBatcher:
+    """Single decode-thread scheduler over an InferenceEngine."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_admit_per_step: int = 4,
+        default_max_new_tokens: int = 64,
+        default_deadline_ms: float = 0.0,
+        eos_id: Optional[int] = None,
+        policy: str = "continuous",
+        recorder: Optional[LatencyRecorder] = None,
+    ) -> None:
+        if policy not in ("continuous", "static"):
+            raise ValueError(f"unknown policy {policy!r}")
+        self.engine = engine
+        self.max_admit_per_step = max(int(max_admit_per_step), 1)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.eos_id = eos_id
+        self.policy = policy
+        self.recorder = recorder or LatencyRecorder()
+        self._ids = itertools.count()
+        self._cond = threading.Condition()
+        self._queue: "deque[Request]" = deque()
+        self._slot_req: Dict[int, Request] = {}
+        self._draining = False
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._decode_steps = 0
+        self._last_publish = 0.0
+
+    # ------------------------------------------------------------ submission
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            _metrics.counter("serve.rejected")
+            raise Rejected("empty prompt")
+        max_new = (
+            self.default_max_new_tokens
+            if max_new_tokens is None
+            else int(max_new_tokens)
+        )
+        # the generation must fit the slot's KV capacity: clamp, and
+        # reject prompts that leave no room for even the first token
+        max_new = min(max_new, self.engine.max_len - int(prompt.size))
+        if max_new < 1:
+            _metrics.counter("serve.rejected")
+            raise Rejected(
+                f"prompt of {prompt.size} tokens leaves no room in a "
+                f"{self.engine.max_len}-token KV slot"
+            )
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        req = Request(
+            id=next(self._ids),
+            prompt=prompt,
+            max_new_tokens=max_new,
+            deadline_ts=(
+                time.monotonic() + deadline_ms / 1e3
+                if deadline_ms and deadline_ms > 0
+                else None
+            ),
+        )
+        with self._cond:
+            # drain check and enqueue under ONE lock: a submit racing
+            # the SIGTERM drain either lands before the flag flips (the
+            # drain loop re-checks the queue, so it WILL be served) or
+            # sees the flag and is rejected — never accepted-then-lost
+            if self._draining:
+                _metrics.counter("serve.rejected")
+                raise Rejected(
+                    "worker is draining (shutdown in progress)"
+                )
+            self._queue.append(req)
+            self._cond.notify_all()
+        _metrics.counter("serve.requests_total")
+        self._publish_gauges()
+        return req
+
+    # ------------------------------------------------------------- the loop
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting NEW submissions; run everything already
+        accepted (queued + in-flight) to completion. Returns True when
+        the plane is empty. Works both loop-driven and manually-stepped
+        (tests): without a running loop the drain steps inline."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self._queue and not self._slot_req:
+                return True
+            if self._running:
+                time.sleep(0.005)
+            else:
+                self.step()
+        return not self._queue and not self._slot_req
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+            try:
+                did = self.step()
+            except Exception:
+                # the scheduler thread must NEVER die silently: every
+                # accepted request's done-event would stay unset and
+                # the HTTP handlers parked on them would block forever
+                # while the announce loop kept advertising a live
+                # worker. Fail loudly: abort everything accepted,
+                # refuse new work, and let /healthz report not-ok.
+                _log.exception(
+                    "serve scheduler failed; aborting accepted requests"
+                )
+                self._abort_all("scheduler failure")
+                with self._cond:
+                    self._draining = True
+                    self._running = False
+                return
+            if not did:
+                with self._cond:
+                    if self._running and not self._queue:
+                        # short timeout: queued deadlines must still
+                        # expire while the plane idles
+                        self._cond.wait(timeout=0.02)
+
+    def _abort_all(self, reason: str) -> None:
+        """Fail every queued and in-flight request (status ``error``)
+        so their waiters unblock — the crash path's drain."""
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
+        for slot in list(self._slot_req):
+            req = self._slot_req.pop(slot)
+            self.engine.manager.free(slot)
+            queued.append(req)
+        for req in queued:
+            req.status = ERROR
+            req._done.set()
+            _metrics.counter("serve.errored")
+        self._publish_gauges(min_interval=0.0)
+
+    # ------------------------------------------------------------- one step
+
+    def step(self) -> bool:
+        """One scheduler round: expire → admit → decode → retire.
+        Returns False when there was nothing to do (idle)."""
+        now = time.monotonic()
+        self._expire_queued(now)
+        admitted = self._admit(now)
+        stepped = self._decode(now)
+        self._publish_gauges()
+        return bool(admitted or stepped)
+
+    def _expire_queued(self, now: float) -> None:
+        with self._cond:
+            keep: "deque[Request]" = deque()
+            for req in self._queue:
+                if req.deadline_ts is not None and now >= req.deadline_ts:
+                    req.status = DEADLINE
+                    req._done.set()
+                    _metrics.counter("serve.expired")
+                else:
+                    keep.append(req)
+            self._queue = keep
+
+    def _admit(self, now: float) -> int:
+        admitted = 0
+        mid_decode = bool(self._slot_req)
+        if self.policy == "static" and mid_decode:
+            return 0
+        limit = (
+            self.engine.slots
+            if self.policy == "static"
+            else self.max_admit_per_step
+        )
+        while admitted < limit:
+            with self._cond:
+                if not self._queue:
+                    break
+                req = self._queue[0]
+            slot = self.engine.manager.alloc(req.id)
+            if slot is None:
+                break
+            with self._cond:
+                # single consumer: the head is still req
+                self._queue.popleft()
+            first = self.engine.prefill(slot, req.prompt)
+            req.status = RUNNING
+            req.ttft_ms = (time.monotonic() - req.submitted) * 1e3
+            req.out_tokens.append(int(first))
+            self.recorder.record_ttft(req.ttft_ms)
+            _metrics.counter("serve.prefill_tokens", int(req.prompt.size))
+            _metrics.counter("serve.tokens_out")
+            if mid_decode:
+                _metrics.counter("serve.admitted_mid_decode")
+            admitted += 1
+            self._slot_req[slot] = req
+            if self._req_complete(req, now):
+                self._retire(slot, req)
+        return admitted
+
+    def _decode(self, now: float) -> bool:
+        if not self._slot_req:
+            return False
+        tokens = np.zeros(self.engine.slots, np.int32)
+        for slot, req in self._slot_req.items():
+            tokens[slot] = req.out_tokens[-1]
+        hub = None
+        if _telemetry.auto_enabled():
+            hub = _telemetry.hub()
+            hub.step_begin(self._decode_steps)
+        t0 = time.monotonic()
+        nxt = self.engine.decode_step(tokens)
+        step_ms = (time.monotonic() - t0) * 1e3
+        self._decode_steps += 1
+        now = time.monotonic()
+        for slot, req in list(self._slot_req.items()):
+            self.engine.manager.advance(slot)
+            req.out_tokens.append(int(nxt[slot]))
+            req.gen_ms = (now - req.submitted) * 1e3 - req.ttft_ms
+            self.recorder.record_tpot(step_ms)
+            _metrics.counter("serve.tokens_out")
+            if self._req_complete(req, now):
+                self._retire(slot, req)
+        if hub is not None:
+            # close AFTER the per-token bookkeeping so the record's
+            # serve.* deltas carry this step's tokens
+            hub.step_end()
+        return True
+
+    def _req_complete(self, req: Request, now: float) -> bool:
+        if req.deadline_ts is not None and now >= req.deadline_ts:
+            req.status = DEADLINE
+            return True
+        if len(req.out_tokens) >= req.max_new_tokens:
+            return True
+        if self.eos_id is not None and req.out_tokens[-1] == self.eos_id:
+            return True
+        return False
+
+    def _retire(self, slot: int, req: Request) -> None:
+        self.engine.manager.free(slot)
+        self._slot_req.pop(slot, None)
+        if req.status == DEADLINE:
+            _metrics.counter("serve.expired")
+        else:
+            req.status = DONE
+            _metrics.counter("serve.completed")
+        req._done.set()
+
+    # --------------------------------------------------------------- stats
+
+    @property
+    def draining(self) -> bool:
+        """True once no new work is accepted — set by drain() or by the
+        scheduler-crash handler. The frontend folds this into its own
+        draining state (503s, /healthz, the KV announcement), so a
+        crashed batcher is visibly drained fleet-wide, not a 429-ing
+        blackhole the Router keeps preferring."""
+        return self._draining
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def active(self) -> int:
+        return len(self._slot_req)
+
+    def stats(self) -> Dict[str, float]:
+        out = {
+            "queue_depth": self.queue_depth(),
+            "decode_steps": self._decode_steps,
+            "draining": 1.0 if self._draining else 0.0,
+        }
+        out.update(self.engine.manager.stats())
+        return out
+
+    def _publish_gauges(self, min_interval: float = 0.25) -> None:
+        """Registry gauge refresh, rate-limited off the decode hot path
+        (recorder.publish sorts the latency rings — O(capacity log
+        capacity) per call has no business running per token; the serve
+        port's /metrics renders its summaries live regardless, so only
+        scrape-side registry staleness is bounded by the interval)."""
+        now = time.monotonic()
+        if now - self._last_publish < min_interval:
+            return
+        self._last_publish = now
+        _metrics.update("serve", self.stats())
+        self.engine.publish()
+        self.recorder.publish()

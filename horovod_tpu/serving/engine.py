@@ -1,0 +1,380 @@
+"""InferenceEngine: prefill/decode split over compiled executables.
+
+The serving analog of the PR 1 fusion-executor rework, with sequence
+length where byte size was:
+
+* **Prefill** is shape-polymorphic in the prompt length, so it compiles
+  through a two-tier executor cache: a *bucket* tier keyed by the
+  power-of-two padded length (any prompt length runs immediately, pad
+  tokens are masked garbage the causal mask never attends), and an
+  *exact* tier a recurring length is promoted into after
+  ``promote_after`` sightings (no pad FLOPs for the lengths a workload
+  actually serves). Prompts past the bucket ceiling run as successive
+  ceiling-sized chunks through the SAME cache-threaded executables
+  (each chunk attends to everything before it), so long prompts cost
+  compile entries only for the ceiling and the remainder bucket.
+* **Decode** is ONE fixed-shape jitted step — ``[slots]`` last tokens +
+  ``[slots]`` cache indices in, ``[slots]`` next tokens + the updated
+  cache out — over the slot-batched KV cache, which is DONATED through
+  every prefill/decode executable so steady-state serving allocates no
+  new cache buffers and never retraces: admissions, evictions and slot
+  reuse change data, never shapes.
+
+Executables are built ahead-of-time (``jit(...).lower(...).compile()``)
+and held in engine-owned tables, so compile counts are exact, assertable
+numbers (``stats()``), not inferences about jit's internal cache.
+
+The model contract (``models/transformer.py``): ``model_fn(params,
+tokens, cache, cache_index) -> (logits, new_cache)`` with per-slot
+write positions and the global causal mask — any model implementing it
+serves; flax Transformer modules are adapted automatically.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..common.logging import get_logger
+from ..common.metrics import registry as _metrics
+from .kv_cache import KVCacheManager
+
+_log = get_logger("serve.engine")
+
+DEFAULT_MIN_BUCKET = 8
+DEFAULT_PROMOTE_AFTER = 2
+# exact-tier LRU bound: one executable per distinct recurring prompt
+# length; the bucket tier below it is bounded by log2(ceiling) anyway
+DEFAULT_EXACT_CAPACITY = 32
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def _as_model_fn(model) -> Callable:
+    """Adapt a flax module (``.apply``; params or full variables dict)
+    to the positional model contract; pass callables through."""
+    apply = getattr(model, "apply", None)
+    if apply is None:
+        if not callable(model):
+            raise TypeError(
+                f"model must be a flax module or a model_fn callable, "
+                f"got {type(model)!r}"
+            )
+        return model
+
+    def model_fn(params, tokens, cache, cache_index):
+        variables = (
+            params
+            if isinstance(params, dict) and "params" in params
+            else {"params": params}
+        )
+        return apply(
+            variables, tokens, train=False,
+            cache=cache, cache_index=cache_index,
+        )
+
+    return model_fn
+
+
+def _default_cache_factory(model):
+    cfg = getattr(model, "cfg", None)
+    if cfg is None:
+        raise TypeError(
+            "cache_factory= is required when the model does not carry "
+            "a TransformerConfig (.cfg) to derive the KV layout from"
+        )
+    from ..models.transformer import init_cache
+
+    return lambda batch, max_len: init_cache(cfg, batch, max_len)
+
+
+class InferenceEngine:
+    """Compiled prefill/decode over a slot-batched, donated KV cache.
+
+    Not thread-safe by design: exactly one consumer (the batcher's step
+    loop) drives it, which is also what makes the donated cache carry
+    sound — there is never a second reference to consume.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        slots: int,
+        max_len: int,
+        cache_factory=None,
+        min_bucket: int = DEFAULT_MIN_BUCKET,
+        prefill_ceiling: Optional[int] = None,
+        promote_after: int = DEFAULT_PROMOTE_AFTER,
+        exact_capacity: int = DEFAULT_EXACT_CAPACITY,
+        donate: Optional[bool] = None,
+        mesh=None,
+        tp_axis: str = "tp",
+    ) -> None:
+        self._model_fn = _as_model_fn(model)
+        self._params = params
+        if cache_factory is None:
+            cache_factory = _default_cache_factory(model)
+        self.manager = KVCacheManager(
+            cache_factory, slots=slots, max_len=max_len,
+            mesh=mesh, tp_axis=tp_axis,
+        )
+        self.slots = self.manager.slots
+        self.max_len = self.manager.max_len
+        self.min_bucket = max(int(min_bucket), 1)
+        # bucket ceiling: a power of two that FITS the cache — clamp to
+        # the largest pow2 <= max_len, never round past it (a prefill
+        # width beyond max_len would build kv updates larger than the
+        # cache leaf and fail at compile)
+        floor_pow2 = 1 << (self.max_len.bit_length() - 1)
+        ceiling = int(prefill_ceiling) if prefill_ceiling else floor_pow2
+        self.prefill_ceiling = min(next_pow2(ceiling), floor_pow2)
+        self.promote_after = max(int(promote_after), 1)
+        self._mesh = mesh
+        if donate is None:
+            import jax
+
+            donate = jax.devices()[0].platform in (
+                "tpu", "gpu", "cuda", "rocm",
+            )
+        self.donate = bool(donate)
+        # two-tier prefill executor cache (PR 1 design on the length
+        # axis) + the one decode executable
+        self._prefill_exact: "collections.OrderedDict" = (
+            collections.OrderedDict()
+        )
+        self._prefill_bucket: Dict[int, object] = {}
+        self._seen: "collections.OrderedDict" = collections.OrderedDict()
+        self._exact_capacity = max(int(exact_capacity), 1)
+        self._decode_exe = None
+        self._lock = threading.Lock()  # guards counters for stats readers
+        self._counters = collections.Counter()
+
+    # -------------------------------------------------------- compile layer
+
+    def _out_shardings(self):
+        """With a tp-sharded cache, pin the outputs: the cache keeps
+        its sharding (a changed output sharding would break the donated
+        carry on the NEXT call), the token output is replicated."""
+        if self.manager.sharding is None:
+            return None
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self._mesh, P())
+        cache_sh = jax.tree_util.tree_map(
+            lambda _: self.manager.sharding, self.manager.cache
+        )
+        return (rep, cache_sh)
+
+    def _compile(self, fn, args, kind: str):
+        import jax
+
+        kwargs = {}
+        if self.donate:
+            kwargs["donate_argnums"] = (1,)  # the cache carry
+        out_sh = self._out_shardings()
+        if out_sh is not None:
+            kwargs["out_shardings"] = out_sh
+        exe = jax.jit(fn, **kwargs).lower(*args).compile()
+        with self._lock:
+            self._counters[f"{kind}_compiles"] += 1
+        return exe
+
+    def _prefill_fn(self, width: int):
+        """Build the prefill computation for a fixed token width: slice
+        the slot's cache row, run the cache-threaded model over the
+        chunk, write the row back, emit the greedy next token at
+        ``last_pos`` (pad positions beyond it are causal-masked junk a
+        later write overwrites before it is ever attendable)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        model_fn = self._model_fn
+
+        def fn(params, cache, tokens, slot, start, last_pos):
+            slot_cache = jax.tree_util.tree_map(
+                lambda leaf: lax.dynamic_slice_in_dim(leaf, slot, 1, 0),
+                cache,
+            )
+            logits, new_slot = model_fn(
+                params, tokens, slot_cache, jnp.reshape(start, (1,))
+            )
+            cache = jax.tree_util.tree_map(
+                lambda leaf, upd: lax.dynamic_update_slice_in_dim(
+                    leaf, upd, slot, 0
+                ),
+                cache,
+                new_slot,
+            )
+            row = lax.dynamic_index_in_dim(
+                logits[0], last_pos, axis=0, keepdims=False
+            )
+            return jnp.argmax(row).astype(jnp.int32), cache
+
+        return fn
+
+    def _decode_fn(self):
+        import jax.numpy as jnp
+
+        model_fn = self._model_fn
+
+        def fn(params, cache, tokens, lengths):
+            logits, cache = model_fn(
+                params, tokens[:, None], cache, lengths
+            )
+            return (
+                jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32),
+                cache,
+            )
+
+        return fn
+
+    def _prefill_args(self, width: int):
+        return (
+            self._params,
+            self.manager.cache,
+            np.zeros((1, width), np.int32),
+            np.int32(0),
+            np.int32(0),
+            np.int32(0),
+        )
+
+    def _bucket_exe(self, width: int):
+        """Bucket-tier lookup/compile for an executable of exactly
+        ``width`` tokens (shared by the two-tier path and the
+        chunked-prefill loop — one home for the hit accounting)."""
+        exe = self._prefill_bucket.get(width)
+        if exe is None:
+            exe = self._compile(
+                self._prefill_fn(width),
+                self._prefill_args(width),
+                "prefill",
+            )
+            self._prefill_bucket[width] = exe
+        else:
+            self._counters["prefill_bucket_hits"] += 1
+        return exe
+
+    def _get_prefill_exe(self, length: int):
+        """Two-tier lookup for the final (or only) chunk of ``length``
+        tokens: exact executable if promoted, else the power-of-two
+        bucket. Returns ``(exe, width)``."""
+        exact = self._prefill_exact
+        if length in exact:
+            exact.move_to_end(length)
+            self._counters["prefill_exact_hits"] += 1
+            return exact[length], length
+        count = self._seen.get(length, 0) + 1
+        self._seen[length] = count
+        self._seen.move_to_end(length)
+        while len(self._seen) > 4 * self._exact_capacity:
+            self._seen.popitem(last=False)  # bounded, PR 1 lesson
+        if count >= self.promote_after:
+            exe = self._compile(
+                self._prefill_fn(length),
+                self._prefill_args(length),
+                "prefill",
+            )
+            exact[length] = exe
+            self._counters["prefill_promotions"] += 1
+            while len(exact) > self._exact_capacity:
+                exact.popitem(last=False)
+            return exe, length
+        bucket = min(
+            max(next_pow2(length), self.min_bucket), self.prefill_ceiling
+        )
+        exe = self._bucket_exe(bucket)
+        self._counters["prefill_pad_tokens"] += bucket - length
+        return exe, bucket
+
+    # ------------------------------------------------------------ execution
+
+    def prefill(self, slot: int, prompt) -> int:
+        """Run the prompt through the slot's cache row; returns the
+        first greedy token. Prompts past the bucket ceiling stream as
+        ceiling-sized chunks (each attends to the cache written so
+        far), the remainder through the two-tier cache like any short
+        prompt."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = prompt.size
+        if not 0 < n <= self.max_len:
+            raise ValueError(
+                f"prompt length {n} outside (0, {self.max_len}]"
+            )
+        start = 0
+        ceiling = self.prefill_ceiling
+        while n - start > ceiling:
+            exe = self._bucket_exe(ceiling)
+            self._counters["chunked_prefill_chunks"] += 1
+            tok, self.manager.cache = exe(
+                self._params,
+                self.manager.cache,
+                prompt[None, start:start + ceiling],
+                np.int32(slot),
+                np.int32(start),
+                np.int32(ceiling - 1),
+            )
+            start += ceiling
+        tail = n - start
+        exe, width = self._get_prefill_exe(tail)
+        tokens = np.zeros((1, width), np.int32)
+        tokens[0, :tail] = prompt[start:]
+        tok, self.manager.cache = exe(
+            self._params,
+            self.manager.cache,
+            tokens,
+            np.int32(slot),
+            np.int32(start),
+            np.int32(tail - 1),
+        )
+        self.manager.set_length(slot, n)
+        self._counters["prefills"] += 1
+        return int(tok)
+
+    def decode_step(self, tokens: np.ndarray) -> np.ndarray:
+        """ONE fixed-shape step over every slot: feed each slot's last
+        token at its cache index, return each slot's greedy next token.
+        Inactive slots (length 0) compute masked junk at position 0
+        that the next occupant's prefill overwrites — the price of a
+        shape that never changes is a little wasted compute, never a
+        retrace."""
+        tokens = np.asarray(tokens, np.int32).reshape(self.slots)
+        lengths = self.manager.lengths_array()
+        if self._decode_exe is None:
+            self._decode_exe = self._compile(
+                self._decode_fn(),
+                (self._params, self.manager.cache, tokens, lengths),
+                "decode",
+            )
+        out, self.manager.cache = self._decode_exe(
+            self._params, self.manager.cache, tokens, lengths
+        )
+        self._counters["decode_steps"] += 1
+        return np.asarray(out)
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+        for key in (
+            "prefill_compiles", "decode_compiles", "prefills",
+            "decode_steps", "prefill_exact_hits", "prefill_bucket_hits",
+            "prefill_promotions", "prefill_pad_tokens",
+            "chunked_prefill_chunks",
+        ):
+            out.setdefault(key, 0)
+        out["prefill_exact_entries"] = len(self._prefill_exact)
+        out["prefill_bucket_entries"] = len(self._prefill_bucket)
+        return out
+
+    def publish(self) -> None:
+        _metrics.update("serve", self.stats())

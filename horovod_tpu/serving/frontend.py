@@ -1,0 +1,647 @@
+"""HTTP frontend + fleet routing: ``hvd.serve(model, params, port=…)``.
+
+The MetricsServer mold (common/telemetry.py): a stdlib
+``ThreadingHTTPServer`` per worker, no new dependencies.
+
+Routes:
+
+* ``POST /generate`` — body ``{"tokens": [...], "max_tokens"?,
+  "deadline_ms"?}``; blocks until the request completes (the handler
+  thread parks on the request's event; the batcher's decode thread
+  does the work) and replies the result JSON (tokens, status, TTFT,
+  generation wall). 503 while draining; 429 when rejected.
+* ``GET /healthz`` — liveness + capacity JSON (free slots, queue
+  depth): the router's direct probe and the LB health check.
+* ``GET /metrics`` — the registry render (common/telemetry.py) with
+  the TTFT/TPOT families as real Prometheus summaries prepended, so a
+  fleet scraper needs only this one port per worker.
+* ``GET /stats`` — engine + batcher counters as JSON.
+
+**Fleet plane:** each worker announces ``{rank, addr, port, free_slots,
+queue_depth, ts}`` into the rendezvous KV (scope ``serve``) on a timer
+— the same channel heartbeats ride. ``Router`` reads those
+announcements plus the heartbeat straggler ledger
+(``runner.rendezvous.read_heartbeat_stats`` →
+``StallInspector.straggler_ranks``) and directs each request to the
+least-loaded worker whose rank is NOT flagged — the PR 4 ledger driving
+traffic, not just logs.
+
+**Drain:** ``serve()`` registers the frontend's drain with
+``preemption.register_drain``, so a SIGTERM under ``GracefulShutdown``
+(or the handler ``serve()`` installs itself) finishes every accepted
+request, lets the in-flight HTTP responses flush, and only then lets
+the worker leave the gang.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..common.logging import TRACE as _TRACE, get_logger
+from ..common.metrics import registry as _metrics
+from ..common.telemetry import (
+    PROM_CONTENT_TYPE,
+    hub as _telemetry_hub,
+    render_prometheus,
+)
+from .batcher import ContinuousBatcher, Rejected
+
+_log = get_logger("serve.frontend")
+
+SERVE_SCOPE = "serve"
+DEFAULT_ANNOUNCE_INTERVAL_S = 1.0
+# announcements older than this are a dead/partitioned worker as far
+# as routing is concerned
+DEFAULT_ANNOUNCE_TTL_S = 10.0
+
+
+def put_announcement(client, rank: int, payload: dict) -> None:
+    """Worker side of the capacity ledger (KVStore or RendezvousClient
+    surface — the same duality as heartbeats)."""
+    client.put(SERVE_SCOPE, str(int(rank)), json.dumps(payload).encode())
+
+
+def read_announcements(store_or_client) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for key in store_or_client.keys(SERVE_SCOPE):
+        raw = store_or_client.get(SERVE_SCOPE, key)
+        if raw is None:
+            continue
+        try:
+            rank = int(key)
+            obj = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if isinstance(obj, dict) and "port" in obj:
+            out[rank] = obj
+    return out
+
+
+class ServeFrontend:
+    def __init__(
+        self,
+        batcher: ContinuousBatcher,
+        port: int = 0,
+        addr: str = "0.0.0.0",
+        advertise_addr: str = "127.0.0.1",
+        rank: Optional[int] = None,
+        announce_client=None,
+        announce_interval_s: float = DEFAULT_ANNOUNCE_INTERVAL_S,
+    ) -> None:
+        self.batcher = batcher
+        self.advertise_addr = advertise_addr
+        self.rank = self._resolve_rank(rank)
+        self._announce_client = announce_client
+        self._announce_interval = float(announce_interval_s)
+        self._announce_stop = threading.Event()
+        self._announce_thread: Optional[threading.Thread] = None
+        self._draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                _log.log(_TRACE, "http " + fmt, *args)
+
+            def _reply(self, code, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code, obj) -> None:
+                self._reply(
+                    code, json.dumps(obj).encode(), "application/json"
+                )
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    return self._json(200, outer.capacity())
+                if path == "/stats":
+                    stats = dict(outer.batcher.stats())
+                    stats.update(outer.batcher.engine.stats())
+                    stats["slo"] = outer.batcher.recorder.summaries()
+                    return self._json(200, stats)
+                if path == "/metrics":
+                    hub = _telemetry_hub()
+                    body = "\n".join(
+                        outer.batcher.recorder
+                        .render_prometheus_summaries()
+                    ) + "\n" + render_prometheus(
+                        _metrics.snapshot(), hub.percentiles()
+                    )
+                    return self._reply(
+                        200, body.encode(), PROM_CONTENT_TYPE
+                    )
+                return self._reply(
+                    404, b"not found\n", "text/plain; charset=utf-8"
+                )
+
+            def do_POST(self):
+                # read the body FIRST: HTTP/1.1 keep-alive means an
+                # early reply that leaves body bytes on the socket
+                # desynchronizes the connection's next request
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                path = self.path.split("?", 1)[0]
+                if path != "/generate":
+                    return self._reply(
+                        404, b"not found\n", "text/plain; charset=utf-8"
+                    )
+                if outer.draining:
+                    return self._json(
+                        503, {"error": "draining", "retry": True}
+                    )
+                try:
+                    payload = json.loads(body or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError(
+                            f"body must be a JSON object, got "
+                            f"{type(payload).__name__}"
+                        )
+                    tokens = payload["tokens"]
+                except (json.JSONDecodeError, KeyError, ValueError) as e:
+                    return self._json(400, {"error": f"bad request: {e}"})
+                with outer._inflight_lock:
+                    outer._inflight += 1
+                try:
+                    try:
+                        req = outer.batcher.submit(
+                            tokens,
+                            max_new_tokens=payload.get("max_tokens"),
+                            deadline_ms=payload.get("deadline_ms"),
+                        )
+                    except Rejected as e:
+                        # draining (planned or crash) is the WORKER's
+                        # state -> 503 so the Router fails over; 429 is
+                        # reserved for requests that can never fit
+                        code = 503 if outer.draining else 429
+                        return self._json(code, {"error": str(e)})
+                    except (TypeError, ValueError) as e:
+                        # well-formed JSON, malformed fields (string
+                        # tokens, non-numeric budgets): the client's
+                        # fault, so the client gets told — not a torn
+                        # socket the router misreads as a dead worker
+                        return self._json(
+                            400, {"error": f"bad request: {e}"}
+                        )
+                    req.wait()
+                    # "error" = the scheduler crashed under this
+                    # request (batcher._abort_all): a worker fault,
+                    # 500 so the router fails over instead of the
+                    # client treating it as a completion
+                    code = 500 if req.status == "error" else 200
+                    return self._json(code, req.result())
+                finally:
+                    with outer._inflight_lock:
+                        outer._inflight -= 1
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((addr, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _resolve_rank(rank: Optional[int]) -> int:
+        if rank is not None:
+            return int(rank)
+        from ..common import basics
+
+        if basics.is_initialized():
+            return basics.rank()
+        cfg = basics.live_config()
+        return cfg.rank if cfg.rank is not None else 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def draining(self) -> bool:
+        """Planned drain OR the batcher's crash-drain: either way this
+        worker takes no new requests, and every surface (503s,
+        /healthz, the KV announcement) must say so consistently."""
+        return self._draining or self.batcher.draining
+
+    def capacity(self) -> dict:
+        mgr = self.batcher.engine.manager.stats()
+        draining = self.draining
+        return {
+            "ok": not draining,
+            "rank": self.rank,
+            "addr": self.advertise_addr,
+            "port": self.port,
+            "free_slots": mgr["slots_free"],
+            "slots_total": mgr["slots_total"],
+            "queue_depth": self.batcher.queue_depth(),
+            "draining": draining,
+            "ts": time.time(),
+        }
+
+    def start(self) -> int:
+        if self._thread is not None:
+            return self.port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="hvd-serve-frontend",
+            daemon=True,
+        )
+        self._thread.start()
+        client = self._resolve_announce_client()
+        if client is not None:
+            self._announce_client = client
+            self._announce_stop.clear()
+            self._announce_thread = threading.Thread(
+                target=self._announce_loop,
+                name="hvd-serve-announce",
+                daemon=True,
+            )
+            self._announce_thread.start()
+        _log.info(
+            "serve frontend on port %d (rank %d)", self.port, self.rank
+        )
+        return self.port
+
+    def _resolve_announce_client(self):
+        if self._announce_client is not None:
+            return self._announce_client
+        from ..common import basics
+
+        cfg = basics.live_config()
+        if not cfg.rendezvous_addr or not cfg.rendezvous_port:
+            return None
+        from ..runner.rendezvous import _client_from_cfg
+
+        return _client_from_cfg(cfg)
+
+    def _announce_loop(self) -> None:
+        while not self._announce_stop.is_set():
+            self.announce()
+            self._announce_stop.wait(self._announce_interval)
+
+    def announce(self) -> None:
+        """One capacity PUT into the rendezvous KV (scope ``serve``)."""
+        if self._announce_client is None:
+            return
+        try:
+            put_announcement(
+                self._announce_client, self.rank, self.capacity()
+            )
+        except (OSError, RuntimeError) as e:
+            _log.debug("serve announce failed: %s", e)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """SIGTERM half of the lifecycle: refuse new work, finish the
+        accepted work, let the in-flight responses flush. Announces the
+        drained state so the router stops sending traffic."""
+        self._draining = True
+        self.announce()
+        ok = self.batcher.drain(timeout=timeout)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.01)
+        self.announce()
+        return ok
+
+    def stop(self) -> None:
+        self._announce_stop.set()
+        if self._announce_thread is not None:
+            self._announce_thread.join(timeout=5)
+            self._announce_thread = None
+        if self._thread is None:
+            self._httpd.server_close()
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        self._thread = None
+
+
+class Router:
+    """Thin fleet router over the rendezvous KV: capacity announcements
+    in, straggler ledger in, pick-and-forward out. Stateless apart from
+    a local free-slot debit so a burst routed between two announcement
+    refreshes spreads instead of piling onto one worker."""
+
+    def __init__(
+        self,
+        store_or_client,
+        straggler_factor: Optional[float] = None,
+        announce_ttl_s: float = DEFAULT_ANNOUNCE_TTL_S,
+    ) -> None:
+        self._store = store_or_client
+        self._ttl = float(announce_ttl_s)
+        from ..common.stall_inspector import StallInspector
+
+        self._inspector = StallInspector(
+            straggler_factor=(
+                3.0 if straggler_factor is None else straggler_factor
+            )
+        )
+        self._debits: Dict[int, int] = {}
+        # rank -> (last announced ts value, local monotonic stamp of
+        # when it last CHANGED): freshness is judged in the router's
+        # clock domain, so cross-host wall-clock skew can't silently
+        # drop a live worker (or keep a dead one) from routing
+        self._seen_ts: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+
+    def snapshot(self) -> Dict[int, dict]:
+        """Live worker view: non-draining announcements whose ts keeps
+        ADVANCING, freshness judged on the router's own monotonic
+        clock. First sight of a rank has no change history, so the
+        announced wall ts is the tiebreak there (a wall-stale leftover
+        from a dead worker stays out); after that only advancement
+        counts, so a live worker with a skewed clock re-qualifies on
+        its next announce instead of being silently unroutable."""
+        now = time.monotonic()
+        out = {}
+        with self._lock:
+            for rank, ann in read_announcements(self._store).items():
+                if ann.get("draining"):
+                    continue
+                ts = float(ann.get("ts", 0))
+                prev = self._seen_ts.get(rank)
+                if prev is None:
+                    # wall tiebreak, once: mark wall-stale first sights
+                    # as already-expired; they revive on any advance
+                    wall_fresh = abs(time.time() - ts) <= self._ttl
+                    stamp = now if wall_fresh else now - self._ttl - 1
+                    self._seen_ts[rank] = (ts, stamp)
+                    if wall_fresh:
+                        out[rank] = ann
+                elif prev[0] != ts:
+                    self._seen_ts[rank] = (ts, now)
+                    out[rank] = ann
+                elif now - prev[1] <= self._ttl:
+                    out[rank] = ann
+        return out
+
+    def straggler_ranks(self) -> List[int]:
+        """The PR 4 ledger, read fleet-side: feed every heartbeat's
+        piggybacked step stats into a StallInspector and flag the slow
+        ranks — the routing table's deny list."""
+        from ..runner.rendezvous import read_heartbeat_stats
+
+        try:
+            stats = read_heartbeat_stats(self._store)
+        except (OSError, RuntimeError):
+            return []
+        for rank, payload in stats.items():
+            self._inspector.record_heartbeat(
+                rank,
+                ts=payload.get("ts"),
+                step=payload.get("step"),
+                step_ms_p50=payload.get("step_ms_p50"),
+                last_step_ts=payload.get("last_step_ts"),
+            )
+        return self._inspector.straggler_ranks()
+
+    def pick(self, exclude=()) -> Optional[dict]:
+        """The least-loaded live worker whose rank is not flagged by
+        the straggler ledger; flagged workers are only used when they
+        are ALL that is left (degraded beats down). ``exclude`` drops
+        ranks a caller already failed against in this routing round."""
+        workers = self.snapshot()
+        for rank in exclude:
+            workers.pop(rank, None)
+        if not workers:
+            return None
+        flagged = set(self.straggler_ranks())
+        healthy = {r: w for r, w in workers.items() if r not in flagged}
+        pool = healthy or workers
+        if not healthy:
+            _log.warning(
+                "all serve workers flagged as stragglers (%s); routing "
+                "to flagged rank anyway", sorted(flagged),
+            )
+        with self._lock:
+            def load(item):
+                rank, w = item
+                free = w.get("free_slots", 0) - self._debits.get(rank, 0)
+                return (-free, w.get("queue_depth", 0), rank)
+
+            rank, ann = min(pool.items(), key=load)
+            self._debits[rank] = self._debits.get(rank, 0) + 1
+            return dict(ann, rank=rank)
+
+    def credit(self, rank: int) -> None:
+        """Return a debit after a routed request completes."""
+        with self._lock:
+            if self._debits.get(rank, 0) > 0:
+                self._debits[rank] -= 1
+
+    def route(
+        self,
+        tokens,
+        max_tokens: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        timeout: float = 60.0,
+        attempts: int = 3,
+    ) -> dict:
+        """POST /generate on the picked worker; a dead or draining pick
+        fails over to the next candidate."""
+        import urllib.error
+        import urllib.request
+
+        payload: dict = {"tokens": list(map(int, tokens))}
+        if max_tokens is not None:
+            payload["max_tokens"] = int(max_tokens)
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        body = json.dumps(payload).encode()
+        last_err: Optional[Exception] = None
+        failed: set = set()
+        for _ in range(max(int(attempts), 1)):
+            ann = self.pick(exclude=failed)
+            if ann is None:
+                if failed:
+                    raise RuntimeError(
+                        f"routing failed: every live worker errored "
+                        f"({sorted(failed)}): {last_err}"
+                    )
+                raise RuntimeError("no live serve workers announced")
+            url = (
+                f"http://{ann.get('addr', '127.0.0.1')}:{ann['port']}"
+                f"/generate"
+            )
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                if e.code == 503 or e.code >= 500:
+                    # draining / server fault: the WORKER's problem,
+                    # fail over to the next candidate
+                    last_err = e
+                    failed.add(ann["rank"])
+                    _metrics.counter("serve.route_failover")
+                    continue
+                # 4xx: the REQUEST's problem — every worker would say
+                # the same thing; surface the actionable error instead
+                # of burning the fleet and masking it as 'all dead'
+                try:
+                    detail = json.loads(e.read().decode()).get("error", "")
+                except (ValueError, OSError):
+                    detail = ""
+                raise RuntimeError(
+                    f"request rejected by rank {ann['rank']} "
+                    f"(HTTP {e.code}): {detail or e.reason}"
+                ) from e
+            except (OSError, ValueError) as e:
+                last_err = e
+                failed.add(ann["rank"])
+                _metrics.counter("serve.route_failover")
+                continue
+            finally:
+                self.credit(ann["rank"])
+        raise RuntimeError(
+            f"routing failed after {attempts} attempts: {last_err}"
+        )
+
+
+class ServeHandle:
+    """What ``hvd.serve`` returns: the running plane + its lifecycle."""
+
+    def __init__(self, engine, batcher, frontend, shutdown_ctx=None):
+        self.engine = engine
+        self.batcher = batcher
+        self.frontend = frontend
+        self._shutdown_ctx = shutdown_ctx
+        self._stopped = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self.frontend.port
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        return self.frontend.drain(timeout=timeout)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until stop() — the serve-worker main thread parks
+        here (SIGTERM interrupts via the drain hook + process exit)."""
+        return self._stopped.wait(timeout)
+
+    def stop(self) -> None:
+        from .. import preemption
+
+        preemption.unregister_drain(self._drain_hook)
+        self.frontend.stop()
+        self.batcher.stop()
+        if self._shutdown_ctx is not None:
+            self._shutdown_ctx.__exit__(None, None, None)
+            self._shutdown_ctx = None
+        self._stopped.set()
+
+    # bound per-handle so unregister removes exactly this plane's hook
+    def _drain_hook(self) -> None:
+        self.frontend.drain()
+
+
+def serve(
+    model,
+    params,
+    port: Optional[int] = None,
+    *,
+    slots: Optional[int] = None,
+    max_len: Optional[int] = None,
+    max_new_tokens: Optional[int] = None,
+    deadline_ms: Optional[float] = None,
+    max_admit_per_step: Optional[int] = None,
+    eos_id: Optional[int] = None,
+    policy: str = "continuous",
+    addr: str = "0.0.0.0",
+    advertise_addr: str = "127.0.0.1",
+    rank: Optional[int] = None,
+    announce_client=None,
+    mesh=None,
+    handle_sigterm: bool = True,
+    **engine_kwargs,
+) -> ServeHandle:
+    """Start the inference plane on this worker: engine + continuous
+    batcher + HTTP frontend, drain-wired into the preemption path.
+
+    The Horovod-paper API shape (arXiv 1802.05799: bolt distributed
+    execution onto an existing model with minimal surface): ``model`` is
+    the same flax module you trained, ``params`` the tree you
+    checkpointed — ``hvd.serve(model, params, port=8500)`` and the
+    worker serves. Env defaults: ``HOROVOD_SERVE_PORT``,
+    ``_SERVE_KV_SLOTS``, ``_SERVE_MAX_BATCH``, ``_SERVE_MAX_TOKENS``,
+    ``_SERVE_DEADLINE_MS`` (docs/env_vars.md).
+
+    ``handle_sigterm=True`` (default) installs a
+    ``preemption.GracefulShutdown(None)`` so a bare serve worker drains
+    on SIGTERM and exits 143; pass False when composing with your own
+    ``GracefulShutdown`` — the drain hook this function registers via
+    ``preemption.register_drain`` makes YOUR shutdown drain the serving
+    plane first, before telemetry/checkpoint.
+    """
+    from ..common import basics
+    from .. import preemption
+    from .engine import InferenceEngine
+
+    cfg = basics.live_config()
+    if port is None:
+        port = cfg.serve_port
+    if slots is None:
+        slots = cfg.serve_kv_slots
+    if max_new_tokens is None:
+        max_new_tokens = cfg.serve_max_tokens
+    if deadline_ms is None:
+        deadline_ms = cfg.serve_deadline_ms
+    if max_admit_per_step is None:
+        max_admit_per_step = cfg.serve_max_batch
+    if max_len is None:
+        model_cfg = getattr(model, "cfg", None)
+        max_len = getattr(model_cfg, "max_len", None)
+        if max_len is None:
+            raise TypeError(
+                "max_len= is required when the model carries no "
+                ".cfg.max_len to derive the KV capacity from"
+            )
+    engine = InferenceEngine(
+        model, params, slots=slots, max_len=max_len, mesh=mesh,
+        **engine_kwargs,
+    )
+    batcher = ContinuousBatcher(
+        engine,
+        max_admit_per_step=max_admit_per_step,
+        default_max_new_tokens=max_new_tokens,
+        default_deadline_ms=deadline_ms,
+        eos_id=eos_id,
+        policy=policy,
+    )
+    frontend = ServeFrontend(
+        batcher, port=port, addr=addr,
+        advertise_addr=advertise_addr, rank=rank,
+        announce_client=announce_client,
+    )
+    shutdown_ctx = None
+    if handle_sigterm:
+        shutdown_ctx = preemption.GracefulShutdown(None)
+        shutdown_ctx.__enter__()
+    handle = ServeHandle(engine, batcher, frontend, shutdown_ctx)
+    preemption.register_drain(handle._drain_hook)
+    batcher.start()
+    frontend.start()
+    return handle

@@ -1,0 +1,168 @@
+"""Slot-based KV-cache manager: the serving plane's memory plane.
+
+The decode batch is a fixed array of ``slots`` — one slot per in-flight
+sequence — so the decode step's shapes never change and the executable
+compiled once serves forever (the PR 1 executor-cache lesson applied to
+inference). This manager owns:
+
+* the cache pytree itself (``[slots, max_len, kv_heads, head_dim]`` per
+  layer, from the model's ``init_cache`` factory) — the DONATED carry
+  the engine threads through successive prefill/decode executables;
+* the batch-slot allocator (free list, per-slot owner/length), so the
+  continuous batcher can admit a queued request into a freed slot
+  between decode steps without touching any other slot;
+* per-slot length tracking (the ``cache_index`` the model contract
+  masks attention by) and eviction on completion/deadline — freeing a
+  slot is O(1) bookkeeping, NO cache zeroing: positions at or beyond a
+  slot's length are masked to exact zeros by the model, and every
+  attended position is overwritten by the next occupant's prefill or
+  decode write before it first becomes attendable;
+* tensor-parallel sharding: on a mesh with a ``tp`` axis the cache is
+  placed with the kv-heads dimension sharded (`parallel/tp.py`'s axis
+  contract), so a GSPMD-compiled decode step partitions attention by
+  head exactly like Megatron partitions the matmuls.
+
+Prompts longer than the engine's prefill-bucket ceiling are fed through
+the same cache in ceiling-sized chunks (`InferenceEngine._chunked
+prefill`); on a mesh with a sequence axis the chunk attention could
+instead ride `parallel/ring_attention.py` — the cache layout is
+compatible (kv stream per slot), left as the documented long-context
+extension (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..common.logging import get_logger
+from ..common.metrics import registry as _metrics
+
+_log = get_logger("serve.kv")
+
+
+class KVCacheManager:
+    """Fixed-slot KV cache + allocator. Thread-safe bookkeeping; the
+    cache pytree itself is only ever touched by the engine's compiled
+    executables (single consumer: the batcher's step loop)."""
+
+    def __init__(
+        self,
+        cache_factory,
+        slots: int,
+        max_len: int,
+        mesh=None,
+        tp_axis: str = "tp",
+    ) -> None:
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.cache = cache_factory(self.slots, self.max_len)
+        self.sharding = None
+        if mesh is not None and tp_axis in getattr(mesh, "axis_names", ()):
+            self.sharding = self._shard(mesh, tp_axis)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.slots))
+        self._owner: Dict[int, object] = {}
+        self._lengths = np.zeros(self.slots, np.int32)
+
+    # ------------------------------------------------------------ sharding
+
+    def _shard(self, mesh, tp_axis: str):
+        """Place every cache leaf with its kv-heads axis (#2 of
+        [slots, seq, kv_heads, head_dim]) on the mesh's tensor-parallel
+        axis. With the params sharded the same way by the caller, the
+        jitted prefill/decode steps compile to per-head-shard attention
+        plus exactly the row-parallel psum `parallel/tp.py` places."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        heads = {leaf.shape[2] for layer in self.cache
+                 for leaf in layer.values()}
+        tp = mesh.shape[tp_axis]
+        for h in heads:
+            if h % tp:
+                raise ValueError(
+                    f"the '{tp_axis}' axis size ({tp}) must divide the "
+                    f"kv head count ({h}) to shard the cache"
+                )
+        sharding = NamedSharding(mesh, P(None, None, tp_axis, None))
+        self.cache = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, sharding), self.cache
+        )
+        return sharding
+
+    # ----------------------------------------------------------- allocator
+
+    def alloc(self, owner=None) -> Optional[int]:
+        """Claim a free slot (length 0) or None when full."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop(0)
+            self._owner[slot] = owner
+            self._lengths[slot] = 0
+        self._publish()
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Evict a slot (completion or deadline): O(1), no cache write —
+        see the module docstring for why stale contents are safe."""
+        with self._lock:
+            if slot in self._owner:
+                del self._owner[slot]
+                self._lengths[slot] = 0
+                self._free.append(slot)
+        self._publish()
+
+    def owner(self, slot: int):
+        with self._lock:
+            return self._owner.get(slot)
+
+    def active_slots(self) -> List[int]:
+        with self._lock:
+            return sorted(self._owner)
+
+    # ------------------------------------------------------------- lengths
+
+    def length(self, slot: int) -> int:
+        return int(self._lengths[slot])
+
+    def set_length(self, slot: int, n: int) -> None:
+        if not 0 <= n <= self.max_len:
+            raise ValueError(
+                f"slot length {n} outside [0, {self.max_len}]"
+            )
+        self._lengths[slot] = n
+
+    def advance(self, slot: int, n: int = 1) -> int:
+        new = int(self._lengths[slot]) + n
+        self.set_length(slot, new)
+        return new
+
+    def lengths_array(self) -> np.ndarray:
+        """The [slots] int32 ``cache_index`` vector the decode step
+        takes — a copy, so the executable's donated input can't alias
+        bookkeeping."""
+        return self._lengths.copy()
+
+    def capacity_left(self, slot: int) -> int:
+        return self.max_len - int(self._lengths[slot])
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            active = len(self._owner)
+        return {
+            "slots_total": self.slots,
+            "slots_active": active,
+            "slots_free": self.slots - active,
+            "kv_max_len": self.max_len,
+        }
+
+    def _publish(self) -> None:
+        _metrics.update("serve", self.stats())

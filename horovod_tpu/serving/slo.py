@@ -1,0 +1,111 @@
+"""SLO instrumentation: TTFT / TPOT summaries for the serving plane.
+
+Two latency families, the ones the Gemma-on-TPU serving paper meters
+(PAPERS.md, arXiv 2605.25645):
+
+* **TTFT** (time to first token): request submission → the first
+  generated token leaving prefill. Queue wait is INCLUDED by design —
+  it is what the user feels, and the difference between TTFT and
+  prefill wall time is exactly the admission policy's cost.
+* **TPOT** (time per output token): the decode-step wall time each
+  subsequent token rode.
+
+Samples land in bounded rings (newest ``capacity``), and ``publish()``
+pushes p50/p95/count gauges into the metrics registry under ``serve.``
+— so they appear on the existing ``/metrics`` endpoint
+(common/telemetry.py MetricsServer) next to the training gauges, and
+in flight-recorder StepStats via the registry snapshot.
+``render_prometheus_summaries()`` additionally renders the two
+families as proper Prometheus ``summary`` types for the serve
+frontend's own ``/metrics`` route.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List
+
+from ..common.metrics import registry as _metrics
+from ..common.telemetry import _percentile
+
+DEFAULT_CAPACITY = 1024
+
+
+class LatencyRecorder:
+    """Bounded-ring p50/p95 for the two serving latency families."""
+
+    FAMILIES = ("ttft_ms", "tpot_ms")
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self._lock = threading.Lock()
+        self._rings = {
+            fam: collections.deque(maxlen=max(int(capacity), 1))
+            for fam in self.FAMILIES
+        }
+        self._counts = {fam: 0 for fam in self.FAMILIES}
+        self._sums = {fam: 0.0 for fam in self.FAMILIES}
+
+    def record_ttft(self, ms: float) -> None:
+        self._record("ttft_ms", ms)
+
+    def record_tpot(self, ms: float) -> None:
+        self._record("tpot_ms", ms)
+
+    def _record(self, fam: str, ms: float) -> None:
+        with self._lock:
+            self._rings[fam].append(float(ms))
+            self._counts[fam] += 1
+            self._sums[fam] += float(ms)
+
+    def summaries(self) -> Dict[str, Dict[str, float]]:
+        """{family: {p50, p95, count, sum}}. The quantiles are
+        ring-windowed (newest ``capacity`` samples, like the step-time
+        summary in common/telemetry.py); count AND sum are lifetime
+        cumulative — the Prometheus summary pair, so sum/count is a
+        true mean for any consumer computing rate(sum)/rate(count)."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            snap = {
+                fam: (sorted(ring), self._counts[fam], self._sums[fam])
+                for fam, ring in self._rings.items()
+            }
+        for fam, (vals, count, total) in snap.items():
+            out[fam] = {
+                "p50": _percentile(vals, 0.50),
+                "p95": _percentile(vals, 0.95),
+                "count": count,
+                "sum": total,
+            }
+        return out
+
+    def publish(self) -> None:
+        """serve.ttft_ms_p50 / _p95 / _count (+ tpot) registry gauges —
+        the existing /metrics endpoint picks them up as hvd_serve_*."""
+        stats = {}
+        for fam, s in self.summaries().items():
+            stats[f"{fam}_p50"] = s["p50"]
+            stats[f"{fam}_p95"] = s["p95"]
+            stats[f"{fam}_count"] = s["count"]
+        _metrics.update("serve", stats)
+
+    def render_prometheus_summaries(self) -> List[str]:
+        """Prometheus text lines rendering both families as real
+        ``summary`` types (quantile labels), for the serve frontend's
+        /metrics route."""
+        lines: List[str] = []
+        helps = {
+            "ttft_ms": "Time to first token (submission -> first "
+            "generated token, queue wait included), ms.",
+            "tpot_ms": "Per-output-token latency (decode-step wall "
+            "time per generated token), ms.",
+        }
+        for fam, s in self.summaries().items():
+            name = f"serve_{fam}"
+            lines.append(f"# HELP {name} {helps[fam]}")
+            lines.append(f"# TYPE {name} summary")
+            lines.append(f'{name}{{quantile="0.5"}} {s["p50"]:.10g}')
+            lines.append(f'{name}{{quantile="0.95"}} {s["p95"]:.10g}')
+            lines.append(f"{name}_sum {s['sum']:.10g}")
+            lines.append(f"{name}_count {s['count']:.10g}")
+        return lines

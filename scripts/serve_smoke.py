@@ -1,0 +1,244 @@
+"""Serve smoke gate (ci.sh): the inference plane end-to-end.
+
+Starts a 2-worker serve fleet on a toy transformer (each worker a real
+subprocess: its own engine, batcher, HTTP frontend, and rendezvous-KV
+capacity announcements against a driver-hosted RendezvousServer), then:
+
+1. routes concurrent prompts of MIXED lengths through the
+   straggler-aware ``Router`` (reading live announcements from the KV)
+   and asserts every completion, plus that the load actually spread
+   across both workers;
+2. scrapes each worker's live ``/metrics`` and asserts the TTFT/TPOT
+   summary quantiles and the slot-occupancy/queue gauges;
+3. fires a burst of in-flight requests, SIGTERMs both workers
+   mid-service, and asserts the drain contract: every ACCEPTED request
+   completes with its full token budget, both workers exit 143.
+
+Exit 0 on success; any assertion failure is a CI failure.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+# runnable as `python scripts/serve_smoke.py` from the repo root
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+GEN_TOKENS = 6
+BURST_TOKENS = 16
+
+WORKER = """\
+import os, sys
+sys.path.insert(0, os.getcwd())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+
+cfg = TransformerConfig(
+    vocab_size=61, num_layers=1, d_model=16, num_heads=2, d_ff=32,
+    max_len=64, causal=True, dtype=jnp.float32,
+)
+model = Transformer(cfg)
+params = model.init(
+    jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), train=False
+)
+handle = hvd.serve(
+    model, params, port=0, slots=4, max_new_tokens=8,
+    addr="127.0.0.1", advertise_addr="127.0.0.1",
+)
+print("SERVING", handle.port, flush=True)
+handle.wait()  # SIGTERM: drain hook finishes accepted work, exit 143
+"""
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def _get_text(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    from horovod_tpu.serving.frontend import Router, read_announcements
+
+    workdir = tempfile.mkdtemp(prefix="hvd-serve-smoke-")
+    server = RendezvousServer()
+    port = server.start()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = "127.0.0.1"
+    env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port)
+
+    script = os.path.join(workdir, "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+    procs = []
+    for rank in range(2):
+        wenv = dict(env, HOROVOD_RANK=str(rank))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, script],
+                env=wenv,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    try:
+        ports = {}
+        for rank, proc in enumerate(procs):
+            line = proc.stdout.readline()
+            assert "SERVING" in line, (
+                f"worker {rank} failed to start: {line!r}\n"
+                f"{proc.stderr.read()[-2000:]}"
+            )
+            ports[rank] = int(line.split()[1])
+        # both workers announced into the KV
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            anns = read_announcements(server.store)
+            if set(anns) >= {0, 1}:
+                break
+            time.sleep(0.05)
+        anns = read_announcements(server.store)
+        assert set(anns) >= {0, 1}, f"announcements missing: {anns}"
+        assert anns[0]["port"] == ports[0] and anns[1]["port"] == ports[1]
+
+        router = Router(server.store)
+
+        # ---- phase 1: concurrent mixed-length prompts via the router
+        prompts = [
+            [3, 5, 7],
+            [4, 6, 8, 10, 12, 14],
+            [9] * 17,
+            list(range(1, 31)),
+            [11, 13, 15, 17, 19],
+            [2] * 9,
+        ]
+        results = [None] * len(prompts)
+
+        def route_one(i):
+            results[i] = router.route(
+                prompts[i], max_tokens=GEN_TOKENS, timeout=120
+            )
+
+        threads = [
+            threading.Thread(target=route_one, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        for i, res in enumerate(results):
+            assert res is not None, f"request {i} never completed"
+            assert res["status"] == "done", res
+            assert len(res["tokens"]) == GEN_TOKENS, res
+            assert res["ttft_ms"] > 0, res
+        per_worker = {}
+        for rank, p in ports.items():
+            stats = _get_json(f"http://127.0.0.1:{p}/stats")
+            per_worker[rank] = stats["prefills"]
+        assert sum(per_worker.values()) == len(prompts), per_worker
+        assert all(v > 0 for v in per_worker.values()), (
+            f"routing did not spread: {per_worker}"
+        )
+        print(f"phase 1 OK: {len(prompts)} completions, "
+              f"spread {per_worker}")
+
+        # ---- phase 2: SLO quantiles + slot gauges on the live scrape
+        for rank, p in ports.items():
+            text = _get_text(f"http://127.0.0.1:{p}/metrics")
+            for needle in (
+                'serve_ttft_ms{quantile="0.5"}',
+                'serve_ttft_ms{quantile="0.95"}',
+                'serve_tpot_ms{quantile="0.5"}',
+                'serve_tpot_ms{quantile="0.95"}',
+                "hvd_serve_slots_total 4",
+                "hvd_serve_slots_free",
+                "hvd_serve_queue_depth",
+                "hvd_serve_tokens_out",
+            ):
+                assert needle in text, (
+                    f"worker {rank} /metrics missing {needle!r}:\n"
+                    + text[:800]
+                )
+            assert "NaN" not in text
+        print("phase 2 OK: TTFT/TPOT quantiles + slot gauges scraped")
+
+        # ---- phase 3: SIGTERM drain — every accepted request finishes
+        burst = [[5, 6], [7, 8, 9], [1] * 12, [2, 3, 4, 5]]
+        burst_results = [None] * len(burst)
+
+        def burst_one(i):
+            # split the burst across the two workers directly — the
+            # drain contract is per-worker, and routing is phase 1's
+            rank = i % 2
+            body = json.dumps(
+                {"tokens": burst[i], "max_tokens": BURST_TOKENS}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ports[rank]}/generate",
+                data=body, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                burst_results[i] = json.load(resp)
+
+        bthreads = [
+            threading.Thread(target=burst_one, args=(i,))
+            for i in range(len(burst))
+        ]
+        for t in bthreads:
+            t.start()
+        # SIGTERM only once every burst request is ACCEPTED (in a slot
+        # or queued) — a drain may legitimately 503 un-submitted work
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            accepted = 0
+            for rank, p in ports.items():
+                h = _get_json(f"http://127.0.0.1:{p}/healthz")
+                accepted += (
+                    h["slots_total"] - h["free_slots"] + h["queue_depth"]
+                )
+            if accepted >= len(burst):
+                break
+            time.sleep(0.02)
+        for proc in procs:
+            proc.send_signal(signal.SIGTERM)
+        for t in bthreads:
+            t.join(timeout=120)
+        for i, res in enumerate(burst_results):
+            assert res is not None, f"burst request {i} lost in drain"
+            assert res["status"] == "done", res
+            assert len(res["tokens"]) == BURST_TOKENS, res
+        rcs = [proc.wait(timeout=120) for proc in procs]
+        assert rcs == [143, 143], f"worker exit codes: {rcs}"
+        print(f"phase 3 OK: drain completed {len(burst)}/{len(burst)} "
+              f"in-flight requests, workers exited {rcs}")
+        print("serve-smoke OK")
+        return 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

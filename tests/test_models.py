@@ -469,3 +469,100 @@ def test_rope_properties_and_llama_shape_trains():
         params, jax.tree_util.tree_map(lambda x: -0.05 * x, g)
     )
     assert float(loss_fn(p2)) < float(l0)
+
+
+@pytest.mark.parametrize(
+    "rope,num_kv_heads", [(False, None), (True, 2)],
+    ids=["learned-pos-mha", "rope-gqa"],
+)
+def test_transformer_incremental_decode_matches_full(rope, num_kv_heads):
+    """The serving engine's model contract (docs/serving.md): the
+    cache-threaded forward must reproduce the full-sequence forward —
+    prefill logits bit-comparable, and token-by-token decode matching
+    the full forward's greedy argmax at every position."""
+    from horovod_tpu.models.transformer import init_cache
+
+    cfg = TransformerConfig(
+        vocab_size=97, num_layers=2, d_model=32, num_heads=4, d_ff=64,
+        max_len=32, causal=True, dtype=jnp.float32, rope=rope,
+        num_kv_heads=num_kv_heads,
+    )
+    model = Transformer(cfg)
+    rng = np.random.default_rng(7)
+    T = 9
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, T)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens, train=False)
+    full = np.asarray(model.apply(params, tokens, train=False))
+
+    # whole-prompt prefill through the cache path: every position's
+    # logits equal the full forward (extra cache keys are masked to
+    # exact zeros, so the reductions see identical terms)
+    cache = init_cache(cfg, 2, 16)
+    logits, cache = model.apply(
+        params, tokens, train=False,
+        cache=cache, cache_index=jnp.zeros((2,), jnp.int32),
+    )
+    np.testing.assert_array_equal(full, np.asarray(logits))
+
+    # token-by-token decode: greedy argmax bit-identical per position
+    cache = init_cache(cfg, 2, 16)
+    step_logits = []
+    for i in range(T):
+        lg, cache = model.apply(
+            params, tokens[:, i:i + 1], train=False,
+            cache=cache, cache_index=jnp.full((2,), i, jnp.int32),
+        )
+        step_logits.append(np.asarray(lg)[:, 0])
+    stepwise = np.stack(step_logits, axis=1)
+    np.testing.assert_array_equal(
+        full.argmax(-1), stepwise.argmax(-1)
+    )
+    np.testing.assert_allclose(full, stepwise, rtol=2e-5, atol=2e-5)
+    # staggered slots: the two rows decode at DIFFERENT cache indices
+    # (row 0 at position 3, row 1 at position 7) in one call
+    idx = jnp.asarray([3, 7], jnp.int32)
+    stag_tokens = jnp.stack([tokens[0, 3], tokens[1, 7]])[:, None]
+    cache4 = init_cache(cfg, 2, 16)
+    _, cache4 = model.apply(
+        params, tokens, train=False,
+        cache=cache4, cache_index=jnp.zeros((2,), jnp.int32),
+    )
+    lg, _ = model.apply(
+        params, stag_tokens, train=False,
+        cache=cache4, cache_index=idx,
+    )
+    lg = np.asarray(lg)[:, 0]
+    np.testing.assert_array_equal(
+        full[0, 3].argmax(-1), lg[0].argmax(-1)
+    )
+    np.testing.assert_array_equal(
+        full[1, 7].argmax(-1), lg[1].argmax(-1)
+    )
+
+
+def test_transformer_cache_rejects_bad_compositions():
+    from horovod_tpu.models.transformer import init_cache
+
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    tokens = jnp.ones((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens, train=False)
+    cache = init_cache(cfg, 1, 8)
+    idx = jnp.zeros((1,), jnp.int32)
+    with pytest.raises(ValueError, match="mask"):
+        model.apply(
+            params, tokens, train=False, cache=cache, cache_index=idx,
+            mask=jnp.ones((1, 4), bool),
+        )
+    import dataclasses
+
+    enc = dataclasses.replace(cfg, causal=False)
+    enc_model = Transformer(enc)
+    enc_params = enc_model.init(
+        jax.random.PRNGKey(0), tokens, train=False
+    )
+    with pytest.raises(ValueError, match="causal"):
+        enc_model.apply(
+            enc_params, tokens, train=False,
+            cache=init_cache(enc, 1, 8), cache_index=idx,
+        )

@@ -61,6 +61,131 @@ def test_uninstall_restores():
     )
 
 
+def test_graceful_shutdown_drain_ordering(monkeypatch):
+    """The SIGTERM sequence contract: registered drains (instance, then
+    module hooks, each in registration order) → flight-recorder dump →
+    durable persist. The serving frontend depends on running FIRST —
+    its in-flight requests must finish while the process is fully
+    alive, before observability and durability take the grace window."""
+    from horovod_tpu import preemption
+    from horovod_tpu.common import telemetry
+
+    order = []
+
+    class _State:
+        def persist(self):
+            order.append("persist")
+
+        def wait_until_finished(self):
+            order.append("wait")
+
+    hub = telemetry.hub()
+    monkeypatch.setattr(hub, "dump", lambda: order.append("telemetry"))
+    gs = preemption.GracefulShutdown(_State())
+    gs.register_drain(lambda: order.append("instance_drain"))
+    preemption.register_drain(lambda: order.append("module_drain_1"))
+    preemption.register_drain(lambda: order.append("module_drain_2"))
+    try:
+        gs._drain()
+    finally:
+        for fn in preemption.drain_hooks():
+            preemption.unregister_drain(fn)
+    assert order == [
+        "instance_drain",
+        "module_drain_1",
+        "module_drain_2",
+        "telemetry",
+        "persist",
+        "wait",
+    ]
+
+
+def test_graceful_shutdown_drain_hook_failure_never_blocks_persist(
+    monkeypatch,
+):
+    from horovod_tpu import preemption
+    from horovod_tpu.common import telemetry
+
+    order = []
+
+    class _State:
+        def persist(self):
+            order.append("persist")
+
+    hub = telemetry.hub()
+    monkeypatch.setattr(hub, "dump", lambda: order.append("telemetry"))
+
+    def _bad():
+        order.append("bad")
+        raise RuntimeError("drain hook blew up")
+
+    gs = preemption.GracefulShutdown(_State())
+    gs.register_drain(_bad)
+    gs.register_drain(lambda: order.append("good"))
+    gs._drain()
+    assert order == ["bad", "good", "telemetry", "persist"]
+
+
+def test_graceful_shutdown_stateless_runs_drains_only(monkeypatch):
+    """state=None (a serving-only worker): drains + flight recorder,
+    no durable step to fail on."""
+    from horovod_tpu import preemption
+    from horovod_tpu.common import telemetry
+
+    order = []
+    hub = telemetry.hub()
+    monkeypatch.setattr(hub, "dump", lambda: order.append("telemetry"))
+    gs = preemption.GracefulShutdown(None)
+    gs.register_drain(lambda: order.append("drain"))
+    gs._drain()
+    assert order == ["drain", "telemetry"]
+
+
+def test_sigterm_runs_registered_drain_before_exit(tmp_path):
+    """Real-signal half of the ordering regression: a SIGTERM'd worker
+    under GracefulShutdown runs the registered drain (which records its
+    evidence on disk) before exiting 143."""
+    script = tmp_path / "serve_drain.py"
+    marker = tmp_path / "drained.txt"
+    script.write_text(
+        textwrap.dedent(
+            f"""
+            import time
+            from horovod_tpu import preemption
+
+            def drain():
+                with open({str(marker)!r}, "w") as f:
+                    f.write("drained\\n")
+
+            preemption.register_drain(drain)
+            with preemption.GracefulShutdown(None):
+                print("READY", flush=True)
+                while True:
+                    time.sleep(0.05)
+            """
+        )
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, str(script)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "READY" in line, line
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 143
+    assert marker.read_text().strip() == "drained"
+
+
 def test_persist_bypasses_save_interval(tmp_path):
     """persist() must write the live state even when commit() would
     batch it away (save_interval>1) — the preemption grace-window

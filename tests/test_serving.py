@@ -1,0 +1,701 @@
+"""Serving-plane tests (horovod_tpu/serving/): engine executor-cache
+behavior and zero-retrace steady state, slot lifecycle, continuous
+batching semantics, deadlines, SLO meters, HTTP frontend round-trip,
+straggler-aware routing, and the SIGTERM drain contract."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+# Deliberately smaller than TransformerConfig.tiny(): every engine
+# instance pays real XLA compiles, so the suite's model is minimal.
+def _cfg(**kw):
+    from horovod_tpu.models.transformer import TransformerConfig
+
+    base = dict(
+        vocab_size=61,
+        num_layers=1,
+        d_model=16,
+        num_heads=2,
+        d_ff=32,
+        max_len=64,
+        causal=True,
+        dtype=jnp.float32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def toy():
+    """(model, params) shared by every test in the module."""
+    from horovod_tpu.models.transformer import Transformer
+
+    model = Transformer(_cfg())
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), train=False
+    )
+    return model, params
+
+
+@pytest.fixture(autouse=True)
+def _clean_drain_hooks():
+    yield
+    from horovod_tpu import preemption
+
+    for fn in preemption.drain_hooks():
+        preemption.unregister_drain(fn)
+
+
+def _engine(toy, **kw):
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    model, params = toy
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("min_bucket", 4)
+    return InferenceEngine(model, params, **kw)
+
+
+def _greedy_ref(model, params, prompt, n):
+    seq = list(map(int, prompt))
+    for _ in range(n):
+        lg = model.apply(params, jnp.asarray([seq]), train=False)
+        seq.append(int(np.asarray(lg)[0, -1].argmax()))
+    return seq[len(prompt):]
+
+
+def _generate(engine, slot, prompt, n):
+    out = [engine.prefill(slot, prompt)]
+    for _ in range(n - 1):
+        toks = np.zeros(engine.slots, np.int32)
+        toks[slot] = out[-1]
+        nxt = engine.decode_step(toks)
+        engine.manager.advance(slot)
+        out.append(int(nxt[slot]))
+    return out
+
+
+# --------------------------------------------------------------- engine
+
+
+def test_engine_greedy_parity(toy):
+    model, params = toy
+    eng = _engine(toy)
+    slot = eng.manager.alloc("r")
+    out = _generate(eng, slot, [5, 7, 11], 6)
+    assert out == _greedy_ref(model, params, [5, 7, 11], 6)
+
+
+def test_prefill_two_tier_hit_miss_promotion(toy):
+    eng = _engine(toy, promote_after=2)
+    # length 5 -> bucket 8 compile (miss)
+    eng.prefill(eng.manager.alloc(), [1, 2, 3, 4, 5])
+    s = eng.stats()
+    assert s["prefill_compiles"] == 1
+    assert s["prefill_bucket_entries"] == 1
+    assert s["prefill_pad_tokens"] == 3
+    # length 6 -> same bucket, hit, no compile
+    eng.prefill(eng.manager.alloc(), [1, 2, 3, 4, 5, 6])
+    s = eng.stats()
+    assert s["prefill_compiles"] == 1
+    assert s["prefill_bucket_hits"] == 1
+    # length 5 again -> second sighting promotes to an exact executable
+    eng.prefill(eng.manager.alloc(), [9, 8, 7, 6, 5])
+    s = eng.stats()
+    assert s["prefill_compiles"] == 2
+    assert s["prefill_promotions"] == 1
+    assert s["prefill_exact_entries"] == 1
+    # and a third length-5 prompt is an exact hit: no compile, no pad
+    pad_before = s["prefill_pad_tokens"]
+    eng.prefill(eng.manager.alloc(), [2, 2, 2, 2, 2])
+    s = eng.stats()
+    assert s["prefill_compiles"] == 2
+    assert s["prefill_exact_hits"] == 1
+    assert s["prefill_pad_tokens"] == pad_before  # exact tier: unpadded
+
+
+def test_exact_tier_is_lru_bounded(toy):
+    eng = _engine(toy, promote_after=1, exact_capacity=2)
+    for ln in (3, 4, 5, 6):
+        eng.prefill(eng.manager.alloc() or 0, list(range(1, ln + 1)))
+        # slots exhaust; reuse slot 0 — allocator state is irrelevant here
+    assert eng.stats()["prefill_exact_entries"] <= 2
+
+
+def test_zero_retrace_steady_state_with_rolling_admissions(toy):
+    """The acceptance property: after warmup, decode steps with
+    admissions/evictions rolling through the slots trigger ZERO new
+    compiles — shapes never change, only data."""
+    model, params = toy
+    eng = _engine(toy, promote_after=10)  # keep everything on one bucket
+    # warmup: one prefill (bucket 4) + one decode step
+    s0 = eng.manager.alloc("warm")
+    eng.prefill(s0, [1, 2, 3])
+    eng.decode_step(np.zeros(eng.slots, np.int32))
+    eng.manager.advance(s0)
+    warm = eng.stats()
+    assert warm["decode_compiles"] == 1
+    # steady state: admit/evict/decode across every slot repeatedly
+    prompts = [[4, 5], [6, 7, 8], [9], [10, 11, 12]]
+    for round_ in range(3):
+        for p in prompts:
+            slot = eng.manager.alloc(round_)
+            if slot is None:
+                slot = eng.manager.active_slots()[0]
+                eng.manager.free(slot)
+                slot = eng.manager.alloc(round_)
+            eng.prefill(slot, p)
+            for _ in range(2):
+                eng.decode_step(np.zeros(eng.slots, np.int32))
+                eng.manager.advance(slot)
+    s = eng.stats()
+    assert s["decode_compiles"] == 1, "decode retraced in steady state"
+    # every prompt length above rides buckets 2/4 compiled in-round;
+    # after the first round no prefill compiles either
+    assert s["prefill_compiles"] <= warm["prefill_compiles"] + 2
+    final_compiles = s["prefill_compiles"] + s["decode_compiles"]
+    for p in prompts:  # one more full round: strictly zero compiles
+        slot = eng.manager.active_slots()[0]
+        eng.manager.free(slot)
+        slot = eng.manager.alloc("again")
+        eng.prefill(slot, p)
+        eng.decode_step(np.zeros(eng.slots, np.int32))
+        eng.manager.advance(slot)
+    s = eng.stats()
+    assert s["prefill_compiles"] + s["decode_compiles"] == final_compiles
+
+
+def test_chunked_prefill_past_bucket_ceiling(toy):
+    model, params = toy
+    eng = _engine(toy, prefill_ceiling=8, max_len=64)
+    prompt = list(
+        np.random.default_rng(3).integers(1, 60, size=21)
+    )  # 21 > 8: two full chunks + remainder 5
+    slot = eng.manager.alloc()
+    out = _generate(eng, slot, prompt, 4)
+    assert out == _greedy_ref(model, params, prompt, 4)
+    s = eng.stats()
+    assert s["chunked_prefill_chunks"] == 2
+    assert eng.manager.length(slot) == len(prompt) + 3
+
+
+def test_prefill_ceiling_clamped_to_cache(toy):
+    """An explicit ceiling must never round PAST a non-power-of-two
+    max_len: a prefill width beyond the cache length would build kv
+    updates larger than the cache leaf and fail at compile."""
+    model, params = toy
+    eng = _engine(toy, max_len=48, prefill_ceiling=64)
+    assert eng.prefill_ceiling == 32  # largest pow2 <= 48
+    prompt = list(np.random.default_rng(1).integers(1, 60, size=40))
+    slot = eng.manager.alloc()
+    out = _generate(eng, slot, prompt, 3)
+    assert out == _greedy_ref(model, params, prompt, 3)
+
+
+def test_slot_reuse_no_stale_leak(toy):
+    """A freed slot is reused WITHOUT zeroing; the mask must make the
+    previous occupant's kv unreachable — greedy output on the reused
+    slot must match a fresh engine exactly."""
+    model, params = toy
+    eng = _engine(toy, slots=1)  # one slot: reuse is guaranteed
+    slot = eng.manager.alloc("a")
+    _generate(eng, slot, [31, 33, 35, 37, 39, 41, 43], 5)
+    eng.manager.free(slot)
+    slot2 = eng.manager.alloc("b")
+    assert slot2 == slot
+    out = _generate(eng, slot2, [2, 4], 6)
+    assert out == _greedy_ref(model, params, [2, 4], 6)
+
+
+# ------------------------------------------------------------- kv cache
+
+
+def test_slot_allocator_lifecycle():
+    from horovod_tpu.serving.kv_cache import KVCacheManager
+
+    factory = lambda b, s: [
+        {"k": jnp.zeros((b, s, 2, 4)), "v": jnp.zeros((b, s, 2, 4))}
+    ]
+    mgr = KVCacheManager(factory, slots=2, max_len=8)
+    a = mgr.alloc("r1")
+    b = mgr.alloc("r2")
+    assert {a, b} == {0, 1}
+    assert mgr.alloc() is None  # full
+    assert mgr.stats()["slots_free"] == 0
+    mgr.set_length(a, 5)
+    assert mgr.capacity_left(a) == 3
+    with pytest.raises(ValueError):
+        mgr.set_length(a, 9)
+    mgr.free(a)
+    assert mgr.stats()["slots_active"] == 1
+    assert mgr.length(a) == 0  # length resets on eviction
+    c = mgr.alloc("r3")
+    assert c == a  # reuse
+    arr = mgr.lengths_array()
+    arr[:] = 99  # a copy: bookkeeping can't be aliased
+    assert mgr.length(b) == 0
+
+
+def test_tp_sharded_cache_matches_unsharded(toy):
+    from jax.sharding import Mesh
+
+    model, params = toy
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    eng_tp = _engine(toy, mesh=mesh)
+    assert eng_tp.manager.sharding is not None
+    slot = eng_tp.manager.alloc()
+    out = _generate(eng_tp, slot, [7, 8, 9], 5)
+    assert out == _greedy_ref(model, params, [7, 8, 9], 5)
+
+
+def test_tp_sharding_requires_divisible_heads(toy):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("tp",))
+    with pytest.raises(ValueError, match="divide"):
+        _engine(toy, mesh=mesh)  # 2 kv heads % 8 != 0
+
+
+# -------------------------------------------------------------- batcher
+
+
+def _batcher(toy, **kw):
+    from horovod_tpu.serving.batcher import ContinuousBatcher
+
+    kw.setdefault("max_admit_per_step", 2)
+    kw.setdefault("default_max_new_tokens", 4)
+    eng = _engine(toy, slots=kw.pop("slots", 2))
+    return ContinuousBatcher(eng, **kw)
+
+
+def test_continuous_admission_mid_decode(toy):
+    from horovod_tpu.common.metrics import registry
+
+    model, params = toy
+    b = _batcher(toy, default_max_new_tokens=8)
+    base = registry.snapshot().get("serve.admitted_mid_decode", 0.0)
+    r1 = b.submit([3, 5, 7], max_new_tokens=8)
+    for _ in range(3):
+        b.step()  # r1 admitted, decoding
+    assert r1.status == "running" and len(r1.out_tokens) >= 2
+    r2 = b.submit([11, 13], max_new_tokens=3)  # lands MID-decode
+    while not (r1.finished() and r2.finished()):
+        assert b.step(), "scheduler idled with work pending"
+    # the admission neither flushed nor perturbed the in-flight stream
+    assert r1.result()["tokens"] == _greedy_ref(model, params, [3, 5, 7], 8)
+    assert r2.result()["tokens"] == _greedy_ref(model, params, [11, 13], 3)
+    assert (
+        registry.snapshot().get("serve.admitted_mid_decode", 0.0) > base
+    )
+    assert b.engine.stats()["decode_compiles"] == 1  # no retrace either
+
+
+def test_queue_overflow_waits_for_free_slot(toy):
+    b = _batcher(toy, slots=2, default_max_new_tokens=4)
+    reqs = [b.submit([i + 1, i + 2]) for i in range(4)]
+    b.step()
+    assert b.active() == 2 and b.queue_depth() == 2  # slots gate admission
+    while not all(r.finished() for r in reqs):
+        b.step()
+    assert all(r.status == "done" for r in reqs)
+    assert {len(r.out_tokens) for r in reqs} == {4}
+
+
+def test_deadline_expires_queued_request(toy):
+    b = _batcher(toy)
+    r = b.submit([1, 2], deadline_ms=1.0)
+    time.sleep(0.02)
+    b.step()
+    assert r.finished() and r.status == "deadline"
+    assert r.result()["tokens"] == []
+
+
+def test_deadline_evicts_running_request_with_partial_output(toy):
+    b = _batcher(toy, default_max_new_tokens=64)
+    r = b.submit([1, 2, 3], deadline_ms=60_000.0)
+    b.step()  # admit + first token (+ first decode)
+    assert r.status == "running"
+    # pull the deadline into the past (deterministic: wall-clock
+    # deadlines under CPU compile jitter would flake either way)
+    r.deadline_ts = time.monotonic() - 0.001
+    b.step()
+    assert r.finished() and r.status == "deadline"
+    assert 0 < len(r.out_tokens) < 64  # partial output returned
+    assert b.active() == 0  # slot evicted
+
+
+def test_static_policy_is_a_batch_barrier(toy):
+    b = _batcher(toy, slots=2, policy="static", default_max_new_tokens=4)
+    r1 = b.submit([1, 2])
+    b.step()
+    assert b.active() == 1
+    r2 = b.submit([3, 4])
+    # the barrier: while the r1 batch is in flight, r2 stays queued
+    while not r1.finished():
+        assert r2.status == "queued"
+        b.step()
+    while not r2.finished():
+        b.step()
+    assert r1.status == r2.status == "done"
+
+
+def test_reject_prompt_that_cannot_fit(toy):
+    from horovod_tpu.serving.batcher import Rejected
+
+    b = _batcher(toy)
+    with pytest.raises(Rejected):
+        b.submit(list(range(1, 65)))  # 64-token prompt: no room to gen
+    with pytest.raises(Rejected):
+        b.submit([])
+
+
+def test_drain_completes_accepted_and_rejects_new(toy):
+    from horovod_tpu.serving.batcher import Rejected
+
+    b = _batcher(toy, default_max_new_tokens=5)
+    reqs = [b.submit([i + 1, i + 2, i + 3]) for i in range(3)]
+    assert b.drain(timeout=30)  # inline-steps without a loop thread
+    assert all(r.status == "done" for r in reqs)
+    assert all(len(r.out_tokens) == 5 for r in reqs)
+    with pytest.raises(Rejected):
+        b.submit([1, 2])
+
+
+def test_scheduler_crash_aborts_accepted_requests(toy, monkeypatch):
+    """An exception on the decode thread must not strand waiters: every
+    accepted request fails loudly (status "error"), new submissions are
+    refused — never a silent blackhole behind a live /healthz."""
+    from horovod_tpu.serving.batcher import Rejected
+
+    b = _batcher(toy, default_max_new_tokens=8)
+
+    def _boom(tokens):
+        raise RuntimeError("device fell over")
+
+    monkeypatch.setattr(b.engine, "decode_step", _boom)
+    b.start()
+    try:
+        r = b.submit([1, 2, 3])
+        assert r.wait(timeout=30), "waiter stranded after scheduler crash"
+        assert r.status == "error"
+        with pytest.raises(Rejected):
+            b.submit([4, 5])
+    finally:
+        b.stop()
+
+
+def test_scheduler_crash_visible_at_frontend_and_fleet(toy, monkeypatch):
+    """The crash-drain must propagate to every fleet surface: requests
+    get 503 (Router fails over), /healthz flips not-ok, and the KV
+    announcement flags draining — a crashed worker must never keep
+    attracting traffic as the emptiest-looking rank."""
+    import horovod_tpu as hvd
+
+    model, params = toy
+    handle = hvd.serve(
+        model, params, port=0, slots=2, max_len=64,
+        max_new_tokens=6, addr="127.0.0.1", handle_sigterm=False,
+    )
+    try:
+        monkeypatch.setattr(
+            handle.engine, "decode_step",
+            lambda tokens: (_ for _ in ()).throw(
+                RuntimeError("device fell over")
+            ),
+        )
+        status, raw = _post_raw_error(
+            handle.port, json.dumps({"tokens": [1, 2, 3]}).encode()
+        )
+        assert status == 500, status
+        assert json.loads(raw)["status"] == "error"
+        status, raw = _post_raw_error(
+            handle.port, json.dumps({"tokens": [4, 5]}).encode()
+        )
+        assert status == 503, status  # failover signal, not 429
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{handle.port}/healthz", timeout=10
+        ) as resp:
+            health = json.load(resp)
+        assert not health["ok"] and health["draining"]
+    finally:
+        handle.stop()
+
+
+def test_init_cache_rejects_overlong_learned_position_cache():
+    from horovod_tpu.models.transformer import init_cache
+
+    cfg = _cfg()  # learned positions, max_len=64
+    with pytest.raises(ValueError, match="position table"):
+        init_cache(cfg, 2, 128)
+    rope_cfg = _cfg(rope=True)
+    init_cache(rope_cfg, 2, 128)  # rope: no table, any length
+
+
+def test_decode_steps_land_in_flight_recorder(toy, monkeypatch):
+    from horovod_tpu.common import telemetry
+
+    monkeypatch.setenv("HOROVOD_TELEMETRY", "1")
+    telemetry._reset_hub()
+    try:
+        b = _batcher(toy, default_max_new_tokens=4)
+        r = b.submit([5, 6, 7])
+        while not r.finished():
+            b.step()
+        recs = telemetry.hub().records()
+        assert recs, "decode steps produced no StepStats records"
+        assert sum(rec["serve.tokens_out"] for rec in recs) >= 3
+    finally:
+        telemetry._reset_hub()
+
+
+def test_slo_recorder_quantiles():
+    from horovod_tpu.common.metrics import registry
+    from horovod_tpu.serving.slo import LatencyRecorder
+
+    rec = LatencyRecorder(capacity=8)
+    for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+        rec.record_ttft(v)
+    rec.record_tpot(7.0)
+    s = rec.summaries()
+    assert s["ttft_ms"]["p50"] == 3.0
+    assert s["ttft_ms"]["p95"] == 100.0
+    assert s["ttft_ms"]["count"] == 5
+    rec.publish()
+    snap = registry.snapshot()
+    assert snap["serve.ttft_ms_p50"] == 3.0
+    assert snap["serve.tpot_ms_count"] == 1
+    text = "\n".join(rec.render_prometheus_summaries())
+    assert 'serve_ttft_ms{quantile="0.5"} 3' in text
+    assert "# TYPE serve_tpot_ms summary" in text
+
+
+# ------------------------------------------------------------- frontend
+
+
+def _post(port, payload, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.load(resp)
+
+
+def test_frontend_http_roundtrip(toy):
+    import horovod_tpu as hvd
+
+    model, params = toy
+    handle = hvd.serve(
+        model, params, port=0, slots=2, max_len=64,
+        max_new_tokens=4, addr="127.0.0.1", handle_sigterm=False,
+    )
+    try:
+        status, out = _post(handle.port, {"tokens": [9, 10, 11]})
+        assert status == 200
+        assert out["status"] == "done"
+        assert out["tokens"] == _greedy_ref(model, params, [9, 10, 11], 4)
+        assert out["ttft_ms"] > 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{handle.port}/healthz", timeout=10
+        ) as resp:
+            health = json.load(resp)
+        assert health["ok"] and health["slots_total"] == 2
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{handle.port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        assert 'serve_ttft_ms{quantile="0.5"}' in text
+        assert "hvd_serve_slots_total" in text
+        status, err = _post_raw_error(handle.port, b"not json")
+        assert status == 400
+        # valid JSON that is not an object, and object with bad field
+        # types: still 400, never a torn socket
+        for body in (b"[1,2,3]", b'{"tokens": "abc"}',
+                     b'{"tokens": [1,2], "max_tokens": "x"}'):
+            status, err = _post_raw_error(handle.port, body)
+            assert status == 400, (body, status)
+    finally:
+        handle.stop()
+
+
+def _post_raw_error(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=body, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_frontend_drain_finishes_inflight_then_503(toy):
+    import horovod_tpu as hvd
+
+    model, params = toy
+    handle = hvd.serve(
+        model, params, port=0, slots=2, max_len=64,
+        max_new_tokens=6, addr="127.0.0.1", handle_sigterm=False,
+    )
+    try:
+        results = {}
+
+        def client(key, tokens):
+            results[key] = _post(handle.port, {"tokens": tokens})
+
+        threads = [
+            threading.Thread(target=client, args=(i, [i + 1, i + 2]))
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        # all three must be ACCEPTED (in a slot, queued, or already
+        # finishing) before the drain starts — a drain may legitimately
+        # 503 a request that has not been submitted yet
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            accepted = (
+                handle.batcher.queue_depth()
+                + handle.batcher.active()
+                + len(results)
+            )
+            if accepted >= 3:
+                break
+            time.sleep(0.005)
+        assert handle.drain(timeout=30)
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 3
+        for status, out in results.values():
+            assert status == 200 and out["status"] == "done"
+        status, _ = _post_raw_error(
+            handle.port, json.dumps({"tokens": [1, 2]}).encode()
+        )
+        assert status == 503  # draining refuses new work
+    finally:
+        handle.stop()
+
+
+def test_serve_registers_and_unregisters_drain_hook(toy):
+    import horovod_tpu as hvd
+    from horovod_tpu import preemption
+
+    model, params = toy
+    before = len(preemption.drain_hooks())
+    handle = hvd.serve(
+        model, params, port=0, slots=2, max_len=64,
+        addr="127.0.0.1", handle_sigterm=False,
+    )
+    assert len(preemption.drain_hooks()) == before + 1
+    handle.stop()
+    assert len(preemption.drain_hooks()) == before
+
+
+# --------------------------------------------------------------- router
+
+
+def _announce(store, rank, port, free_slots, queue_depth=0,
+              draining=False, ts=None):
+    store.put(
+        "serve",
+        str(rank),
+        json.dumps(
+            {
+                "rank": rank,
+                "addr": "127.0.0.1",
+                "port": port,
+                "free_slots": free_slots,
+                "queue_depth": queue_depth,
+                "draining": draining,
+                "ts": time.time() if ts is None else ts,
+            }
+        ).encode(),
+    )
+
+
+def test_router_picks_least_loaded(toy):
+    from horovod_tpu.runner.rendezvous import KVStore
+    from horovod_tpu.serving.frontend import Router
+
+    store = KVStore()
+    _announce(store, 0, 9000, free_slots=1, queue_depth=5)
+    _announce(store, 1, 9001, free_slots=7, queue_depth=0)
+    router = Router(store)
+    assert router.pick()["rank"] == 1
+    # local debits spread a burst between announcement refreshes
+    picks = [router.pick()["rank"] for _ in range(7)]
+    assert 0 in picks
+
+
+def test_router_avoids_straggler_ranks(toy):
+    from horovod_tpu.runner.rendezvous import KVStore, put_heartbeat
+    from horovod_tpu.serving.frontend import Router
+
+    store = KVStore()
+    # rank 0 has MORE free slots but its heartbeat p50 is 10x the gang
+    _announce(store, 0, 9000, free_slots=8)
+    _announce(store, 1, 9001, free_slots=2)
+    _announce(store, 2, 9002, free_slots=2)
+
+    class _Client:
+        def put(self, scope, key, value):
+            store.put(scope, key, value)
+
+    for rank, p50 in ((0, 500.0), (1, 50.0), (2, 55.0)):
+        put_heartbeat(
+            _Client(), rank,
+            {"step": 100, "step_ms_p50": p50, "last_step_ts": time.time()},
+        )
+    router = Router(store)
+    assert router.straggler_ranks() == [0]
+    assert router.pick()["rank"] in (1, 2)  # flagged rank 0 bypassed
+
+
+def test_router_skips_stale_and_draining(toy):
+    from horovod_tpu.runner.rendezvous import KVStore
+    from horovod_tpu.serving.frontend import Router
+
+    store = KVStore()
+    _announce(store, 0, 9000, free_slots=8, ts=time.time() - 60)  # stale
+    _announce(store, 1, 9001, free_slots=1, draining=True)
+    router = Router(store)
+    assert router.pick() is None
+    _announce(store, 2, 9002, free_slots=1)
+    assert router.pick()["rank"] == 2
+
+
+def test_router_routes_to_live_worker_with_failover(toy):
+    import horovod_tpu as hvd
+    from horovod_tpu.runner.rendezvous import KVStore
+    from horovod_tpu.serving.frontend import Router
+
+    model, params = toy
+    handle = hvd.serve(
+        model, params, port=0, slots=2, max_len=64,
+        max_new_tokens=3, addr="127.0.0.1", handle_sigterm=False,
+    )
+    try:
+        store = KVStore()
+        _announce(store, 0, 1, free_slots=9)  # port 1: nothing listens
+        _announce(store, 1, handle.port, free_slots=2)
+        router = Router(store)
+        out = router.route([4, 5, 6], attempts=3)
+        assert out["status"] == "done"
+        assert out["tokens"] == _greedy_ref(model, params, [4, 5, 6], 3)
+        # a 4xx is the REQUEST's fault: surfaced, not failed-over
+        with pytest.raises(RuntimeError, match="rejected"):
+            router.route(list(range(1, 65)), attempts=3)
+    finally:
+        handle.stop()
