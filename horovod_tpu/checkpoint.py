@@ -433,6 +433,14 @@ class DurableJaxState(JaxState):
     across the durable boundary, including a world-size change (the
     cursor is global; the restored sampler re-stripes the remainder
     over the new replica count).
+
+    ZeRO-2/3 layouts save AS-IS: the ShardedDistributedOptimizer's
+    state dict (inner moments + guard counters + wire residual rows)
+    and the stage-3 ``[world, cols]`` parameter shard rows are plain
+    array pytrees, so the save path — and the content-digest sidecar
+    the restore verifies — operates on the SHARDED layout directly;
+    nothing is gathered to host-full form at any point
+    (tests/test_zero.py::test_zero3_checkpoint_roundtrip_sharded_no_gather).
     """
 
     def __init__(

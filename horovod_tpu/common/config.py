@@ -137,6 +137,21 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
 
+    # --- ZeRO sharding stage (sharded_optimizer.py) ---
+    # default zero_stage for ShardedDistributedOptimizer(zero_stage=None):
+    # 1 = optimizer-state sharding only, 2 = + gradient shards (bucketed
+    # reduce-scatter straight into shard storage), 3 = + parameter shards
+    # (forward-interleaved per-bucket all-gather). Explicit zero_stage=
+    # per optimizer always wins.
+    zero_stage: int = 1
+    # wire format of the SHARDED exchange legs (reduce-scatter /
+    # all-gather) when the optimizer passes wire=None. Deliberately a
+    # SEPARATE knob from fusion_wire: HOROVOD_FUSION_WIRE governs the
+    # eager fused allreduce wire, and inheriting it here would silently
+    # change sharded-optimizer numerics (and its state layout) for
+    # deployments that set it long before ZeRO-2/3 existed.
+    zero_wire: str = "fp32"
+
     # --- backward-interleaved gradient exchange (ops/overlap.py) ---
     # master switch: when on, DistributedOptimizer / value_and_grad /
     # ShardedDistributedOptimizer default to the bucketed exchange
@@ -305,6 +320,14 @@ class Config:
             ),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            zero_stage=int(
+                _env_choice("HOROVOD_ZERO_STAGE", "1", ("1", "2", "3"))
+            ),
+            zero_wire=_env_choice(
+                "HOROVOD_ZERO_WIRE",
+                "fp32",
+                ("fp32", "bf16", "int8", "auto"),
+            ),
             overlap=_env_bool("HOROVOD_OVERLAP"),
             overlap_buckets=_env_int("HOROVOD_OVERLAP_BUCKETS", 4),
             overlap_min_bytes=_env_int(

@@ -117,12 +117,19 @@ class JaxState(ObjectState):
     mesh, replicated, on restore/sync — the broadcast-from-root that
     TorchState does with hvd.broadcast_parameters [V].
 
-    ZeRO-1 note: a ShardedDistributedOptimizer state carries a leading
+    ZeRO note: a ShardedDistributedOptimizer state carries a leading
     [world] axis; after a WORLD-SIZE change, run it through
     ``opt.reshard_state(state.opt_state, state.params, hvd.size())``
     in your reset/on_hosts_updated callback before training resumes —
-    it carries the optimizer moments across the new gang instead of
-    resetting them (docs/api.md, tests/test_sharded_optimizer.py).
+    it carries the optimizer moments (and, at zero_stage>=2, the guard
+    counters and error-feedback wire residuals) across the new gang
+    instead of resetting them. At zero_stage=3 the PARAMETERS are a
+    [world, cols] shard-row tree too: register the row tree (not full
+    params) and additionally run
+    ``opt.reshard_params(state.pstate, params_template, hvd.size())``
+    — both trees ride this class unchanged, since commit/restore/sync
+    only ever device_get/device_put them (docs/api.md,
+    tests/test_zero.py).
     """
 
     _TREE_PREFIX = "_tree_"

@@ -432,6 +432,351 @@ def bucketed_allreduce(
     return reduced, new_res
 
 
+# ----------------------------------------- sharded (ZeRO) bucket wire
+#
+# The ZeRO-2/3 exchange legs: per-bucket reduce-scatter of a gradient
+# pytree INTO per-leaf shard slices, and the dual per-bucket all-gather
+# of shard slices back to full leaves. Same schedule machinery and
+# pane geometry as bucketed_allreduce (member leaves' padded [n, cols]
+# panes concatenated column-wise, ONE collective per bucket), so the
+# compiled step carries N independent collectives at their dataflow
+# frontiers; the shard slice of each bucket's reduce-scatter output IS
+# the per-rank storage slice — no full reduced-gradient buffer exists
+# at any point. Wire formats ride per bucket (fp32 / bf16 cast /
+# block-scaled int8 with pad exclusion by construction), resolved
+# statically at trace time via resolve_wire / the WireTuner.
+
+_WIRE_TUNER = None
+
+
+def wire_tuner():
+    """Process-wide WireTuner consulted by ``wire='auto'`` buckets.
+    Trace-time choices freeze into the compiled step, so the tuner's
+    explore-then-exploit plays out across RECOMPILES (the step harness
+    / bench loop feeds ``record``, exactly like the OverlapTuner)."""
+    global _WIRE_TUNER
+    if _WIRE_TUNER is None:
+        from ..common import basics
+        from ..common.autotune import WireTuner
+
+        _WIRE_TUNER = WireTuner(
+            min_int8_bytes=basics.live_config().fusion_wire_min_bytes
+        )
+    return _WIRE_TUNER
+
+
+def reset_wire_tuner() -> None:
+    global _WIRE_TUNER
+    _WIRE_TUNER = None
+
+
+def resolve_wire(wire, bucket_bytes: int, itemsize: int = 4, key=None) -> str:
+    """Static per-bucket wire-format resolution. Explicit formats pass
+    through; ``'auto'`` resolves per bucket at TRACE time: under the
+    ``HOROVOD_FUSION_WIRE_MIN_BYTES`` floor the quant tax always wins
+    (fp32); above it the PR-2 premise prior picks int8 for 4-byte
+    payloads — unless the WireTuner holds measured goodput for this
+    bucket key, in which case the bandit's argmax wins (the step
+    harness records observations across recompiles, the OverlapTuner
+    pattern). Returns one of ``'fp32' | 'bf16' | 'int8'``."""
+    if wire in (None, "fp32"):
+        return "fp32"
+    if wire in ("bf16", "int8"):
+        return wire
+    if wire == "auto":
+        tuner = wire_tuner()
+        if int(bucket_bytes) < tuner.min_int8_bytes:
+            return "fp32"
+        key = key if key is not None else ("bucket", int(bucket_bytes))
+        if any(
+            tuner.goodput(key, c) > 0 for c in tuner.CANDIDATES
+        ):
+            return tuner.choose(
+                key, int(bucket_bytes), itemsize=itemsize
+            )
+        return "int8" if itemsize >= 4 else "fp32"
+    raise ValueError(f"unknown wire format {wire!r}")
+
+
+def _leaf_panes(leaf, n):
+    """One leaf's rank-major pane: flatten, zero-pad, [n, cols]."""
+    from ..parallel.fsdp import pad_to
+
+    return pad_to(leaf.reshape(-1), n).reshape(n, -1)
+
+
+def bucketed_reduce_scatter(
+    grads,
+    op=None,
+    average: Optional[bool] = None,
+    n_buckets: Optional[int] = None,
+    axis_name: str = WORLD_AXIS,
+    wire: str = "fp32",
+    wire_block: Optional[int] = None,
+    seed=0,
+    residuals=None,
+    min_bucket_bytes: Optional[int] = None,
+    schedule: Optional[BucketSchedule] = None,
+):
+    """Reduce-scatter a pytree as N independent per-bucket collectives,
+    returning per-leaf SHARD slices (nonscalar leaf → its ``[cols]``
+    rank shard, ``cols = ceil(size/world)``; 0-d leaf → replicated
+    psum) — the ZeRO-2 gradient leg. Elementwise identical to a
+    per-leaf ``psum_scatter`` for the fp32 wire (same per-element
+    cross-replica sums), so shard values are bit-exact vs the
+    monolithic ZeRO-1 path.
+
+    ``wire`` picks the per-bucket format (``resolve_wire``): bf16
+    casts the pane buffer, int8 rides
+    :func:`~horovod_tpu.ops.traced.quantized_reducescatter` with
+    ``wire_block``-scaled stochastic rounding. ``residuals`` (tree
+    mirroring ``grads``, input units) is the error-feedback carry for
+    lossy buckets: it joins the pane signal before the wire and the new
+    per-leaf residual comes back in leaf geometry (exact-wire buckets
+    return zero residuals — everything was transmitted). Returns
+    ``(shards, new_residuals)`` when ``residuals`` is given."""
+    op = resolve_op(op, average)
+    if op not in (Sum, Average):
+        raise ValueError(
+            "bucketed_reduce_scatter supports op=Sum/Average only"
+        )
+    if n_buckets is None:
+        n_buckets = default_buckets() or 1
+    if min_bucket_bytes is None:
+        min_bucket_bytes = default_min_bytes()
+    n = jax.lax.axis_size(axis_name)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    nonscalar = [
+        i for i, g in enumerate(leaves)
+        if np.ndim(g) > 0 and not _is_float0(g)
+    ]
+    if schedule is None:
+        schedule = schedule_for(
+            [leaves[i] for i in nonscalar], treedef,
+            n_buckets, min_bucket_bytes,
+        )
+    _publish(schedule)
+    r_leaves = (
+        treedef.flatten_up_to(residuals) if residuals is not None else None
+    )
+    out: list = [None] * len(leaves)
+    res_out: list = [None] * len(leaves)
+    in_schedule = set(nonscalar)
+    for i, g in enumerate(leaves):
+        if i in in_schedule:
+            continue
+        if _is_float0(g) or not jnp.issubdtype(
+            jnp.result_type(g), jnp.inexact
+        ):
+            out[i] = g  # passthrough (float0 cotangents etc.)
+        else:
+            red = jax.lax.psum(g, axis_name)
+            out[i] = red / n if op == Average else red
+        if r_leaves is not None:
+            res_out[i] = r_leaves[i]
+    for b, idxs in enumerate(schedule.buckets):
+        members = [leaves[nonscalar[j]] for j in idxs]
+        panes = [_leaf_panes(m, n) for m in members]
+        cols = [p.shape[1] for p in panes]
+        buf = panes[0] if len(panes) == 1 else jnp.concatenate(
+            panes, axis=1
+        )
+        if r_leaves is not None:
+            rparts = [
+                _leaf_panes(
+                    r_leaves[nonscalar[j]].astype(buf.dtype), n
+                )
+                for j in idxs
+            ]
+            buf = buf + (
+                rparts[0] if len(rparts) == 1
+                else jnp.concatenate(rparts, axis=1)
+            )
+        bw = resolve_wire(
+            wire, int(schedule.bucket_bytes[b]),
+            itemsize=jnp.result_type(members[0]).itemsize,
+            key=("zero_rs", b, buf.shape[1]),
+        )
+        new_r = None
+        if bw == "int8":
+            bseed = seed * schedule.n_buckets + b
+            if r_leaves is not None:
+                red, new_r = traced.quantized_reducescatter(
+                    buf, op=Sum, axis_name=axis_name, seed=bseed,
+                    block_size=wire_block, return_residual=True,
+                )
+            else:
+                red = traced.quantized_reducescatter(
+                    buf, op=Sum, axis_name=axis_name, seed=bseed,
+                    block_size=wire_block,
+                )
+            if op == Average:
+                red = red / jnp.asarray(n, red.dtype)
+        else:
+            wire_buf = buf.astype(jnp.bfloat16) if bw == "bf16" else buf
+            red = jax.lax.psum_scatter(
+                wire_buf, axis_name, scatter_dimension=0, tiled=False
+            ).astype(buf.dtype)
+            if op == Average:
+                red = red / jnp.asarray(n, red.dtype)
+            if r_leaves is not None:
+                # exact wire transmits everything: residual drains;
+                # bf16 carries the local cast error (input units)
+                new_r = (
+                    buf - wire_buf.astype(buf.dtype)
+                    if bw == "bf16"
+                    else jnp.zeros_like(buf)
+                )
+        off = 0
+        for j, c in zip(idxs, cols):
+            i = nonscalar[j]
+            out[i] = red[off : off + c].astype(
+                jnp.result_type(leaves[i])
+            )
+            if r_leaves is not None:
+                size = int(np.prod(np.shape(leaves[i]), dtype=np.int64))
+                res_out[i] = (
+                    new_r[:, off : off + c]
+                    .reshape(-1)[:size]
+                    .reshape(np.shape(leaves[i]))
+                    .astype(r_leaves[i].dtype)
+                )
+            off += c
+    shards = jax.tree_util.tree_unflatten(treedef, out)
+    if residuals is None:
+        return shards
+    return shards, jax.tree_util.tree_unflatten(treedef, res_out)
+
+
+def bucketed_shard_all_gather(
+    shards,
+    like,
+    n_buckets: Optional[int] = None,
+    axis_name: str = WORLD_AXIS,
+    wire: str = "fp32",
+    wire_block: Optional[int] = None,
+    seed=0,
+    residuals=None,
+    min_bucket_bytes: Optional[int] = None,
+    schedule: Optional[BucketSchedule] = None,
+):
+    """The dual of :func:`bucketed_reduce_scatter`: per-leaf shard
+    slices → full leaves with ``like``'s shapes, as N independent
+    per-bucket all-gathers (concat member shards → ONE collective per
+    bucket → per-leaf columns → unpad/reshape). The schedule is keyed
+    on ``like``'s (full) leaf geometry, so a matched reduce-scatter /
+    all-gather pair shares ONE cached schedule.
+
+    ``residuals`` (tree in SHARD geometry — leaf ``[cols]``) is the
+    error-feedback carry for lossy buckets on this leg: it joins the
+    shard signal before the wire; returns ``(full, new_residuals)``.
+    Buckets whose member dtypes diverge fall back to per-leaf fp32
+    gathers (an inner transform that changes dtype per leaf)."""
+    if n_buckets is None:
+        n_buckets = default_buckets() or 1
+    if min_bucket_bytes is None:
+        min_bucket_bytes = default_min_bytes()
+    n = jax.lax.axis_size(axis_name)
+    s_leaves, s_def = jax.tree_util.tree_flatten(shards)
+    l_leaves = s_def.flatten_up_to(like)
+    nonscalar = [
+        i for i, l in enumerate(l_leaves)
+        if np.ndim(l) > 0 and not _is_float0(l)
+    ]
+    if schedule is None:
+        schedule = schedule_for(
+            [l_leaves[i] for i in nonscalar], s_def,
+            n_buckets, min_bucket_bytes,
+        )
+    r_leaves = (
+        s_def.flatten_up_to(residuals) if residuals is not None else None
+    )
+    out: list = [None] * len(s_leaves)
+    res_out: list = [None] * len(s_leaves)
+    in_schedule = set(nonscalar)
+    for i in range(len(s_leaves)):
+        if i not in in_schedule:
+            out[i] = s_leaves[i]  # replicated scalars pass through
+            if r_leaves is not None:
+                res_out[i] = r_leaves[i]
+    for b, idxs in enumerate(schedule.buckets):
+        mem = [s_leaves[nonscalar[j]] for j in idxs]
+        if len({m.dtype for m in mem}) > 1:
+            for j in idxs:
+                i = nonscalar[j]
+                l = l_leaves[i]
+                full = jax.lax.all_gather(
+                    s_leaves[i], axis_name, axis=0
+                ).reshape(-1)
+                size = int(np.prod(np.shape(l), dtype=np.int64))
+                out[i] = (
+                    full[:size].reshape(np.shape(l))
+                    .astype(s_leaves[i].dtype)
+                )
+                if r_leaves is not None:
+                    res_out[i] = r_leaves[i]
+            continue
+        cols = [m.shape[0] for m in mem]
+        buf = mem[0] if len(mem) == 1 else jnp.concatenate(mem)
+        if r_leaves is not None:
+            rparts = [
+                r_leaves[nonscalar[j]].astype(buf.dtype) for j in idxs
+            ]
+            buf = buf + (
+                rparts[0] if len(rparts) == 1
+                else jnp.concatenate(rparts)
+            )
+        bw = resolve_wire(
+            wire, int(schedule.bucket_bytes[b]),
+            itemsize=mem[0].dtype.itemsize,
+            key=("zero_ag", b, buf.shape[0]),
+        )
+        new_r = None
+        if bw == "int8":
+            bseed = seed * schedule.n_buckets + b
+            if r_leaves is not None:
+                full, new_r = traced.quantized_allgather(
+                    buf, axis_name=axis_name, seed=bseed,
+                    block_size=wire_block, return_residual=True,
+                )
+            else:
+                full = traced.quantized_allgather(
+                    buf, axis_name=axis_name, seed=bseed,
+                    block_size=wire_block,
+                )
+        else:
+            wire_buf = buf.astype(jnp.bfloat16) if bw == "bf16" else buf
+            full = jax.lax.all_gather(
+                wire_buf, axis_name, axis=0
+            ).astype(buf.dtype)  # [n, C]
+            if r_leaves is not None:
+                new_r = (
+                    buf - wire_buf.astype(buf.dtype)
+                    if bw == "bf16"
+                    else jnp.zeros_like(buf)
+                )
+        off = 0
+        for j, c in zip(idxs, cols):
+            i = nonscalar[j]
+            l = l_leaves[i]
+            size = int(np.prod(np.shape(l), dtype=np.int64))
+            out[i] = (
+                full[:, off : off + c]
+                .reshape(-1)[:size]
+                .reshape(np.shape(l))
+                .astype(s_leaves[i].dtype)
+            )
+            if r_leaves is not None:
+                res_out[i] = new_r[off : off + c].astype(
+                    r_leaves[i].dtype
+                )
+            off += c
+    gathered = jax.tree_util.tree_unflatten(s_def, out)
+    if residuals is None:
+        return gathered
+    return gathered, jax.tree_util.tree_unflatten(s_def, res_out)
+
+
 def overlap_boundary(
     tree,
     op=Average,

@@ -685,6 +685,103 @@ def quantized_allreduce(
     return out, residual
 
 
+def quantized_reducescatter(
+    panes,
+    op=None,
+    axis_name: str = WORLD_AXIS,
+    seed=0,
+    block_size: Optional[int] = None,
+    return_residual: bool = False,
+):
+    """Single-stage quantized reduce-scatter of a ``[n, cols]`` pane
+    buffer (row ``j`` destined for rank ``j`` — the ``psum_scatter``
+    layout the sharded optimizer's bucket panes already use): each rank
+    block-quantizes its rows to int8 with stochastic rounding, an
+    ``all_to_all`` moves int8 + scales, and the destination dequantizes
+    and sums in fp32 — the scatter half of :func:`quantized_allreduce`
+    with NO second quantization stage, so the error bound is ONE
+    quantum per element (vs two for the full quantized allreduce).
+
+    Pad exclusion by construction: pane pad entries are zeros
+    (``parallel.fsdp.pad_to`` contract), zeros quantize to zeros and
+    never raise a block's absmax, so a padded pane's block scales equal
+    the unpadded pane's and pad positions carry zero residual —
+    asserted in tests/test_zero.py.
+
+    Returns the fp32 ``[cols]`` shard. ``return_residual=True``
+    additionally returns this rank's local quantization error
+    (``panes − dequant(quant(panes))``, input units, ``[n, cols]``) —
+    the error-feedback carry: add it to the NEXT step's panes before
+    quantizing. Input-unit carry needs no Average rescale: the error
+    enters the output pre-division, so a +res input correction restores
+    exactly what the quantization cost. Sum/Average only.
+    """
+    _stall_check()
+    op = resolve_op(op, None)
+    if op not in (Average, Sum):
+        raise ValueError("quantized_reducescatter supports Sum/Average only")
+    n = lax.axis_size(axis_name)
+    if panes.ndim != 2 or panes.shape[0] != n:
+        raise ValueError(
+            f"panes must be [world={n}, cols], got {panes.shape}"
+        )
+    cols = panes.shape[1]
+    idx = lax.axis_index(axis_name)
+    x = panes.astype(jnp.float32)
+    block = int(block_size) if block_size else max(cols, 1)
+    key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+    key = jax.random.fold_in(key, idx)
+    q, scales = _stochastic_round_blocks(x, block, key)  # [n, nb, block]
+    recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    recv_s = lax.all_to_all(scales, axis_name, split_axis=0,
+                            concat_axis=0, tiled=True)
+    shard = jnp.sum(_block_dequant(recv, recv_s), axis=0)[:cols]
+    if op == Average:
+        shard = shard / jnp.asarray(n, shard.dtype)
+    if not return_residual:
+        return shard
+    residual = x - _block_dequant(q, scales)[:, :cols]
+    return shard, residual
+
+
+def quantized_allgather(
+    shard,
+    axis_name: str = WORLD_AXIS,
+    seed=0,
+    block_size: Optional[int] = None,
+    return_residual: bool = False,
+):
+    """Quantized all-gather of a per-rank ``[cols]`` shard: block-scaled
+    int8 with stochastic rounding on the wire, one quantization stage.
+    EVERY rank — the shard's owner included — consumes the dequantized
+    wire value, so a gathered parameter-update stays bit-identical
+    across replicas (the Horovod replica-consistency contract) at the
+    cost of one quantum of update error, which the error-feedback carry
+    (``return_residual=True``: ``shard − dequant(quant(shard))``, input
+    units, ``[cols]``) cancels cumulatively. Same pad-exclusion-by-
+    construction contract as :func:`quantized_reducescatter`.
+
+    Returns the fp32 ``[n, cols]`` gather (row ``r`` = rank r's shard).
+    """
+    _stall_check()
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    x = shard.reshape(1, -1).astype(jnp.float32)
+    cols = x.shape[1]
+    block = int(block_size) if block_size else max(cols, 1)
+    key = jax.random.fold_in(jax.random.PRNGKey(1), seed)
+    key = jax.random.fold_in(key, idx)
+    q, s = _stochastic_round_blocks(x, block, key)  # [1, nb, block]
+    all_q = lax.all_gather(q[0], axis_name)  # [n, nb, block]
+    all_s = lax.all_gather(s[0], axis_name)  # [n, nb]
+    out = _block_dequant(all_q, all_s)[:, :cols]
+    if not return_residual:
+        return out
+    residual = (x - _block_dequant(q, s)[:, :cols])[0]
+    return out, residual
+
+
 # Axis names for the two-level mesh built by hierarchical_mesh().
 INTRA_AXIS = "intra"  # within a host/slice: ICI
 INTER_AXIS = "inter"  # across hosts/slices: DCN

@@ -1,43 +1,133 @@
-"""FSDP-style parameter sharding for the jit/GSPMD path.
+"""Parameter-shard layouts: the ONE source of truth for how a pytree
+leaf maps onto per-rank shards.
 
-Beyond the reference's surface (Horovod replicates parameters on every
-rank). Where ``ShardedDistributedOptimizer`` shards the *optimizer
-update* with explicit collectives inside ``shard_map``, this module
-serves the **jit + NamedSharding** style: annotate each parameter leaf
-as sharded along the data axis and let GSPMD insert the all-gathers
-(before use) and reduce-scatters (for grads) — the XLA
-weight-update-sharding recipe (PAPERS.md arXiv:2004.13336; the
-scaling-book FSDP axis). Parameters, gradients, and optimizer state
-then all live 1/N-sharded in HBM with no manual collective code.
+Two styles share this module so their math can never drift apart:
 
-Usage::
+* **Flat ZeRO layout** (``shard_cols`` / ``pad_to`` / ``host_shard`` /
+  ``host_shard_rows`` / ``dyn_shard`` / ``host_unshard``): every
+  nonscalar leaf is flattened, zero-padded to a multiple of the world
+  size, and split rank-major into ``[world, cols]`` rows. This is the
+  layout ``ShardedDistributedOptimizer`` uses for optimizer state
+  (ZeRO-1), gradient shards (ZeRO-2), and parameter storage (ZeRO-3),
+  and what ``reshard_state`` / ``reshard_params`` re-split elastically
+  across world changes. It is deliberately shape-oblivious — one rule
+  for every leaf — so bucketed collectives can concatenate member
+  panes column-wise and the shard slice of a bucket's reduce-scatter
+  output IS the storage slice (PAPERS.md arXiv:2004.13336; the ZeRO
+  recipe).
+* **GSPMD NamedSharding rule** (``fsdp_spec`` / ``fsdp_sharding`` /
+  ``fsdp_shard``): for the jit + NamedSharding style, annotate each
+  leaf as sharded along its largest divisible dimension and let GSPMD
+  insert the all-gathers and reduce-scatters. Kept for the
+  compiler-driven path; the explicit-collective stack above is the
+  optimizer's layout.
 
-    shardings = fsdp_sharding(params, mesh)          # pytree of NamedSharding
-    params = fsdp_shard(params, mesh)                # device_put accordingly
-    opt_state = jax.tree.map(...)                    # init from sharded params
-    step = jax.jit(train_step, donate_argnums=(0, 1))
-    # XLA inserts gather/scatter; batch rides P(axis) as usual
-
-Sharding rule per leaf: the largest dimension divisible by the axis
-size is sharded; leaves with no divisible dimension or fewer than
-``min_elems`` elements replicate (tiny leaves cost more to gather than
-they save). This is deliberately static and predictable — no cost
-model, same rule every run.
+Before PR 9 the flat-layout helpers lived as private duplicates inside
+``sharded_optimizer.py``; they were folded here so the ZeRO-2/3
+parameter/gradient shards, the elastic reshard, and the GSPMD rule all
+read one definition.
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common.topology import WORLD_AXIS
 
 
+# ------------------------------------------------------ flat ZeRO layout
+
+
+def shard_cols(size: int, world: int) -> int:
+    """Per-rank shard length of a flattened leaf of ``size`` elements:
+    ``ceil(size / world)`` (the zero-padded split)."""
+    return -(-int(size) // int(world))
+
+
+def pad_to(flat, n):
+    """Zero-pad a 1-D array to a multiple of ``n`` (traced-safe).
+    Pad elements are ZEROS by contract: they quantize to zeros, never
+    raise an int8 block's absmax, and carry zero EF residual — the
+    by-construction pad-exclusion the sharded wire relies on."""
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def host_shard(x, n, r):
+    """Host-side shard ``r`` of leaf ``x`` (init path, outside jit);
+    0-d leaves replicate."""
+    x = jnp.asarray(x)
+    if x.ndim == 0:
+        return x
+    flat = pad_to(x.reshape(-1), n)
+    return flat.reshape(n, -1)[r]
+
+
+def host_shard_rows(x, n):
+    """All ``n`` shards of leaf ``x`` stacked rank-major: ``[n, cols]``
+    (0-d leaves broadcast to ``[n]``) — the ZeRO-3 parameter-storage
+    layout, matching the optimizer state's leading-world-axis
+    convention so both ride ``shard_map`` with one ``P(axis)`` spec."""
+    x = jnp.asarray(x)
+    if x.ndim == 0:
+        return jnp.broadcast_to(x, (n,))
+    return pad_to(x.reshape(-1), n).reshape(n, -1)
+
+
+def dyn_shard(x, n, idx):
+    """Traced shard selection by the rank's axis_index (update path)."""
+    flat = pad_to(x.reshape(-1), n)
+    return jax.lax.dynamic_index_in_dim(
+        flat.reshape(n, -1), idx, axis=0, keepdims=False
+    )
+
+
+def host_unshard(rows, shape, dtype=None):
+    """Invert :func:`host_shard_rows` on the host: ``[n, cols]`` rows →
+    the original leaf (drop the zero-pad tail, restore ``shape``)."""
+    rows = np.asarray(rows)
+    if len(tuple(shape)) == 0:
+        out = rows.reshape(-1)[0]
+    else:
+        size = int(np.prod(shape, dtype=np.int64))
+        out = rows.reshape(-1)[:size].reshape(shape)
+    return jnp.asarray(out, dtype) if dtype is not None else jnp.asarray(out)
+
+
+def reshard_rows(rows, size: int, new_world: int, dtype=None):
+    """Re-split one leaf's shard rows at a new world size, preserving
+    values bit-exactly: concat the old shards, re-pad (or drop only
+    zero-pad tail) for the new split. ``size`` is the ORIGINAL
+    (unpadded) element count; entries past it are padding zeros that no
+    consumer ever reads back."""
+    rows = np.asarray(rows)
+    per = shard_cols(size, new_world)
+    flat = rows.reshape(-1)
+    need = new_world * per
+    if flat.size < need:
+        flat = np.pad(flat, (0, need - flat.size))
+    else:
+        flat = flat[:need]
+    out = flat.reshape(new_world, per)
+    return jnp.asarray(out, dtype) if dtype is not None else jnp.asarray(out)
+
+
+# ------------------------------------------- GSPMD NamedSharding rule
+
+
 def fsdp_spec(
     leaf, axis_size: int, axis: str = WORLD_AXIS, min_elems: int = 2**14
 ) -> P:
-    """PartitionSpec for one leaf under the FSDP rule."""
+    """PartitionSpec for one leaf under the GSPMD FSDP rule: the
+    largest dimension divisible by the axis size is sharded; leaves
+    with no divisible dimension or fewer than ``min_elems`` elements
+    replicate (tiny leaves cost more to gather than they save).
+    Deliberately static and predictable — no cost model."""
     shape = np.shape(leaf)
     if int(np.prod(shape, dtype=np.int64)) < min_elems:
         return P()

@@ -1,31 +1,55 @@
-"""ZeRO-1-style sharded weight update for data-parallel training.
+"""ZeRO-style sharded weight update for data-parallel training.
 
-Beyond-parity, TPU-first (the reference has no analog): instead of
-allreducing gradients and running the optimizer replicated, each rank
+Beyond-parity, TPU-first (the reference has no analog): three sharding
+stages over one optimizer, selected by ``zero_stage`` (default
+``HOROVOD_ZERO_STAGE``; the staging mirrors DeepSpeed's ZeRO and the
+XLA "automatic cross-replica sharding of weight update" recipe —
+PAPERS.md: Xu et al., arXiv:2004.13336, pattern reference only):
 
-1. **reduce-scatters** the gradients (each rank receives the reduced
-   1/N shard — half the wire bytes of a ring allreduce),
-2. runs the optimizer update on its shard only (optimizer state — Adam
-   moments etc. — lives sharded, 1/N of the memory per rank), then
-3. **all-gathers** the parameter updates (the other half of the bytes).
+* ``zero_stage=1`` — optimizer-state sharding (the original contract):
+  each rank **reduce-scatters** the gradients (1/N shard each, half the
+  wire bytes of a ring allreduce), runs the inner transform on its
+  shard only (Adam moments etc. live 1/N-sharded), then **all-gathers**
+  the parameter updates. Total communication equals one ring allreduce;
+  optimizer math and state memory drop to 1/N.
+* ``zero_stage=2`` — gradient sharding on top: grads taken through
+  :meth:`value_and_grad` are reduce-scattered **per overlap bucket
+  inside backprop** (a ``custom_vjp`` boundary — the mirror of
+  ``hvd.overlap_boundary``), so each bucket's reduce-scatter output IS
+  the per-rank shard slice and no reduced full-gradient buffer ever
+  materializes. The int8/bf16 quantized wire applies to both exchange
+  legs (``wire=``, per-bucket resolution via
+  ``ops.overlap.resolve_wire``/WireTuner) with error-feedback residual
+  rows carried in the optimizer state (``error_feedback=True``).
+* ``zero_stage=3`` — parameter sharding: params live as
+  ``[world, cols]`` shard rows between steps (:meth:`init_params`,
+  layout: ``parallel.fsdp.host_shard_rows``). The forward all-gathers
+  each parameter bucket through a ``custom_vjp`` boundary at its
+  forward dataflow frontier — the compiled HLO carries N INDEPENDENT
+  all-gathers interleaved into compute, not one up-front unshard — and
+  the backward's cotangent leaves through the same bucketed
+  reduce-scatter, landing gradients directly in shard geometry.
+  :meth:`update` then updates the local shard with NO collective (the
+  next forward's gathers re-publish the new params), so replicated
+  param+grad residency drops world-fold.
 
-Total communication equals one ring allreduce; optimizer math and
-state memory drop to 1/N. This is the XLA "automatic cross-replica
-sharding of weight update" / ZeRO-1 recipe (PAPERS.md: Xu et al.,
-arXiv:2004.13336 — pattern reference only) expressed with explicit
-collectives so it composes with the rest of the shard_map stack.
+Contract (all stages):
 
-Contract:
-
-* ``opt = ShardedDistributedOptimizer(optax.adam(1e-3))``
+* ``opt = ShardedDistributedOptimizer(optax.adam(1e-3), zero_stage=s)``
 * ``state = opt.init(params)`` — OUTSIDE jit/shard_map. Every state
   leaf gains a leading ``world`` axis (rank r's shard at index r;
   scalar leaves like Adam's ``count`` are broadcast), so the whole
   state threads through ``jax.shard_map`` with a uniform
-  ``P(WORLD_AXIS)`` spec.
+  ``P(WORLD_AXIS)`` spec. Stage 3 adds
+  ``pstate = opt.init_params(params)`` with the same convention.
 * ``updates, state = opt.update(grads, state, params)`` — INSIDE
-  ``shard_map`` over the world axis, full (replicated-shape) grads and
-  params in, full updates out.
+  ``shard_map`` over the world axis. Stages 1-2 accept full
+  (replicated-shape) grads/params and return full updates; grads
+  produced by :meth:`value_and_grad` arrive pre-scattered (per-leaf
+  shard slices) and skip the internal reduce-scatter. Stage 3 takes
+  shard grads + ``opt.local_shards(pstate)`` and returns SHARD
+  updates — apply them with ``optax.apply_updates`` on the local
+  shards and re-stack with ``opt.as_rows``.
 
 Supported inner transforms: elementwise ones (sgd, momentum, adam,
 adamw, rmsprop, ...). Norm-based transforms like
@@ -38,6 +62,11 @@ update is not elementwise and raises ``ValueError`` with the
 clip-before-wrapper recipe instead of letting training silently
 diverge. ``HOROVOD_SHARDED_OPT_PROBE=0`` skips the probe (e.g. for a
 deliberately stochastic transform that the probe cannot compare).
+
+Shard layout is owned by ``parallel/fsdp.py`` (ONE source of truth for
+the flat pad/split geometry — this module holds no private copy), and
+the bucketed exchange legs by ``ops/overlap.py``
+(``bucketed_reduce_scatter`` / ``bucketed_shard_all_gather``).
 """
 
 from __future__ import annotations
@@ -51,30 +80,31 @@ import optax
 
 from .common.topology import WORLD_AXIS
 from .ops.reduction_ops import Average, ReduceOp, Sum, resolve_op
+from .parallel.fsdp import (
+    dyn_shard as _shard_dyn_impl,
+    host_shard as _shard_host_impl,
+    host_shard_rows,
+    host_unshard,
+    pad_to as _pad_to_impl,
+    reshard_rows,
+    shard_cols,
+)
+
+_WIRE_FORMATS = ("fp32", "bf16", "int8", "auto")
 
 
 def _pad_to(flat, n):
-    pad = (-flat.size) % n
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    return flat
+    return _pad_to_impl(flat, n)
 
 
 def _shard_host(x, n, r):
     """Host-side shard r of array x (init path, outside jit)."""
-    x = jnp.asarray(x)
-    if x.ndim == 0:
-        return x
-    flat = _pad_to(x.reshape(-1), n)
-    return flat.reshape(n, -1)[r]
+    return _shard_host_impl(x, n, r)
 
 
 def _shard_dyn(x, n, idx):
     """Traced shard selection by the rank's axis_index (update path)."""
-    flat = _pad_to(x.reshape(-1), n)
-    return jax.lax.dynamic_index_in_dim(
-        flat.reshape(n, -1), idx, axis=0, keepdims=False
-    )
+    return _shard_dyn_impl(x, n, idx)
 
 
 def _probe_nonelementwise(inner: optax.GradientTransformation) -> bool:
@@ -179,7 +209,7 @@ def _probe_nonelementwise(inner: optax.GradientTransformation) -> bool:
 
 class ShardedDistributedOptimizer:
     """Data-parallel optimizer with reduce-scatter/all-gather weight
-    update and 1/world-sharded optimizer state (module docstring)."""
+    update and ZeRO-1/2/3 sharding stages (module docstring)."""
 
     def __init__(
         self,
@@ -192,8 +222,17 @@ class ShardedDistributedOptimizer:
         overlap_min_bytes: Optional[int] = None,
         grad_guard: Optional[bool] = None,
         guard_max_skips: Optional[int] = None,
+        zero_stage: Optional[int] = None,
+        wire: Optional[str] = None,
+        wire_block: Optional[int] = None,
+        error_feedback: bool = False,
     ):
-        """``overlap_buckets=N`` buckets the exchange (ops/overlap.py):
+        """``zero_stage`` selects the sharding stage (module docstring);
+        ``None`` defers to ``HOROVOD_ZERO_STAGE`` (default 1). Stage 3
+        always runs the bucketed exchange (``overlap_buckets`` floors
+        at 1 — its schedule IS the parameter gather plan).
+
+        ``overlap_buckets=N`` buckets the exchange (ops/overlap.py):
         gradients reduce-scatter as N independent per-bucket collectives
         (member leaves' padded [n, ·] panes concatenated column-wise —
         elementwise identical to the per-leaf scatter, so the shard
@@ -202,10 +241,25 @@ class ShardedDistributedOptimizer:
         enforces it), the single ``inner.update`` call decomposes into
         per-leaf dataflow: bucket k's update math depends only on
         bucket k's reduce-scatter output, so XLA overlaps the update
-        compute with the tail of the exchange — the ZeRO-1 shard-by-
-        shard interleave of arXiv 2004.13336, with state/checkpoint
-        layout unchanged. ``None`` defers to ``HOROVOD_OVERLAP``/
+        compute with the tail of the exchange — the shard-by-shard
+        interleave of arXiv 2004.13336, with state/checkpoint layout
+        unchanged. ``None`` defers to ``HOROVOD_OVERLAP``/
         ``HOROVOD_OVERLAP_BUCKETS``; 0 keeps the per-leaf collectives.
+
+        ``wire`` picks the exchange wire format per bucket
+        (``fp32``/``bf16``/``int8``/``auto``; ``None`` defers to
+        ``HOROVOD_ZERO_WIRE``, default fp32 — deliberately NOT
+        ``HOROVOD_FUSION_WIRE``, the eager fused-wire knob). ``auto``
+        resolves per bucket through
+        ``ops.overlap.resolve_wire`` (size floor + WireTuner).
+        ``error_feedback=True`` (stages 1-2, quantized-capable wire,
+        full-gradient update path) carries both legs' quantization
+        errors in the optimizer state — ``rs`` rows in full gradient
+        geometry, ``ag`` rows in shard geometry (1/N per rank) — plus a
+        per-step wire-seed counter, all riding the same
+        leading-world-axis convention so ``reshard_state`` carries them
+        elastically. Pad positions hold zero residual by construction
+        (``parallel.fsdp.pad_to`` contract).
 
         ``grad_guard=True`` (``None`` defers to ``HOROVOD_GUARD``)
         adds the non-finite skip-step sentinel (common/guard.py).
@@ -228,11 +282,52 @@ class ShardedDistributedOptimizer:
             )
         self._axis = axis_name
         self._world = world
+        from .common import basics
+
+        cfg = basics.live_config()
+        self._stage = int(
+            zero_stage if zero_stage is not None else cfg.zero_stage
+        )
+        if self._stage not in (1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be 1, 2 or 3, got {self._stage}"
+            )
+        # wire=None defers to the DEDICATED sharded-wire knob
+        # (HOROVOD_ZERO_WIRE, default fp32) — never to
+        # HOROVOD_FUSION_WIRE, which governs the eager fused wire and
+        # predates ZeRO-2/3: inheriting it would silently quantize the
+        # sharded exchange (and flip the state layout) under existing
+        # deployments' env
+        self._wire = wire if wire is not None else cfg.zero_wire
+        if self._wire not in _WIRE_FORMATS:
+            raise ValueError(
+                f"wire must be one of {_WIRE_FORMATS}, got {self._wire!r}"
+            )
+        self._wire_block = int(
+            wire_block if wire_block is not None else cfg.fusion_wire_block
+        )
+        self._ef = bool(error_feedback)
+        if self._ef and self._wire not in ("int8", "auto"):
+            raise ValueError(
+                "error_feedback requires a quantized-capable wire "
+                "(wire='int8' or 'auto'); fp32/bf16 residuals drain to "
+                "the exact cast error and buy nothing"
+            )
+        if self._ef and self._stage >= 3:
+            raise ValueError(
+                "error_feedback composes with zero_stage<=2 only: the "
+                "stage-3 gather/scatter boundary is a stateless "
+                "custom_vjp and cannot thread residual carries; run "
+                "stage 3 with wire='fp32'/'bf16' or plain int8"
+            )
         from .ops import overlap as _overlap
 
         if overlap_buckets is None:
             overlap_buckets = _overlap.default_buckets()
         self._overlap_buckets = int(overlap_buckets)
+        if self._stage >= 3:
+            # the schedule IS the parameter gather/scatter plan
+            self._overlap_buckets = max(self._overlap_buckets, 1)
         self._overlap_min_bytes = (
             _overlap.default_min_bytes()
             if overlap_min_bytes is None
@@ -251,6 +346,7 @@ class ShardedDistributedOptimizer:
             else _guard.default_max_skips()
         )
         self._guard_src = _guard.new_source() if self._guard_on else 0
+        self._pmeta = None  # stage-3 full-parameter geometry
         import os
 
         if os.environ.get(
@@ -293,44 +389,194 @@ class ShardedDistributedOptimizer:
             lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
             *shard_states,
         )
-        if not self._guard_on:
-            return stacked
-        # guard counters ride the same rank-major convention ([world]
-        # rows of replicated scalars) so the whole state still threads
-        # through shard_map with the single P(axis) spec
-        z = jnp.zeros((n,), jnp.int32)
-        return {"state": stacked, "guard": {"skips": z, "streak": z, "step": z}}
-
-    # -- update (inside shard_map over axis_name) --------------------------
-    @staticmethod
-    def _is_guarded_layout(state) -> bool:
-        return isinstance(state, dict) and set(state) == {
-            "state", "guard",
-        }
-
-    def update(self, grads, state, params):
         guard_rows = None
         if self._guard_on:
-            if not self._is_guarded_layout(state):
-                raise ValueError(
-                    "grad_guard is on but the optimizer state has the "
-                    "flat (unguarded) layout — it was created before "
-                    "the guard was enabled. Migrate it once with "
-                    "reshard_state(state, params, world) (which "
-                    "synthesizes zero guard counters), or re-run "
-                    "init(params)."
-                )
-            guard_rows = state["guard"]
-            state = state["state"]
-        elif self._is_guarded_layout(state):
-            raise ValueError(
-                "the optimizer state carries guard counters "
-                "({'state','guard'} layout) but grad_guard is off — "
-                "it was checkpointed by a GUARDED run. Re-enable the "
-                "guard, or downgrade the state once with "
-                "reshard_state(state, params, world) (which strips "
-                "the counters when the guard is off)."
+            # guard counters ride the same rank-major convention
+            # ([world] rows of replicated scalars) so the whole state
+            # still threads through shard_map with the single P(axis)
+            # spec
+            z = jnp.zeros((n,), jnp.int32)
+            guard_rows = {"skips": z, "streak": z, "step": z}
+        wire_rows = (
+            self._init_wire_rows(params, n)
+            if self._wants_wire_rows()
+            else None
+        )
+        return self._compose_state(stacked, guard_rows, wire_rows)
+
+    def _wants_wire_rows(self) -> bool:
+        """A quantized-capable wire on the update-internal legs needs
+        state: a per-step seed counter (a FIXED stochastic-rounding
+        seed would repeat the same realized error every step — a
+        directional drift instead of an unbiased walk), plus the EF
+        residual rows when error_feedback is on. Stage 3 has no wire
+        leg inside update (the boundary carries the exchange), so its
+        state stays wire-free."""
+        return self._stage <= 2 and (
+            self._ef or self._wire in ("int8", "auto")
+        )
+
+    def _init_wire_rows(self, params, n):
+        """Wire-seed counter (+ error-feedback carries when EF is on),
+        rank-major: ``rs`` rows mirror the FULL gradient geometry (each
+        rank's quantization error is over its own full local
+        contribution), ``ag`` rows the shard geometry (the update-leg
+        error lives on the shard its rank owns — genuinely 1/N)."""
+        rows = {"step": jnp.zeros((n,), jnp.int32)}
+        if not self._ef:
+            return rows
+
+        # shape/dtype only — a jax.eval_shape template works here too
+        def _full_rows(p):
+            return jnp.zeros(
+                (n,) + tuple(np.shape(p)), jnp.result_type(p)
             )
+
+        def _shard_rows(p):
+            shape = tuple(np.shape(p))
+            if not shape:
+                return jnp.zeros((n,), jnp.result_type(p))
+            size = int(np.prod(shape, dtype=np.int64))
+            return jnp.zeros(
+                (n, shard_cols(size, n)), jnp.result_type(p)
+            )
+
+        rows["rs"] = jax.tree_util.tree_map(_full_rows, params)
+        rows["ag"] = jax.tree_util.tree_map(_shard_rows, params)
+        return rows
+
+    # -- state layout ------------------------------------------------------
+    @staticmethod
+    def _layout(state):
+        """Decompose a state into (inner, guard_rows, wire_rows) without
+        enforcing the optimizer's flags (the reshard migration point)."""
+        if (
+            isinstance(state, dict)
+            and "state" in state
+            and set(state) <= {"state", "guard", "wire"}
+        ):
+            return state["state"], state.get("guard"), state.get("wire")
+        return state, None, None
+
+    @staticmethod
+    def _compose_state(inner, guard_rows, wire_rows):
+        extras = {}
+        if guard_rows is not None:
+            extras["guard"] = guard_rows
+        if wire_rows is not None:
+            extras["wire"] = wire_rows
+        if not extras:
+            return inner
+        return {"state": inner, **extras}
+
+    @staticmethod
+    def _is_guarded_layout(state) -> bool:
+        inner, guard_rows, _ = ShardedDistributedOptimizer._layout(state)
+        return guard_rows is not None
+
+    def _split_state(self, state):
+        """Layout split + flag validation (update path: mismatches are
+        hard errors pointing at the reshard_state migration)."""
+        inner, guard_rows, wire_rows = self._layout(state)
+        if self._guard_on and guard_rows is None:
+            raise ValueError(
+                "grad_guard is on but the optimizer state has the "
+                "flat (unguarded) layout — it was created before "
+                "the guard was enabled. Migrate it once with "
+                "reshard_state(state, params, world) (which "
+                "synthesizes zero guard counters), or re-run "
+                "init(params)."
+            )
+        if not self._guard_on and guard_rows is not None:
+            raise ValueError(
+                "the optimizer state carries guard counters but "
+                "grad_guard is off — it was checkpointed by a GUARDED "
+                "run. Re-enable the guard, or downgrade the state once "
+                "with reshard_state(state, params, world) (which "
+                "strips the counters when the guard is off)."
+            )
+        wants = self._wants_wire_rows()
+        if wants and wire_rows is None:
+            raise ValueError(
+                "the quantized wire needs wire state rows (per-step "
+                "seed counter and, with error_feedback, the wire "
+                "residual rows) but the optimizer state has none — "
+                "migrate it once with reshard_state(state, params, "
+                "world) (which synthesizes them), or re-run "
+                "init(params)."
+            )
+        if not wants and wire_rows is not None:
+            raise ValueError(
+                "the optimizer state carries wire residual/seed rows "
+                "but this optimizer's wire is exact (fp32/bf16, no "
+                "error_feedback) — re-enable the quantized wire, or "
+                "downgrade the state once with reshard_state(state, "
+                "params, world)."
+            )
+        if self._ef and wire_rows is not None and "rs" not in wire_rows:
+            raise ValueError(
+                "error_feedback is on but the optimizer state carries "
+                "no wire residual rows (seed-only wire state from a "
+                "plain-int8 run) — migrate it once with "
+                "reshard_state(state, params, world)."
+            )
+        if (
+            not self._ef
+            and wire_rows is not None
+            and "rs" in wire_rows
+        ):
+            raise ValueError(
+                "the optimizer state carries wire residual rows but "
+                "error_feedback is off — re-enable it, or downgrade "
+                "the state once with reshard_state(state, params, "
+                "world)."
+            )
+        return inner, guard_rows, wire_rows
+
+    # -- gradient classification -------------------------------------------
+    def _grads_are_shards(self, grads, params, n) -> bool:
+        """Static (trace-time) classification: did ``grads`` come from
+        the in-backprop scatter boundary (per-leaf shard slices) or
+        from plain backprop (full leaves)? Shapes decide: a shard leaf
+        is 1-D of length ``ceil(size/world)``. Leaves where both
+        readings coincide (``p.size <= 1``) follow the unambiguous
+        majority; an all-ambiguous tree reads as full (legacy)."""
+        g_l, g_def = jax.tree_util.tree_flatten(grads)
+        p_l = g_def.flatten_up_to(params)
+        kinds = []
+        for g, p in zip(g_l, p_l):
+            if np.ndim(p) == 0:
+                continue
+            if jnp.result_type(g) == jax.dtypes.float0:
+                continue  # non-differentiable leaf: passthrough either way
+            gs, ps = tuple(np.shape(g)), tuple(np.shape(p))
+            size = int(np.prod(ps, dtype=np.int64))
+            sc = (shard_cols(size, n),)
+            if gs == ps and gs != sc:
+                kinds.append(False)
+            elif gs == sc and gs != ps:
+                kinds.append(True)
+            elif gs == ps == sc:
+                continue  # ambiguous corner (size <= 1-ish leaves)
+            else:
+                raise ValueError(
+                    f"gradient leaf shape {gs} matches neither the "
+                    f"param shape {ps} nor its shard shape {sc}"
+                )
+        if not kinds:
+            return False
+        if all(kinds):
+            return True
+        if not any(kinds):
+            return False
+        raise ValueError(
+            "gradient tree mixes full and shard leaves — pass either "
+            "raw backprop gradients or the tree from opt.value_and_grad"
+        )
+
+    # -- update (inside shard_map over axis_name) --------------------------
+    def update(self, grads, state, params):
+        inner_rows, guard_rows, wire_rows = self._split_state(state)
         n = jax.lax.axis_size(self._axis)
         if self._world is not None and n != self._world:
             raise ValueError(
@@ -341,38 +587,84 @@ class ShardedDistributedOptimizer:
             )
         idx = jax.lax.axis_index(self._axis)
         # shard_map hands each rank its [1, ...] state slice
-        local_state = jax.tree_util.tree_map(lambda x: x[0], state)
+        local_state = jax.tree_util.tree_map(lambda x: x[0], inner_rows)
+        local_wire = (
+            jax.tree_util.tree_map(lambda x: x[0], wire_rows)
+            if wire_rows is not None
+            else None
+        )
+        wire_seed = local_wire["step"] if local_wire is not None else 0
 
-        # 0-d leaves (scalar temperature etc.) stay replicated — exactly
-        # like init's _shard_host — so state shapes are stable step-over-
-        # step (a shape flip would force a retrace and break donation)
-        def rs(g):
-            if g.ndim == 0:
-                red = jax.lax.psum(g, self._axis)
-                return red / n if self._op == Average else red
-            flat = _pad_to(g.reshape(-1), n).reshape(n, -1)
-            red = jax.lax.psum_scatter(
-                flat, self._axis, scatter_dimension=0, tiled=False
-            )
-            if self._op == Average:
-                red = red / n
-            return red
-
-        sched = None
-        if self._overlap_buckets:
-            from .ops import overlap as _overlap
-
-            g_leaves, g_def = jax.tree_util.tree_flatten(grads)
-            nonscalar = [i for i, g in enumerate(g_leaves) if g.ndim > 0]
-            sched = _overlap.schedule_for(
-                [g_leaves[i] for i in nonscalar], g_def,
-                self._overlap_buckets, self._overlap_min_bytes,
-            )
-            g_sh = self._bucketed_rs(
-                g_leaves, g_def, nonscalar, sched, n
-            )
+        if self._stage >= 3:
+            bad = [
+                p for p in jax.tree_util.tree_leaves(params)
+                if np.ndim(p) > 1
+            ]
+            if bad:
+                raise ValueError(
+                    "zero_stage=3 update expects LOCAL parameter shards "
+                    "(opt.local_shards(pstate) inside shard_map), got a "
+                    f"leaf of shape {np.shape(bad[0])} — full params "
+                    "never exist at stage 3"
+                )
+            p_sh = params
+            shard_in = True
         else:
+            shard_in = self._grads_are_shards(grads, params, n)
+            p_sh = jax.tree_util.tree_map(
+                lambda p: p if p.ndim == 0 else _shard_dyn(p, n, idx),
+                params,
+            )
+        if shard_in and self._ef:
+            raise ValueError(
+                "error_feedback rides the full-gradient update path "
+                "(the reduce-scatter happens inside update, where the "
+                "residual rows live); grads from opt.value_and_grad "
+                "arrive pre-scattered — pass raw backprop gradients "
+                "instead, or drop error_feedback"
+            )
+
+        from .ops import overlap as _overlap
+
+        new_rs_res = None
+        if shard_in:
+            g_sh = grads
+        elif self._overlap_buckets or self._wire != "fp32":
+            buckets = max(self._overlap_buckets, 1)
+            if self._ef:
+                g_sh, new_rs_res = _overlap.bucketed_reduce_scatter(
+                    grads, op=self._op, n_buckets=buckets,
+                    axis_name=self._axis, wire=self._wire,
+                    wire_block=self._wire_block, seed=wire_seed,
+                    residuals=local_wire["rs"],
+                    min_bucket_bytes=self._overlap_min_bytes,
+                )
+            else:
+                g_sh = _overlap.bucketed_reduce_scatter(
+                    grads, op=self._op, n_buckets=buckets,
+                    axis_name=self._axis, wire=self._wire,
+                    wire_block=self._wire_block, seed=wire_seed,
+                    min_bucket_bytes=self._overlap_min_bytes,
+                )
+        else:
+            # 0-d leaves (scalar temperature etc.) stay replicated —
+            # exactly like init's _shard_host — so state shapes are
+            # stable step-over-step (a shape flip would force a retrace
+            # and break donation)
+            def rs(g):
+                if g.ndim == 0:
+                    red = jax.lax.psum(g, self._axis)
+                    return red / n if self._op == Average else red
+                flat = _pad_to(g.reshape(-1), n).reshape(n, -1)
+                red = jax.lax.psum_scatter(
+                    flat, self._axis, scatter_dimension=0, tiled=False
+                )
+                if self._op == Average:
+                    red = red / n
+                return red
+
             g_sh = jax.tree_util.tree_map(rs, grads)
+
         finite = None
         if self._guard_on:
             from .ops.traced import tree_finite
@@ -392,9 +684,6 @@ class ShardedDistributedOptimizer:
             g_sh = jax.tree_util.tree_map(
                 lambda g: jnp.where(finite, g, jnp.zeros_like(g)), g_sh
             )
-        p_sh = jax.tree_util.tree_map(
-            lambda p: p if p.ndim == 0 else _shard_dyn(p, n, idx), params
-        )
         upd_sh, new_local = self._inner.update(g_sh, local_state, p_sh)
         if self._guard_on:
             # skip-step semantics by selection: zero updates, state of
@@ -408,21 +697,79 @@ class ShardedDistributedOptimizer:
                 new_local, local_state,
             )
 
-        def gather(u, p):
-            if p.ndim == 0:
-                return u
-            full = jax.lax.all_gather(u, self._axis, axis=0).reshape(-1)
-            return full[: p.size].reshape(p.shape).astype(u.dtype)
-
-        if sched is not None:
-            upd = self._bucketed_ag(upd_sh, params, nonscalar, sched, gather)
+        new_ag_res = None
+        if self._stage >= 3:
+            # Shard updates out: the next forward's gathers re-publish
+            # the new params. Rounding note: XLA contracts the inner
+            # transform's final multiply into the caller's
+            # `params + update` add as an FMA (one rounding, not two —
+            # verified on XLA:CPU, where even optimization_barrier is
+            # stripped before fusion), so stage-3 PARAMS can sit 1 ulp
+            # from the stage-1 trajectory, whose add consumes an
+            # all-gather output and cannot contract. Gradient shards,
+            # moments and updates stay bit-exact; the FMA'd apply is
+            # the MORE accurate of the two (tests/test_zero.py pins
+            # the <=1-ulp bound).
+            upd = upd_sh
+        elif self._overlap_buckets or self._wire != "fp32":
+            buckets = max(self._overlap_buckets, 1)
+            if self._ef:
+                upd, new_ag_res = _overlap.bucketed_shard_all_gather(
+                    upd_sh, params, n_buckets=buckets,
+                    axis_name=self._axis, wire=self._wire,
+                    wire_block=self._wire_block, seed=wire_seed,
+                    residuals=local_wire["ag"],
+                    min_bucket_bytes=self._overlap_min_bytes,
+                )
+            else:
+                upd = _overlap.bucketed_shard_all_gather(
+                    upd_sh, params, n_buckets=buckets,
+                    axis_name=self._axis, wire=self._wire,
+                    wire_block=self._wire_block, seed=wire_seed,
+                    min_bucket_bytes=self._overlap_min_bytes,
+                )
         else:
+            def gather(u, p):
+                if p.ndim == 0:
+                    return u
+                full = jax.lax.all_gather(
+                    u, self._axis, axis=0
+                ).reshape(-1)
+                return full[: p.size].reshape(p.shape).astype(u.dtype)
+
             upd = jax.tree_util.tree_map(gather, upd_sh, params)
-        new_state = jax.tree_util.tree_map(
-            lambda x: x[None], new_local
-        )
+        if self._guard_on and self._stage < 3:
+            # a lossy AG leg transmits quantize(0 + residual) on a
+            # skipped step; the post-gather gate discards it so skipped
+            # steps move nothing (shard updates were gated above)
+            upd = jax.tree_util.tree_map(
+                lambda u: jnp.where(finite, u, jnp.zeros_like(u)), upd
+            )
+
+        new_inner = jax.tree_util.tree_map(lambda x: x[None], new_local)
+        new_wire = None
+        if local_wire is not None:
+            def _gate(new_r, old_r):
+                if finite is None:
+                    return new_r
+                return jnp.where(finite, new_r, old_r)
+
+            # the seed counter advances even on skips — rounding stays
+            # decorrelated across retries of a bad region
+            new_wire = {
+                "step": (local_wire["step"] + jnp.int32(1))[None]
+            }
+            if self._ef:
+                new_wire["rs"] = jax.tree_util.tree_map(
+                    lambda a, b: _gate(a, b)[None],
+                    new_rs_res, local_wire["rs"],
+                )
+                new_wire["ag"] = jax.tree_util.tree_map(
+                    lambda a, b: _gate(a, b)[None],
+                    new_ag_res, local_wire["ag"],
+                )
         if not self._guard_on:
-            return upd, new_state
+            return upd, self._compose_state(new_inner, None, new_wire)
         import functools
 
         from .common import guard as _guard
@@ -454,79 +801,275 @@ class ShardedDistributedOptimizer:
             "streak": jnp.where(finite, zero, streak_next)[None],
             "step": (step + one)[None],
         }
-        return upd, {"state": new_state, "guard": new_guard}
+        return upd, self._compose_state(new_inner, new_guard, new_wire)
 
-    # -- bucketed exchange (overlap_buckets) -------------------------------
-    def _bucketed_rs(self, g_leaves, g_def, nonscalar, sched, n):
-        """Per-bucket reduce-scatter: member leaves' padded [n, cols]
-        panes concat column-wise, ONE psum_scatter per bucket, shard
-        split back per leaf. Elementwise identical to the per-leaf
-        scatter (same per-element cross-replica sums), but the compiled
-        program carries len(sched.buckets) INDEPENDENT collectives."""
-        out = [None] * len(g_leaves)
-        for i, g in enumerate(g_leaves):
-            if g.ndim == 0:
-                red = jax.lax.psum(g, self._axis)
-                out[i] = red / n if self._op == Average else red
-        for idxs in sched.buckets:
-            panes = [
-                _pad_to(g_leaves[nonscalar[j]].reshape(-1), n).reshape(n, -1)
-                for j in idxs
-            ]
-            cols = [p.shape[1] for p in panes]
-            buf = panes[0] if len(panes) == 1 else jnp.concatenate(
-                panes, axis=1
-            )
-            red = jax.lax.psum_scatter(
-                buf, self._axis, scatter_dimension=0, tiled=False
-            )
-            if self._op == Average:
-                red = red / n
-            off = 0
-            for j, c in zip(idxs, cols):
-                out[nonscalar[j]] = red[off : off + c]
-                off += c
-        return jax.tree_util.tree_unflatten(g_def, out)
+    # -- in-backprop scatter / forward gather boundaries -------------------
+    def _scatter_kw(self, seed):
+        return dict(
+            op=self._op,
+            n_buckets=max(self._overlap_buckets, 1),
+            axis_name=self._axis,
+            wire=self._wire,
+            wire_block=self._wire_block,
+            seed=seed,
+            min_bucket_bytes=self._overlap_min_bytes,
+        )
 
-    def _bucketed_ag(self, upd_sh, params, nonscalar, sched, gather):
-        """Per-bucket all-gather of the update shards: the dual of
-        :meth:`_bucketed_rs` (concat shards → ONE all_gather per bucket
-        → per-leaf columns → unpad/reshape). Falls back to the per-leaf
-        gather for a bucket whose update dtypes diverged (an inner
-        transform that changes dtype per leaf)."""
-        u_leaves, u_def = jax.tree_util.tree_flatten(upd_sh)
-        p_leaves = u_def.flatten_up_to(params)
-        out = [None] * len(u_leaves)
-        for i, (u, p) in enumerate(zip(u_leaves, p_leaves)):
-            if p.ndim == 0:
-                out[i] = u
-        for idxs in sched.buckets:
-            mem = [u_leaves[nonscalar[j]] for j in idxs]
-            if len({m.dtype for m in mem}) > 1:
-                for j in idxs:
-                    out[nonscalar[j]] = gather(
-                        u_leaves[nonscalar[j]], p_leaves[nonscalar[j]]
-                    )
-                continue
-            cols = [m.shape[0] for m in mem]
-            buf = mem[0] if len(mem) == 1 else jnp.concatenate(mem)
-            full = jax.lax.all_gather(buf, self._axis, axis=0)  # [n, L]
-            off = 0
-            for j, c in zip(idxs, cols):
-                i = nonscalar[j]
-                p = p_leaves[i]
-                flat = full[:, off : off + c].reshape(-1)
-                out[i] = (
-                    flat[: p.size]
-                    .reshape(p.shape)
-                    .astype(u_leaves[i].dtype)
+    def _gather_kw(self, seed):
+        return dict(
+            n_buckets=max(self._overlap_buckets, 1),
+            axis_name=self._axis,
+            wire=self._wire,
+            wire_block=self._wire_block,
+            seed=seed,
+            min_bucket_bytes=self._overlap_min_bytes,
+        )
+
+    def _carrier_call(self, psh, pfull, seed):
+        """Stage-1/2 boundary: the full params pass through untouched
+        on the forward (their shard slices are dead forward values XLA
+        DCEs away), and the COTANGENT tree leaves through the bucketed
+        reduce-scatter — each overlap bucket's reduce-scatter output IS
+        the gradient shard slice, emitted at its backward dataflow
+        frontier. The full params ride as an explicit operand (zero
+        cotangent) because custom_vjp cannot close over tracers; the
+        wire seed rides the same way (an int32 operand whose cotangent
+        is float0 — kept integer so step counters never collapse to
+        shared float32 values past 2^24), so a TRACED per-step seed
+        decorrelates a quantized wire's stochastic rounding across
+        steps instead of replaying one fixed realization."""
+        from .ops import overlap as _overlap
+
+        kw = self._scatter_kw(0)
+        kw.pop("seed")
+        s = jnp.asarray(seed, jnp.int32)
+
+        @jax.custom_vjp
+        def _carrier(q, pf, sv):
+            return pf
+
+        def _fwd(q, pf, sv):
+            return pf, sv
+
+        def _bwd(sv, ct):
+            g_sh = _overlap.bucketed_reduce_scatter(ct, seed=sv, **kw)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, ct)
+            return g_sh, zeros, np.zeros(sv.shape, jax.dtypes.float0)
+
+        _carrier.defvjp(_fwd, _bwd)
+        return _carrier(psh, pfull, s)
+
+    def _gather_call(self, psh, seed, differentiable=True):
+        """Stage-3 boundary: per-bucket all-gathers reconstruct the full
+        params at their forward dataflow frontiers (N INDEPENDENT
+        collectives — XLA interleaves each into the compute that first
+        consumes its bucket, no monolithic unshard); the backward's
+        cotangents leave through the matching bucketed reduce-scatter,
+        landing gradients directly in shard geometry. The wire seed is
+        a traced int32 operand (see _carrier_call). To re-gather
+        instead of keeping the full params live across backward, wrap
+        per-layer blocks in ``jax.checkpoint`` — the boundary composes
+        with remat (the gathers rerun inside the rematerialized
+        block)."""
+        from .ops import overlap as _overlap
+
+        self._require_meta()
+        meta = self._pmeta
+        ag_kw = self._gather_kw(0)
+        ag_kw.pop("seed")
+        rs_kw = self._scatter_kw(0)
+        rs_kw.pop("seed")
+        s = jnp.asarray(seed, jnp.int32)
+
+        def _ag(q, sv):
+            return _overlap.bucketed_shard_all_gather(
+                q, meta, seed=sv, **ag_kw
+            )
+
+        if not differentiable:
+            return _ag(psh, s)
+
+        @jax.custom_vjp
+        def _gather(q, sv):
+            return _ag(q, sv)
+
+        def _fwd(q, sv):
+            return _ag(q, sv), sv
+
+        def _bwd(sv, ct):
+            g_sh = _overlap.bucketed_reduce_scatter(
+                ct, seed=sv, **rs_kw
+            )
+            return g_sh, np.zeros(sv.shape, jax.dtypes.float0)
+
+        _gather.defvjp(_fwd, _bwd)
+        return _gather(psh, s)
+
+    def value_and_grad(self, fn, has_aux: bool = False, seed: int = 0):
+        """The sharded tape: ``opt.value_and_grad(loss_fn)`` returns a
+        function whose gradients arrive as per-leaf SHARD slices,
+        reduce-scattered per overlap bucket INSIDE backprop (no reduced
+        full-gradient tree ever materializes — the ZeRO-2/3 gradient
+        leg). Call INSIDE shard_map:
+
+        * stages 1-2: ``loss, g_sh = vg(params, *args)`` with FULL
+          params — forward is untouched; the exchange rides the
+          backward.
+        * stage 3: ``loss, g_sh = vg(opt.local_shards(pstate), *args)``
+          — the forward all-gathers each parameter bucket on demand
+          (:meth:`gather_params` dataflow) and ``fn`` receives the full
+          params.
+
+        Feed the result straight to :meth:`update` (the shard shapes
+        are detected statically and the internal reduce-scatter is
+        skipped). Quantized-wire seeding: ``seed`` is the per-trace
+        default; the returned function also takes ``wire_seed=`` at
+        CALL time, which may be a TRACED value (thread your step
+        counter through it) — a fixed seed would replay the identical
+        stochastic-rounding realization every step, turning unbiased
+        rounding noise into a directional drift. fp32/bf16 wires
+        ignore it."""
+
+        def vg(p, *args, wire_seed=None, **kwargs):
+            sv = seed if wire_seed is None else wire_seed
+            if self._stage >= 3:
+                def wrapped(q):
+                    return fn(self._gather_call(q, sv), *args, **kwargs)
+
+                return jax.value_and_grad(wrapped, has_aux=has_aux)(p)
+            n = jax.lax.axis_size(self._axis)
+            idx = jax.lax.axis_index(self._axis)
+            pc = jax.tree_util.tree_map(jax.lax.stop_gradient, p)
+            psh = jax.tree_util.tree_map(
+                lambda x: x if x.ndim == 0 else _shard_dyn(x, n, idx),
+                pc,
+            )
+
+            def wrapped(q):
+                return fn(
+                    self._carrier_call(q, pc, sv), *args, **kwargs
                 )
-                off += c
-        return jax.tree_util.tree_unflatten(u_def, out)
+
+            return jax.value_and_grad(wrapped, has_aux=has_aux)(psh)
+
+        return vg
+
+    def grad(self, fn, has_aux: bool = False, seed: int = 0):
+        vg = self.value_and_grad(fn, has_aux=has_aux, seed=seed)
+
+        def g(*args, **kwargs):
+            out = vg(*args, **kwargs)
+            return out[1]
+
+        return g
+
+    # -- stage-3 parameter storage -----------------------------------------
+    def _bind_meta(self, params) -> None:
+        self._pmeta = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(
+                np.shape(p), jnp.result_type(p)
+            ),
+            params,
+        )
+
+    def _require_meta(self):
+        if self._pmeta is None:
+            raise ValueError(
+                "stage-3 parameter geometry is unbound: call "
+                "init_params(params) (fresh start) or "
+                "bind_params_like(params_template) (elastic/checkpoint "
+                "resume — shapes only, jax.eval_shape output works) "
+                "before gathering"
+            )
+
+    def init_params(self, params):
+        """Stage-3 parameter storage: every leaf becomes its
+        ``[world, cols]`` rank-major shard rows (0-d leaves broadcast
+        to ``[world]``), the layout of ``parallel.fsdp.host_shard_rows``
+        — the same leading-world-axis convention as the optimizer
+        state, so BOTH thread through shard_map with ``state_spec()``
+        and checkpoint/reshard with the same machinery. Call OUTSIDE
+        jit. Also binds the full-parameter geometry used by
+        :meth:`gather_params` and the stage-3 boundary."""
+        from .common import basics
+
+        n = self._world or basics.size()
+        self._world = n
+        self._bind_meta(params)
+        return jax.tree_util.tree_map(
+            lambda p: host_shard_rows(p, n), params
+        )
+
+    def bind_params_like(self, params) -> "ShardedDistributedOptimizer":
+        """Record the full-parameter geometry (shapes/dtypes only —
+        ``jax.eval_shape`` output is fine) without building storage:
+        the elastic-resume path, where the shard rows come back from a
+        checkpoint but the optimizer object is fresh. Returns self."""
+        self._bind_meta(params)
+        return self
+
+    @staticmethod
+    def local_shards(pstate):
+        """Inside shard_map: strip the ``[1, ...]`` world slice off
+        every leaf of the parameter storage (or any state-convention
+        tree) — the local shard view ``update`` and
+        ``optax.apply_updates`` operate on."""
+        return jax.tree_util.tree_map(lambda x: x[0], pstate)
+
+    @staticmethod
+    def as_rows(local):
+        """Inverse of :meth:`local_shards`: re-add the leading world
+        axis so the updated shards flow out through ``state_spec()``."""
+        return jax.tree_util.tree_map(lambda x: x[None], local)
+
+    def gather_params(self, shards, seed: int = 0):
+        """Traced full-parameter reconstruction from local shard leaves
+        (inside shard_map): the stage-3 forward unshard as N
+        independent per-bucket all-gathers, without the gradient
+        boundary — for eval/inference steps. Pass
+        ``opt.local_shards(pstate)``."""
+        return self._gather_call(shards, seed, differentiable=False)
+
+    def unshard_params(self, pstate):
+        """HOST-side full parameter tree from the ``[world, cols]``
+        shard rows (outside jit; export/eval/debug). The training path
+        never needs this — checkpoints save the shard rows directly."""
+        self._require_meta()
+        return jax.tree_util.tree_map(
+            lambda rows, m: host_unshard(rows, m.shape, m.dtype),
+            pstate, self._pmeta,
+        )
+
+    def reshard_params(self, pstate, params, new_world: int):
+        """Host-side elastic reshard of the stage-3 parameter storage:
+        ``[old_world, cols]`` rows → ``[new_world, cols']`` PRESERVING
+        every parameter value bit-exactly (only zero-pad tail is
+        re-cut). ``params`` is the full-parameter template (shapes —
+        ``jax.eval_shape`` output works). Call OUTSIDE jit after the
+        new gang forms, alongside ``reshard_state``."""
+        if new_world < 1:
+            raise ValueError(f"new_world must be >= 1, got {new_world}")
+        self._bind_meta(params)
+
+        def _re(rows, p):
+            shape = np.shape(p)
+            if len(shape) == 0:
+                return jnp.broadcast_to(
+                    jnp.asarray(np.asarray(rows).reshape(-1)[0]),
+                    (new_world,),
+                )
+            size = int(np.prod(shape, dtype=np.int64))
+            return reshard_rows(
+                rows, size, new_world, jnp.result_type(p)
+            )
+
+        self._world = new_world
+        return jax.tree_util.tree_map(_re, pstate, params)
 
     def state_spec(self):
         """The single PartitionSpec for the whole state pytree in
-        shard_map in_specs/out_specs."""
+        shard_map in_specs/out_specs (the stage-3 parameter storage
+        uses the same spec)."""
         from jax.sharding import PartitionSpec as P
 
         return P(self._axis)
@@ -538,7 +1081,8 @@ class ShardedDistributedOptimizer:
         moments across a gang restart — the elastic alternative to
         the "re-run init(params)" error, which would reset Adam
         moments on every world change. Call OUTSIDE jit, with the
-        restored full params, after the new gang forms::
+        restored full params (a shape template suffices), after the
+        new gang forms::
 
             state = opt.reshard_state(state, params, hvd.size())
 
@@ -547,36 +1091,51 @@ class ShardedDistributedOptimizer:
         concatenates the old shards and re-splits at the new padding
         (tail entries beyond the param's size are padding positions —
         zeros that no update ever reads back). Replicated leaves
-        (scalars like Adam's ``count``; 0-d params) re-broadcast."""
+        (scalars like Adam's ``count``; 0-d params) re-broadcast.
+
+        Layout migration happens HERE: guard counters and wire
+        (error-feedback) residual rows are carried when the optimizer
+        still wants them, synthesized as zeros when newly enabled, and
+        stripped when disabled. ``ag`` residuals are shard-major and
+        re-split bit-exactly like the moments; ``rs`` residuals are
+        per-rank FULL-geometry errors, so the carry preserves the
+        TOTAL un-transmitted signal exactly (summed onto rank 0 — the
+        reduction only ever consumes the sum)."""
         if new_world < 1:
             raise ValueError(f"new_world must be >= 1, got {new_world}")
-        guard_rows = None
-        if self._guard_on:
-            if self._is_guarded_layout(state):
-                # guarded layout: reshard the inner state, then
-                # re-stack the (replicated) guard counters at the new
-                # world size — skip totals and the escalation streak
-                # survive the gang change just like the Adam moments
-                guard_rows = state["guard"]
-                state = state["state"]
-            else:
-                # legacy flat state under a NEWLY-enabled guard:
-                # resharding is the migration point — synthesize zero
-                # counters so the resumed job starts guarded instead
-                # of crashing at its first update
-                zero = np.zeros((1,), np.int64)
-                guard_rows = {"skips": zero, "streak": zero, "step": zero}
-        elif self._is_guarded_layout(state):
+        inner, guard_rows, wire_rows = self._layout(state)
+        if self._guard_on and guard_rows is None:
+            # legacy flat state under a NEWLY-enabled guard: resharding
+            # is the migration point — synthesize zero counters so the
+            # resumed job starts guarded instead of crashing at its
+            # first update
+            zero = np.zeros((1,), np.int64)
+            guard_rows = {"skips": zero, "streak": zero, "step": zero}
+        elif not self._guard_on:
             # guard turned OFF against a guarded checkpoint: the same
-            # migration point downgrades — strip the counters and
-            # reshard the inner state alone
-            state = state["state"]
+            # migration point downgrades — strip the counters
+            guard_rows = None
+        wants_wire = self._wants_wire_rows()
+        synthesize_wire = wants_wire and wire_rows is None
+        if not wants_wire:
+            wire_rows = None
+
+        # shard-geometry zeros, not a value shard: only leaf
+        # size/dtype/structure are read off the template, and zeros
+        # keep a jax.eval_shape params template working (the
+        # documented elastic-resume path never materializes values)
+        def _shard_zeros(p):
+            shape = tuple(np.shape(p))
+            dt = jnp.result_type(p)
+            if not shape:
+                return jnp.zeros((), dt)
+            size = int(np.prod(shape, dtype=np.int64))
+            return jnp.zeros((shard_cols(size, new_world),), dt)
+
         template = self._inner.init(
-            jax.tree_util.tree_map(
-                lambda p: _shard_host(p, new_world, 0), params
-            )
+            jax.tree_util.tree_map(_shard_zeros, params)
         )
-        old_leaves = jax.tree_util.tree_leaves(state)
+        old_leaves = jax.tree_util.tree_leaves(inner)
         tmpl_leaves, treedef = jax.tree_util.tree_flatten(template)
         if len(old_leaves) != len(tmpl_leaves):
             raise ValueError(
@@ -595,25 +1154,84 @@ class ShardedDistributedOptimizer:
                     )
                 )
                 continue
-            per_rank = t.size  # new shard length (new padding)
-            full = o.reshape(-1)
-            need = new_world * per_rank
-            if full.size < need:  # new world pads more: extend zeros
-                full = np.pad(full, (0, need - full.size))
-            else:  # old world padded more: drop only padding tail
-                full = full[:need]
+            # padded full length: per-rank re-split lands exactly on
+            # the template's shard size (parallel.fsdp.reshard_rows —
+            # the ONE re-split implementation, shared with
+            # reshard_params and the ag residuals)
             out.append(
-                jnp.asarray(full.reshape(new_world, per_rank), t.dtype)
+                reshard_rows(o, t.size * new_world, new_world, t.dtype)
             )
         self._world = new_world
         resharded = jax.tree_util.tree_unflatten(treedef, out)
-        if guard_rows is None:
-            return resharded
-        new_guard = {
-            key: jnp.broadcast_to(
-                jnp.asarray(np.asarray(val).reshape(-1)[0], jnp.int32),
-                (new_world,),
+        new_guard = None
+        if guard_rows is not None:
+            new_guard = {
+                key: jnp.broadcast_to(
+                    jnp.asarray(
+                        np.asarray(val).reshape(-1)[0], jnp.int32
+                    ),
+                    (new_world,),
+                )
+                for key, val in guard_rows.items()
+            }
+        new_wire = None
+        if synthesize_wire:
+            new_wire = self._init_wire_rows(params, new_world)
+        elif wire_rows is not None:
+            new_wire = self._reshard_wire_rows(
+                wire_rows, params, new_world
             )
-            for key, val in guard_rows.items()
+        return self._compose_state(resharded, new_guard, new_wire)
+
+    def _reshard_wire_rows(self, wire_rows, params, new_world: int):
+        step = jnp.broadcast_to(
+            jnp.asarray(
+                np.asarray(wire_rows["step"]).reshape(-1)[0], jnp.int32
+            ),
+            (new_world,),
+        )
+        if not self._ef:
+            return {"step": step}  # seed-only (plain quantized wire)
+        if "rs" not in wire_rows:
+            # EF newly enabled against a seed-only wire state: the
+            # migration point synthesizes zero carries, keeping the
+            # seed counter
+            out = self._init_wire_rows(params, new_world)
+            out["step"] = step
+            return out
+
+        def _re_rs(rows, p):
+            # per-rank FULL-geometry error: the future wire only ever
+            # consumes the cross-rank SUM, so carrying Σ over the old
+            # gang onto rank 0 (zeros elsewhere) preserves the
+            # un-transmitted signal exactly across the resize
+            rows = np.asarray(rows)
+            if np.ndim(p) == 0:
+                return jnp.broadcast_to(
+                    jnp.asarray(rows.reshape(-1)[0]), (new_world,)
+                )
+            total = rows.sum(axis=0)
+            out = np.zeros((new_world,) + total.shape, rows.dtype)
+            out[0] = total
+            return jnp.asarray(out)
+
+        def _re_ag(rows, p):
+            if np.ndim(p) == 0:
+                return jnp.broadcast_to(
+                    jnp.asarray(np.asarray(rows).reshape(-1)[0]),
+                    (new_world,),
+                )
+            size = int(np.prod(np.shape(p), dtype=np.int64))
+            return reshard_rows(
+                rows, size, new_world, np.asarray(rows).dtype
+            )
+
+        return {
+            "step": step,
+            "rs": jax.tree_util.tree_map(
+                _re_rs, wire_rows["rs"], params
+            ),
+            "ag": jax.tree_util.tree_map(
+                _re_ag, wire_rows["ag"], params
+            ),
         }
-        return {"state": resharded, "guard": new_guard}
