@@ -48,7 +48,8 @@ step() { printf '\n=== %s ===\n' "$*"; }
 lint() {
   step "lint: pyflakes-level check via python -m compileall + import"
   python -m compileall -q horovod_tpu tests bench.py bench_lm.py \
-    bench_allreduce.py bench_serve.py bench_zero.py __graft_entry__.py
+    bench_allreduce.py bench_serve.py bench_zero.py bench_hier.py \
+    __graft_entry__.py
   # ruff/flake8 aren't in the image; compile + import-sanity is the
   # supported floor. Import must succeed without TPU hardware.
   JAX_PLATFORMS=cpu python -c "import horovod_tpu"
@@ -112,6 +113,14 @@ bench_smoke() {
   for leg in ab_zero1 ab_zero2 ab_zero3; do
     test -s "$art_dir/zero_${leg}.json" \
       || { echo "missing artifact: zero_${leg}.json" >&2; exit 1; }
+  done
+  step "bench-smoke: bench_hier.py dryrun (two-level wire A/B + DCN-byte gate)"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
+    python bench_hier.py
+  for leg in ab_flat ab_hier ab_hier_int8; do
+    test -s "$art_dir/hier_${leg}.json" \
+      || { echo "missing artifact: hier_${leg}.json" >&2; exit 1; }
   done
   step "bench-smoke: bench_serve.py dryrun (static-vs-continuous A/B)"
   JAX_PLATFORMS=cpu \

@@ -302,6 +302,14 @@ class WireTuner(_GoodputBandit):
     is format-independent and would teach the tuner nothing — and stops
     recording once the trials are in (explore-then-freeze).
 
+    Two-level wires key the bandit PER HOP — callers append the hop to
+    the bucket key (``(bucket-tier..., 'intra'|'inter')``), so goodput
+    can converge on bf16-intra / int8-inter independently: the intra
+    menu never includes int8 (ICI is fast; the quant tax cannot pay
+    for itself inside a slice) while the inter key is sized by the
+    1/L shard the DCN actually carries. Flat wires keep the plain
+    bucket key — the keyspaces never mix.
+
     Two static priors bound the exploration:
 
     * buckets under ``min_int8_bytes`` never try int8 — the per-dispatch

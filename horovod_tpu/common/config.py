@@ -137,6 +137,32 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
 
+    # --- two-level topology (common/topology.py hierarchy_stages) ---
+    # HOROVOD_HIERARCHICAL: route the fused eager batch, the overlap
+    # buckets and the ZeRO-2/3 exchange legs through the two-level
+    # (intra-slice ICI / inter-slice DCN) recipe. "auto" (default)
+    # engages it exactly when a real inter axis exists (multi-slice
+    # detection, or an explicit HOROVOD_INTRA_SIZE); "on" forces it
+    # wherever a non-degenerate split is resolvable; "off" keeps every
+    # wire flat. The legacy HOROVOD_HIERARCHICAL_ALLREDUCE=1 is read
+    # as "on".
+    hierarchical: str = "auto"
+    # explicit chips-per-slice override for the slice-boundary
+    # detection (None = detect from JAX device slice_index / process
+    # structure). Must divide the world; a non-dividing value degrades
+    # to gcd(intra, world) so an elastic reshard (8 -> 6) keeps a
+    # valid two-level split instead of crashing.
+    intra_size: Optional[int] = None
+    # axis NAME the two-level world mesh uses for the cross-slice
+    # (DCN) dimension; the intra axis is always "intra"
+    inter_axis: str = "inter"
+    # straggler-aware scheduling (elastic/driver.py): publish per-rank
+    # micro-batch weights into the rendezvous KV, down-weighting ranks
+    # whose step p50 STAYS flagged by the straggler ledger, instead of
+    # only logging them. Workers read the weights via
+    # hvd.elastic.rebalance_weight().
+    rebalance: bool = False
+
     # --- ZeRO sharding stage (sharded_optimizer.py) ---
     # default zero_stage for ShardedDistributedOptimizer(zero_stage=None):
     # 1 = optimizer-state sharding only, 2 = + gradient shards (bucketed
@@ -320,6 +346,17 @@ class Config:
             ),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            hierarchical=_env_choice(
+                "HOROVOD_HIERARCHICAL", "auto", ("auto", "on", "off")
+            ),
+            intra_size=(
+                _env_int("HOROVOD_INTRA_SIZE", 0)
+                if env.get("HOROVOD_INTRA_SIZE", "").strip()
+                else None
+            ),
+            inter_axis=env.get("HOROVOD_INTER_AXIS", "inter").strip()
+            or "inter",
+            rebalance=_env_bool("HOROVOD_REBALANCE"),
             zero_stage=int(
                 _env_choice("HOROVOD_ZERO_STAGE", "1", ("1", "2", "3"))
             ),

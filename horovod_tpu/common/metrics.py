@@ -33,6 +33,31 @@ from typing import Dict, Optional
 WIRE_FORMAT_CODES = {"fp32": 0, "bf16": 1, "int8": 2}
 WIRE_FORMAT_NAMES = {v: k for k, v in WIRE_FORMAT_CODES.items()}
 
+# Two-level (topology-aware) wire metric families — the per-hop split
+# of the fused dispatcher's wire ledger plus the driver's
+# straggler-rebalance surface. Emitters: ops/fusion.py cache_stats
+# (fusion.*), elastic/driver.py (driver.rebalance.*). Kept here as the
+# single legend so dashboards and tests never re-derive the spelling:
+#   fusion.hier_dispatches         fused batches that rode the two-level
+#                                  recipe (counter)
+#   fusion.wire_bytes_saved_intra  intra-hop (ICI) bytes removed vs the
+#                                  flat fp32 baseline (counter)
+#   fusion.wire_bytes_saved_inter  inter-hop (DCN) bytes removed — the
+#                                  scarce-hop meter (counter)
+#   fusion.wire_format_intra/inter last dispatch's per-hop wire, as a
+#                                  WIRE_FORMAT_CODES code (gauge)
+#   driver.rebalance.active        ranks currently down-weighted (gauge)
+#   driver.rebalance.updates       weight-map publications (counter)
+HIERARCHY_METRICS = (
+    "fusion.hier_dispatches",
+    "fusion.wire_bytes_saved_intra",
+    "fusion.wire_bytes_saved_inter",
+    "fusion.wire_format_intra",
+    "fusion.wire_format_inter",
+    "driver.rebalance.active",
+    "driver.rebalance.updates",
+)
+
 # Training-state integrity metric families (PR 7 — the names the
 # runbook in docs/robustness.md documents; emitters: common/guard.py,
 # audit.py, checkpoint.py, elastic/driver.py). Kept here as the single

@@ -71,6 +71,12 @@ _COUNTER_KEYS = (
     "fusion.flushed_bytes",
     "fusion.bucket_pad_bytes",
     "fusion.wire_bytes_saved",
+    # two-level wire: per-hop split of the saved-bytes ledger (inter =
+    # the DCN hop — the scarce one; advanced by hierarchical
+    # dispatches only, so a step's inter delta IS its DCN saving)
+    "fusion.wire_bytes_saved_intra",
+    "fusion.wire_bytes_saved_inter",
+    "fusion.hier_dispatches",
     "fusion.quant_blocks",
     # chaos-hardened control plane (common/retry.py, testing/chaos.py):
     # per-step deltas let a postmortem correlate a slow step with the
@@ -98,7 +104,12 @@ _COUNTER_KEYS = (
 # post-mortem can correlate a regression with the knob flip that
 # caused it.
 _TUNER_PREFIXES = ("autotune.",)
-_TUNER_KEYS = ("fusion.wire_format", "overlap.buckets")
+_TUNER_KEYS = (
+    "fusion.wire_format",
+    "fusion.wire_format_intra",
+    "fusion.wire_format_inter",
+    "overlap.buckets",
+)
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -331,6 +342,17 @@ class TelemetryHub:
                 ),
                 "wire_bytes": max(wire, 0.0),
                 "wire_bytes_saved": deltas["fusion.wire_bytes_saved"],
+                # two-level wire: the per-hop split (inter = the DCN
+                # hop). Advanced only by hierarchical dispatches, so a
+                # step's inter delta IS its DCN saving
+                # (docs/observability.md)
+                "wire_bytes_saved_intra": deltas[
+                    "fusion.wire_bytes_saved_intra"
+                ],
+                "wire_bytes_saved_inter": deltas[
+                    "fusion.wire_bytes_saved_inter"
+                ],
+                "hier_dispatches": deltas["fusion.hier_dispatches"],
                 "wire_format": WIRE_FORMAT_NAMES.get(
                     int(snap.get("fusion.wire_format", 0)), "fp32"
                 ),

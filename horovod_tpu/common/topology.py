@@ -25,6 +25,7 @@ global arrays.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -36,6 +37,13 @@ from .config import Config
 # The canonical data-parallel ("world") mesh axis name, used everywhere the
 # reference would say "the global communicator".
 WORLD_AXIS = "hvd"
+
+# Canonical axis names of the two-level ("inter", "intra") world mesh:
+# ``intra`` rides ICI within a slice, ``inter`` rides DCN across slices.
+# The inter NAME is overridable (HOROVOD_INTER_AXIS) for deployments
+# whose own meshes already spell the DCN axis differently.
+INTRA_AXIS = "intra"
+INTER_AXIS = "inter"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,6 +89,39 @@ class Topology:
         devs = np.asarray([self.devices[r] for r in ranks])
         return Mesh(devs, (WORLD_AXIS,))
 
+    @property
+    def intra_size(self) -> int:
+        """Chips per slice (the ICI-connected unit) — the L of the
+        two-level decomposition. Detected from the JAX devices'
+        ``slice_index`` when they expose one, else the process
+        structure; ``HOROVOD_INTRA_SIZE`` overrides. Degrades to
+        ``gcd(intra, world)`` when the override no longer divides an
+        elastically resized world."""
+        return detect_intra_size(
+            self.devices, self.local_device_count, self.process_count
+        )
+
+    def two_level_mesh(
+        self, intra_size: Optional[int] = None, inter_axis: Optional[str] = None
+    ) -> Mesh:
+        """The 2-axis ``(inter, intra)`` world mesh alongside the flat
+        ``"hvd"`` axis — the TPU shape of the reference's node
+        hierarchy (NCCL intra-node + MPI inter-node,
+        HOROVOD_HIERARCHICAL_ALLREDUCE [V]). Devices stay in rank
+        order, reshaped ``[world/L, L]``; the inter axis name follows
+        ``HOROVOD_INTER_AXIS`` (default ``"inter"``)."""
+        if intra_size is None:
+            intra_size = self.intra_size
+        if inter_axis is None:
+            inter_axis = Config.from_env().inter_axis
+        devices = np.asarray(self.devices)
+        if intra_size < 1 or devices.size % intra_size:
+            raise ValueError(
+                f"intra_size {intra_size} must divide world {devices.size}"
+            )
+        grid = devices.reshape(devices.size // intra_size, intra_size)
+        return Mesh(grid, (inter_axis, INTRA_AXIS))
+
 
 def discover(config: Optional[Config] = None) -> Topology:
     """Build the topology from the JAX runtime and validate it against the
@@ -121,6 +162,176 @@ def discover(config: Optional[Config] = None) -> Topology:
                 "slice topology: " + "; ".join(mismatches)
             )
     return topo
+
+
+# ---------------------------------------------------------------------------
+# Two-level (intra-slice / inter-slice) topology detection.
+#
+# Everything below answers one question: where is the slice boundary —
+# the point past which bytes leave ICI and cross DCN? The answer drives
+# the hierarchical wire (ops/traced.py recipe family) that the fused
+# dispatcher, the overlap buckets and the ZeRO exchange legs route
+# through by default (HOROVOD_HIERARCHICAL).
+# ---------------------------------------------------------------------------
+
+
+def _gcd_degrade(intra: int, world: int) -> int:
+    """Largest split compatible with ``world``: a non-dividing intra
+    size (an elastic 8 -> 6 reshard under HOROVOD_INTRA_SIZE=4)
+    degrades to gcd(intra, world) — the two-level world survives the
+    resize with a coarser but valid slice boundary instead of
+    crashing, and a gcd of 1 falls back to flat."""
+    if intra < 1:
+        return 1
+    if world % intra == 0:
+        return intra
+    return math.gcd(intra, world)
+
+
+def _slice_index_split(devices) -> Optional[int]:
+    """Chips per slice from the devices' ``slice_index`` attribute
+    (multi-slice TPU runtimes expose it), or None when the devices
+    don't expose one / only one slice exists / slices are uneven."""
+    indices = []
+    for d in devices:
+        si = getattr(d, "slice_index", None)
+        if si is None:
+            return None
+        indices.append(si)
+    counts: dict = {}
+    for si in indices:
+        counts[si] = counts.get(si, 0) + 1
+    if len(counts) < 2:
+        return None
+    sizes = set(counts.values())
+    if len(sizes) != 1:
+        return None  # uneven slices: no uniform two-level split
+    return sizes.pop()
+
+
+def detect_intra_size(
+    devices=(),
+    local_device_count: int = 1,
+    process_count: int = 1,
+    override: Optional[int] = None,
+) -> int:
+    """The L of the two-level world. Resolution order:
+
+    1. ``override`` / ``HOROVOD_INTRA_SIZE`` — the operator knows the
+       topology;
+    2. JAX device ``slice_index`` groups (multi-slice runtimes);
+    3. process structure: >1 process with >1 chip each reads as one
+       slice per process (the single-controller-per-host contract);
+    4. otherwise the whole world is one slice.
+
+    Non-dividing answers degrade via gcd (see :func:`_gcd_degrade`) so
+    the split survives elastic resizes."""
+    world = max(len(devices), 1)
+    if override is None:
+        override = Config.from_env().intra_size
+    if override is not None:
+        return _gcd_degrade(int(override), world)
+    split = _slice_index_split(devices)
+    if split is not None:
+        return _gcd_degrade(split, world)
+    if 1 < local_device_count < world:
+        # one controller per slice: its addressable chips are the slice
+        # (covers the multi-process runtime, where local·processes =
+        # world, and a topology whose local count was pinned smaller)
+        return _gcd_degrade(int(local_device_count), world)
+    return world
+
+
+def hierarchical_stage_groups(world: int, local: int):
+    """Replica groups for the two-level decomposition, or None when the
+    hierarchy degenerates (single slice, or slices of one chip):
+    stage 1 = one group per slice (intra, ICI), stage 2 = one group per
+    slice-local slot across slices (inter, DCN). Summing stage 1 then
+    stage 2 equals the flat world sum."""
+    if local <= 1 or world <= local or world % local:
+        return None
+    hosts = world // local
+    intra = [list(range(h * local, (h + 1) * local)) for h in range(hosts)]
+    inter = [[i + h * local for h in range(hosts)] for i in range(local)]
+    return intra, inter
+
+
+def hierarchy_stages(
+    world: Optional[int] = None,
+    mode: Optional[str] = None,
+    intra: Optional[int] = None,
+):
+    """THE routing decision every hierarchical-by-default wire consults
+    (fused dispatcher, overlap buckets, ZeRO legs): the two-level
+    ``(intra_groups, inter_groups)`` replica groups of the current
+    topology, or None when bytes never leave the slice.
+
+    ``mode`` defaults to ``HOROVOD_HIERARCHICAL``:
+
+    * ``off``  — always None (flat wire everywhere);
+    * ``on``   — the split whenever one is resolvable (an explicit
+      ``HOROVOD_INTRA_SIZE`` works even on a single host — the test /
+      bench posture);
+    * ``auto`` — the split only when a REAL inter axis exists: an
+      explicit override, distinct device ``slice_index`` values, or a
+      multi-process runtime driving >1 chip per process. A single-slice
+      job never pays the two-stage decomposition.
+
+    The legacy ``HOROVOD_HIERARCHICAL_ALLREDUCE=1`` is honored as
+    ``on``. ``world`` defaults to the discovered topology's size; pass
+    the traced axis size when deciding inside a shard_mapped program.
+    """
+    from . import basics as _basics
+
+    cfg = (
+        _basics.state().config
+        if _basics.is_initialized() and _basics.state().config is not None
+        else Config.from_env()
+    )
+    if mode is None:
+        mode = cfg.hierarchical
+        if (
+            cfg.hierarchical_allreduce or cfg.hierarchical_allgather
+        ) and mode != "off":
+            mode = "on"
+    if mode == "off":
+        return None
+    topo = _basics.state().topology if _basics.is_initialized() else None
+    devices = topo.devices if topo is not None else ()
+    local_count = topo.local_device_count if topo is not None else 1
+    proc_count = topo.process_count if topo is not None else 1
+    if world is None:
+        world = len(devices) or 1
+    if intra is None:
+        if mode == "auto":
+            # require positive evidence of a second level
+            evidence = (
+                cfg.intra_size is not None
+                or _slice_index_split(devices) is not None
+                or (proc_count > 1 and local_count > 1)
+            )
+            if not evidence:
+                return None
+        if cfg.intra_size is not None:
+            # the override stands on its own (trace-time decisions may
+            # run before hvd.init, when no device list exists yet)
+            intra = cfg.intra_size
+        else:
+            intra = detect_intra_size(devices, local_count, proc_count)
+    intra = _gcd_degrade(int(intra), int(world))
+    return hierarchical_stage_groups(int(world), intra)
+
+
+def stage_positions(groups) -> "np.ndarray":
+    """Static [world] int32 table: each rank's index WITHIN its group —
+    the lookup the grouped quantized recipes need for chunk ownership
+    (position-j members across groups exchange chunk j)."""
+    world = sum(len(g) for g in groups)
+    pos = np.zeros(world, dtype=np.int32)
+    for g in groups:
+        for j, r in enumerate(g):
+            pos[r] = j
+    return pos
 
 
 # ---------------------------------------------------------------------------

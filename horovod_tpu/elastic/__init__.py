@@ -39,5 +39,7 @@ from .worker import (  # noqa: F401
     WorkerNotificationManager,
     WorkerNotificationService,
     notification_manager,
+    rebalance_weight,
+    rebalance_weights,
     run,
 )

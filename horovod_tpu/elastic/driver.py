@@ -38,6 +38,13 @@ from ..runner.service import BasicClient
 from .discovery import HostDiscovery, HostManager
 
 
+# Consecutive fresh-heartbeat flags before a rank's slice is DOWN-
+# WEIGHTED (HOROVOD_REBALANCE) — deliberately below the quarantine
+# threshold: shed work first, restart the gang only if the rank stays
+# flagged past HOROVOD_STRAGGLER_QUARANTINE_POLLS.
+_REBALANCE_STREAK = 2
+
+
 class SlotAssignment:
     """One epoch's worth of placement: which ranks on which hosts."""
 
@@ -127,6 +134,14 @@ class ElasticDriver:
         # quarantines replicas that disagree with the majority
         self._last_audit_poll = 0.0
         self._last_audit_step: Optional[int] = None
+        # straggler-aware scheduling (HOROVOD_REBALANCE): instead of
+        # only logging a flagged rank, publish micro-batch weights that
+        # shift work away from slices whose step p50 STAYS flagged —
+        # the soft remedy BELOW the quarantine threshold (rebalance at
+        # streak >= _REBALANCE_STREAK, quarantine at >=
+        # HOROVOD_STRAGGLER_QUARANTINE_POLLS)
+        self._rebalance = _cfg.rebalance
+        self._rebalance_weights: Dict[int, float] = {}
 
     # ---------------------------------------------------------- planning
 
@@ -423,7 +438,69 @@ class ElasticDriver:
             elif self._last_stragglers:
                 _log.info("straggler ranks recovered")
             self._last_stragglers = stragglers
+        self._maybe_rebalance()
         return self._maybe_quarantine()
+
+    def _maybe_rebalance(self) -> None:
+        """Consume the straggler ledger as a SCHEDULING signal
+        (HOROVOD_REBALANCE, ROADMAP item 3): ranks whose step p50 has
+        stayed flagged for ``_REBALANCE_STREAK`` consecutive fresh
+        heartbeats get a micro-batch weight of ``gang-median-p50 /
+        their-p50`` (clamped to [0.25, 1.0]); everyone else 1.0. The
+        map is published into the rendezvous KV on CHANGE only —
+        workers read it via ``hvd.elastic.rebalance_weight()`` and
+        scale their local micro-batch, so a persistently slow slice
+        sheds work instead of gating every step, without the cost of a
+        gang restart (the quarantine path remains the hard remedy)."""
+        if not self._rebalance or self._server is None:
+            return
+        import statistics as _stats
+
+        streaks = self.stall_inspector.straggler_streaks()
+        hb = self.stall_inspector.heartbeat_stats()
+        p50s = {
+            r: s["step_ms_p50"]
+            for r, s in hb.items()
+            if s.get("step_ms_p50", 0) > 0
+        }
+        weights = {r: 1.0 for r in hb}
+        if len(p50s) >= 2:
+            median = _stats.median(p50s.values())
+            for r, n in streaks.items():
+                if n >= _REBALANCE_STREAK and p50s.get(r, 0) > 0 and median > 0:
+                    w = max(0.25, min(1.0, median / p50s[r]))
+                    weights[r] = round(w, 2)
+        down_now = any(w < 1.0 for w in weights.values())
+        down_before = any(
+            w < 1.0 for w in self._rebalance_weights.values()
+        )
+        if not down_now and not down_before:
+            return  # nothing to say: the gang never left parity
+        if weights == self._rebalance_weights:
+            return
+        from ..common.metrics import registry as _metrics
+        from ..runner.rendezvous import put_rebalance_weights
+
+        try:
+            put_rebalance_weights(
+                self._server.store, weights, epoch=self._epoch
+            )
+        except Exception:
+            _log.debug("rebalance publish failed", exc_info=True)
+            return
+        self._rebalance_weights = dict(weights)
+        slowed = sorted(r for r, w in weights.items() if w < 1.0)
+        _metrics.gauge("driver.rebalance.active", len(slowed))
+        _metrics.counter("driver.rebalance.updates")
+        if slowed:
+            _log.warning(
+                "rebalancing micro-batch weights away from straggling "
+                "rank(s) %s: %s",
+                ",".join(map(str, slowed)),
+                ",".join(f"{r}={weights[r]}" for r in slowed),
+            )
+        else:
+            _log.info("straggler rebalance cleared: all weights 1.0")
 
     def _maybe_quarantine(self) -> Optional[str]:
         """Self-healing half of ROADMAP Open item 3: consume the

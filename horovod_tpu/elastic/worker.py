@@ -64,6 +64,8 @@ class WorkerNotificationManager:
             from ..audit import _reset_client as _audit_reset
 
             _audit_reset()
+            # same re-dial contract for the rebalance-weight reader
+            _reset_rebalance_cache()
             cfg = config_mod.Config.from_env()
             if not (
                 cfg.rendezvous_addr
@@ -159,6 +161,82 @@ class WorkerNotificationManager:
 
 
 notification_manager = WorkerNotificationManager()
+
+
+# rebalance_weights is documented for per-micro-batch polling, so the
+# KV client is built once per endpoint and reads are rate-limited —
+# the hot loop must never pay an env parse + TCP roundtrip per batch
+# (the driver publishes on CHANGE only; a few-seconds-stale map is by
+# construction still valid).
+_REBALANCE_POLL_S = 5.0
+_rebalance_cache = {"endpoint": None, "client": None, "ts": 0.0, "map": {}}
+
+
+def _reset_rebalance_cache() -> None:
+    """Drop the cached client/map (gang restart re-dials rendezvous)."""
+    _rebalance_cache.update(
+        endpoint=None, client=None, ts=0.0, map={}
+    )
+
+
+def rebalance_weights(max_age_s: float = _REBALANCE_POLL_S) -> dict:
+    """The driver's newest micro-batch weight map
+    (``{rank: weight in (0, 1]}``) from the rendezvous KV, or ``{}``
+    when no driver published one (HOROVOD_REBALANCE off, not under an
+    elastic driver, or no straggler ever stayed flagged). Worker side
+    of the straggler-aware scheduling loop — see
+    :func:`rebalance_weight` for the single-rank view. Reads are
+    cached for ``max_age_s`` (pass 0 to force a fresh KV read)."""
+    import time
+
+    now = time.monotonic()
+    if (
+        _rebalance_cache["client"] is not None
+        and now - _rebalance_cache["ts"] < max_age_s
+    ):
+        return dict(_rebalance_cache["map"])
+    from ..common import config as config_mod
+    from ..runner.rendezvous import (
+        _client_from_cfg,
+        read_rebalance_weights,
+    )
+
+    cfg = config_mod.Config.from_env()
+    if not (cfg.rendezvous_addr and cfg.rendezvous_port):
+        return {}
+    endpoint = (cfg.rendezvous_addr, cfg.rendezvous_port)
+    if (
+        _rebalance_cache["client"] is None
+        or _rebalance_cache["endpoint"] != endpoint
+    ):
+        _rebalance_cache["client"] = _client_from_cfg(cfg)
+        _rebalance_cache["endpoint"] = endpoint
+    try:
+        weights = read_rebalance_weights(_rebalance_cache["client"])
+    except OSError:
+        return dict(_rebalance_cache["map"])  # rendezvous going away
+    _rebalance_cache["ts"] = now
+    _rebalance_cache["map"] = weights
+    return dict(weights)
+
+
+def rebalance_weight(rank: Optional[int] = None, default: float = 1.0) -> float:
+    """This rank's micro-batch weight under the driver's straggler
+    rebalance (1.0 when none is published). Poll it at micro-batch
+    boundaries and scale the LOCAL batch share by it::
+
+        w = hvd.elastic.rebalance_weight()
+        local_batch = max(1, int(round(base_batch * w)))
+
+    The weight is a scheduling hint, not a collective contract: ranks
+    keep participating in every collective (use ``allreduce(mask=)``
+    or loss re-weighting to keep gradient expectations unbiased when
+    shares diverge — docs/design.md shows the pattern)."""
+    import os
+
+    if rank is None:
+        rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+    return float(rebalance_weights().get(int(rank), default))
 
 
 def _reset_runtime() -> None:

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -81,6 +82,11 @@ def adasum_allreduce(
     axis_name: str = WORLD_AXIS,
     process_set=None,
     groups: Optional[Sequence[Sequence[int]]] = None,
+    hierarchical: bool = False,
+    intra_axis: Optional[str] = None,
+    inter_axis: Optional[str] = None,
+    inter_wire: str = "fp32",
+    seed: int = 0,
 ):
     """Adasum-allreduce across a mesh axis, for use inside jit/shard_map
     (ref: the Adasum path selected by hvd.DistributedOptimizer(op=hvd.Adasum)
@@ -90,7 +96,39 @@ def adasum_allreduce(
     partition can't be expressed with axis_index_groups) — sets are small
     by construction and correctness dominates there. Non-members return
     their input unchanged. ``groups`` (a single explicit rank list) is
-    accepted for backward compatibility and treated like a process set."""
+    accepted for backward compatibility and treated like a process set.
+
+    ``hierarchical=True`` is the reference's hierarchical Adasum
+    (adasum_gpu_operations.cc [V]: NCCL sum within the node, Adasum
+    across nodes) on the two-level scaffold, for use inside shard_map
+    over a :func:`~horovod_tpu.ops.traced.hierarchical_mesh`: intra-axis
+    SUM via reduce-scatter (each rank holds a 1/L shard of its slice's
+    sum), then VHDD Adasum across the INTER axis on the shards — the
+    three dot products of every combine are completed by an extra psum
+    over the intra axis, so the math is the exact full-vector Adasum of
+    the slice sums (host oracle: ``adasum_vhdd_host`` over per-slice
+    sums) while every DCN hop moves 1/L of the bytes — then intra-axis
+    all-gather. ``inter_wire='int8'`` additionally block-quantizes the
+    VHDD half-exchanges with stochastic rounding (both sweeps; an owner
+    consumes the self-dequantized value of any piece it kept, so all
+    ranks still agree bit-for-bit); ``'bf16'`` casts them. Scale
+    invariance survives any wire: Adasum's coefficients are computed on
+    what actually arrived."""
+    if hierarchical:
+        if process_set is not None or groups is not None:
+            raise NotImplementedError(
+                "hierarchical Adasum composes with the full two-level "
+                "mesh only (no process sets / explicit groups)"
+            )
+        from ..common.topology import INTER_AXIS, INTRA_AXIS
+
+        return _hier_adasum(
+            tensor,
+            intra_axis or INTRA_AXIS,
+            inter_axis or INTER_AXIS,
+            inter_wire,
+            seed,
+        )
     ranks = None
     if process_set is not None and process_set.process_set_id != 0:
         ranks = list(process_set.ranks)
@@ -135,9 +173,73 @@ def _pair_f32(a, b):
     return acoef * a + bcoef * b
 
 
-def _vhdd_allreduce(tensor, axis_name: str, n: int):
+def _hier_adasum(tensor, intra_axis, inter_axis, inter_wire, seed):
+    """Intra Sum (reduce-scatter) -> VHDD Adasum across the inter axis
+    on the 1/L shards (dots completed over intra) -> intra all-gather.
+    See :func:`adasum_allreduce`'s ``hierarchical=True`` contract."""
+    if inter_wire not in ("fp32", "bf16", "int8"):
+        raise ValueError(f"unknown inter_wire {inter_wire!r}")
+    L = int(lax.axis_size(intra_axis))
+    H = int(lax.axis_size(inter_axis))
+    shape, dtype = tensor.shape, tensor.dtype
+    x = tensor.astype(jnp.float32).reshape(-1)
+    m = x.shape[0]
+    pad = (-m) % L
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+    shard = lax.psum_scatter(
+        x, intra_axis, scatter_dimension=0, tiled=True
+    )  # 1/L of this slice's SUM
+    if H > 1:
+        shard = _vhdd_allreduce(
+            shard, inter_axis, H, dot_axis=intra_axis,
+            wire=inter_wire, seed=seed,
+        ).reshape(-1)
+    out = lax.all_gather(shard, intra_axis, tiled=True)
+    return out[:m].reshape(shape).astype(dtype)
+
+
+def _wire_exchange(send, perm, axis_name, wire, key):
+    """One VHDD half-exchange over ``perm`` at the chosen wire.
+    Returns ``(recv, self_wire)`` where ``self_wire`` is what the REST
+    of the gang would reconstruct from this rank's transmission — an
+    owner that keeps a piece must consume ``self_wire`` instead of the
+    raw piece, or quantization would fork the replicas."""
+    if wire == "fp32":
+        return lax.ppermute(send, axis_name, perm), send
+    if wire == "bf16":
+        w = send.astype(jnp.bfloat16)
+        return (
+            lax.ppermute(w, axis_name, perm).astype(jnp.float32),
+            w.astype(jnp.float32),
+        )
+    from .traced import _block_dequant, _stochastic_round_blocks
+
+    block = min(512, max(send.shape[0], 1))
+    q, s = _stochastic_round_blocks(send[None], block, key)
+    self_deq = _block_dequant(q, s)[0][: send.shape[0]]
+    rq = lax.ppermute(q, axis_name, perm)
+    rs = lax.ppermute(s, axis_name, perm)
+    recv = _block_dequant(rq, rs)[0][: send.shape[0]]
+    return recv, self_deq
+
+
+def _vhdd_allreduce(
+    tensor, axis_name: str, n: int, dot_axis: Optional[str] = None,
+    wire: str = "fp32", seed: int = 0,
+):
     """Vector-halving distance-doubling Adasum over the full axis
-    (ref: adasum.h FusedAllreduce + adasum_mpi_operations.cc [V])."""
+    (ref: adasum.h FusedAllreduce + adasum_mpi_operations.cc [V]).
+
+    ``dot_axis`` is the hierarchical extension: the operand is a
+    1/L shard and every combine's three dot products are additionally
+    ``psum``-completed over that axis, so the coefficients are the
+    full-vector values (the intra members jointly hold the vector).
+    ``wire`` ∈ {fp32, bf16, int8} applies to the half-exchanges of
+    BOTH sweeps (the non-pow2 pre/post hops stay full precision —
+    they exist only on unusual slice counts); owners consume the
+    self-reconstructed wire value of any piece they kept, keeping
+    replicas bit-identical under a lossy wire."""
     p = 1 << (n.bit_length() - 1)  # largest power of two <= n
     excess = n - p
     shape, dtype = tensor.shape, tensor.dtype
@@ -147,6 +249,13 @@ def _vhdd_allreduce(tensor, axis_name: str, n: int):
     pad = (-payload) % p  # so every halving stage splits evenly
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+    key = jax.random.PRNGKey(seed) if wire == "int8" else None
+
+    def _dots(dot, nk, nr):
+        if dot_axis is None:
+            return dot, nk, nr
+        s = lax.psum(jnp.stack([dot, nk, nr]), dot_axis)
+        return s[0], s[1], s[2]
 
     if excess:
         # Pre-reduction: ranks [p, n) fold their vector into partner
@@ -155,7 +264,15 @@ def _vhdd_allreduce(tensor, axis_name: str, n: int):
         recv = lax.ppermute(
             x, axis_name, [(p + i, i) for i in range(excess)]
         )
-        x = jnp.where(r < excess, _pair_f32(x, recv), x)
+        if dot_axis is None:
+            x = jnp.where(r < excess, _pair_f32(x, recv), x)
+        else:
+            dot, asq, bsq = _dots(
+                jnp.sum(x * recv), jnp.sum(x * x), jnp.sum(recv * recv)
+            )
+            acoef = 1.0 - jnp.where(asq > 0, dot / (2.0 * asq), 0.0)
+            bcoef = 1.0 - jnp.where(bsq > 0, dot / (2.0 * bsq), 0.0)
+            x = jnp.where(r < excess, acoef * x + bcoef * recv, x)
 
     stages = p.bit_length() - 1  # log2(p)
     piece = x
@@ -170,7 +287,12 @@ def _vhdd_allreduce(tensor, axis_name: str, n: int):
         send = jnp.where(bit, low, high)
         keep = jnp.where(bit, high, low)
         perm = [(i, i ^ d) for i in range(p)]
-        recv = lax.ppermute(send, axis_name, perm)
+        recv, _ = _wire_exchange(
+            send, perm, axis_name, wire,
+            None
+            if key is None
+            else jax.random.fold_in(jax.random.fold_in(key, 100 + k), r),
+        )
         # Complete the three dots over the 2d-rank block that jointly
         # holds both vectors ('a' = the bit-clear side's vector).
         dot = jnp.sum(keep * recv)
@@ -200,6 +322,10 @@ def _vhdd_allreduce(tensor, axis_name: str, n: int):
             tot = jnp.asarray(bmat)[r] @ gathered
         else:
             tot = lax.psum(scal, axis_name, axis_index_groups=blocks)
+        if dot_axis is not None:
+            # hierarchical completion: the 2d-block holds only 1/L of
+            # each vector — finish the dots across the intra axis
+            tot = lax.psum(tot, dot_axis)
         dot_t, asq, bsq = tot[0], tot[1], tot[2]
         acoef = 1.0 - jnp.where(asq > 0, dot_t / (2.0 * asq), 0.0)
         bcoef = 1.0 - jnp.where(bsq > 0, dot_t / (2.0 * bsq), 0.0)
@@ -208,14 +334,30 @@ def _vhdd_allreduce(tensor, axis_name: str, n: int):
             + jnp.where(bit, acoef, bcoef) * recv
         )
 
-    # Distance-halving allgather: reassemble the full vector.
+    # Distance-halving allgather: reassemble the full vector. Under a
+    # lossy wire the kept half is replaced by its self-reconstructed
+    # wire value — every rank then assembles identical bits whether a
+    # piece arrived over the wire or stayed home.
     for k in reversed(range(stages)):
         d = 1 << k
         perm = [(i, i ^ d) for i in range(p)]
-        recv = lax.ppermute(piece, axis_name, perm)
+        # Key by the piece's EQUIVALENCE CLASS, not the rank: after the
+        # up-stages already run (distances > d), ranks equal mod 2d
+        # hold identical pieces — they must emit identical wire bits,
+        # or two receivers of "the same" piece would reconstruct
+        # different stochastic roundings and fork the replicas.
+        recv, self_wire = _wire_exchange(
+            piece, perm, axis_name, wire,
+            None
+            if key is None
+            else jax.random.fold_in(
+                jax.random.fold_in(key, 200 + k), r & (2 * d - 1)
+            ),
+        )
         bit = (r & d) != 0
         piece = jnp.concatenate(
-            [jnp.where(bit, recv, piece), jnp.where(bit, piece, recv)]
+            [jnp.where(bit, recv, self_wire),
+             jnp.where(bit, self_wire, recv)]
         )
 
     if excess:

@@ -196,15 +196,19 @@ class Int8BlockCompressor(Int8Compressor):
 
 class HierarchicalInt8Compressor(Int8BlockCompressor):
     """Hierarchical wire placement (EQuARX's insight, PAPERS.md): bf16
-    on the intra-host stage where ICI is fast, block-scaled int8 only
-    on the cross-host stage where DCN bytes are scarce. Meaningful on
-    the eager fused path (``hvd.allreduce(..., compression=
-    Compression.hier_int8)``) on a multi-host topology — on a single
-    host the hierarchy degenerates and the flat int8 wire is used.
-    On the TRACED/optimizer path (a single mesh axis — no topology
-    split to place stages on) this behaves as flat block-scaled int8;
-    for explicit two-axis placement use
-    ``traced.hierarchical_quantized_allreduce`` over a
+    on the intra-slice hops where ICI is fast, block-scaled int8 only
+    on the cross-slice hop where DCN bytes are scarce. On the eager
+    fused path (``hvd.allreduce(..., compression=
+    Compression.hier_int8)``) AND on the traced/optimizer path
+    (``DistributedOptimizer(compression=...)``, the bucketed exchange)
+    this rides the real two-level recipe —
+    ``traced.hierarchical_allreduce_groups``: intra RS -> int8 inter
+    collective on the 1/L shard -> intra AG — whenever a slice split
+    is resolvable (``common/topology.py hierarchy_stages``, an
+    explicit request: HOROVOD_INTRA_SIZE works even single-host). On
+    a genuinely single-slice topology the hierarchy degenerates and
+    the flat block-scaled int8 wire is used. For explicit two-axis
+    placement use ``traced.hierarchical_quantized_allreduce`` over a
     ``hierarchical_mesh()``."""
 
     wire_format = "int8_hier"

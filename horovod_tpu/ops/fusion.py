@@ -218,8 +218,10 @@ class _ExecSpec:
     needs_keep: bool = False  # adasum_pset: dynamic join-mask argument
     needs_seed: bool = False  # quantized wire: per-dispatch rounding seed
     want_res: bool = False  # error-feedback residual outputs
-    wire: str = "fp32"  # 'fp32' | 'bf16' | 'int8'
-    hier_n: Optional[int] = None  # int8 hier: inter-group (host) count
+    wire: str = "fp32"  # INTER-hop (or flat) wire: 'fp32' | 'bf16' | 'int8'
+    hier_n: Optional[int] = None  # two-level: inter-group (slice) count
+    intra_n: Optional[int] = None  # two-level: chips per slice (L)
+    intra_wire: str = "fp32"  # two-level: the intra-hop wire format
     tuned: bool = False  # wire chosen by the WireTuner (auto mode)
     block: Optional[int] = None  # int8: elements per block scale
 
@@ -430,6 +432,14 @@ class FusionManager:
         self.last_cycle_wire_saved = 0
         self.quant_blocks_total = 0  # block-scale quantizations performed
         self.last_wire_format = "fp32"  # wire of the most recent dispatch
+        # two-level (intra/inter) split of the same ledger — advanced
+        # only by hierarchical dispatches, so the inter counter is a
+        # pure DCN-byte meter (docs/observability.md)
+        self.hier_dispatches = 0
+        self.wire_bytes_saved_intra_total = 0
+        self.wire_bytes_saved_inter_total = 0
+        self.last_wire_format_intra = "fp32"
+        self.last_wire_format_inter = "fp32"
         self.ef_residual_norm = 0.0  # L2 of the last EF residual batch
         self._seed_counter = 0  # decorrelates stochastic rounding per dispatch
         self._prev_outs = None  # queue-drain anchor for WireTuner trials
@@ -756,6 +766,15 @@ class FusionManager:
             "wire_bytes_saved": self.wire_bytes_saved_total,
             "quant_blocks": self.quant_blocks_total,
             "wire_format": WIRE_FORMAT_CODES.get(self.last_wire_format, 0),
+            "hier_dispatches": self.hier_dispatches,
+            "wire_bytes_saved_intra": self.wire_bytes_saved_intra_total,
+            "wire_bytes_saved_inter": self.wire_bytes_saved_inter_total,
+            "wire_format_intra": WIRE_FORMAT_CODES.get(
+                self.last_wire_format_intra, 0
+            ),
+            "wire_format_inter": WIRE_FORMAT_CODES.get(
+                self.last_wire_format_inter, 0
+            ),
         }
 
     def _shard_map(self, fn, in_specs=P(WORLD_AXIS), out_specs=P(WORLD_AXIS)):
@@ -770,25 +789,42 @@ class FusionManager:
     # ---------------------------------------------------- fused dispatch
 
     def _hier_stages(self):
-        """Two-level replica groups of the current topology, or None
-        when the hierarchy degenerates. Factored out so tests can
-        inject a synthetic multi-host split on a single-host mesh."""
-        from ..common import basics as _basics
+        """Two-level replica groups for an EXPLICIT per-call request
+        (Compression.hier_int8 / HOROVOD_FUSION_WIRE_HIER): any
+        resolvable split qualifies (mode "on"), or None when the
+        hierarchy degenerates. Factored out so tests can inject a
+        synthetic multi-slice split on a single-host mesh."""
+        from ..common import topology as _topo
 
-        local = (
-            _basics.topology().local_size if _basics.is_initialized() else 1
-        )
-        return hierarchical_stage_groups(self.world, local)
+        return _topo.hierarchy_stages(world=self.world, mode="on")
+
+    def _default_hier_stages(self):
+        """The DEFAULT-routing decision — HOROVOD_HIERARCHICAL's
+        tri-state (common/topology.py hierarchy_stages): every fused
+        allreduce batch rides the two-level recipe when a real inter
+        axis is present, flat otherwise."""
+        from ..common import topology as _topo
+
+        return _topo.hierarchy_stages(world=self.world)
 
     def _resolve_wire(self, e0: _Entry, plan: _BatchPlan):
-        """Pick the wire format for one allreduce batch: the entry's
+        """Pick the wire plan for one allreduce batch: the entry's
         compression override beats the manager knob; ``auto`` asks the
-        per-bucket WireTuner. Returns ``(wire, hier_stages, tuned)``
-        with ``wire`` in {'fp32','bf16','int8'} — ineligible batches
-        (non-float dtype, reductions that don't commute with
-        quantization/cast) always ride fp32; ``tuned`` marks a choice
-        that came from the tuner (only those dispatches ever pay trial
-        synchronization)."""
+        per-bucket WireTuner. Returns ``(wire, hier_stages, tuned,
+        intra_wire)`` with both wires in {'fp32','bf16','int8'} —
+        ineligible batches (non-float dtype, reductions that don't
+        commute with quantization/cast) always ride fp32; ``tuned``
+        marks choices that came from the tuner (only those dispatches
+        ever pay trial synchronization).
+
+        Hierarchy: an explicit request (``Compression.hier_int8`` /
+        ``HOROVOD_FUSION_WIRE_HIER``) places bf16 intra + int8 inter
+        whenever a split is resolvable; otherwise EVERY eligible batch
+        consults the HOROVOD_HIERARCHICAL default decision — when an
+        inter axis is present, the fused collective decomposes into
+        intra RS -> inter collective on the 1/L shard -> intra AG,
+        with each hop's format resolved independently (``wire`` names
+        the INTER hop; the WireTuner keys are per (bucket-tier, hop))."""
         import jax.numpy as _jnp
 
         wire = e0.wire or self.wire
@@ -803,15 +839,23 @@ class FusionManager:
                 )
             # EF is defined by the quantization error — it forces the
             # flat int8 wire (the hierarchical split has no single
-            # local residual to carry).
-            return "int8", None, False
-        if not eligible or wire in (None, "fp32"):
-            return "fp32", None, False
-        hier = None
-        tuned = False
-        if wire == "int8_hier" or (wire == "int8" and self.wire_hier):
-            hier = self._hier_stages()
+            # local residual to carry on this path).
+            return "int8", None, False, "fp32"
+        if not eligible:
+            return "fp32", None, False, "fp32"
+        explicit_hier = wire == "int8_hier" or (
+            wire == "int8" and self.wire_hier
+        )
+        if wire == "int8_hier":
             wire = "int8"
+        hier = (
+            self._hier_stages()
+            if explicit_hier
+            else self._default_hier_stages()
+        )
+        tuned = False
+        if wire in (None, "fp32"):
+            return "fp32", hier, False, "fp32"
         if wire == "auto":
             if self.wire_tuner is None:  # knob flipped after init
                 from ..common.autotune import WireTuner
@@ -820,6 +864,24 @@ class FusionManager:
                     min_int8_bytes=self.wire_min_bytes
                 )
             bucket_key = ("allreduce", plan.bucket, plan.dtype)
+            if hier is not None:
+                # per-hop choice: the inter hop sees 1/L of the bytes
+                # (int8 competes there), the intra hop the full buffer
+                # (fp32/bf16 only — ICI is fast, the quant tax never
+                # pays for itself inside the slice)
+                intra_n = len(hier[0][0])
+                wire = self.wire_tuner.choose(
+                    bucket_key + ("inter",),
+                    payload_bytes=plan.bucket * plan.itemsize // intra_n,
+                    itemsize=plan.itemsize,
+                )
+                intra_wire = self.wire_tuner.choose(
+                    bucket_key + ("intra",),
+                    payload_bytes=plan.bucket * plan.itemsize,
+                    itemsize=plan.itemsize,
+                    candidates=("fp32", "bf16"),
+                )
+                return wire, hier, True, intra_wire
             wire = self.wire_tuner.choose(
                 bucket_key,
                 payload_bytes=plan.bucket * plan.itemsize,
@@ -828,7 +890,10 @@ class FusionManager:
             tuned = True
             if wire == "int8" and self.wire_hier:
                 hier = self._hier_stages()
-        return wire, (hier if wire == "int8" else None), tuned
+        # static per-hop defaults: the EQuARX placement (bf16 intra
+        # under an int8 inter); exact/bf16 wires apply hop-uniformly
+        intra_wire = "bf16" if wire == "int8" and hier is not None else wire
+        return wire, hier, tuned, intra_wire
 
     def _classify(self, batch: List[_Entry]) -> "_ExecSpec":
         """Resolve a batch to an _ExecSpec. `core_key` identifies the
@@ -858,12 +923,18 @@ class FusionManager:
                 return _ExecSpec(plan, core_key, builder, needs_keep=True)
             mask = None if e0.mask is None else tuple(bool(b) for b in e0.mask)
             plan = self._plan(batch, "allreduce", self.world)
-            wire, hier, tuned = self._resolve_wire(e0, plan)
+            wire, hier, tuned, intra_wire = self._resolve_wire(e0, plan)
             if pset_mask is not None or mask is not None:
                 # masked hierarchy degenerates to flat inside the core;
                 # keep the spec (and so the wire-byte model + autotune
                 # feed) consistent with what actually compiles
                 hier = None
+            # the canonical (world, L) layout pins the group structure,
+            # so this pair is the cache-key-safe hier fingerprint (a
+            # topology change mid-process re-keys the executors)
+            hier_key = (
+                None if hier is None else (len(hier[0]), len(hier[0][0]))
+            )
             if wire == "int8":
                 # a compressor's block_size (Compression.int8_block
                 # subclasses) beats the manager knob, matching the
@@ -872,27 +943,34 @@ class FusionManager:
                 core_key = (
                     "allreduce_q", int(e0.op), e0.prescale, e0.postscale,
                     pset_mask, mask, plan.bucket, plan.dtype, block,
-                    e0.want_residual, hier is not None,
+                    e0.want_residual, hier_key, intra_wire,
                 )
                 builder = lambda: self._core_allreduce_q(
                     e0.op, e0.prescale, e0.postscale, pset_mask, mask,
-                    block, e0.want_residual, hier,
+                    block, e0.want_residual, hier, intra_wire,
                 )
                 return _ExecSpec(
                     plan, core_key, builder, needs_seed=True,
                     want_res=e0.want_residual, wire="int8",
                     hier_n=None if hier is None else len(hier[1][0]),
-                    tuned=tuned, block=block,
+                    intra_n=None if hier is None else len(hier[0][0]),
+                    tuned=tuned, block=block, intra_wire=intra_wire,
                 )
             core_key = (
                 "allreduce", int(e0.op), e0.prescale, e0.postscale,
                 pset_mask, mask, plan.bucket, plan.dtype, wire,
+                hier_key, intra_wire,
             )
             builder = lambda: self._core_allreduce(
                 e0.op, e0.prescale, e0.postscale, pset_mask, mask,
-                wire=wire,
+                wire=wire, hier_stages=hier, intra_wire=intra_wire,
             )
-            return _ExecSpec(plan, core_key, builder, wire=wire, tuned=tuned)
+            return _ExecSpec(
+                plan, core_key, builder, wire=wire, tuned=tuned,
+                hier_n=None if hier is None else len(hier[1][0]),
+                intra_n=None if hier is None else len(hier[0][0]),
+                intra_wire=intra_wire,
+            )
         if kind == "broadcast":
             pset_mask = self._pset_mask(e0)
             plan = self._plan(batch, "broadcast", self.world)
@@ -978,11 +1056,25 @@ class FusionManager:
         outs = None
         used_plan = plan
         misses_before = self.cache_misses
-        trial_key = None
+        trial_pairs = []
         if spec.tuned:  # wire came from the tuner — no trials otherwise
             bucket_key = ("allreduce", plan.bucket, plan.dtype)
-            if self.wire_tuner.needs_trial(bucket_key, spec.wire):
-                trial_key = bucket_key
+            if spec.hier_n:
+                # per-hop keys: the inter and intra decisions explore
+                # and converge independently (bf16-intra / int8-inter
+                # is reachable without a combined menu)
+                cand = [
+                    (bucket_key + ("inter",), spec.wire),
+                    (bucket_key + ("intra",), spec.intra_wire),
+                ]
+            else:
+                cand = [(bucket_key, spec.wire)]
+            trial_pairs = [
+                (k, c)
+                for k, c in cand
+                if self.wire_tuner.needs_trial(k, c)
+            ]
+            if trial_pairs:
                 self._anchor_ttl = 16  # exploration active: keep anchors
                 # drain the dispatch queue up to the PREVIOUS batch so
                 # the trial's clock measures this dispatch alone, not
@@ -1059,20 +1151,25 @@ class FusionManager:
         self.pad_bytes_total += used_plan.pad_bytes
         self.last_cycle_pad_bytes += used_plan.pad_bytes
         self._account_wire(spec, used_plan)
-        if trial_key is not None and self.cache_misses == misses_before:
+        if trial_pairs and self.cache_misses == misses_before:
             # exploration observation: pay one sync so the sample
             # measures execution (quant tax + wire), not the
             # format-independent async dispatch overhead; compile-time
-            # dispatches are excluded — they would poison the goodput
+            # dispatches are excluded — they would poison the goodput.
+            # A hierarchical dispatch feeds BOTH hop keys the same
+            # whole-dispatch sample — each hop's bandit ranks its own
+            # candidates by it across dispatches.
             jax.block_until_ready(outs)
-            self.wire_tuner.record(
-                trial_key,
-                spec.wire,
-                useful_bytes=spec.plan.useful
-                * spec.plan.itemsize
-                * used_plan.world,
-                seconds=time.monotonic() - t_disp,
-            )
+            seconds = time.monotonic() - t_disp
+            for k, c in trial_pairs:
+                self.wire_tuner.record(
+                    k,
+                    c,
+                    useful_bytes=spec.plan.useful
+                    * spec.plan.itemsize
+                    * used_plan.world,
+                    seconds=seconds,
+                )
         # the anchor pins the previous batch's outputs in memory, so it
         # lives only while exploration is ACTIVE: each trial refreshes
         # a small TTL, and a half-explored bucket that stops recurring
@@ -1140,35 +1237,74 @@ class FusionManager:
         self._seed_counter += 1
         return s
 
+    @staticmethod
+    def _hop_bytes(elems: int, wire: str, itemsize: int, n: int, block):
+        """Payload-width model of one hop's per-row wire bytes: the
+        allreduce-equivalent traffic of ``elems`` elements at ``wire``
+        over ``n`` participants (RS+AG of a ring allreduce jointly move
+        ~one payload; ring/topology factors cancel in every ratio this
+        model feeds). int8 adds both stages' block scales."""
+        if wire == "bf16":
+            return elems * 2, 0
+        if wire == "int8":
+            chunk = -(-elems // max(n, 1))
+            nb = -(-chunk // block)
+            return elems + nb * (n + 1) * 4, nb * (n + 1)
+        return elems * itemsize, 0
+
     def _account_wire(
         self, spec: "_ExecSpec", used_plan: _BatchPlan
     ) -> None:
-        """Wire-byte accounting for one dispatch, payload-width model:
-        the fused buffer's bytes at the chosen wire format vs fp32 —
-        per rank row, ``bucket·itemsize`` at fp32, ``bucket·2`` at
-        bf16, ``bucket + 4·scales`` at int8 (both quantization stages'
-        block scales counted; the hierarchical placement additionally
-        pays its bf16 intra stage and quantizes over the inter group
-        only). Ring/topology factors multiply both sides of the
-        comparison equally, so the saved-bytes ratio is exact even
-        though the absolute byte counts are buffer-level."""
+        """Wire-byte accounting for one dispatch, payload-width model
+        (:meth:`_hop_bytes`), vs the flat-fp32 baseline of
+        ``bucket·itemsize`` per rank row.
+
+        Flat dispatches feed the aggregate ``wire_bytes_saved`` /
+        ``wire_format`` exactly as before. A HIERARCHICAL dispatch
+        splits the ledger per hop: the intra hop carries the full
+        buffer at ``intra_wire``; the inter (DCN) hop carries the
+        1/L shard at ``wire`` — so ``wire_bytes_saved_inter`` measures
+        exactly the scarce-hop bytes the two-level recipe removed
+        (≥3x for fp32 payloads under int8-inter: L·4x minus scale
+        overhead), and ``wire_format_intra/inter`` let telemetry and
+        the flight recorder attribute a regression to the right hop."""
         self.last_wire_format = spec.wire
         rows = used_plan.world
         elems = used_plan.bucket
-        fp32_b = elems * used_plan.itemsize
+        itemsize = used_plan.itemsize
+        fp32_b = elems * itemsize
+        block = spec.block or self.wire_block
+        if spec.hier_n:
+            L = spec.intra_n or 1
+            shard = -(-elems // L)
+            intra_b, _ = self._hop_bytes(
+                elems, spec.intra_wire, itemsize, L, block
+            )
+            inter_b, qb = self._hop_bytes(
+                shard, spec.wire, itemsize, spec.hier_n, block
+            )
+            self.quant_blocks_total += qb * rows
+            saved_intra = max(fp32_b - intra_b, 0) * rows
+            saved_inter = max(fp32_b - inter_b, 0) * rows
+            self.wire_bytes_saved_intra_total += saved_intra
+            self.wire_bytes_saved_inter_total += saved_inter
+            self.last_wire_format_intra = spec.intra_wire
+            self.last_wire_format_inter = spec.wire
+            self.hier_dispatches += 1
+            saved = max(fp32_b - intra_b - inter_b, 0) * rows
+            self.wire_bytes_saved_total += saved
+            self.last_cycle_wire_saved += saved
+            return
         saved = 0
         if spec.wire == "bf16":
             saved = max(fp32_b - elems * 2, 0) * rows
         elif spec.wire == "int8":
-            n = spec.hier_n or self.world
-            chunk = -(-elems // n)
-            nb = -(-chunk // (spec.block or self.wire_block))
-            scale_floats = nb * (n + 1)  # stage-1 n·nb + stage-2 nb
-            wire_b = elems + scale_floats * 4
-            if spec.hier_n:
-                wire_b += elems * 2  # the bf16 intra-host stage
+            n = self.world
+            wire_b, qb = self._hop_bytes(
+                elems, "int8", itemsize, n, block
+            )
             saved = max(fp32_b - wire_b, 0) * rows
-            self.quant_blocks_total += nb * (n + 1) * rows
+            self.quant_blocks_total += qb * rows
         self.wire_bytes_saved_total += saved
         self.last_cycle_wire_saved += saved
 
@@ -1360,7 +1496,8 @@ class FusionManager:
     # key already pins the exact shapes, so padding would buy nothing.
 
     def _core_allreduce(
-        self, op, prescale, postscale, pset_mask, mask, wire="fp32"
+        self, op, prescale, postscale, pset_mask, mask, wire="fp32",
+        hier_stages=None, intra_wire=None,
     ):
         world = self.world
         op = ReduceOp(op)
@@ -1378,18 +1515,16 @@ class FusionManager:
         else:
             active_arr = mask_arr if mask_arr is not None else pset_arr
 
-        # HOROVOD_HIERARCHICAL_ALLREDUCE (ref: nccl_operations.cc [V]):
-        # decompose the world psum into an intra-host stage + a
-        # cross-host stage via replica groups, letting XLA emit the
-        # ICI-local collective separately from the DCN hop. Only the
-        # unrestricted Sum/Average path qualifies.
-        hier_stages = None
-        from ..common import basics as _basics
-
-        cfg = _basics.get_config() if _basics.is_initialized() else None
-        local = _basics.topology().local_size if _basics.is_initialized() else 1
-        if cfg is not None and cfg.hierarchical_allreduce and active_arr is None:
-            hier_stages = hierarchical_stage_groups(world, local)
+        # Two-level decomposition (ref: nccl_operations.cc
+        # HOROVOD_HIERARCHICAL_ALLREDUCE [V], promoted to the
+        # HOROVOD_HIERARCHICAL default): the caller (_classify /
+        # _resolve_wire) already resolved the topology decision; masked
+        # batches arrive with hier_stages=None (degenerate to flat).
+        # Only the unrestricted Sum/Average path qualifies.
+        if active_arr is not None or op not in (Average, Sum):
+            hier_stages = None
+        if intra_wire is None:
+            intra_wire = wire if bf16_wire else "fp32"
 
         def per_shard(x):  # x: [1, N] — this rank's slice of the buffer
             idx = lax.axis_index(WORLD_AXIS)
@@ -1403,19 +1538,16 @@ class FusionManager:
                 active = jnp.asarray(True)
                 contrib = x
             if op in (Average, Sum) and hier_stages is not None:
-                intra_groups, inter_groups = hier_stages
-                if bf16_wire:
-                    contrib = contrib.astype(jnp.bfloat16)
-                out = lax.psum(
-                    contrib, WORLD_AXIS, axis_index_groups=intra_groups
-                )
-                out = lax.psum(
-                    out, WORLD_AXIS, axis_index_groups=inter_groups
-                )
-                if bf16_wire:
-                    out = out.astype(x.dtype)
-                if op == Average:
-                    out = out / jnp.asarray(world, out.dtype)
+                # intra RS -> inter psum on the 1/L shard -> intra AG
+                # (ops/traced.py recipe family): the DCN hop carries
+                # 1/L of the buffer; exact for fp32 hops.
+                from .traced import hierarchical_allreduce_groups
+
+                out = hierarchical_allreduce_groups(
+                    contrib[0], op=ReduceOp(op), axis_name=WORLD_AXIS,
+                    stages=hier_stages, intra_wire=intra_wire,
+                    inter_wire=wire,
+                )[None]
             elif op in (Average, Sum):
                 # bf16 wire: the cast is the compression — XLA fuses it
                 # into the collective's producer/consumer, so the wire
@@ -1477,7 +1609,7 @@ class FusionManager:
 
     def _core_allreduce_q(
         self, op, prescale, postscale, pset_mask, mask, block,
-        want_res, hier_stages,
+        want_res, hier_stages, intra_wire="bf16",
     ):
         """The quantized fused wire: the whole fused buffer traverses
         the collective as block-scaled int8, entirely inside the
@@ -1546,11 +1678,25 @@ class FusionManager:
                 row = jnp.where(active, row, jnp.zeros_like(row))
             if hier_stages is not None:
                 intra_groups, inter_groups = hier_stages
-                # intra-host stage at bf16: ICI is fast, spend 2 bytes
-                row = lax.psum(
-                    row.astype(jnp.bfloat16),
-                    WORLD_AXIS,
-                    axis_index_groups=intra_groups,
+                # intra reduce-scatter FIRST (bf16 by default — ICI is
+                # fast, spend 2 bytes), so the int8 inter stage below
+                # quantizes the 1/L shard: the DCN hop pays
+                # payload/L/4, not payload/4 (the full hierarchical
+                # recipe, ops/traced.py). The matching intra all-gather
+                # runs after the inter stage.
+                L = len(intra_groups[0])
+                mfull = row.shape[0]
+                pad_l = (-mfull) % L
+                if pad_l:
+                    row = jnp.pad(row, (0, pad_l))
+                wire_row = (
+                    row.astype(jnp.bfloat16)
+                    if intra_wire == "bf16"
+                    else row
+                )
+                row = lax.psum_scatter(
+                    wire_row, WORLD_AXIS, scatter_dimension=0,
+                    tiled=True, axis_index_groups=intra_groups,
                 ).astype(jnp.float32)
                 n = len(inter_groups[0])
                 groups = inter_groups
@@ -1595,6 +1741,18 @@ class FusionManager:
                 s2[0], WORLD_AXIS, axis_index_groups=groups
             )
             out = _block_dequant(all_q, all_s)[:, :chunk].reshape(-1)[:m]
+            if hier_stages is not None:
+                # the reduced 1/L shard rides the intra all-gather home
+                # (same wire as the intra RS leg)
+                ag = (
+                    out.astype(jnp.bfloat16)
+                    if intra_wire == "bf16"
+                    else out
+                )
+                out = lax.all_gather(
+                    ag, WORLD_AXIS, tiled=True,
+                    axis_index_groups=hier_stages[0],
+                ).astype(jnp.float32)[:mfull]
             if postscale != 1.0:
                 out = out * jnp.asarray(postscale, out.dtype)
             out = out.astype(x.dtype)[None]
@@ -1800,18 +1958,9 @@ class FusionManager:
         return jax.jit(self._shard_map(per_shard))
 
 
-def hierarchical_stage_groups(world: int, local: int):
-    """Replica groups for the two-level decomposition, or None when the
-    hierarchy degenerates (single host, or hosts of one chip): stage 1 =
-    one group per host (intra, ICI), stage 2 = one group per local slot
-    across hosts (inter, DCN). Summing stage 1 then stage 2 equals the
-    flat world sum."""
-    if local <= 1 or world <= local or world % local:
-        return None
-    hosts = world // local
-    intra = [list(range(h * local, (h + 1) * local)) for h in range(hosts)]
-    inter = [[i + h * local for h in range(hosts)] for i in range(local)]
-    return intra, inter
+# The group builder moved to common/topology.py (the one home of the
+# two-level split); re-exported here for the existing import surface.
+from ..common.topology import hierarchical_stage_groups  # noqa: E402,F401
 
 
 def _max_value(dtype):

@@ -244,6 +244,22 @@ def default_min_bytes() -> int:
     return basics.live_config().overlap_min_bytes
 
 
+def _auto_stages(hier_stages, world: int):
+    """Resolve a bucketed function's ``hier_stages`` argument:
+    ``"auto"`` (the default) consults the HOROVOD_HIERARCHICAL
+    topology decision for this axis size — when a real inter axis is
+    present, every bucket's collective decomposes into intra RS ->
+    inter hop on the 1/L shard -> intra AG (ops/traced.py recipe
+    family); an explicit ``(intra_groups, inter_groups)`` tuple is
+    used as-is (the test/bench injection point); ``None`` keeps the
+    flat wire."""
+    if hier_stages == "auto":
+        from ..common import topology as _topo
+
+        return _topo.hierarchy_stages(world=world)
+    return hier_stages
+
+
 def _publish(schedule: BucketSchedule) -> None:
     from ..common import metrics
 
@@ -268,9 +284,23 @@ def bucketed_allreduce(
     min_bucket_bytes: Optional[int] = None,
     schedule: Optional[BucketSchedule] = None,
     return_finite: bool = False,
+    hier_stages="auto",
 ):
     """Allreduce a gradient pytree as N independent per-bucket
     collectives (module docstring).
+
+    ``hier_stages`` routes each bucket through the TWO-LEVEL recipe
+    (``traced.hierarchical_allreduce_groups``: intra RS -> inter
+    collective on the 1/L shard -> intra AG) — ``"auto"`` (default)
+    engages it exactly when ``HOROVOD_HIERARCHICAL`` resolves an inter
+    axis for this topology; pass an explicit ``(intra, inter)`` group
+    tuple or ``None`` to force/disable. Process sets and join masks
+    degenerate to the flat wire (masked hierarchy has no uniform
+    group shape). Quantized compressors place int8 on the INTER hop
+    only (``Compression.hier_int8`` additionally rides bf16 intra —
+    its documented eager placement, now honored on this path too);
+    error-feedback residuals follow the hierarchical input-unit carry
+    contract.
 
     Each bucket: concat its members' flattened leaves → ONE collective
     → split back. For the fp32/bf16 wires the collective is
@@ -351,6 +381,32 @@ def bucketed_allreduce(
         if r_leaves is not None:
             res_leaves[i] = r_leaves[i]
 
+    stages = _auto_stages(hier_stages, jax.lax.axis_size(axis_name))
+    if (
+        stages is None
+        and hier_stages == "auto"
+        and getattr(compression, "wire_format", None) == "int8_hier"
+    ):
+        # Compression.hier_int8 is an EXPLICIT per-call request: any
+        # resolvable split qualifies, not just auto-mode evidence
+        from ..common import topology as _topo
+
+        stages = _topo.hierarchy_stages(
+            world=jax.lax.axis_size(axis_name), mode="on"
+        )
+    if stages is not None and (
+        (process_set is not None and process_set.process_set_id != 0)
+        or mask is not None
+    ):
+        stages = None  # masked hierarchy degenerates to flat
+    # Compression.hier_int8's eager contract, honored here: bf16 on
+    # the intra hops under the int8 inter; plain int8 keeps the intra
+    # hops exact (quantize only where bytes are scarce)
+    hier_intra = (
+        "bf16"
+        if getattr(compression, "wire_format", None) == "int8_hier"
+        else "fp32"
+    )
     block = getattr(compression, "block_size", None)
     finite = None
     for b, idxs in enumerate(schedule.buckets):
@@ -375,11 +431,29 @@ def bucketed_allreduce(
                 r_flat = (
                     parts[0] if len(parts) == 1 else jnp.concatenate(parts)
                 )
-                out_flat, new_r = traced.quantized_allreduce(
-                    flat + r_flat, op=op, axis_name=axis_name,
-                    seed=bseed, return_residual=True,
-                    prescale_factor=prescale_factor, block_size=block,
+                if stages is not None:
+                    out_flat, new_r = traced.hierarchical_allreduce_groups(
+                        flat + r_flat, op=op, axis_name=axis_name,
+                        stages=stages, intra_wire=hier_intra,
+                        inter_wire="int8", seed=bseed, block_size=block,
+                        prescale_factor=prescale_factor,
+                        return_residual=True,
+                    )
+                else:
+                    out_flat, new_r = traced.quantized_allreduce(
+                        flat + r_flat, op=op, axis_name=axis_name,
+                        seed=bseed, return_residual=True,
+                        prescale_factor=prescale_factor, block_size=block,
+                    )
+            elif stages is not None:
+                # the two-level placement: int8 on the DCN hop only
+                out_flat = traced.hierarchical_allreduce_groups(
+                    flat, op=op, axis_name=axis_name, stages=stages,
+                    intra_wire=hier_intra, inter_wire="int8",
+                    seed=bseed, block_size=block,
+                    prescale_factor=prescale_factor,
                 )
+                new_r = None
             else:
                 out_flat = traced.quantized_allreduce(
                     flat, op=op, axis_name=axis_name, seed=bseed,
@@ -390,6 +464,18 @@ def bucketed_allreduce(
                 out_flat = out_flat * jnp.asarray(
                     postscale_factor, out_flat.dtype
                 )
+        elif stages is not None:
+            wire, ctx = compression.compress(flat)
+            red = traced.hierarchical_allreduce_groups(
+                wire,
+                op=op,
+                axis_name=axis_name,
+                stages=stages,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
+            out_flat = compression.decompress(red, ctx)
+            new_r = None
         else:
             wire, ctx = compression.compress(flat)
             red = traced.allreduce(
@@ -470,7 +556,9 @@ def reset_wire_tuner() -> None:
     _WIRE_TUNER = None
 
 
-def resolve_wire(wire, bucket_bytes: int, itemsize: int = 4, key=None) -> str:
+def resolve_wire(
+    wire, bucket_bytes: int, itemsize: int = 4, key=None, hop=None
+) -> str:
     """Static per-bucket wire-format resolution. Explicit formats pass
     through; ``'auto'`` resolves per bucket at TRACE time: under the
     ``HOROVOD_FUSION_WIRE_MIN_BYTES`` floor the quant tax always wins
@@ -478,23 +566,40 @@ def resolve_wire(wire, bucket_bytes: int, itemsize: int = 4, key=None) -> str:
     payloads — unless the WireTuner holds measured goodput for this
     bucket key, in which case the bandit's argmax wins (the step
     harness records observations across recompiles, the OverlapTuner
-    pattern). Returns one of ``'fp32' | 'bf16' | 'int8'``."""
+    pattern). Returns one of ``'fp32' | 'bf16' | 'int8'``.
+
+    ``hop`` ∈ {None, 'intra', 'inter'} splits the tuner keyspace per
+    hop of the two-level wire — (bucket-tier, hop) — so goodput can
+    pick bf16-intra and int8-inter independently; the intra hop's
+    candidate menu never includes int8 (ICI is fast: the quant tax
+    can't pay for itself inside the slice), and ``bucket_bytes`` for
+    the inter hop should be the 1/L shard the DCN actually carries."""
     if wire in (None, "fp32"):
         return "fp32"
     if wire in ("bf16", "int8"):
+        if hop == "intra" and wire == "int8":
+            return "fp32"  # int8 never rides the intra hop
         return wire
     if wire == "auto":
         tuner = wire_tuner()
         if int(bucket_bytes) < tuner.min_int8_bytes:
             return "fp32"
+        candidates = (
+            ("fp32", "bf16") if hop == "intra" else tuner.CANDIDATES
+        )
         key = key if key is not None else ("bucket", int(bucket_bytes))
+        if hop is not None:
+            key = tuple(key) + (hop,)
         if any(
-            tuner.goodput(key, c) > 0 for c in tuner.CANDIDATES
+            tuner.goodput(key, c) > 0 for c in candidates
         ):
             return tuner.choose(
-                key, int(bucket_bytes), itemsize=itemsize
+                key, int(bucket_bytes), itemsize=itemsize,
+                candidates=candidates,
             )
-        return "int8" if itemsize >= 4 else "fp32"
+        if "int8" in candidates and itemsize >= 4:
+            return "int8"
+        return "fp32"
     raise ValueError(f"unknown wire format {wire!r}")
 
 
@@ -517,6 +622,7 @@ def bucketed_reduce_scatter(
     residuals=None,
     min_bucket_bytes: Optional[int] = None,
     schedule: Optional[BucketSchedule] = None,
+    hier_stages="auto",
 ):
     """Reduce-scatter a pytree as N independent per-bucket collectives,
     returning per-leaf SHARD slices (nonscalar leaf → its ``[cols]``
@@ -534,7 +640,15 @@ def bucketed_reduce_scatter(
     lossy buckets: it joins the pane signal before the wire and the new
     per-leaf residual comes back in leaf geometry (exact-wire buckets
     return zero residuals — everything was transmitted). Returns
-    ``(shards, new_residuals)`` when ``residuals`` is given."""
+    ``(shards, new_residuals)`` when ``residuals`` is given.
+
+    ``hier_stages`` (``"auto"`` = the HOROVOD_HIERARCHICAL topology
+    decision) routes each bucket through
+    :func:`traced.hierarchical_reducescatter` — intra RS of the pane
+    buffer, inter hop on the 1/L panes (int8 there when the resolved
+    wire is int8), so the ZeRO-2 gradient leg's DCN bytes drop L-fold.
+    Error-feedback buckets keep the FLAT wire (the EF carry is defined
+    against the flat pane quantization; see docs/design.md)."""
     op = resolve_op(op, average)
     if op not in (Sum, Average):
         raise ValueError(
@@ -545,6 +659,10 @@ def bucketed_reduce_scatter(
     if min_bucket_bytes is None:
         min_bucket_bytes = default_min_bytes()
     n = jax.lax.axis_size(axis_name)
+    stages = _auto_stages(hier_stages, n)
+    if residuals is not None:
+        stages = None  # EF carries are defined against the flat wire
+    hier_L = None if stages is None else len(stages[0][0])
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     nonscalar = [
         i for i, g in enumerate(leaves)
@@ -592,6 +710,28 @@ def bucketed_reduce_scatter(
                 rparts[0] if len(rparts) == 1
                 else jnp.concatenate(rparts, axis=1)
             )
+        if stages is not None:
+            # two-level leg: the inter hop sees 1/L of the bucket, so
+            # the wire decision is keyed (and sized) per hop
+            bw = resolve_wire(
+                wire, int(schedule.bucket_bytes[b]) // hier_L,
+                itemsize=jnp.result_type(members[0]).itemsize,
+                key=("zero_rs", b, buf.shape[1]), hop="inter",
+            )
+            bseed = seed * schedule.n_buckets + b
+            red = traced.hierarchical_reducescatter(
+                buf, op=op, axis_name=axis_name, stages=stages,
+                intra_wire="bf16" if bw == "bf16" else "fp32",
+                inter_wire=bw, seed=bseed, block_size=wire_block,
+            )
+            off = 0
+            for j, c in zip(idxs, cols):
+                i = nonscalar[j]
+                out[i] = red[off : off + c].astype(
+                    jnp.result_type(leaves[i])
+                )
+                off += c
+            continue
         bw = resolve_wire(
             wire, int(schedule.bucket_bytes[b]),
             itemsize=jnp.result_type(members[0]).itemsize,
@@ -659,6 +799,7 @@ def bucketed_shard_all_gather(
     residuals=None,
     min_bucket_bytes: Optional[int] = None,
     schedule: Optional[BucketSchedule] = None,
+    hier_stages="auto",
 ):
     """The dual of :func:`bucketed_reduce_scatter`: per-leaf shard
     slices → full leaves with ``like``'s shapes, as N independent
@@ -677,6 +818,10 @@ def bucketed_shard_all_gather(
     if min_bucket_bytes is None:
         min_bucket_bytes = default_min_bytes()
     n = jax.lax.axis_size(axis_name)
+    stages = _auto_stages(hier_stages, n)
+    if residuals is not None:
+        stages = None  # EF carries are defined against the flat wire
+    hier_L = None if stages is None else len(stages[0][0])
     s_leaves, s_def = jax.tree_util.tree_flatten(shards)
     l_leaves = s_def.flatten_up_to(like)
     nonscalar = [
@@ -726,6 +871,31 @@ def bucketed_shard_all_gather(
                 rparts[0] if len(rparts) == 1
                 else jnp.concatenate(rparts)
             )
+        if stages is not None:
+            bw = resolve_wire(
+                wire, int(schedule.bucket_bytes[b]) // hier_L,
+                itemsize=mem[0].dtype.itemsize,
+                key=("zero_ag", b, buf.shape[0]), hop="inter",
+            )
+            bseed = seed * schedule.n_buckets + b
+            full = traced.hierarchical_allgather(
+                buf, axis_name=axis_name, stages=stages,
+                intra_wire="bf16" if bw == "bf16" else "fp32",
+                inter_wire=bw, seed=bseed, block_size=wire_block,
+            )
+            off = 0
+            for j, c in zip(idxs, cols):
+                i = nonscalar[j]
+                l = l_leaves[i]
+                size = int(np.prod(np.shape(l), dtype=np.int64))
+                out[i] = (
+                    full[:, off : off + c]
+                    .reshape(-1)[:size]
+                    .reshape(np.shape(l))
+                    .astype(s_leaves[i].dtype)
+                )
+                off += c
+            continue
         bw = resolve_wire(
             wire, int(schedule.bucket_bytes[b]),
             itemsize=mem[0].dtype.itemsize,
@@ -790,6 +960,7 @@ def overlap_boundary(
     seed=0,
     mask=None,
     min_bucket_bytes: Optional[int] = None,
+    hier_stages="auto",
 ):
     """The in-backprop boundary marker: identity on the forward; on the
     backward, the cotangent pytree leaves through
@@ -820,6 +991,7 @@ def overlap_boundary(
         seed=seed,
         mask=mask,
         min_bucket_bytes=min_bucket_bytes,
+        hier_stages=hier_stages,
     )
 
     @jax.custom_vjp

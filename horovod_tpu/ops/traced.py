@@ -692,6 +692,7 @@ def quantized_reducescatter(
     seed=0,
     block_size: Optional[int] = None,
     return_residual: bool = False,
+    groups=None,
 ):
     """Single-stage quantized reduce-scatter of a ``[n, cols]`` pane
     buffer (row ``j`` destined for rank ``j`` — the ``psum_scatter``
@@ -720,7 +721,7 @@ def quantized_reducescatter(
     op = resolve_op(op, None)
     if op not in (Average, Sum):
         raise ValueError("quantized_reducescatter supports Sum/Average only")
-    n = lax.axis_size(axis_name)
+    n = len(groups[0]) if groups is not None else lax.axis_size(axis_name)
     if panes.ndim != 2 or panes.shape[0] != n:
         raise ValueError(
             f"panes must be [world={n}, cols], got {panes.shape}"
@@ -733,9 +734,10 @@ def quantized_reducescatter(
     key = jax.random.fold_in(key, idx)
     q, scales = _stochastic_round_blocks(x, block, key)  # [n, nb, block]
     recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
-                          tiled=True)
+                          tiled=True, axis_index_groups=groups)
     recv_s = lax.all_to_all(scales, axis_name, split_axis=0,
-                            concat_axis=0, tiled=True)
+                            concat_axis=0, tiled=True,
+                            axis_index_groups=groups)
     shard = jnp.sum(_block_dequant(recv, recv_s), axis=0)[:cols]
     if op == Average:
         shard = shard / jnp.asarray(n, shard.dtype)
@@ -751,6 +753,7 @@ def quantized_allgather(
     seed=0,
     block_size: Optional[int] = None,
     return_residual: bool = False,
+    groups=None,
 ):
     """Quantized all-gather of a per-rank ``[cols]`` shard: block-scaled
     int8 with stochastic rounding on the wire, one quantization stage.
@@ -765,7 +768,7 @@ def quantized_allgather(
     Returns the fp32 ``[n, cols]`` gather (row ``r`` = rank r's shard).
     """
     _stall_check()
-    n = lax.axis_size(axis_name)
+    n = len(groups[0]) if groups is not None else lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     x = shard.reshape(1, -1).astype(jnp.float32)
     cols = x.shape[1]
@@ -773,8 +776,12 @@ def quantized_allgather(
     key = jax.random.fold_in(jax.random.PRNGKey(1), seed)
     key = jax.random.fold_in(key, idx)
     q, s = _stochastic_round_blocks(x, block, key)  # [1, nb, block]
-    all_q = lax.all_gather(q[0], axis_name)  # [n, nb, block]
-    all_s = lax.all_gather(s[0], axis_name)  # [n, nb]
+    all_q = lax.all_gather(
+        q[0], axis_name, axis_index_groups=groups
+    )  # [n, nb, block]
+    all_s = lax.all_gather(
+        s[0], axis_name, axis_index_groups=groups
+    )  # [n, nb]
     out = _block_dequant(all_q, all_s)[:, :cols]
     if not return_residual:
         return out
@@ -782,9 +789,308 @@ def quantized_allgather(
     return out, residual
 
 
-# Axis names for the two-level mesh built by hierarchical_mesh().
-INTRA_AXIS = "intra"  # within a host/slice: ICI
-INTER_AXIS = "inter"  # across hosts/slices: DCN
+# Axis names for the two-level mesh built by hierarchical_mesh()
+# (canonical home: common/topology.py — re-bound here for the existing
+# import surface).
+from ..common.topology import INTRA_AXIS, INTER_AXIS  # noqa: E402,F401
+
+
+# ------------------------------------------------------------------
+# Two-level recipe family ON THE FLAT AXIS (replica groups).
+#
+# The two-axis forms below (hierarchical_allreduce & co over a
+# hierarchical_mesh) prove the dataflow; these group-flavored forms are
+# what the DEFAULT wire actually routes through — the fused dispatcher,
+# the overlap buckets and the ZeRO legs all trace over the flat "hvd"
+# axis, where the slice boundary is expressible only as
+# axis_index_groups (common/topology.py hierarchy_stages). Every recipe
+# is the same three-hop shape: intra reduce-scatter -> inter collective
+# on the 1/L shard -> intra all-gather, each hop with its own wire
+# format; zero-pad never reaches a block scale or residual (zeros
+# quantize to zeros and never raise an absmax — the standing pad
+# contract).
+# ------------------------------------------------------------------
+
+
+def _stage_cast(x, wire):
+    """Cast a buffer onto one hop's wire: bf16 halves the bytes (XLA
+    fuses the cast into the collective's producer/consumer); fp32 /
+    payload width is the identity."""
+    return x.astype(jnp.bfloat16) if wire == "bf16" else x
+
+
+def _group_pos_table(groups):
+    """Static [world] int32 table: each rank's index within its group
+    (chunk ownership for the grouped quantized recipes)."""
+    from ..common.topology import stage_positions
+
+    return stage_positions(groups)
+
+
+def _quantized_sum_groups(
+    row, axis_name, groups, n, block, key, pos=None, want_residual=False
+):
+    """The two-stage block-scaled int8 allreduce recipe of
+    :func:`quantized_allreduce`, over ``axis_index_groups`` of the flat
+    axis (``groups=None`` = the whole axis): chunk the row across the
+    ``n`` group members, stochastic-round to int8, all_to_all int8 +
+    scales, dequant-sum, re-round the reduced chunk, all_gather.
+    SUM semantics (callers divide for Average). Returns ``(out, res)``
+    with ``res`` the sum-level input-unit EF carry (both stages, the
+    quantized_allreduce contract) or None."""
+    m = row.shape[0]
+    chunk = -(-m // n)
+    flat = jnp.pad(row, (0, chunk * n - m)) if chunk * n != m else row
+    chunks = flat.reshape(n, chunk)
+    q, scales = _stochastic_round_blocks(chunks, block, key)
+    recv = lax.all_to_all(
+        q, axis_name, split_axis=0, concat_axis=0, tiled=True,
+        axis_index_groups=groups,
+    )
+    recv_s = lax.all_to_all(
+        scales, axis_name, split_axis=0, concat_axis=0, tiled=True,
+        axis_index_groups=groups,
+    )
+    shard = jnp.sum(_block_dequant(recv, recv_s), axis=0)  # [chunk]
+    q2, s2 = _stochastic_round_blocks(
+        shard[None], block, jax.random.fold_in(key, 7919)
+    )
+    all_q = lax.all_gather(q2[0], axis_name, axis_index_groups=groups)
+    all_s = lax.all_gather(s2[0], axis_name, axis_index_groups=groups)
+    out = _block_dequant(all_q, all_s)[:, :chunk].reshape(-1)[:m]
+    if not want_residual:
+        return out, None
+    # which chunk this rank owns = its position within its group
+    if pos is None:
+        idx = lax.axis_index(axis_name)
+        p = (
+            jnp.asarray(_group_pos_table(groups))[idx]
+            if groups is not None
+            else idx
+        )
+    else:
+        p = pos
+    res_flat = (chunks - _block_dequant(q, scales)[:, :chunk]).reshape(-1)
+    e2 = (shard - _block_dequant(q2, s2)[0])[:chunk]
+    res_flat = lax.dynamic_update_slice(
+        res_flat,
+        lax.dynamic_slice(res_flat, (p * chunk,), (chunk,)) + e2,
+        (p * chunk,),
+    )
+    return out, res_flat[:m]
+
+
+def hierarchical_allreduce_groups(
+    tensor,
+    op=None,
+    axis_name: str = WORLD_AXIS,
+    stages=None,
+    intra_wire: str = "fp32",
+    inter_wire: str = "fp32",
+    seed=0,
+    block_size: Optional[int] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    return_residual: bool = False,
+):
+    """Two-level allreduce on the FLAT axis: intra reduce-scatter ->
+    inter collective on the 1/L shard -> intra all-gather, via the
+    replica groups in ``stages`` (``topology.hierarchy_stages()``).
+    This is the recipe the fused dispatcher, the overlap buckets and
+    the hier_int8 optimizer path ride when an inter axis is present:
+    the slow cross-slice hop carries 1/L of the bytes — times another
+    ~4x when ``inter_wire='int8'`` (EQuARX's placement: quantize only
+    where bytes are scarce).
+
+    ``intra_wire`` ∈ {fp32, bf16} applies to BOTH intra hops;
+    ``inter_wire`` ∈ {fp32, bf16, int8}. With everything at fp32 the
+    result is the exact two-level sum (bit-exact vs flat for payloads
+    whose partial sums are exactly representable — integer-valued
+    grids; a few ulp of reassociation otherwise, see docs/perf.md).
+    Sum/Average only.
+
+    ``return_residual`` (int8 inter only): the inter-stage EF carry in
+    INPUT units — the shard residual re-broadcast over the intra
+    groups divided by L, so adding it to the NEXT step's tensor makes
+    the intra reduce-scatter reconstruct exactly one copy at the shard
+    owner (``hierarchical_quantized_allreduce``'s contract, group
+    edition)."""
+    op = resolve_op(op, None)
+    if op not in (Average, Sum):
+        raise ValueError(
+            "hierarchical_allreduce_groups supports Sum/Average only"
+        )
+    if stages is None:
+        raise ValueError("stages is required (topology.hierarchy_stages)")
+    if return_residual and inter_wire != "int8":
+        raise ValueError(
+            "return_residual needs inter_wire='int8' (exact hops have "
+            "no residual to carry)"
+        )
+    intra_groups, inter_groups = stages
+    L = len(intra_groups[0])
+    H = len(inter_groups[0])
+    n = L * H
+    shape, dtype = tensor.shape, tensor.dtype
+    flat = tensor.reshape(-1)
+    if inter_wire == "int8":
+        flat = flat.astype(jnp.float32)
+    m = flat.shape[0]
+    pad = (-m) % L
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    if prescale_factor != 1.0:
+        flat = flat * jnp.asarray(prescale_factor, flat.dtype)
+    shard = lax.psum_scatter(
+        _stage_cast(flat, intra_wire), axis_name,
+        scatter_dimension=0, tiled=True, axis_index_groups=intra_groups,
+    ).astype(flat.dtype)
+    residual = None
+    if inter_wire == "int8":
+        idx = lax.axis_index(axis_name)
+        key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+        key = jax.random.fold_in(key, idx)
+        block = int(block_size) if block_size else max(shard.shape[0], 1)
+        pos = jnp.asarray(_group_pos_table(inter_groups))[idx]
+        red, res = _quantized_sum_groups(
+            shard, axis_name, inter_groups, H, block, key, pos=pos,
+            want_residual=return_residual,
+        )
+        if res is not None:
+            if prescale_factor == 0.0:
+                # nothing was transmitted: zero carry (the
+                # quantized_allreduce contract), not 0/0 NaNs
+                res = jnp.zeros_like(res)
+            elif prescale_factor != 1.0:
+                # back to INPUT units: the correction will be
+                # re-multiplied by the prescale on its way in
+                res = res / jnp.asarray(prescale_factor, res.dtype)
+            residual = lax.all_gather(
+                res / jnp.asarray(L, res.dtype), axis_name,
+                tiled=True, axis_index_groups=intra_groups,
+            )[:m]
+    else:
+        red = lax.psum(
+            _stage_cast(shard, inter_wire), axis_name,
+            axis_index_groups=inter_groups,
+        ).astype(shard.dtype)
+    out = lax.all_gather(
+        _stage_cast(red, intra_wire), axis_name,
+        tiled=True, axis_index_groups=intra_groups,
+    ).astype(flat.dtype)
+    if op == Average:
+        out = out / jnp.asarray(n, out.dtype)
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, out.dtype)
+    out = out[:m].reshape(shape).astype(dtype)
+    if not return_residual:
+        return out
+    residual = (
+        jnp.zeros(shape, dtype)
+        if residual is None
+        else residual[:m].reshape(shape).astype(dtype)
+    )
+    return out, residual
+
+
+def hierarchical_reducescatter(
+    panes,
+    op=None,
+    axis_name: str = WORLD_AXIS,
+    stages=None,
+    intra_wire: str = "fp32",
+    inter_wire: str = "fp32",
+    seed=0,
+    block_size: Optional[int] = None,
+):
+    """Two-level reduce-scatter of a ``[n, cols]`` pane buffer (row j
+    destined for flat rank j — the ZeRO bucket layout): intra
+    reduce-scatter of the destination rows that share this rank's
+    slice-local slot -> inter collective on the 1/L-sized ``[H, cols]``
+    panes -> this rank's ``[cols]`` shard. The DCN hop moves 1/L of the
+    flat reduce-scatter's bytes (int8 inter: ~4x less again).
+    Elementwise identical to the flat scatter for exact wires (each
+    output element is the same set of addends, summed intra-then-inter).
+    Requires the canonical ``stages`` layout (contiguous intra groups —
+    ``topology.hierarchy_stages``). Sum/Average only."""
+    op = resolve_op(op, None)
+    if op not in (Average, Sum):
+        raise ValueError(
+            "hierarchical_reducescatter supports Sum/Average only"
+        )
+    if stages is None:
+        raise ValueError("stages is required (topology.hierarchy_stages)")
+    intra_groups, inter_groups = stages
+    L = len(intra_groups[0])
+    H = len(inter_groups[0])
+    n = L * H
+    if panes.ndim != 2 or panes.shape[0] != n:
+        raise ValueError(
+            f"panes must be [world={n}, cols], got {panes.shape}"
+        )
+    cols = panes.shape[1]
+    dtype = panes.dtype
+    buf = panes.reshape(H, L, cols)
+    s1 = lax.psum_scatter(
+        _stage_cast(buf, intra_wire), axis_name,
+        scatter_dimension=1, tiled=True, axis_index_groups=intra_groups,
+    ).astype(dtype).reshape(H, cols)
+    if inter_wire == "int8":
+        shard = quantized_reducescatter(
+            s1.astype(jnp.float32), op=Sum, axis_name=axis_name,
+            seed=seed, block_size=block_size, groups=inter_groups,
+        ).astype(dtype)
+    else:
+        shard = lax.psum_scatter(
+            _stage_cast(s1, inter_wire), axis_name,
+            scatter_dimension=0, tiled=True,
+            axis_index_groups=inter_groups,
+        ).astype(dtype).reshape(cols)
+    if op == Average:
+        shard = shard / jnp.asarray(n, shard.dtype)
+    return shard.reshape(cols)
+
+
+def hierarchical_allgather(
+    shard,
+    axis_name: str = WORLD_AXIS,
+    stages=None,
+    intra_wire: str = "fp32",
+    inter_wire: str = "fp32",
+    seed=0,
+    block_size: Optional[int] = None,
+):
+    """Two-level all-gather, the dual of
+    :func:`hierarchical_reducescatter`: each rank's ``[cols]`` shard ->
+    inter all-gather among same-slot peers (1/L of the DCN bytes of a
+    flat gather; int8 inter rides
+    :func:`quantized_allgather`'s one-stage wire, every rank — owners
+    included — consuming the dequantized value so replicas stay
+    bit-identical) -> intra all-gather + static reorder back to flat
+    rank-major ``[n, cols]``."""
+    if stages is None:
+        raise ValueError("stages is required (topology.hierarchy_stages)")
+    intra_groups, inter_groups = stages
+    L = len(intra_groups[0])
+    H = len(inter_groups[0])
+    n = L * H
+    cols = shard.shape[0]
+    dtype = shard.dtype
+    if inter_wire == "int8":
+        g1 = quantized_allgather(
+            shard.astype(jnp.float32), axis_name=axis_name, seed=seed,
+            block_size=block_size, groups=inter_groups,
+        ).astype(dtype)  # [H, cols]
+    else:
+        g1 = lax.all_gather(
+            _stage_cast(shard, inter_wire), axis_name,
+            axis_index_groups=inter_groups,
+        ).astype(dtype)  # [H, cols]
+    g2 = lax.all_gather(
+        _stage_cast(g1, intra_wire), axis_name,
+        axis_index_groups=intra_groups,
+    ).astype(dtype)  # [L, H, cols]
+    return jnp.transpose(g2, (1, 0, 2)).reshape(n, cols)
 
 
 def hierarchical_mesh(local_size: Optional[int] = None):
@@ -802,7 +1108,9 @@ def hierarchical_mesh(local_size: Optional[int] = None):
     topo = basics.topology()
     devices = np.asarray(topo.devices)
     if local_size is None:
-        local_size = topo.local_size
+        # slice-boundary detection incl. the HOROVOD_INTRA_SIZE
+        # override (common/topology.py); falls back to chips-per-host
+        local_size = topo.intra_size
     if local_size < 1 or devices.size % local_size:
         raise ValueError(
             f"local_size {local_size} must divide world {devices.size}"

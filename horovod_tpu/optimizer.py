@@ -70,6 +70,17 @@ def _allreduce_grads(
                 "Compression.int8 over a process set is not supported; "
                 "use fp16/bf16 compression or the global process set"
             )
+        # Compression.hier_int8 on the traced/optimizer path: the real
+        # two-level recipe (bf16 intra hops, int8 on the inter hop
+        # only — the eager placement, no longer a flat degeneration)
+        # whenever a slice split is resolvable for this axis.
+        hier_stages = None
+        if getattr(compression, "wire_format", None) == "int8_hier":
+            from .common import topology as _topo
+
+            hier_stages = _topo.hierarchy_stages(
+                world=int(jax.lax.axis_size(axis_name)), mode="on"
+            )
 
         def one_q(g, r=None):
             """One leaf through the quantized wire; with an error-
@@ -88,7 +99,26 @@ def _allreduce_grads(
             ``block_size`` (Compression.int8_block and descendants)
             gets block-wise wire scales on this path too."""
             block = getattr(compression, "block_size", None)
-            if r is None:
+            if hier_stages is not None:
+                x = g if r is None else g + r.astype(g.dtype)
+                if r is None:
+                    out = traced.hierarchical_allreduce_groups(
+                        x, op=op, axis_name=axis_name,
+                        stages=hier_stages, intra_wire="bf16",
+                        inter_wire="int8", seed=seed, block_size=block,
+                        prescale_factor=prescale_factor,
+                    )
+                    new_r = None
+                else:
+                    out, new_r = traced.hierarchical_allreduce_groups(
+                        x, op=op, axis_name=axis_name,
+                        stages=hier_stages, intra_wire="bf16",
+                        inter_wire="int8", seed=seed, block_size=block,
+                        prescale_factor=prescale_factor,
+                        return_residual=True,
+                    )
+                    new_r = new_r.astype(r.dtype)
+            elif r is None:
                 out = traced.quantized_allreduce(
                     g, op=op, axis_name=axis_name, seed=seed,
                     prescale_factor=prescale_factor, block_size=block,

@@ -526,6 +526,48 @@ def read_audit_digests(store_or_client) -> Dict[int, dict]:
     return out
 
 
+REBALANCE_SCOPE = "rebalance"
+
+
+def put_rebalance_weights(
+    store_or_client, weights: Dict[int, float], epoch: int = 0
+) -> None:
+    """Driver side of straggler-aware scheduling (HOROVOD_REBALANCE):
+    publish the gang's micro-batch weight map — ``weights[r]`` in
+    (0, 1], 1.0 = full share, <1 = the driver wants rank r's slice to
+    take proportionally less work because its step p50 STAYS flagged
+    by the straggler ledger. One KV blob, overwritten per update —
+    workers only ever apply the newest map."""
+    import time as _time
+
+    payload = {
+        "ts": _time.time(),
+        "epoch": int(epoch),
+        "weights": {str(int(r)): float(w) for r, w in weights.items()},
+    }
+    store_or_client.put(
+        REBALANCE_SCOPE, "weights", json.dumps(payload).encode()
+    )
+
+
+def read_rebalance_weights(store_or_client) -> Dict[int, float]:
+    """Worker side: ``{rank: weight}`` of the newest published map, or
+    ``{}`` when the driver never published one (rebalance off, or no
+    straggler ever stayed flagged). Malformed blobs read as {} — a
+    corrupt scheduling hint must never stall training."""
+    raw = store_or_client.get(REBALANCE_SCOPE, "weights")
+    if raw is None:
+        return {}
+    try:
+        obj = json.loads(raw.decode())
+        return {
+            int(r): float(w)
+            for r, w in obj.get("weights", {}).items()
+        }
+    except (ValueError, UnicodeDecodeError, AttributeError):
+        return {}
+
+
 def _client_from_cfg(cfg) -> "RendezvousClient":
     """Shared construction of the worker-side KV client from config
     (secret decode + endpoint) — used by the object collectives and the

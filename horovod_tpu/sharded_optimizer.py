@@ -226,6 +226,7 @@ class ShardedDistributedOptimizer:
         wire: Optional[str] = None,
         wire_block: Optional[int] = None,
         error_feedback: bool = False,
+        hierarchical: Optional[bool] = None,
     ):
         """``zero_stage`` selects the sharding stage (module docstring);
         ``None`` defers to ``HOROVOD_ZERO_STAGE`` (default 1). Stage 3
@@ -260,6 +261,16 @@ class ShardedDistributedOptimizer:
         leading-world-axis convention so ``reshard_state`` carries them
         elastically. Pad positions hold zero residual by construction
         (``parallel.fsdp.pad_to`` contract).
+
+        ``hierarchical`` controls the two-level routing of the exchange
+        legs: ``None`` (default) defers to ``HOROVOD_HIERARCHICAL`` —
+        when the topology resolves an inter axis, every per-bucket
+        reduce-scatter / all-gather decomposes into intra RS -> inter
+        hop on the 1/L panes -> intra AG (the ZeRO wire's DCN bytes
+        drop L-fold; an int8 ``wire`` quantizes the inter hop only);
+        ``False`` pins the flat wire regardless of topology.
+        Error-feedback buckets always ride the flat wire (the carry is
+        defined against the flat pane quantization).
 
         ``grad_guard=True`` (``None`` defers to ``HOROVOD_GUARD``)
         adds the non-finite skip-step sentinel (common/guard.py).
@@ -306,6 +317,9 @@ class ShardedDistributedOptimizer:
         self._wire_block = int(
             wire_block if wire_block is not None else cfg.fusion_wire_block
         )
+        # two-level routing of the exchange legs: "auto" = the
+        # HOROVOD_HIERARCHICAL topology decision; None pins flat
+        self._hier_arg = None if hierarchical is False else "auto"
         self._ef = bool(error_feedback)
         if self._ef and self._wire not in ("int8", "auto"):
             raise ValueError(
@@ -638,6 +652,7 @@ class ShardedDistributedOptimizer:
                     wire_block=self._wire_block, seed=wire_seed,
                     residuals=local_wire["rs"],
                     min_bucket_bytes=self._overlap_min_bytes,
+                    hier_stages=self._hier_arg,
                 )
             else:
                 g_sh = _overlap.bucketed_reduce_scatter(
@@ -645,6 +660,7 @@ class ShardedDistributedOptimizer:
                     axis_name=self._axis, wire=self._wire,
                     wire_block=self._wire_block, seed=wire_seed,
                     min_bucket_bytes=self._overlap_min_bytes,
+                    hier_stages=self._hier_arg,
                 )
         else:
             # 0-d leaves (scalar temperature etc.) stay replicated —
@@ -720,6 +736,7 @@ class ShardedDistributedOptimizer:
                     wire_block=self._wire_block, seed=wire_seed,
                     residuals=local_wire["ag"],
                     min_bucket_bytes=self._overlap_min_bytes,
+                    hier_stages=self._hier_arg,
                 )
             else:
                 upd = _overlap.bucketed_shard_all_gather(
@@ -727,6 +744,7 @@ class ShardedDistributedOptimizer:
                     axis_name=self._axis, wire=self._wire,
                     wire_block=self._wire_block, seed=wire_seed,
                     min_bucket_bytes=self._overlap_min_bytes,
+                    hier_stages=self._hier_arg,
                 )
         else:
             def gather(u, p):
@@ -813,6 +831,7 @@ class ShardedDistributedOptimizer:
             wire_block=self._wire_block,
             seed=seed,
             min_bucket_bytes=self._overlap_min_bytes,
+            hier_stages=self._hier_arg,
         )
 
     def _gather_kw(self, seed):
@@ -823,6 +842,7 @@ class ShardedDistributedOptimizer:
             wire_block=self._wire_block,
             seed=seed,
             min_bucket_bytes=self._overlap_min_bytes,
+            hier_stages=self._hier_arg,
         )
 
     def _carrier_call(self, psh, pfull, seed):
