@@ -1,4 +1,4 @@
-"""Continuous-vs-static batching A/B (horovod_tpu/serving/).
+"""Serving A/B harness (horovod_tpu/serving/): scheduling + memory plane.
 
 Measures what the continuous-batching scheduler actually buys over
 classic batch-barrier inference ON THE SAME engine — the serving
@@ -17,6 +17,19 @@ bench_results/serve/):
   the batch's tail token rate decays as members finish.
 * ``ab_continuous`` — the default policy: arrivals admitted into freed
   slots between decode steps, no flush, no barrier.
+* ``ab_paged``      — slab vs paged memory plane at IDENTICAL traffic
+  (serving/paged_kv.py): per-arm persistent-KV bytes from the donated
+  cache carry's live buffers, plus a paged pool sized at a second,
+  doubled max_len to show the footprint scales with PAGES, not
+  max_len. Dryrun gates: identical outputs, paged-carry bytes <
+  slab-carry bytes at undersubscribed pools, and byte-identical pool
+  size across the two max_len values.
+* ``ab_prefix``     — shared-system-prompt trace (the traffic reality
+  the prefix cache exists for): every request carries the same
+  system-prefix pages; the cold arm runs with the prefix cache off.
+  Dryrun gates: warm arm skips ≥1 prefill chunk per follow-up request
+  (``prefill_chunks_skipped``, ``prefix_hits`` > 0) with identical
+  outputs; timing rows report TTFT p50/p95 warm vs cold.
 
 Each artifact records per-request TTFT and per-token TPOT p50/p95 plus
 aggregate generated tokens/s. Both legs pay their compiles in an
@@ -34,6 +47,14 @@ Env: BENCH_REQUESTS / BENCH_GEN_TOKENS / BENCH_SLOTS / BENCH_STAGGER_MS.
 import json
 import os
 import time
+
+
+def _pct(vals, q):
+    """Nearest-rank percentile over a sorted list (shared by every leg
+    so the quantile method can never diverge between A/B arms)."""
+    idx = min(int(q * (len(vals) - 1) + 0.5), len(vals) - 1)
+    return vals[idx]
+
 
 _SIM_NOTE = (
     "logic-validation only (CPU simulation); decode steps are ms on "
@@ -133,12 +154,6 @@ def main():
         slo = batcher.recorder.summaries()
         total_tokens = sum(len(r.out_tokens) for r in reqs)
 
-        def pct(vals, q):
-            idx = min(
-                int(q * (len(vals) - 1) + 0.5), len(vals) - 1
-            )
-            return vals[idx]
-
         return {
             "metric": "serve_ab",
             "leg": f"ab_{policy}",
@@ -151,8 +166,8 @@ def main():
             "wall_s": round(wall_s, 4),
             "tokens_out": total_tokens,
             "tokens_per_s": round(total_tokens / wall_s, 3),
-            "ttft_ms_p50": round(pct(ttfts, 0.5), 3),
-            "ttft_ms_p95": round(pct(ttfts, 0.95), 3),
+            "ttft_ms_p50": round(_pct(ttfts, 0.5), 3),
+            "ttft_ms_p95": round(_pct(ttfts, 0.95), 3),
             "tpot_ms_p50": round(slo["tpot_ms"]["p50"], 4),
             "tpot_ms_p95": round(slo["tpot_ms"]["p95"], 4),
             "decode_steps": engine.stats()["decode_steps"],
@@ -164,6 +179,187 @@ def main():
     for policy in ("static", "continuous"):
         line = run_leg(policy)
         path = os.path.join(artifact_dir, f"serve_ab_{policy}.json")
+        with open(path, "w") as f:
+            f.write(json.dumps(line) + "\n")
+        print(json.dumps(line))
+
+    # ---------------------------------------------------- memory-plane legs
+
+    def kv_carry_bytes(engine):
+        """Persistent KV residency: the donated cache carry's live
+        buffers (the pool under paging, the slab otherwise) — the
+        number that scales with HBM at steady state. Transient
+        per-step activations are excluded by construction: only the
+        carry survives between steps."""
+        import jax as _jax
+
+        return int(
+            sum(
+                leaf.nbytes
+                for leaf in _jax.tree_util.tree_leaves(
+                    engine.manager.cache
+                )
+            )
+        )
+
+    def drive(engine, trace, gen):
+        """Run a trace through a manually-stepped batcher; returns
+        per-request results + TTFTs (arrival stagger suppressed — the
+        memory legs measure residency and hits, not scheduling)."""
+        b = ContinuousBatcher(
+            engine,
+            max_admit_per_step=max(slots // 2, 1),
+            default_max_new_tokens=gen,
+        )
+        reqs = [b.submit(p) for p in trace]
+        guard = 0
+        while not all(r.finished() for r in reqs):
+            b.step()
+            guard += 1
+            assert guard < 100_000, "trace failed to complete"
+        assert all(r.status == "done" for r in reqs), [
+            r.status for r in reqs
+        ]
+        return b, reqs
+
+    def run_paged_leg() -> dict:
+        page_tokens = 16
+        # undersubscribed pool: enough for the trace's tokens in
+        # flight, well under slots × max_len worth of backing
+        pool_pages = int(max(
+            slots * ((int(max(lengths)) + gen_tokens) // page_tokens + 2),
+            slots + 2,
+        ))
+        # the leg's claim is pool < slab, so stay strictly under full
+        # backing even when env knobs (BENCH_GEN_TOKENS) inflate the
+        # trace — admission simply gates concurrency to what fits
+        full_backing = slots * (cfg.max_len // page_tokens)
+        pool_pages = min(pool_pages, full_backing - slots)
+        arms = {}
+        outs = {}
+        for arm in ("slab", "paged"):
+            engine = InferenceEngine(
+                model, params, slots=slots, max_len=cfg.max_len,
+                paged=(arm == "paged"), page_tokens=page_tokens,
+                pages=pool_pages, prefix_cache=False,
+            )
+            t0 = time.monotonic()
+            _, reqs = drive(engine, prompts, gen_tokens)
+            wall_s = time.monotonic() - t0
+            outs[arm] = [r.out_tokens for r in reqs]
+            arms[arm] = {
+                "kv_carry_bytes": kv_carry_bytes(engine),
+                "wall_s": round(wall_s, 4),
+                "decode_compiles": engine.stats()["decode_compiles"],
+                "page_allocs": (
+                    engine.manager.stats().get("page_allocs", 0)
+                ),
+            }
+        # the footprint claim: the pool's size is set by PAGES — the
+        # same pool at double the max_len is byte-identical (only the
+        # page-table width, a tiny int32 row, grows)
+        eng2 = InferenceEngine(
+            model, params, slots=slots, max_len=2 * cfg.max_len,
+            paged=True, page_tokens=page_tokens, pages=pool_pages,
+            prefix_cache=False,
+        )
+        arms["paged_2x_max_len"] = {
+            "kv_carry_bytes": kv_carry_bytes(eng2)
+        }
+        assert outs["slab"] == outs["paged"], (
+            "paged decode diverged from the slab at identical traffic"
+        )
+        assert (
+            arms["paged"]["kv_carry_bytes"]
+            == arms["paged_2x_max_len"]["kv_carry_bytes"]
+        ), "pool bytes moved with max_len"
+        assert (
+            arms["paged"]["kv_carry_bytes"]
+            < arms["slab"]["kv_carry_bytes"]
+        ), "undersubscribed pool not smaller than the slab"
+        return {
+            "metric": "serve_ab_paged",
+            "leg": "ab_paged",
+            "platform": platform,
+            "requests": n_requests,
+            "slots": slots,
+            "gen_tokens": gen_tokens,
+            "page_tokens": page_tokens,
+            "pool_pages": pool_pages,
+            "max_len": cfg.max_len,
+            "carry_bytes_ratio": round(
+                arms["slab"]["kv_carry_bytes"]
+                / arms["paged"]["kv_carry_bytes"],
+                3,
+            ),
+            "arms": arms,
+            "outputs_identical": True,
+            "dryrun": dryrun,
+            "note": _SIM_NOTE if platform == "cpu" else "on-chip",
+        }
+
+    def run_prefix_leg() -> dict:
+        page_tokens = 16
+        sys_prefix = list(
+            rng.integers(1, cfg.vocab_size, size=2 * page_tokens)
+        )  # two full shared pages per request
+        tails = [
+            list(rng.integers(1, cfg.vocab_size, size=int(t)))
+            for t in rng.integers(3, 14, size=n_requests)
+        ]
+        trace = [sys_prefix + t for t in tails]
+        arms = {}
+        outs = {}
+        for arm in ("cold", "warm"):
+            engine = InferenceEngine(
+                model, params, slots=slots, max_len=cfg.max_len,
+                paged=True, page_tokens=page_tokens,
+                prefix_cache=(arm == "warm"),
+            )
+            t0 = time.monotonic()
+            b, reqs = drive(engine, trace, gen_tokens)
+            wall_s = time.monotonic() - t0
+            outs[arm] = [r.out_tokens for r in reqs]
+            ttfts = sorted(r.ttft_ms for r in reqs)
+            st = engine.stats()
+            mstats = engine.manager.stats()
+            arms[arm] = {
+                "wall_s": round(wall_s, 4),
+                "ttft_ms_p50": round(_pct(ttfts, 0.5), 3),
+                "ttft_ms_p95": round(_pct(ttfts, 0.95), 3),
+                "prefill_chunks_skipped": st["prefill_chunks_skipped"],
+                "prefill_tokens_skipped": st["prefill_tokens_skipped"],
+                "prefix_hits": mstats["prefix_hits"],
+                "prefix_hit_rate": round(mstats["prefix_hit_rate"], 4),
+            }
+        assert outs["cold"] == outs["warm"], (
+            "prefix-hit decode diverged from cold prefill"
+        )
+        warm = arms["warm"]
+        assert warm["prefix_hits"] > 0, "no prefix hits on shared trace"
+        # every request after the first shares 2 full pages
+        assert warm["prefill_chunks_skipped"] >= 2 * (n_requests - 1), (
+            warm
+        )
+        assert arms["cold"]["prefill_chunks_skipped"] == 0
+        return {
+            "metric": "serve_ab_prefix",
+            "leg": "ab_prefix",
+            "platform": platform,
+            "requests": n_requests,
+            "slots": slots,
+            "gen_tokens": gen_tokens,
+            "page_tokens": page_tokens,
+            "shared_prefix_tokens": len(sys_prefix),
+            "arms": arms,
+            "outputs_identical": True,
+            "dryrun": dryrun,
+            "note": _SIM_NOTE if platform == "cpu" else "on-chip",
+        }
+
+    for leg_fn, name in ((run_paged_leg, "paged"), (run_prefix_leg, "prefix")):
+        line = leg_fn()
+        path = os.path.join(artifact_dir, f"serve_ab_{name}.json")
         with open(path, "w") as f:
             f.write(json.dumps(line) + "\n")
         print(json.dumps(line))

@@ -122,11 +122,11 @@ bench_smoke() {
     test -s "$art_dir/hier_${leg}.json" \
       || { echo "missing artifact: hier_${leg}.json" >&2; exit 1; }
   done
-  step "bench-smoke: bench_serve.py dryrun (static-vs-continuous A/B)"
+  step "bench-smoke: bench_serve.py dryrun (static-vs-continuous + paged-KV + prefix-cache A/B)"
   JAX_PLATFORMS=cpu \
     BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
     python bench_serve.py
-  for leg in static continuous; do
+  for leg in static continuous paged prefix; do
     test -s "$art_dir/serve_ab_${leg}.json" \
       || { echo "missing artifact: serve_ab_${leg}.json" >&2; exit 1; }
   done

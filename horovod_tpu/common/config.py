@@ -52,6 +52,15 @@ DEFAULT_SERVE_KV_SLOTS = 8
 DEFAULT_SERVE_MAX_BATCH = 4
 DEFAULT_SERVE_MAX_TOKENS = 64
 DEFAULT_SERVE_DEADLINE_MS = 0.0  # 0 = no deadline
+# Serving memory plane (serving/paged_kv.py): tokens per KV page, pool
+# size in pages (0 = auto: full backing, slots × max_len ÷ page_tokens
+# — undersubscribe explicitly to make HBM scale with tokens in
+# flight), prefix-cache toggle, and the admission reserve watermark
+# (-1 = auto: 0 at full backing, one page per slot otherwise).
+DEFAULT_SERVE_PAGE_TOKENS = 16
+DEFAULT_SERVE_PAGES = 0
+DEFAULT_SERVE_PREFIX_CACHE = True
+DEFAULT_SERVE_PAGE_WATERMARK = -1
 
 
 def _env_bool(name: str, default: bool = False) -> bool:
@@ -282,6 +291,12 @@ class Config:
     serve_max_tokens: int = DEFAULT_SERVE_MAX_TOKENS
     # default per-request deadline in ms (0 = none; per-request wins)
     serve_deadline_ms: float = DEFAULT_SERVE_DEADLINE_MS
+    # paged KV memory plane: tokens per page, pool pages (0 = full
+    # backing), prefix-cache toggle, admission watermark (-1 = auto)
+    serve_page_tokens: int = DEFAULT_SERVE_PAGE_TOKENS
+    serve_pages: int = DEFAULT_SERVE_PAGES
+    serve_prefix_cache: bool = DEFAULT_SERVE_PREFIX_CACHE
+    serve_page_watermark: int = DEFAULT_SERVE_PAGE_WATERMARK
 
     # --- logging ---
     log_level: str = "warning"
@@ -443,6 +458,19 @@ class Config:
             ),
             serve_deadline_ms=_env_float(
                 "HOROVOD_SERVE_DEADLINE_MS", DEFAULT_SERVE_DEADLINE_MS
+            ),
+            serve_page_tokens=_env_int(
+                "HOROVOD_SERVE_PAGE_TOKENS", DEFAULT_SERVE_PAGE_TOKENS
+            ),
+            serve_pages=_env_int(
+                "HOROVOD_SERVE_PAGES", DEFAULT_SERVE_PAGES
+            ),
+            serve_prefix_cache=_env_bool(
+                "HOROVOD_SERVE_PREFIX_CACHE", DEFAULT_SERVE_PREFIX_CACHE
+            ),
+            serve_page_watermark=_env_int(
+                "HOROVOD_SERVE_PAGE_WATERMARK",
+                DEFAULT_SERVE_PAGE_WATERMARK,
             ),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_timestamp=_env_bool("HOROVOD_LOG_TIMESTAMP", True),

@@ -79,6 +79,49 @@ INTEGRITY_METRICS = (
     "driver.divergence_restarts",
 )
 
+# Serving memory-plane metric families (serving/paged_kv.py — the
+# names the docs/serving.md "memory plane" runbook documents; emitter:
+# PagedKVCacheManager.stats → the `serve.` registry prefix, rendered
+# as `hvd_serve_*` on /metrics). Kept here as the single legend so
+# dashboards and tests never re-derive the spelling:
+#   serve.pages_total / pages_free   pool size / free-list pages (gauge)
+#   serve.pages_active               pages held by live slots (gauge)
+#   serve.pages_cached               pages held ONLY by the prefix
+#                                    index — reclaimable (gauge)
+#   serve.page_allocs                pages taken at write frontiers
+#                                    (counter)
+#   serve.page_evictions             LRU index evictions at refcount 0
+#                                    (counter)
+#   serve.page_cow                   copy-on-write page copies (counter;
+#                                    0 under the shipped sharing policy)
+#   serve.prefix_hits                cached pages attached instead of
+#                                    prefilled (counter)
+#   serve.prefix_hit_requests / prefix_lookups / prefix_hit_rate
+#                                    request-level hit accounting
+#   serve.prefix_published           pages published into the index
+#   serve.paused / serve.resumed     pool-exhaustion preemptions and
+#                                    their resumes (counters)
+#   serve.paused_pages_reclaimed     paused requests whose kept pages
+#                                    were reclaimed past the deadline
+#                                    (counter; they re-prefill)
+SERVING_PAGE_METRICS = (
+    "serve.pages_total",
+    "serve.pages_free",
+    "serve.pages_active",
+    "serve.pages_cached",
+    "serve.page_allocs",
+    "serve.page_evictions",
+    "serve.page_cow",
+    "serve.prefix_hits",
+    "serve.prefix_hit_requests",
+    "serve.prefix_lookups",
+    "serve.prefix_hit_rate",
+    "serve.prefix_published",
+    "serve.paused",
+    "serve.resumed",
+    "serve.paused_pages_reclaimed",
+)
+
 
 class MetricsRegistry:
     def __init__(self) -> None:

@@ -97,6 +97,12 @@ _COUNTER_KEYS = (
     # caused it
     "serve.tokens_out",
     "serve.admitted_mid_decode",
+    # paged memory plane (serving/paged_kv.py): page_allocs deltas mark
+    # the steps whose slots crossed page boundaries (allocation IS the
+    # write frontier), prefix_hits deltas mark admissions that attached
+    # cached prefix pages instead of prefilling them
+    "serve.page_allocs",
+    "serve.prefix_hits",
 )
 
 # Gauges copied into the record's ``tuner`` dict — the autotune /
@@ -383,6 +389,8 @@ class TelemetryHub:
                 "serve.admitted_mid_decode": deltas[
                     "serve.admitted_mid_decode"
                 ],
+                "serve.page_allocs": deltas["serve.page_allocs"],
+                "serve.prefix_hits": deltas["serve.prefix_hits"],
                 "tuner": tuner,
             }
         )

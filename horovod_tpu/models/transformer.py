@@ -223,7 +223,7 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, lengths=None, cache=None,
-                 cache_index=None):
+                 cache_index=None, pages=None):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
         if cache is not None:
@@ -236,6 +236,10 @@ class MultiHeadAttention(nn.Module):
                     "cache= does not compose with mask=/lengths=: the "
                     "cache_index IS the per-slot length"
                 )
+        elif pages is not None:
+            raise ValueError(
+                "pages= (the paged-KV page table) requires cache="
+            )
         if cfg.num_kv_heads:
             if cfg.num_heads % cfg.num_kv_heads:
                 raise ValueError(
@@ -263,7 +267,8 @@ class MultiHeadAttention(nn.Module):
             k = apply_rope(k, cfg.rope_base, offset=rope_offset)
         if cache is not None:
             return self._cached_attention(cfg, x, q, k, v, cache,
-                                          cache_index, head_dim)
+                                          cache_index, head_dim,
+                                          pages=pages)
         # lengths (right-padding) stays on the flash path — the kernels
         # take it natively; only ARBITRARY masks force dense.
         use_flash = cfg.uses_flash(mask, seq=x.shape[1])
@@ -338,7 +343,7 @@ class MultiHeadAttention(nn.Module):
         )(out)
 
     def _cached_attention(self, cfg, x, q, k, v, cache, cache_index,
-                          head_dim):
+                          head_dim, pages=None):
         """Incremental-decode attention: write this call's k/v into the
         per-slot cache at ``cache_index`` (each batch row at its own
         position — prefill passes t=prompt tokens at index 0, decode
@@ -348,20 +353,74 @@ class MultiHeadAttention(nn.Module):
         exact −1e30 → exact-zero probabilities, so stale slot contents
         (a reused slot, bucket padding) can never leak into the output
         and the dense path stays bit-comparable with the full-sequence
-        forward. Returns ``(out, {"k", "v"})`` — the updated cache."""
+        forward. Returns ``(out, {"k", "v"})`` — the updated cache.
+
+        Two cache layouts share this math:
+
+        * contiguous slab (``pages=None``): per-slot rows
+          ``[batch, max_len, kv_heads, head_dim]``, vmapped
+          ``dynamic_update_slice`` writes;
+        * paged (``pages=[batch, n_pages]`` int32 page table over a
+          ``[num_pages, page_tokens, ...]`` block pool,
+          `serving/paged_kv.py`): writes scatter into physical pages
+          (``pool.at[phys, offset].set(..., mode="drop")`` — the
+          sentinel/out-of-range entries of unallocated logical pages
+          drop their writes, exactly the pad positions the slab path
+          masks away), reads gather the slot's pages back into a
+          transient contiguous view. Because a slot's pages tile
+          ``max_len`` exactly, the gathered view has the SAME shape and
+          the SAME values at every attendable position as the slab
+          row, so the attention below is bit-identical between
+          layouts — the serving plane's paged-parity contract.
+        """
         b, t = x.shape[0], x.shape[1]
-        seq = cache["k"].shape[1]
         idx = jnp.asarray(cache_index, jnp.int32)
 
-        def _write(buf, new, i):
-            return jax.lax.dynamic_update_slice(
-                buf, new.astype(buf.dtype), (i, 0, 0)
-            )
+        if pages is None:
+            seq = cache["k"].shape[1]
 
-        k_cache = jax.vmap(_write)(cache["k"], k, idx)
-        v_cache = jax.vmap(_write)(cache["v"], v, idx)
+            def _write(buf, new, i):
+                return jax.lax.dynamic_update_slice(
+                    buf, new.astype(buf.dtype), (i, 0, 0)
+                )
+
+            k_cache = jax.vmap(_write)(cache["k"], k, idx)
+            v_cache = jax.vmap(_write)(cache["v"], v, idx)
+        else:
+            pages = jnp.asarray(pages, jnp.int32)
+            num_pages, page_tokens = cache["k"].shape[:2]
+            n_logical = pages.shape[1]
+            seq = n_logical * page_tokens
+            pos = idx[:, None] + jnp.arange(t)            # [b, t] global
+            lp = pos // page_tokens
+            off = pos % page_tokens
+            # physical page per written token; positions past the table
+            # (bucket-pad overhang) route to the out-of-range sentinel
+            # and are dropped — they could never become attendable
+            phys = jnp.take_along_axis(
+                pages, jnp.clip(lp, 0, n_logical - 1), axis=1
+            )
+            phys = jnp.where(lp < n_logical, phys, num_pages)
+
+            def _scatter(pool, new):
+                return pool.at[phys, off].set(
+                    new.astype(pool.dtype), mode="drop"
+                )
+
+            k_cache = _scatter(cache["k"], k)
+            v_cache = _scatter(cache["v"], v)
         new_cache = {"k": k_cache, "v": v_cache}
-        kk, vv = k_cache, v_cache
+        if pages is None:
+            kk, vv = k_cache, v_cache
+        else:
+            # gather-from-pages read: reassemble each row's pages in
+            # logical order (sentinel entries clamp into arbitrary
+            # garbage the causal mask below zeroes exactly)
+            def _gather(pool):
+                g = jnp.take(pool, pages, axis=0, mode="clip")
+                return g.reshape(b, seq, *pool.shape[2:])
+
+            kk, vv = _gather(k_cache), _gather(v_cache)
         if cfg.num_kv_heads and cfg.num_kv_heads != cfg.num_heads:
             rep = cfg.num_heads // cfg.num_kv_heads
             kk = jnp.repeat(kk, rep, axis=2)
@@ -390,7 +449,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True, lengths=None,
-                 cache=None, cache_index=None):
+                 cache=None, cache_index=None, pages=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         new_cache = None
@@ -398,7 +457,8 @@ class Block(nn.Module):
             h = MultiHeadAttention(cfg)(h, mask, lengths)
         else:
             h, new_cache = MultiHeadAttention(cfg)(
-                h, mask, lengths, cache=cache, cache_index=cache_index
+                h, mask, lengths, cache=cache, cache_index=cache_index,
+                pages=pages,
             )
         h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         x = x + h
@@ -451,7 +511,7 @@ class Transformer(nn.Module):
     def __call__(
         self, tokens, mask=None, train: bool = True,
         return_hidden: bool = False, lengths=None,
-        cache=None, cache_index=None,
+        cache=None, cache_index=None, pages=None,
     ):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype)(tokens)
@@ -473,6 +533,9 @@ class Transformer(nn.Module):
             # KV-cache-threaded forward (the serving engine's model
             # contract, horovod_tpu/serving/engine.py): same param
             # tree, same block stack, dense attention over the cache.
+            # pages= switches the layout to the paged block pool
+            # (serving/paged_kv.py) — the table is shared by every
+            # layer, each layer's pool is its cache[i] entry.
             # remat is a backward-pass memory trade — inference-only
             # path, so it never wraps here.
             if return_hidden:
@@ -482,6 +545,7 @@ class Transformer(nn.Module):
                 x, layer_cache = Block(cfg, name=f"block_{i}")(
                     x, mask, train, lengths,
                     cache=cache[i], cache_index=cache_index,
+                    pages=pages,
                 )
                 new_cache.append(layer_cache)
             x = nn.LayerNorm(dtype=jnp.float32)(x)

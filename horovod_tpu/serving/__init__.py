@@ -5,8 +5,10 @@ donated fused executables, shape-bucketed executor caches, /metrics
 telemetry, straggler ledger) turned into an inference fleet: continuous
 batching over a fixed-shape donated decode step, a two-tier
 (exact/bucket) prefill executor cache on the prompt-length axis, a
-slot-based KV-cache manager, SLO-metered TTFT/TPOT on the existing
-scrape endpoint, capacity announcements + straggler-aware routing over
+paged KV memory plane (block pool + page tables + hash-keyed prefix
+cache — `paged_kv.py`; the PR 8 contiguous slab remains as the A/B
+baseline), SLO-metered TTFT/TPOT on the existing scrape endpoint,
+page-headroom capacity announcements + straggler-aware routing over
 the rendezvous KV, and a SIGTERM drain that finishes every accepted
 request before the worker leaves the gang.
 
@@ -16,9 +18,11 @@ request before the worker leaves the gang.
     handle.wait()          # POST /generate, GET /healthz|/metrics|/stats
 
 Layers (docs/serving.md): models/transformer.py owns the incremental-
-decode model contract; `engine` the compiled prefill/decode split;
-`kv_cache` the slots; `batcher` the scheduler; `slo` the latency
-meters; `frontend` HTTP + fleet routing.
+decode model contract (paged or slab cache layout); `engine` the
+compiled prefill/decode split; `paged_kv` the block pool + prefix
+cache; `kv_cache` the slab baseline + the manager factory; `batcher`
+the scheduler (page-gated admission, pause-on-exhaustion); `slo` the
+latency meters; `frontend` HTTP + fleet routing.
 """
 
 from .batcher import (  # noqa: F401
@@ -34,5 +38,10 @@ from .frontend import (  # noqa: F401
     read_announcements,
     serve,
 )
-from .kv_cache import KVCacheManager  # noqa: F401
+from .kv_cache import KVCacheManager, create_kv_manager  # noqa: F401
+from .paged_kv import (  # noqa: F401
+    PagedKVCacheManager,
+    PagePoolExhausted,
+    page_hashes,
+)
 from .slo import LatencyRecorder  # noqa: F401

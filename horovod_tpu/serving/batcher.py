@@ -21,6 +21,17 @@ Policy knobs:
   prefill; running requests are evicted at the deadline with their
   partial output (status ``"deadline"``).
 
+Paged memory plane (serving/paged_kv.py, the default): admission is
+additionally gated on free KV *pages* — a request is only admitted
+when its worst-case prompt pages fit above the reserve watermark, so
+mid-decode allocation can't strand in-flight sequences. If the pool
+still exhausts mid-decode (prefix-cache churn, undersized pools), the
+step does not raise: the YOUNGEST running request is paused — re-queued
+at the front with its pages kept for a pointer-cheap resume — and, as
+the last resort, paused requests' kept pages are reclaimed
+deadline-aware (nearest deadline first; those resume by re-prefilling
+prompt + generated-so-far, usually through the prefix cache).
+
 Draining (``drain()``, wired to SIGTERM via
 ``preemption.register_drain``) stops ADMISSION of new submissions but
 runs queue + in-flight to completion — every accepted request finishes
@@ -41,6 +52,7 @@ import numpy as np
 from ..common import telemetry as _telemetry
 from ..common.logging import get_logger
 from ..common.metrics import registry as _metrics
+from .paged_kv import PagePoolExhausted
 from .slo import LatencyRecorder
 
 _log = get_logger("serve.batcher")
@@ -68,6 +80,16 @@ class Request:
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     ttft_ms: float = 0.0
     gen_ms: float = 0.0
+    # paged memory plane (serving/paged_kv.py): pause/resume state. A
+    # request paused on pool exhaustion re-queues with ``paused=True``;
+    # ``kept_pages`` holds its page-table snapshot (refcounts
+    # transferred from the slot) so resume is a pointer re-attach — or
+    # None once the deadline-aware reclaim dropped them, in which case
+    # resume re-prefills prompt + generated-so-far.
+    paused: bool = False
+    kept_pages: Optional[list] = None
+    resume_length: int = 0
+    admit_seq: int = -1
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
@@ -113,6 +135,7 @@ class ContinuousBatcher:
         self.policy = policy
         self.recorder = recorder or LatencyRecorder()
         self._ids = itertools.count()
+        self._admit_ids = itertools.count()
         self._cond = threading.Condition()
         self._queue: "deque[Request]" = deque()
         self._slot_req: Dict[int, Request] = {}
@@ -148,6 +171,17 @@ class ContinuousBatcher:
                 f"prompt of {prompt.size} tokens leaves no room in a "
                 f"{self.engine.max_len}-token KV slot"
             )
+        if self.engine.paged:
+            mgr = self.engine.manager
+            worst = mgr.pages_needed(int(prompt.size) + max_new)
+            if worst > mgr.num_pages:
+                # can NEVER fit, even with the whole pool to itself —
+                # the paged analog of the slot-capacity reject above
+                _metrics.counter("serve.rejected")
+                raise Rejected(
+                    f"request needs {worst} KV pages but the pool has "
+                    f"only {mgr.num_pages}"
+                )
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         req = Request(
@@ -253,6 +287,9 @@ class ContinuousBatcher:
             self.engine.manager.free(slot)
             queued.append(req)
         for req in queued:
+            if req.kept_pages:
+                self.engine.manager.release_kept(req.kept_pages)
+                req.kept_pages = None
             req.status = ERROR
             req._done.set()
             _metrics.counter("serve.errored")
@@ -273,14 +310,44 @@ class ContinuousBatcher:
     def _expire_queued(self, now: float) -> None:
         with self._cond:
             keep: "deque[Request]" = deque()
+            expired = []
             for req in self._queue:
                 if req.deadline_ts is not None and now >= req.deadline_ts:
-                    req.status = DEADLINE
-                    req._done.set()
-                    _metrics.counter("serve.expired")
+                    expired.append(req)
                 else:
                     keep.append(req)
             self._queue = keep
+        for req in expired:
+            if req.kept_pages:
+                # a paused request expiring in the queue releases the
+                # pages it was holding for resume
+                self.engine.manager.release_kept(req.kept_pages)
+                req.kept_pages = None
+            req.status = DEADLINE
+            req._done.set()
+            _metrics.counter("serve.expired")
+
+    def _resume_seq(self, req: Request) -> np.ndarray:
+        """The token sequence a page-dropped paused request re-prefills:
+        prompt plus everything generated EXCEPT the newest token — that
+        one is fed to the next decode step (which writes its kv), the
+        same frontier the request was paused at."""
+        return np.concatenate(
+            [req.prompt, np.asarray(req.out_tokens[:-1], np.int32)]
+        )
+
+    def _admission_pages_needed(self, req: Request) -> int:
+        """Pages the admission gate must see headroom for: a resume
+        with kept pages needs none (they are already held); a
+        page-dropped resume re-prefills its whole sequence-so-far; a
+        fresh request needs its prompt (prefix hits can only reduce
+        this — the gate is deliberately conservative)."""
+        mgr = self.engine.manager
+        if req.kept_pages is not None:
+            return 0
+        if req.paused and req.out_tokens:
+            return mgr.pages_needed(self._resume_seq(req).size)
+        return mgr.pages_needed(int(req.prompt.size))
 
     def _admit(self, now: float) -> int:
         admitted = 0
@@ -292,25 +359,61 @@ class ContinuousBatcher:
             if self.policy == "static"
             else self.max_admit_per_step
         )
+        paged = self.engine.paged
         while admitted < limit:
             with self._cond:
                 if not self._queue:
                     break
                 req = self._queue[0]
+            if paged and (
+                self._admission_pages_needed(req)
+                > self.engine.manager.admission_headroom()
+            ):
+                # the page gate: admission never dips into the reserve
+                # watermark — those pages belong to in-flight decodes
+                break
             slot = self.engine.manager.alloc(req.id)
             if slot is None:
                 break
             with self._cond:
                 # single consumer: the head is still req
                 self._queue.popleft()
-            first = self.engine.prefill(slot, req.prompt)
-            req.status = RUNNING
-            req.ttft_ms = (time.monotonic() - req.submitted) * 1e3
-            req.out_tokens.append(int(first))
-            self.recorder.record_ttft(req.ttft_ms)
-            _metrics.counter("serve.prefill_tokens", int(req.prompt.size))
-            _metrics.counter("serve.tokens_out")
+            req.admit_seq = next(self._admit_ids)
+            if req.kept_pages is not None:
+                # resume from pause: the kept pages pointer-attach and
+                # decode continues exactly where it stopped — no
+                # prefill, no second TTFT
+                self.engine.manager.reattach(
+                    slot, req.kept_pages, req.resume_length
+                )
+                req.kept_pages = None
+                req.paused = False
+                req.status = RUNNING
+                _metrics.counter("serve.resumed")
+            else:
+                if req.paused and req.out_tokens:
+                    # pages were reclaimed while paused: rebuild the
+                    # slot by re-prefilling prompt + generated-so-far
+                    # (the prefix cache usually makes this cheap); the
+                    # emitted token is discarded — the real newest
+                    # token is fed to the next decode step
+                    self.engine.prefill(slot, self._resume_seq(req))
+                    req.paused = False
+                    req.status = RUNNING
+                    _metrics.counter("serve.resumed")
+                else:
+                    first = self.engine.prefill(slot, req.prompt)
+                    req.status = RUNNING
+                    req.ttft_ms = (time.monotonic() - req.submitted) * 1e3
+                    req.out_tokens.append(int(first))
+                    self.recorder.record_ttft(req.ttft_ms)
+                    _metrics.counter(
+                        "serve.prefill_tokens", int(req.prompt.size)
+                    )
+                    _metrics.counter("serve.tokens_out")
             if mid_decode:
+                # counted for every admission path — fresh prefill,
+                # reprefill-resume AND pointer reattach-resume alike
                 _metrics.counter("serve.admitted_mid_decode")
             admitted += 1
             self._slot_req[slot] = req
@@ -318,9 +421,87 @@ class ContinuousBatcher:
                 self._retire(slot, req)
         return admitted
 
+    def _pause_youngest(self, now: float) -> bool:
+        """Pool-exhaustion remedy: take the youngest running request
+        out of its slot and re-queue it (front), keeping its pages for
+        a pointer-cheap resume. A request already past its deadline
+        expires instead (its pages free immediately). Returns False
+        when there is no second request to pause."""
+        if len(self._slot_req) < 2:
+            return False
+        slot, req = max(
+            self._slot_req.items(), key=lambda kv: kv[1].admit_seq
+        )
+        self._slot_req.pop(slot)
+        mgr = self.engine.manager
+        if req.deadline_ts is not None and now >= req.deadline_ts:
+            mgr.free(slot)
+            req.status = DEADLINE
+            req._done.set()
+            _metrics.counter("serve.expired")
+            return True
+        req.kept_pages, req.resume_length = mgr.detach_keep(slot)
+        req.paused = True
+        req.status = QUEUED
+        with self._cond:
+            self._queue.appendleft(req)
+        _metrics.counter("serve.paused")
+        _log.debug(
+            "page pool exhausted: paused request %d (kept %d pages)",
+            req.id, len(req.kept_pages),
+        )
+        return True
+
+    def _reclaim_paused_pages(self) -> bool:
+        """Last-resort page source: drop the kept pages of a paused
+        request so an older in-flight one can take its next page.
+        Deadline-aware: the victim is the paused holder with the LEAST
+        deadline headroom (most likely to expire unserved anyway);
+        holders with no deadline are spared longest. The victim stays
+        queued — it re-prefills on resume."""
+        with self._cond:
+            holders = [r for r in self._queue if r.kept_pages]
+        if not holders:
+            return False
+        victim = min(
+            holders,
+            key=lambda r: (
+                r.deadline_ts is None,
+                r.deadline_ts if r.deadline_ts is not None else 0.0,
+            ),
+        )
+        self.engine.manager.release_kept(victim.kept_pages)
+        victim.kept_pages = None
+        _metrics.counter("serve.paused_pages_reclaimed")
+        return True
+
+    def _make_decodable(self, now: float) -> None:
+        """Run the pre-decode page sweep until every remaining slot
+        can take its next token, pausing the youngest request (then
+        reclaiming paused holds) as needed — graceful degradation, the
+        step itself never sees exhaustion."""
+        # bounded: each round pauses a request or reclaims one holder
+        for _ in range(self.engine.slots + len(self._queue) + 2):
+            if not self.engine.prepare_decode():
+                return
+            if self._pause_youngest(now):
+                continue
+            if self._reclaim_paused_pages():
+                continue
+            # a single in-flight request, nothing left to reclaim:
+            # unreachable when the pool admits only what fits
+            # (submit's can-never-fit gate), but never silent
+            raise PagePoolExhausted(
+                list(self.engine.prepare_decode())
+            )
+
     def _decode(self, now: float) -> bool:
         if not self._slot_req:
             return False
+        if self.engine.paged:
+            self._make_decodable(now)
+            if not self._slot_req:
+                return False
         tokens = np.zeros(self.engine.slots, np.int32)
         for slot, req in self._slot_req.items():
             tokens[slot] = req.out_tokens[-1]

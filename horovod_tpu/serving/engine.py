@@ -19,6 +19,12 @@ length where byte size was:
   every prefill/decode executable so steady-state serving allocates no
   new cache buffers and never retraces: admissions, evictions and slot
   reuse change data, never shapes.
+* **Memory plane**: the cache behind those executables is the paged
+  block pool by default (`serving/paged_kv.py` — page tables ride the
+  executables as extra int32 DATA inputs, so the zero-retrace invariant
+  is untouched; prompt prefixes shared with the hash-keyed cache skip
+  their prefill chunks outright). ``paged=False`` keeps the PR 8
+  contiguous slab — the A/B baseline, bit-identical greedy output.
 
 Executables are built ahead-of-time (``jit(...).lower(...).compile()``)
 and held in engine-owned tables, so compile counts are exact, assertable
@@ -40,7 +46,7 @@ import numpy as np
 
 from ..common.logging import get_logger
 from ..common.metrics import registry as _metrics
-from .kv_cache import KVCacheManager
+from .paged_kv import PagePoolExhausted  # noqa: F401  (engine API)
 
 _log = get_logger("serve.engine")
 
@@ -57,7 +63,10 @@ def next_pow2(n: int) -> int:
 
 def _as_model_fn(model) -> Callable:
     """Adapt a flax module (``.apply``; params or full variables dict)
-    to the positional model contract; pass callables through."""
+    to the positional model contract; pass callables through. With the
+    paged memory plane the contract grows a ``pages=`` kwarg (the
+    per-row page table, `serving/paged_kv.py`) — custom callables only
+    need to accept it when they are served with ``paged=True``."""
     apply = getattr(model, "apply", None)
     if apply is None:
         if not callable(model):
@@ -65,18 +74,24 @@ def _as_model_fn(model) -> Callable:
                 f"model must be a flax module or a model_fn callable, "
                 f"got {type(model)!r}"
             )
-        return model
 
-    def model_fn(params, tokens, cache, cache_index):
+        def passthrough(params, tokens, cache, cache_index, pages=None):
+            if pages is None:
+                return model(params, tokens, cache, cache_index)
+            return model(params, tokens, cache, cache_index, pages=pages)
+
+        return passthrough
+
+    def model_fn(params, tokens, cache, cache_index, pages=None):
         variables = (
             params
             if isinstance(params, dict) and "params" in params
             else {"params": params}
         )
-        return apply(
-            variables, tokens, train=False,
-            cache=cache, cache_index=cache_index,
-        )
+        kwargs = dict(train=False, cache=cache, cache_index=cache_index)
+        if pages is not None:
+            kwargs["pages"] = pages
+        return apply(variables, tokens, **kwargs)
 
     return model_fn
 
@@ -116,13 +131,41 @@ class InferenceEngine:
         donate: Optional[bool] = None,
         mesh=None,
         tp_axis: str = "tp",
+        paged: Optional[bool] = None,
+        page_tokens: Optional[int] = None,
+        pages: Optional[int] = None,
+        prefix_cache: Optional[bool] = None,
+        page_watermark: Optional[int] = None,
     ) -> None:
         self._model_fn = _as_model_fn(model)
         self._params = params
         if cache_factory is None:
             cache_factory = _default_cache_factory(model)
-        self.manager = KVCacheManager(
-            cache_factory, slots=slots, max_len=max_len,
+        # memory plane: paged block pool + prefix cache by default
+        # (serving/paged_kv.py); paged=False keeps the PR 8 contiguous
+        # slab — the A/B baseline (bench_serve.py ab_paged). None knobs
+        # resolve from the env contract (docs/env_vars.md).
+        from ..common import basics
+        from .kv_cache import create_kv_manager
+
+        cfg = basics.live_config()
+        self.paged = True if paged is None else bool(paged)
+        self.manager = create_kv_manager(
+            cache_factory, slots, max_len,
+            paged=self.paged,
+            page_tokens=(
+                cfg.serve_page_tokens if page_tokens is None
+                else int(page_tokens)
+            ),
+            num_pages=cfg.serve_pages if pages is None else int(pages),
+            prefix_cache=(
+                cfg.serve_prefix_cache if prefix_cache is None
+                else bool(prefix_cache)
+            ),
+            watermark=(
+                cfg.serve_page_watermark if page_watermark is None
+                else int(page_watermark)
+            ),
             mesh=mesh, tp_axis=tp_axis,
         )
         self.slots = self.manager.slots
@@ -153,6 +196,7 @@ class InferenceEngine:
         self._seen: "collections.OrderedDict" = collections.OrderedDict()
         self._exact_capacity = max(int(exact_capacity), 1)
         self._decode_exe = None
+        self._decode_swept = False
         self._lock = threading.Lock()  # guards counters for stats readers
         self._counters = collections.Counter()
 
@@ -188,16 +232,33 @@ class InferenceEngine:
         return exe
 
     def _prefill_fn(self, width: int):
-        """Build the prefill computation for a fixed token width: slice
-        the slot's cache row, run the cache-threaded model over the
-        chunk, write the row back, emit the greedy next token at
-        ``last_pos`` (pad positions beyond it are causal-masked junk a
-        later write overwrites before it is ever attendable)."""
+        """Build the prefill computation for a fixed token width: run
+        the cache-threaded model over the chunk, emit the greedy next
+        token at ``last_pos`` (pad positions beyond it are causal-masked
+        junk a later write overwrites before it is ever attendable).
+
+        Slab layout: slice the slot's cache row, model over the row,
+        write the row back. Paged layout: the model scatters straight
+        into the donated block pool through the slot's page-table row
+        (no slice/write-back — the table IS the slot)."""
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         model_fn = self._model_fn
+
+        if self.paged:
+            def fn(params, cache, tokens, table_row, start, last_pos):
+                logits, cache = model_fn(
+                    params, tokens, cache, jnp.reshape(start, (1,)),
+                    pages=table_row[None],
+                )
+                row = lax.dynamic_index_in_dim(
+                    logits[0], last_pos, axis=0, keepdims=False
+                )
+                return jnp.argmax(row).astype(jnp.int32), cache
+
+            return fn
 
         def fn(params, cache, tokens, slot, start, last_pos):
             slot_cache = jax.tree_util.tree_map(
@@ -226,6 +287,19 @@ class InferenceEngine:
 
         model_fn = self._model_fn
 
+        if self.paged:
+            def fn(params, cache, tokens, lengths, tables):
+                logits, cache = model_fn(
+                    params, tokens[:, None], cache, lengths,
+                    pages=tables,
+                )
+                return (
+                    jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32),
+                    cache,
+                )
+
+            return fn
+
         def fn(params, cache, tokens, lengths):
             logits, cache = model_fn(
                 params, tokens[:, None], cache, lengths
@@ -238,6 +312,18 @@ class InferenceEngine:
         return fn
 
     def _prefill_args(self, width: int):
+        if self.paged:
+            return (
+                self._params,
+                self.manager.cache,
+                np.zeros((1, width), np.int32),
+                np.full(
+                    self.manager.pages_per_slot,
+                    self.manager.sentinel, np.int32,
+                ),
+                np.int32(0),
+                np.int32(0),
+            )
         return (
             self._params,
             self.manager.cache,
@@ -263,10 +349,15 @@ class InferenceEngine:
             self._counters["prefill_bucket_hits"] += 1
         return exe
 
-    def _get_prefill_exe(self, length: int):
+    def _get_prefill_exe(self, length: int, avail: Optional[int] = None):
         """Two-tier lookup for the final (or only) chunk of ``length``
         tokens: exact executable if promoted, else the power-of-two
-        bucket. Returns ``(exe, width)``."""
+        bucket. Returns ``(exe, width)``. ``avail`` is the room left in
+        the slot (max_len − start): when the padded bucket would
+        overrun it (possible only for a non-pow2-multiple max_len
+        tail), the chunk compiles at its exact width instead — padding
+        past the slot would clamp-shift the slab write or drop the pad
+        pages' worth of paged writes."""
         exact = self._prefill_exact
         if length in exact:
             exact.move_to_end(length)
@@ -277,7 +368,12 @@ class InferenceEngine:
         self._seen.move_to_end(length)
         while len(self._seen) > 4 * self._exact_capacity:
             self._seen.popitem(last=False)  # bounded, PR 1 lesson
-        if count >= self.promote_after:
+        bucket = min(
+            max(next_pow2(length), self.min_bucket), self.prefill_ceiling
+        )
+        if count >= self.promote_after or (
+            avail is not None and bucket > avail
+        ):
             exe = self._compile(
                 self._prefill_fn(length),
                 self._prefill_args(length),
@@ -288,21 +384,36 @@ class InferenceEngine:
             while len(exact) > self._exact_capacity:
                 exact.popitem(last=False)
             return exe, length
-        bucket = min(
-            max(next_pow2(length), self.min_bucket), self.prefill_ceiling
-        )
         exe = self._bucket_exe(bucket)
         self._counters["prefill_pad_tokens"] += bucket - length
         return exe, bucket
 
     # ------------------------------------------------------------ execution
 
+    def _slot_arg(self, slot: int):
+        """The per-slot routing argument of a prefill executable: the
+        page-table row under paging (re-fetched every chunk — earlier
+        chunks may have allocated), the slot index for the slab."""
+        if self.paged:
+            return self.manager.table_row(slot)
+        return np.int32(slot)
+
     def prefill(self, slot: int, prompt) -> int:
-        """Run the prompt through the slot's cache row; returns the
-        first greedy token. Prompts past the bucket ceiling stream as
+        """Run the prompt through the slot's cache; returns the first
+        greedy token. Prompts past the bucket ceiling stream as
         ceiling-sized chunks (each attends to the cache written so
         far), the remainder through the two-tier cache like any short
-        prompt."""
+        prompt.
+
+        Paged plane: the prompt's leading full pages are first looked
+        up in the prefix cache — every hit is attached by page-table
+        pointer write and its prefill chunk NEVER RUNS (the
+        ``prefill_chunks_skipped`` counter). The final prompt token is
+        always recomputed even on a full-prefix hit, so the first
+        greedy token's logits exist and shared pages stay immutable.
+        The remaining pages are allocated here (allocate-on-write);
+        after the prefill the slot's full prompt pages are published
+        back into the prefix index."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n = prompt.size
         if not 0 < n <= self.max_len:
@@ -310,6 +421,25 @@ class InferenceEngine:
                 f"prompt length {n} outside (0, {self.max_len}]"
             )
         start = 0
+        hashes = []
+        if self.paged:
+            from .paged_kv import page_hashes
+
+            mgr = self.manager
+            if mgr.prefix_cache_enabled:
+                hashes = page_hashes(prompt, mgr.page_tokens)
+                hits = mgr.lookup_prefix(hashes)
+                # cap: the LAST prompt token is always recomputed (its
+                # logits produce the first output; recomputing it also
+                # means no write ever targets a shared page)
+                k = min(len(hits), (n - 1) // mgr.page_tokens)
+                if k:
+                    mgr.attach_prefix(slot, hits[:k])
+                    start = k * mgr.page_tokens
+                    self._counters["prefill_chunks_skipped"] += k
+                    self._counters["prefill_tokens_skipped"] += start
+            if not mgr.ensure_pages(slot, n, write_from=start):
+                raise PagePoolExhausted([slot])
         ceiling = self.prefill_ceiling
         while n - start > ceiling:
             exe = self._bucket_exe(ceiling)
@@ -318,26 +448,43 @@ class InferenceEngine:
                 self._params,
                 self.manager.cache,
                 prompt[None, start:start + ceiling],
-                np.int32(slot),
+                self._slot_arg(slot),
                 np.int32(start),
                 np.int32(ceiling - 1),
             )
             start += ceiling
         tail = n - start
-        exe, width = self._get_prefill_exe(tail)
+        exe, width = self._get_prefill_exe(tail, avail=self.max_len - start)
         tokens = np.zeros((1, width), np.int32)
         tokens[0, :tail] = prompt[start:]
         tok, self.manager.cache = exe(
             self._params,
             self.manager.cache,
             tokens,
-            np.int32(slot),
+            self._slot_arg(slot),
             np.int32(start),
             np.int32(tail - 1),
         )
         self.manager.set_length(slot, n)
         self._counters["prefills"] += 1
+        if self.paged and hashes:
+            self.manager.publish_prefix(slot, hashes)
         return int(tok)
+
+    def prepare_decode(self) -> list:
+        """Pre-decode page sweep (paged plane): allocate each active
+        slot's next-token page; returns the slots the pool could NOT
+        supply (always ``[]`` for the slab). The batcher calls this
+        BEFORE :meth:`decode_step` and pauses requests until the list
+        is empty — exhaustion is a scheduling event, not an error."""
+        if not self.paged:
+            return []
+        starved = self.manager.ensure_decode_pages()
+        # a clean sweep is remembered so the next decode_step doesn't
+        # repeat it (the batcher sweeps right before stepping); any
+        # starvation leaves the flag down and decode_step re-checks
+        self._decode_swept = not starved
+        return starved
 
     def decode_step(self, tokens: np.ndarray) -> np.ndarray:
         """ONE fixed-shape step over every slot: feed each slot's last
@@ -345,18 +492,24 @@ class InferenceEngine:
         Inactive slots (length 0) compute masked junk at position 0
         that the next occupant's prefill overwrites — the price of a
         shape that never changes is a little wasted compute, never a
-        retrace."""
+        retrace. (Paged: an inactive slot's page table is all sentinel,
+        so even its junk write is dropped.)"""
         tokens = np.asarray(tokens, np.int32).reshape(self.slots)
+        if self.paged:
+            if not self._decode_swept:
+                starved = self.prepare_decode()
+                if starved:
+                    raise PagePoolExhausted(starved)
+            self._decode_swept = False
         lengths = self.manager.lengths_array()
+        args = (self._params, self.manager.cache, tokens, lengths)
+        if self.paged:
+            args = args + (self.manager.tables_array(),)
         if self._decode_exe is None:
             self._decode_exe = self._compile(
-                self._decode_fn(),
-                (self._params, self.manager.cache, tokens, lengths),
-                "decode",
+                self._decode_fn(), args, "decode"
             )
-        out, self.manager.cache = self._decode_exe(
-            self._params, self.manager.cache, tokens, lengths
-        )
+        out, self.manager.cache = self._decode_exe(*args)
         self._counters["decode_steps"] += 1
         return np.asarray(out)
 
@@ -369,7 +522,8 @@ class InferenceEngine:
             "prefill_compiles", "decode_compiles", "prefills",
             "decode_steps", "prefill_exact_hits", "prefill_bucket_hits",
             "prefill_promotions", "prefill_pad_tokens",
-            "chunked_prefill_chunks",
+            "chunked_prefill_chunks", "prefill_chunks_skipped",
+            "prefill_tokens_skipped",
         ):
             out.setdefault(key, 0)
         out["prefill_exact_entries"] = len(self._prefill_exact)

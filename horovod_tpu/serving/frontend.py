@@ -18,13 +18,17 @@ Routes:
 * ``GET /stats`` — engine + batcher counters as JSON.
 
 **Fleet plane:** each worker announces ``{rank, addr, port, free_slots,
-queue_depth, ts}`` into the rendezvous KV (scope ``serve``) on a timer
-— the same channel heartbeats ride. ``Router`` reads those
-announcements plus the heartbeat straggler ledger
-(``runner.rendezvous.read_heartbeat_stats`` →
-``StallInspector.straggler_ranks``) and directs each request to the
+queue_depth, ts}`` — plus, under the paged memory plane,
+``free_pages`` / ``pages_total`` / ``prefix_hit_rate`` — into the
+rendezvous KV (scope ``serve``) on a timer — the same channel
+heartbeats ride. ``Router`` reads those announcements plus the
+heartbeat straggler ledger (``runner.rendezvous.read_heartbeat_stats``
+→ ``StallInspector.straggler_ranks``) and directs each request to the
 least-loaded worker whose rank is NOT flagged — the PR 4 ledger driving
-traffic, not just logs.
+traffic, not just logs. Page headroom outranks slot headroom when both
+are announced (pages are what admission actually gates on); old
+``free_slots``-only blobs keep parsing, so mixed fleets mid-rollout
+stay routable.
 
 **Drain:** ``serve()`` registers the frontend's drain with
 ``preemption.register_drain``, so a SIGTERM under ``GracefulShutdown``
@@ -239,7 +243,7 @@ class ServeFrontend:
     def capacity(self) -> dict:
         mgr = self.batcher.engine.manager.stats()
         draining = self.draining
-        return {
+        cap = {
             "ok": not draining,
             "rank": self.rank,
             "addr": self.advertise_addr,
@@ -250,6 +254,27 @@ class ServeFrontend:
             "draining": draining,
             "ts": time.time(),
         }
+        if "pages_total" in mgr:
+            # paged memory plane: page headroom is the truthful
+            # capacity signal (admission is gated on it, not on
+            # slots). free_pages is watermark-adjusted — what
+            # admission may actually spend — and a SATURATED pool
+            # flips the slot capacity to 0 too, so even a
+            # slots-only/legacy Router steers away from a worker
+            # that would only queue the request.
+            manager = self.batcher.engine.manager
+            free_pages = manager.admission_headroom()
+            cap["free_pages"] = free_pages
+            cap["pages_total"] = mgr["pages_total"]
+            cap["prefix_hit_rate"] = round(mgr["prefix_hit_rate"], 4)
+            if free_pages <= 0:
+                cap["free_slots"] = 0
+            if cap["free_slots"] <= 0:
+                # the symmetric clamp: admission needs a slot AND
+                # pages, so a slot-saturated worker must not look
+                # page-rich to a Router that prefers page headroom
+                cap["free_pages"] = 0
+        return cap
 
     def start(self) -> int:
         if self._thread is not None:
@@ -434,7 +459,21 @@ class Router:
         with self._lock:
             def load(item):
                 rank, w = item
-                free = w.get("free_slots", 0) - self._debits.get(rank, 0)
+                # page headroom gates admission on the paged plane, but
+                # every admission ALSO needs a slot — min() folds both
+                # into request-capacity units, so a page-rich worker
+                # with one free slot can't outrank an idle slab worker,
+                # and the 1-per-route debit below subtracts in the same
+                # unit. Old announcements carrying only free_slots keep
+                # routing exactly as before — mixed fleets mid-rollout
+                # stay routable.
+                pages = w.get("free_pages")
+                slots_free = w.get("free_slots", 0)
+                if pages is None:
+                    free = slots_free
+                else:
+                    free = min(int(slots_free), int(pages))
+                free -= self._debits.get(rank, 0)
                 return (-free, w.get("queue_depth", 0), rank)
 
             rank, ann = min(pool.items(), key=load)

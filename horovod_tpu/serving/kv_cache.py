@@ -28,6 +28,11 @@ prefill`); on a mesh with a sequence axis the chunk attention could
 instead ride `parallel/ring_attention.py` — the cache layout is
 compatible (kv stream per slot), left as the documented long-context
 extension (docs/serving.md).
+
+This slab manager is now the A/B *baseline*: the default memory plane
+is the paged block pool + prefix cache in `paged_kv.py` (same slot
+API, HBM scaling with tokens in flight instead of slots × max_len);
+`create_kv_manager` below is where the engine picks.
 """
 
 from __future__ import annotations
@@ -41,6 +46,39 @@ from ..common.logging import get_logger
 from ..common.metrics import registry as _metrics
 
 _log = get_logger("serve.kv")
+
+
+def create_kv_manager(
+    cache_factory,
+    slots: int,
+    max_len: int,
+    *,
+    paged: bool = True,
+    page_tokens: int = 16,
+    num_pages: int = 0,
+    prefix_cache: bool = True,
+    watermark: int = -1,
+    mesh=None,
+    tp_axis: str = "tp",
+):
+    """The one place the engine picks its memory plane: the paged
+    block-pool manager (`paged_kv.PagedKVCacheManager`, the default —
+    HBM scales with pages/tokens-in-flight, prefix cache available) or
+    the PR 8 contiguous slab (`KVCacheManager`, the A/B baseline —
+    HBM scales with slots × max_len). Both speak the same slot API."""
+    if paged:
+        from .paged_kv import PagedKVCacheManager
+
+        return PagedKVCacheManager(
+            cache_factory, slots, max_len,
+            page_tokens=page_tokens, num_pages=num_pages,
+            prefix_cache=prefix_cache, watermark=watermark,
+            mesh=mesh, tp_axis=tp_axis,
+        )
+    return KVCacheManager(
+        cache_factory, slots=slots, max_len=max_len,
+        mesh=mesh, tp_axis=tp_axis,
+    )
 
 
 class KVCacheManager:

@@ -1,0 +1,484 @@
+"""Paged KV memory plane: fixed-size page block-pool + prefix cache.
+
+PR 8's `KVCacheManager` backs every decode slot with a contiguous
+``[slots, max_len, ...]`` slab, so HBM scales with ``slots × max_len``
+even when most sequences are short. This module replaces the slab with
+a **block pool** (the vLLM/Gemma-serving design, PAPERS.md arXiv
+2605.25645, re-derived for the repo's donated-carry invariants):
+
+* Physical storage is ``[num_pages, page_tokens, kv_heads, head_dim]``
+  per layer — ONE pytree, still the single donated carry through every
+  prefill/decode executable. Its size is set by ``num_pages`` (tokens
+  in flight), **independent of max_len**.
+* Each slot owns a **page table**: an int32 row of physical page ids,
+  one per ``page_tokens``-sized logical chunk of its sequence. Tables
+  are DATA fed to the fixed-shape executables (never shapes), so
+  admissions, evictions, page reuse and prefix sharing can never
+  retrace. Unallocated entries hold the sentinel ``num_pages`` — an
+  out-of-range index the in-JIT scatter drops and the gather clamps
+  into mask-unreachable garbage.
+* Allocation is **on write**: prefill takes the prompt's pages at
+  admission, decode takes one page each time a slot's frontier crosses
+  a page boundary. Freeing a retired slot is O(1) refcount
+  bookkeeping — NO zeroing, stale pages stay mask-unreachable exactly
+  like stale slab rows did (kv_cache.py docstring), and are fully
+  overwritten by their next owner's writes before any position in them
+  becomes attendable.
+
+On top of the pool sits the **prefix cache** — the PR 1/PR 8 two-tier
+exact/bucket *promotion* design reapplied to cache *content*: prompt
+token-chunks are chain-hashed per page boundary, finished prefill
+pages are published into a refcounted ``hash → page`` index, and a
+request sharing a cached prefix attaches those physical pages by
+pointer-write instead of recomputing their prefill chunks. Shared
+pages are immutable by construction (sequences are append-only and
+only FULLY-written pages are ever published or attached; the final
+prompt token is always recomputed so logits exist), with a
+copy-on-write guard for any future partial-page sharing. Index
+entries are LRU-evicted only at refcount 0 — i.e. only once no slot
+references the page.
+
+Admission control (`serving/batcher.py`) gates on free *pages* with a
+reserve watermark; `admission_headroom()` is the gate's single source
+of truth. Pool exhaustion mid-decode is survivable: the batcher pauses
+the youngest request (`detach_keep`/`reattach`) instead of raising.
+
+Memory model note (docs/serving.md "memory plane"): the *persistent*
+KV residency is the pool — that is what scales with pages. Each
+attention read still gathers a slot's pages into a transient
+contiguous view inside the executable (exact-parity dense attention);
+fusing the gather into a paged-attention kernel is the documented
+follow-up, orthogonal to this allocator.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.logging import get_logger
+from ..common.metrics import registry as _metrics
+from .kv_cache import KVCacheManager
+
+_log = get_logger("serve.paged")
+
+
+class PagePoolExhausted(RuntimeError):
+    """A decode step found slots whose next write has no page and the
+    pool could not supply one. The batcher catches this *before* it can
+    happen (``ensure_decode_pages`` + pause-youngest); seeing it raised
+    means the engine was driven directly past capacity."""
+
+    def __init__(self, slots: List[int]):
+        super().__init__(
+            f"page pool exhausted: no page for the next token of "
+            f"slots {slots}"
+        )
+        self.slots = list(slots)
+
+
+def page_hashes(prompt: np.ndarray, page_tokens: int) -> List[bytes]:
+    """Chained per-page digests of a prompt: ``h[i]`` commits to the
+    FULL prefix ``prompt[: (i+1) * page_tokens]`` (each digest chains
+    the previous one), so equal hashes ⇒ equal prefixes and a cached
+    page can never be attached under a different history. Only FULL
+    pages are hashed — a partial final chunk is never shareable."""
+    prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+    out: List[bytes] = []
+    h = b""
+    for i in range(prompt.size // page_tokens):
+        chunk = prompt[i * page_tokens:(i + 1) * page_tokens]
+        h = hashlib.sha256(h + chunk.tobytes()).digest()
+        out.append(h)
+    return out
+
+
+class PagedKVCacheManager(KVCacheManager):
+    """Block-pool KV manager behind the slot API the engine/batcher
+    already speak (`kv_cache.KVCacheManager`), plus the page-table /
+    prefix-cache surface the paged executables and the scheduler use.
+
+    Same threading contract as the slab manager: bookkeeping is
+    lock-guarded, the pool pytree itself is only touched by the
+    engine's compiled executables (single consumer: the batcher's step
+    loop)."""
+
+    def __init__(
+        self,
+        cache_factory,
+        slots: int,
+        max_len: int,
+        *,
+        page_tokens: int = 16,
+        num_pages: int = 0,
+        mesh=None,
+        tp_axis: str = "tp",
+        prefix_cache: bool = True,
+        watermark: int = -1,
+    ) -> None:
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        if page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+        if max_len % page_tokens:
+            # divisibility keeps the paged logical sequence EXACTLY
+            # max_len tokens long, so the paged attention runs the same
+            # shapes (and the same reductions) as the slab path — the
+            # bit-parity contract. Loud here, not wrong logits later.
+            raise ValueError(
+                f"page_tokens ({page_tokens}) must divide max_len "
+                f"({max_len}) — pick a divisor (the paged attention "
+                f"view must tile the slot exactly)"
+            )
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.page_tokens = int(page_tokens)
+        self.pages_per_slot = self.max_len // self.page_tokens
+        full_backing = self.slots * self.pages_per_slot
+        self.num_pages = int(num_pages) if num_pages else full_backing
+        if self.num_pages < 1:
+            raise ValueError(f"need at least one page, got {self.num_pages}")
+        # reserve watermark: pages admission must leave free so
+        # mid-decode allocation cannot strand in-flight sequences.
+        # auto (-1): zero at full backing (starvation impossible — every
+        # slot's worst case is covered), one page per slot otherwise
+        # (one decode round's worst-case frontier crossings).
+        if watermark < 0:
+            watermark = 0 if self.num_pages >= full_backing else self.slots
+        self.watermark = min(int(watermark), max(self.num_pages - 1, 0))
+        self.prefix_cache_enabled = bool(prefix_cache)
+        # the pool: same leaf structure as the slab (list of {"k","v"}),
+        # batch axis = pages, seq axis = page_tokens — init_cache's
+        # signature serves both layouts
+        self.cache = cache_factory(self.num_pages, self.page_tokens)
+        self.sharding = None
+        if mesh is not None and tp_axis in getattr(mesh, "axis_names", ()):
+            self.sharding = self._shard(mesh, tp_axis)
+        self._lock = threading.Lock()
+        self._owner: Dict[int, object] = {}
+        self._lengths = np.zeros(self.slots, np.int32)
+        # sentinel == num_pages: out of range, so in-JIT writes drop
+        # and gathers clamp into masked garbage
+        self.sentinel = self.num_pages
+        self._tables = np.full(
+            (self.slots, self.pages_per_slot), self.sentinel, np.int32
+        )
+        self._free: "collections.deque[int]" = collections.deque(
+            range(self.num_pages)
+        )
+        self._ref = np.zeros(self.num_pages, np.int32)
+        # prefix index: hash -> physical page, LRU-ordered (move_to_end
+        # on every hit); _page_hash is the reverse map for eviction
+        self._index: "collections.OrderedDict[bytes, int]" = (
+            collections.OrderedDict()
+        )
+        self._page_hash: Dict[int, bytes] = {}
+        # incremental count of index entries whose page is held ONLY
+        # by the index (ref == 1) — the reclaimable pool. Maintained at
+        # every ref transition of an indexed page so the admission gate
+        # and /healthz never rescan the index (O(1), not O(entries)).
+        self._reclaimable = 0
+        self._counters = collections.Counter()
+
+    # ----------------------------------------------------------- page pool
+
+    def _alloc_page_locked(self) -> Optional[int]:
+        """One free page, evicting LRU refcount-0 index entries if the
+        free list is dry. Caller holds the lock."""
+        if self._free:
+            return self._free.popleft()
+        # LRU sweep: an index entry whose page is referenced ONLY by
+        # the index (ref == 1) is reclaimable; entries still attached
+        # to live slots are skipped — eviction only at refcount 0
+        for h in list(self._index):
+            page = self._index[h]
+            if self._ref[page] == 1:
+                del self._index[h]
+                del self._page_hash[page]
+                self._ref[page] = 0
+                self._reclaimable -= 1
+                self._counters["page_evictions"] += 1
+                return page
+        return None
+
+    def _unref_locked(self, page: int) -> None:
+        self._ref[page] -= 1
+        if self._ref[page] == 1 and page in self._page_hash:
+            self._reclaimable += 1
+        if self._ref[page] <= 0:
+            # published pages always keep the index's own hold, so a
+            # zero refcount means nobody (slot or index) wants it
+            self._ref[page] = 0
+            self._free.append(page)
+
+    def free_pages_available(self) -> int:
+        """Free-list pages plus index entries reclaimable right now
+        (refcount 0 once the index's own hold is dropped)."""
+        with self._lock:
+            return len(self._free) + self._reclaimable
+
+    def admission_headroom(self) -> int:
+        """Pages the admission gate may spend: available minus the
+        reserve watermark. THE number `/healthz`, the KV announcement
+        and the batcher's gate all read."""
+        return max(self.free_pages_available() - self.watermark, 0)
+
+    def pages_needed(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_tokens)
+
+    def ensure_pages(
+        self, slot: int, upto: int, write_from: int = 0,
+        start_page: int = 0,
+    ) -> bool:
+        """Allocate logical pages so positions ``[0, upto)`` are
+        mapped, and make every page that will be WRITTEN (covering
+        positions >= ``write_from``) exclusively owned — a shared page
+        in the write range is copied first (copy-on-write). Returns
+        False when the pool cannot supply a page; allocations made so
+        far stay owned by the slot (freed with it). ``start_page``
+        skips logical pages the caller KNOWS are already mapped (the
+        decode sweep's frontier fast path — don't rescan a long
+        sequence's whole table every token)."""
+        needed = self.pages_needed(upto)
+        first_write = int(write_from) // self.page_tokens
+        for lp in range(start_page, min(needed, self.pages_per_slot)):
+            with self._lock:
+                phys = int(self._tables[slot, lp])
+                if phys == self.sentinel:
+                    page = self._alloc_page_locked()
+                    if page is None:
+                        return False
+                    self._tables[slot, lp] = page
+                    self._ref[page] = 1
+                    self._counters["page_allocs"] += 1
+                    continue
+                shared = lp >= first_write and self._ref[phys] > 1
+            if shared and not self._cow(slot, lp):
+                return False
+        return True
+
+    def _cow(self, slot: int, lp: int) -> bool:
+        """Copy-on-write: give ``slot`` a private copy of logical page
+        ``lp`` before it writes into it. Never taken by the shipped
+        sharing policy (only full, immutable pages are shared and the
+        last prompt token is always recomputed) — this is the safety
+        valve that keeps any future partial-page sharing correct. The
+        copy is one eager device op on the pool (outside the compiled
+        step; the manager re-binds ``self.cache`` like the executables
+        do)."""
+        import jax
+
+        with self._lock:
+            old = int(self._tables[slot, lp])
+            new = self._alloc_page_locked()
+            if new is None:
+                return False
+            self._tables[slot, lp] = new
+            self._ref[new] = 1
+            self._unref_locked(old)
+            self._counters["page_cow"] += 1
+        self.cache = jax.tree_util.tree_map(
+            lambda leaf: leaf.at[new].set(leaf[old]), self.cache
+        )
+        return True
+
+    def ensure_decode_pages(self) -> List[int]:
+        """Pre-decode allocation sweep: every active slot's next write
+        position (its length) must land in an owned page. Returns the
+        slots that could NOT be supplied — the batcher's cue to pause
+        the youngest request rather than let the step raise."""
+        starved: List[int] = []
+        with self._lock:
+            active = sorted(self._owner)
+        for slot in active:
+            n = int(self._lengths[slot])
+            if n >= self.max_len:
+                continue  # full slot: retires this round, writes drop
+            # pages below the frontier are mapped by the slot's own
+            # prefill/decode history — only the frontier page can need
+            # a page, so start the scan there (O(1) per slot per step)
+            if not self.ensure_pages(
+                slot, n + 1, write_from=n,
+                start_page=n // self.page_tokens,
+            ):
+                starved.append(slot)
+        return starved
+
+    # -------------------------------------------------------- prefix cache
+
+    def lookup_prefix(self, hashes: List[bytes]) -> List[int]:
+        """Longest cached run of leading full pages: physical ids for
+        ``hashes[0..k-1]``, stopping at the first miss. Touches LRU
+        recency on every hit."""
+        self._counters["prefix_lookups"] += 1
+        if not self.prefix_cache_enabled:
+            return []
+        out: List[int] = []
+        with self._lock:
+            for h in hashes:
+                page = self._index.get(h)
+                if page is None:
+                    break
+                self._index.move_to_end(h)
+                out.append(page)
+        return out
+
+    def attach_prefix(self, slot: int, pages: List[int]) -> None:
+        """Point the slot's leading page-table entries at cached
+        physical pages — the prefill those pages carry is skipped
+        entirely. Refcounts pin the pages for the slot's lifetime."""
+        with self._lock:
+            for lp, page in enumerate(pages):
+                if self._tables[slot, lp] != self.sentinel:
+                    raise ValueError(
+                        f"slot {slot} logical page {lp} already mapped"
+                    )
+                self._tables[slot, lp] = page
+                if self._ref[page] == 1:
+                    # was index-only: a slot hold makes it unreclaimable
+                    self._reclaimable -= 1
+                self._ref[page] += 1
+            self._counters["prefix_hits"] += len(pages)
+            if pages:
+                self._counters["prefix_hit_requests"] += 1
+
+    def publish_prefix(self, slot: int, hashes: List[bytes]) -> None:
+        """After a prefill completes, publish the slot's full prompt
+        pages into the index (first publisher wins — an existing entry
+        for the same hash keeps its page). The index takes its own
+        refcount hold, so a published page survives its slot."""
+        if not self.prefix_cache_enabled:
+            return
+        with self._lock:
+            for lp, h in enumerate(hashes[: self.pages_per_slot]):
+                phys = int(self._tables[slot, lp])
+                if phys == self.sentinel or h in self._index:
+                    continue
+                self._index[h] = phys
+                self._index.move_to_end(h)
+                self._page_hash[phys] = h
+                self._ref[phys] += 1
+                self._counters["prefix_published"] += 1
+
+    # ------------------------------------------------- pause/resume surface
+
+    def detach_keep(self, slot: int) -> Tuple[List[Tuple[int, int]], int]:
+        """Pause support: release the SLOT but keep its pages alive
+        under the request's own refcount holds. Returns
+        ``(kept, length)`` where ``kept`` is ``[(logical, physical)]``
+        for :meth:`reattach` — the refcounts transfer to the caller, so
+        nothing is freed and nothing can be reused underneath it."""
+        with self._lock:
+            kept = [
+                (lp, int(p))
+                for lp, p in enumerate(self._tables[slot])
+                if p != self.sentinel
+            ]
+            length = int(self._lengths[slot])
+            self._tables[slot] = self.sentinel
+            self._lengths[slot] = 0
+            self._owner.pop(slot, None)
+        self._publish()
+        return kept, length
+
+    def reattach(
+        self, slot: int, kept: List[Tuple[int, int]], length: int
+    ) -> None:
+        """Resume a paused request into a (freshly allocated) slot: the
+        kept pages slot back into the table at their logical positions
+        and decode continues where it stopped — no re-prefill."""
+        with self._lock:
+            for lp, page in kept:
+                self._tables[slot, lp] = page
+        self.set_length(slot, length)
+        self._publish()
+
+    def release_kept(self, kept: List[Tuple[int, int]]) -> None:
+        """Drop a paused request's page holds (deadline-aware reclaim,
+        or the request expired in the queue). The request must
+        re-prefill on resume; its published prefix pages may still hit."""
+        with self._lock:
+            for _, page in kept:
+                self._unref_locked(page)
+        self._publish()
+
+    # ------------------------------------------------------ slot API (base)
+
+    def alloc(self, owner=None) -> Optional[int]:
+        with self._lock:
+            for slot in range(self.slots):
+                if slot not in self._owner:
+                    self._owner[slot] = owner
+                    self._lengths[slot] = 0
+                    break
+            else:
+                return None
+        self._publish()
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Retire a slot: O(1) per page — refcounts drop, pages whose
+        count reaches zero return to the free list, pages pinned by the
+        prefix index (or another slot) live on. No cache writes."""
+        with self._lock:
+            if slot not in self._owner:
+                return
+            del self._owner[slot]
+            for lp in range(self.pages_per_slot):
+                phys = int(self._tables[slot, lp])
+                if phys != self.sentinel:
+                    self._unref_locked(phys)
+                self._tables[slot, lp] = self.sentinel
+            self._lengths[slot] = 0
+        self._publish()
+
+    def table_row(self, slot: int) -> np.ndarray:
+        """The slot's page-table row (a copy — executable inputs can't
+        alias bookkeeping, same contract as ``lengths_array``)."""
+        return self._tables[slot].copy()
+
+    def tables_array(self) -> np.ndarray:
+        """[slots, pages_per_slot] int32 for the decode step (a copy)."""
+        return self._tables.copy()
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            active_slots = len(self._owner)
+            free = len(self._free)
+            reclaimable = self._reclaimable
+            counters = dict(self._counters)
+            index_entries = len(self._index)
+        lookups = counters.get("prefix_lookups", 0)
+        out = {
+            "slots_total": self.slots,
+            "slots_active": active_slots,
+            "slots_free": self.slots - active_slots,
+            "kv_max_len": self.max_len,
+            "page_tokens": self.page_tokens,
+            "pages_total": self.num_pages,
+            "pages_free": free,
+            "pages_cached": reclaimable,
+            "pages_active": self.num_pages - free - reclaimable,
+            "page_watermark": self.watermark,
+            "prefix_index_entries": index_entries,
+            "prefix_hit_rate": (
+                counters.get("prefix_hit_requests", 0) / lookups
+                if lookups
+                else 0.0
+            ),
+        }
+        for key in (
+            "page_allocs", "page_evictions", "page_cow", "prefix_hits",
+            "prefix_hit_requests", "prefix_lookups", "prefix_published",
+        ):
+            out[key] = counters.get(key, 0)
+        return out
+
+    def _publish(self) -> None:
+        _metrics.update("serve", self.stats())

@@ -9,8 +9,12 @@ capacity announcements against a driver-hosted RendezvousServer), then:
    and asserts every completion, plus that the load actually spread
    across both workers;
 2. scrapes each worker's live ``/metrics`` and asserts the TTFT/TPOT
-   summary quantiles and the slot-occupancy/queue gauges;
-3. fires a burst of in-flight requests, SIGTERMs both workers
+   summary quantiles and the slot-occupancy/queue/page gauges;
+3. sends a shared-prefix burst (same system prompt, distinct tails) to
+   ONE worker and asserts ``hvd_serve_prefix_hits`` > 0 on its live
+   ``/metrics`` scrape — the paged memory plane's prefix cache can't
+   silently rot;
+4. fires a burst of in-flight requests, SIGTERMs both workers
    mid-service, and asserts the drain contract: every ACCEPTED request
    completes with its full token budget, both workers exit 143.
 
@@ -162,7 +166,7 @@ def main() -> int:
         print(f"phase 1 OK: {len(prompts)} completions, "
               f"spread {per_worker}")
 
-        # ---- phase 2: SLO quantiles + slot gauges on the live scrape
+        # ---- phase 2: SLO quantiles + slot/page gauges on the live scrape
         for rank, p in ports.items():
             text = _get_text(f"http://127.0.0.1:{p}/metrics")
             for needle in (
@@ -174,13 +178,47 @@ def main() -> int:
                 "hvd_serve_slots_free",
                 "hvd_serve_queue_depth",
                 "hvd_serve_tokens_out",
+                "hvd_serve_pages_total",
+                "hvd_serve_pages_free",
             ):
                 assert needle in text, (
                     f"worker {rank} /metrics missing {needle!r}:\n"
                     + text[:800]
                 )
             assert "NaN" not in text
-        print("phase 2 OK: TTFT/TPOT quantiles + slot gauges scraped")
+        # /healthz carries the page headroom the Router now prefers
+        h = _get_json(f"http://127.0.0.1:{ports[0]}/healthz")
+        assert "free_pages" in h and h["pages_total"] > 0, h
+        print("phase 2 OK: TTFT/TPOT quantiles + slot/page gauges scraped")
+
+        # ---- phase 2.5: shared-prefix burst → prefix-cache hits
+        # (all to ONE worker so the shared pages are actually local)
+        sys_prefix = [7, 11, 13, 17, 19, 23, 29, 31] * 2  # one full page
+        tails = [[41, 43], [47, 53, 2], [3, 5]]
+        for tail in tails:
+            body = json.dumps(
+                {"tokens": sys_prefix + tail, "max_tokens": 4}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ports[0]}/generate",
+                data=body, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                out = json.load(resp)
+            assert out["status"] == "done", out
+        text = _get_text(f"http://127.0.0.1:{ports[0]}/metrics")
+        hits = 0.0
+        for line in text.splitlines():
+            if line.startswith("hvd_serve_prefix_hits "):
+                hits = float(line.split()[1])
+        assert hits > 0, (
+            "shared-prefix burst produced no prefix hits:\n"
+            + "\n".join(
+                ln for ln in text.splitlines() if "prefix" in ln
+            )
+        )
+        print(f"phase 2.5 OK: shared-prefix burst hit the prefix cache "
+              f"({int(hits)} pages attached)")
 
         # ---- phase 3: SIGTERM drain — every accepted request finishes
         burst = [[5, 6], [7, 8, 9], [1] * 12, [2, 3, 4, 5]]
