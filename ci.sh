@@ -49,7 +49,7 @@ lint() {
   step "lint: pyflakes-level check via python -m compileall + import"
   python -m compileall -q horovod_tpu tests bench.py bench_lm.py \
     bench_allreduce.py bench_serve.py bench_zero.py bench_hier.py \
-    __graft_entry__.py
+    bench_moe.py __graft_entry__.py
   # ruff/flake8 aren't in the image; compile + import-sanity is the
   # supported floor. Import must succeed without TPU hardware.
   JAX_PLATFORMS=cpu python -c "import horovod_tpu"
@@ -121,6 +121,14 @@ bench_smoke() {
   for leg in ab_flat ab_hier ab_hier_int8; do
     test -s "$art_dir/hier_${leg}.json" \
       || { echo "missing artifact: hier_${leg}.json" >&2; exit 1; }
+  done
+  step "bench-smoke: bench_moe.py dryrun (expert-wire A/B + DCN-byte + capacity-tuner gates)"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
+    python bench_moe.py
+  for leg in ab_flat ab_hier_int8 ab_captuned; do
+    test -s "$art_dir/moe_${leg}.json" \
+      || { echo "missing artifact: moe_${leg}.json" >&2; exit 1; }
   done
   step "bench-smoke: bench_serve.py dryrun (static-vs-continuous + paged-KV + prefix-cache A/B)"
   JAX_PLATFORMS=cpu \

@@ -251,12 +251,56 @@ class _GoodputBandit:
     ``trials`` exploration visits round-robin, then argmax. A bandit,
     not a GP: these decisions are small discrete menus, where the GP's
     machinery buys nothing (it remains the right tool for the
-    continuous (threshold, cycle) box above)."""
+    continuous (threshold, cycle) box above).
+
+    Observations are durable: :meth:`state_dict` /
+    :meth:`load_state_dict` serialize them, and the module-level
+    :func:`warm_start` / :func:`persist` pair keys the file by
+    (tuner name, topology fingerprint) under ``HOROVOD_TUNER_CACHE``
+    so a fleet explores once instead of per-process per-run — the
+    per-hop keyspaces (PR 10's (bucket-tier, hop), PR 12's
+    (alltoall, hop)) made cold-start strictly more expensive."""
 
     def __init__(self, trials: int = 3):
         self.trials = max(int(trials), 1)
         # (key, candidate) -> [useful_bytes_total, seconds_total, n]
         self._obs = {}
+
+    # -- persistence --------------------------------------------------
+
+    def state_dict(self) -> dict:
+        """JSON-serializable snapshot of every observation. Keys are
+        tuples of str/int/float (the tuners' contract) — encoded as
+        lists and rebuilt as tuples on load."""
+        return {
+            "trials": self.trials,
+            "obs": [
+                [list(key) if isinstance(key, tuple) else [key],
+                 cand, s[0], s[1], s[2]]
+                for (key, cand), s in self._obs.items()
+            ],
+        }
+
+    def load_state_dict(self, state: dict) -> int:
+        """Merge a snapshot back in (existing observations win — live
+        measurements beat stale disk state). Returns the number of
+        (key, candidate) entries adopted; malformed entries are
+        skipped — a corrupt cache must never break the tuner."""
+        adopted = 0
+        for row in state.get("obs", ()):
+            try:
+                key_list, cand, by, secs, n = row
+                key = tuple(key_list)
+                if isinstance(cand, list):
+                    cand = tuple(cand)
+                entry = (key, cand)
+                if entry in self._obs:
+                    continue
+                self._obs[entry] = [float(by), float(secs), int(n)]
+                adopted += 1
+            except (TypeError, ValueError):
+                continue
+        return adopted
 
     def _stats(self, key, cand):
         return self._obs.setdefault((key, cand), [0.0, 0.0, 0])
@@ -399,3 +443,326 @@ class OverlapTuner(_GoodputBandit):
 
     def choose(self, step_key, total_bytes: int) -> int:
         return self._choose_among(step_key, self.viable(total_bytes))
+
+
+class CapacityTuner(_GoodputBandit):
+    """Online choice of the MoE dispatch's ``capacity_factor``
+    (``parallel/moe.py``) by KEPT-token goodput, fed by the per-expert
+    load counters the dispatch already produces (``MoEStats``): a
+    higher factor drops fewer tokens but pays a proportionally larger
+    dispatch buffer (wire bytes, expert pad FLOPs); a lower one is
+    cheap until hot experts overflow — and hot experts ARE stragglers,
+    so the drop counters are the load-imbalance signal the byte model
+    cannot rank a priori. Scoring kept tokens per second of step wall
+    time lets the measurement settle it, exactly the OverlapTuner's
+    reasoning — and like the bucket count, capacity is a COMPILE-TIME
+    shape: the step harness times a few honestly-synced steps per
+    candidate across recompiles (bench_moe.py ``ab_captuned`` shows
+    the loop), never inside one compiled step.
+
+    ``observe_load`` additionally folds the raw histogram into
+    per-candidate drop-rate / imbalance summaries, which ``choose``
+    uses as a hard prior: a candidate whose measured drop rate exceeds
+    ``max_drop_rate`` after its trials is never exploited — dropped
+    tokens are silently-degraded model quality, not just lost goodput.
+    The same summaries feed the per-rank expert-load publications
+    through the rendezvous KV (elastic/worker.py publish_expert_load).
+    """
+
+    CANDIDATES = (1.0, 1.25, 1.5, 2.0)
+
+    def __init__(
+        self,
+        trials: int = 3,
+        candidates=None,
+        max_drop_rate: float = 0.2,
+    ):
+        super().__init__(trials=trials)
+        self.candidates = tuple(
+            candidates if candidates is not None else self.CANDIDATES
+        )
+        self.max_drop_rate = float(max_drop_rate)
+        # (key, cand) -> [dropped_total, routed_total, hot_max, n_loads]
+        self._loads = {}
+
+    def observe_load(
+        self, key, cand, expert_tokens, dropped: float, total: float,
+        seconds: Optional[float] = None,
+    ) -> None:
+        """One step's load counters for (key, candidate):
+        ``expert_tokens`` is the kept-token histogram ([E_total]),
+        ``dropped``/``total`` the overflow and routed counts
+        (``MoEStats`` fields, host floats). With ``seconds`` the call
+        also feeds the goodput ledger (kept tokens as the useful
+        quantity)."""
+        tokens = [float(t) for t in expert_tokens]
+        s = self._loads.setdefault(
+            (key, cand), [0.0, 0.0, 0.0, 0, max(len(tokens), 1)]
+        )
+        s[0] += float(dropped)
+        s[1] += float(total)
+        s[2] = max(s[2], max(tokens, default=0.0))
+        s[3] += 1
+        s[4] = max(s[4], len(tokens))
+        if seconds is not None:
+            kept = float(total) - float(dropped)
+            self.record(key, cand, kept, seconds)
+
+    def drop_rate(self, key, cand) -> float:
+        s = self._loads.get((key, cand))
+        if not s or s[1] <= 0:
+            return 0.0
+        return s[0] / s[1]
+
+    def imbalance(self, key, cand) -> float:
+        """Hottest-expert load as a multiple of the per-step PER-EXPERT
+        mean kept tokens — the hot-experts-are-stragglers meter (1.0 =
+        perfectly balanced)."""
+        s = self._loads.get((key, cand))
+        if not s or s[3] == 0 or s[1] <= s[0]:
+            return 1.0
+        mean_kept = (s[1] - s[0]) / s[3] / max(s[4], 1)
+        if mean_kept <= 0:
+            return 1.0
+        return s[2] / mean_kept
+
+    def choose(self, key) -> float:
+        cands = [
+            c
+            for c in self.candidates
+            if self.needs_trial(key, c)
+            or self.drop_rate(key, c) <= self.max_drop_rate
+        ]
+        if not cands:
+            # every candidate overflows past the bound: take the
+            # largest buffer — it drops least
+            return max(self.candidates)
+        return self._choose_among(key, tuple(cands))
+
+    def state_dict(self) -> dict:
+        d = super().state_dict()
+        d["loads"] = [
+            [list(key) if isinstance(key, tuple) else [key],
+             cand, s[0], s[1], s[2], s[3], s[4]]
+            for (key, cand), s in self._loads.items()
+        ]
+        return d
+
+    def load_state_dict(self, state: dict) -> int:
+        adopted = super().load_state_dict(state)
+        for row in state.get("loads", ()):
+            try:
+                key_list, cand, dropped, total, hot, n, ne = row
+                entry = (tuple(key_list), cand)
+                if entry in self._loads:
+                    continue
+                self._loads[entry] = [
+                    float(dropped), float(total), float(hot), int(n),
+                    int(ne),
+                ]
+            except (TypeError, ValueError):
+                continue
+        return adopted
+
+
+# ---------------------------------------------------------------------------
+# Persistent tuner state (HOROVOD_TUNER_CACHE, ROADMAP item 1a).
+#
+# Exploration is the expensive half of a bandit whose keyspace grew
+# per-hop (PR 10) and per-collective-family (PR 12): every process of
+# every run used to pay `trials` deliberately-slow synchronized
+# dispatches per (key, candidate). Persisting the observations keyed by
+# (tuner name, topology fingerprint) lets a restarted — or freshly
+# scheduled — job start from the fleet's measurements and skip straight
+# to exploitation. The fingerprint pins everything that changes what a
+# measurement MEANS: world size, the two-level split, and the backend.
+# ---------------------------------------------------------------------------
+
+
+def topology_fingerprint() -> str:
+    """``w<world>-l<intra>-<platform>`` of the current process — the
+    cache key namespace for persisted tuner state. Falls back to the
+    env contract before hvd.init (trace-time tuners may run first)."""
+    import jax
+
+    from . import basics as _basics
+    from .config import Config
+    from .topology import detect_intra_size
+
+    if _basics.is_initialized():
+        topo = _basics.state().topology
+        world = topo.size
+        intra = topo.intra_size
+    else:
+        cfg = Config.from_env()
+        world = cfg.size or len(jax.devices())
+        intra = detect_intra_size(
+            jax.devices(), jax.local_device_count(), jax.process_count()
+        )
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+    return f"w{world}-l{intra}-{platform}"
+
+
+def tuner_cache_path(
+    name: str, fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+) -> Optional[str]:
+    """The persisted-state file for one tuner, or None when no cache
+    directory is configured (HOROVOD_TUNER_CACHE / explicit base)."""
+    import os
+
+    if base is None:
+        base = os.environ.get("HOROVOD_TUNER_CACHE") or None
+    if not base:
+        return None
+    if fingerprint is None:
+        fingerprint = topology_fingerprint()
+    return os.path.join(base, f"{name}-{fingerprint}.json")
+
+
+def warm_start(
+    tuner: _GoodputBandit, name: str,
+    fingerprint: Optional[str] = None, base: Optional[str] = None,
+) -> int:
+    """Load persisted observations into ``tuner`` (existing live
+    entries win). Returns the number of entries adopted; 0 when no
+    cache is configured, the file is absent, or it is corrupt — warm
+    start is best-effort by design, cold start is always correct."""
+    import json
+    import os
+
+    path = tuner_cache_path(name, fingerprint, base)
+    if not path or not os.path.exists(path):
+        return 0
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    if not isinstance(state, dict):
+        return 0
+    n = tuner.load_state_dict(state)
+    if n:
+        from .metrics import registry as _metrics
+
+        _metrics.counter("autotune.warm_started", n)
+    return n
+
+
+def _merge_rows(own, disk):
+    """Union of state rows keyed by (key, candidate) — the tuner's own
+    rows win. Rows are ``[key_list, cand, ...]``."""
+    def _k(row):
+        cand = row[1]
+        return (tuple(row[0]), tuple(cand) if isinstance(cand, list) else cand)
+
+    seen = {_k(r) for r in own}
+    return list(own) + [r for r in disk if _k(r) not in seen]
+
+
+def persist(
+    tuner: _GoodputBandit, name: str,
+    fingerprint: Optional[str] = None, base: Optional[str] = None,
+) -> Optional[str]:
+    """Write ``tuner``'s observations to the cache (tmp+rename — a
+    killed process can never leave a torn file), MERGED with whatever
+    is already on disk (rows this tuner never saw are kept; its own
+    rows win): several tuners legitimately share one file — the fused
+    dispatcher's WireTuner (allreduce keys) and the trace-time shared
+    tuner (alltoall keys) both persist under ``wire`` — and a plain
+    overwrite would have the last atexit writer discard the other's
+    run. Returns the path, or None when no cache is configured / the
+    write failed (best-effort: persistence must never take a training
+    loop down)."""
+    import json
+    import os
+    import tempfile
+
+    path = tuner_cache_path(name, fingerprint, base)
+    if not path:
+        return None
+    state = tuner.state_dict()
+    try:
+        with open(path) as f:
+            disk = json.load(f)
+    except (OSError, ValueError):
+        disk = None
+    if isinstance(disk, dict):
+        for field in ("obs", "loads"):
+            if field in state or field in disk:
+                state[field] = _merge_rows(
+                    state.get(field, []), disk.get(field, [])
+                )
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        return None
+    return path
+
+
+_shared_wire_tuner: Optional[WireTuner] = None
+
+
+def shared_wire_tuner() -> WireTuner:
+    """The process-wide WireTuner for TRACE-TIME wire decisions (the
+    MoE alltoall's ``(alltoall, payload-bucket, dtype, hop)`` keys —
+    compile-time choices consulted while tracing, unlike the fusion
+    manager's per-dispatch instance). Warm-started from
+    HOROVOD_TUNER_CACHE on first use and persisted at exit alongside
+    it (same ``wire`` namespace: the keyspaces are disjoint by
+    construction — (alltoall, ...) vs (allreduce, ...) — so one file
+    serves both)."""
+    global _shared_wire_tuner
+    if _shared_wire_tuner is None:
+        from .config import Config
+
+        cfg = Config.from_env()
+        _shared_wire_tuner = WireTuner(
+            min_int8_bytes=cfg.fusion_wire_min_bytes
+        )
+        warm_start(_shared_wire_tuner, "wire")
+        register_persist_at_exit(_shared_wire_tuner, "wire")
+    return _shared_wire_tuner
+
+
+_persist_registry = []
+_persist_hook_installed = [False]
+
+
+def register_persist_at_exit(tuner: _GoodputBandit, name: str) -> None:
+    """Arrange for ``tuner`` to be persisted at interpreter exit (one
+    atexit hook for every registered tuner; no-ops without a cache
+    dir). Registration is idempotent per (id(tuner), name)."""
+    import atexit
+
+    entry = (id(tuner), name)
+    if any(e == entry for e, _ in _persist_registry):
+        return
+    _persist_registry.append((entry, (tuner, name)))
+    if not _persist_hook_installed[0]:
+        _persist_hook_installed[0] = True
+
+        def _flush():
+            for _, (t, n) in list(_persist_registry):
+                try:
+                    persist(t, n)
+                except Exception:
+                    pass
+
+        atexit.register(_flush)

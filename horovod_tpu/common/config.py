@@ -52,6 +52,15 @@ DEFAULT_SERVE_KV_SLOTS = 8
 DEFAULT_SERVE_MAX_BATCH = 4
 DEFAULT_SERVE_MAX_TOKENS = 64
 DEFAULT_SERVE_DEADLINE_MS = 0.0  # 0 = no deadline
+# Expert wire (parallel/moe.py, PR 12): dispatch/return wire format,
+# ICI-leg format under a two-level split, block-scale granularity of
+# the int8 alltoall, and the default capacity factor (the static
+# per-destination buffer size; CapacityTuner can drive it per step
+# harness instead of leaving it hand-set).
+DEFAULT_MOE_WIRE = "fp32"
+DEFAULT_MOE_INTRA_WIRE = "fp32"
+DEFAULT_MOE_WIRE_BLOCK = 512
+DEFAULT_MOE_CAPACITY_FACTOR = 1.25
 # Serving memory plane (serving/paged_kv.py): tokens per KV page, pool
 # size in pages (0 = auto: full backing, slots × max_len ÷ page_tokens
 # — undersubscribe explicitly to make HBM scale with tokens in
@@ -204,9 +213,30 @@ class Config:
     # overhead outweighs any overlap win under the floor
     overlap_min_bytes: int = 1 << 20
 
+    # --- expert wire (parallel/moe.py) ---
+    # dispatch/return wire of the MoE alltoall: fp32 (payload width),
+    # bf16, int8 (block-scaled quantized, ops/traced.py
+    # quantized_alltoall), or auto (trace-time choice through the
+    # shared WireTuner's (alltoall, hop) keys). Under a two-level
+    # split (HOROVOD_HIERARCHICAL) this names the INTER (DCN) hop.
+    moe_wire: str = DEFAULT_MOE_WIRE
+    # ICI-leg format of the two-level expert dispatch (never int8 —
+    # the quant tax cannot pay for itself inside a slice)
+    moe_intra_wire: str = DEFAULT_MOE_INTRA_WIRE
+    # elements per block scale on the int8 expert wire
+    moe_wire_block: int = DEFAULT_MOE_WIRE_BLOCK
+    # default capacity factor of the switch-MoE dispatch buffer
+    # (explicit capacity_factor= per call wins)
+    moe_capacity_factor: float = DEFAULT_MOE_CAPACITY_FACTOR
+
     # --- autotune ---
     autotune: bool = False
     autotune_log: Optional[str] = None
+    # directory for persistent tuner state (common/autotune.py):
+    # WireTuner / OverlapTuner / CapacityTuner observations serialize
+    # here keyed by (tuner name, topology fingerprint) and warm-start
+    # exploration across runs. None = in-memory only.
+    tuner_cache: Optional[str] = None
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
     autotune_bayes_opt_max_samples: int = 20
@@ -385,8 +415,25 @@ class Config:
             overlap_min_bytes=_env_int(
                 "HOROVOD_OVERLAP_MIN_BYTES", 1 << 20
             ),
+            moe_wire=_env_choice(
+                "HOROVOD_MOE_WIRE",
+                DEFAULT_MOE_WIRE,
+                ("fp32", "bf16", "int8", "auto"),
+            ),
+            moe_intra_wire=_env_choice(
+                "HOROVOD_MOE_INTRA_WIRE",
+                DEFAULT_MOE_INTRA_WIRE,
+                ("fp32", "bf16"),
+            ),
+            moe_wire_block=_env_int(
+                "HOROVOD_MOE_WIRE_BLOCK", DEFAULT_MOE_WIRE_BLOCK
+            ),
+            moe_capacity_factor=_env_float(
+                "HOROVOD_MOE_CAPACITY_FACTOR", DEFAULT_MOE_CAPACITY_FACTOR
+            ),
             autotune=_env_bool("HOROVOD_AUTOTUNE"),
             autotune_log=env.get("HOROVOD_AUTOTUNE_LOG"),
+            tuner_cache=env.get("HOROVOD_TUNER_CACHE") or None,
             autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),
             autotune_steps_per_sample=_env_int(
                 "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10

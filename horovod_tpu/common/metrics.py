@@ -58,6 +58,38 @@ HIERARCHY_METRICS = (
     "driver.rebalance.updates",
 )
 
+# Expert-wire metric families (PR 12 — parallel/moe.py +
+# ops/fusion.py eager alltoall). Emitters: the fusion manager's flush
+# (alltoall.*, cumulative — closes the observability gap where eager
+# alltoall dispatches were counted in cache_stats but never reached a
+# legend or the flight recorder) and :func:`publish_moe` (moe.*, the
+# step harness / serving loop publishes the MoEStats counters plus the
+# capacity decision in force). Kept here as the single legend so
+# dashboards and tests never re-derive the spelling:
+#   alltoall.dispatches       eager alltoall executor invocations
+#                             (counter)
+#   alltoall.wire_bytes       cumulative (n-1)/n-model bytes those
+#                             dispatches moved (counter)
+#   moe.dropped_tokens        tokens past the capacity gate (counter)
+#   moe.routed_tokens         live tokens routed (counter)
+#   moe.expert_tokens_max     hottest expert's kept tokens, last step
+#                             (gauge)
+#   moe.imbalance             hottest / mean kept tokens (gauge; 1.0 =
+#                             balanced — hot experts ARE stragglers)
+#   moe.drop_rate             dropped / routed, last step (gauge)
+#   moe.capacity_factor       the factor in force (gauge; the
+#                             CapacityTuner's decision when tuned)
+MOE_METRICS = (
+    "alltoall.dispatches",
+    "alltoall.wire_bytes",
+    "moe.dropped_tokens",
+    "moe.routed_tokens",
+    "moe.expert_tokens_max",
+    "moe.imbalance",
+    "moe.drop_rate",
+    "moe.capacity_factor",
+)
+
 # Training-state integrity metric families (PR 7 — the names the
 # runbook in docs/robustness.md documents; emitters: common/guard.py,
 # audit.py, checkpoint.py, elastic/driver.py). Kept here as the single
@@ -259,6 +291,33 @@ class MetricsRegistry:
 
 
 registry = MetricsRegistry()
+
+
+def publish_moe(
+    expert_tokens,
+    dropped: float,
+    total: float,
+    capacity_factor: Optional[float] = None,
+) -> None:
+    """Publish one step's expert-load counters (``moe.*`` — the
+    MOE_METRICS legend) from a fetched ``MoEStats``: the step harness
+    or serving loop calls this with host floats, so it costs no device
+    sync of its own. Counters accumulate (dropped/routed); the
+    histogram summaries and capacity decision are gauges."""
+    tokens = [float(t) for t in expert_tokens]
+    hot = max(tokens, default=0.0)
+    kept = float(total) - float(dropped)
+    mean = kept / len(tokens) if tokens and kept > 0 else 0.0
+    registry.counter("moe.dropped_tokens", float(dropped))
+    registry.counter("moe.routed_tokens", float(total))
+    registry.gauge("moe.expert_tokens_max", hot)
+    registry.gauge("moe.imbalance", hot / mean if mean > 0 else 1.0)
+    registry.gauge(
+        "moe.drop_rate",
+        float(dropped) / float(total) if float(total) > 0 else 0.0,
+    )
+    if capacity_factor is not None:
+        registry.gauge("moe.capacity_factor", float(capacity_factor))
 
 
 def publish_overlap(

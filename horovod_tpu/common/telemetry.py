@@ -78,6 +78,14 @@ _COUNTER_KEYS = (
     "fusion.wire_bytes_saved_inter",
     "fusion.hier_dispatches",
     "fusion.quant_blocks",
+    # expert wire (parallel/moe.py + eager alltoall — the PR 12
+    # observability fix): a step's alltoall deltas attribute its
+    # expert-dispatch bytes, and a nonzero dropped-tokens delta marks
+    # a capacity overflow on exactly that step
+    "alltoall.dispatches",
+    "alltoall.wire_bytes",
+    "moe.dropped_tokens",
+    "moe.routed_tokens",
     # chaos-hardened control plane (common/retry.py, testing/chaos.py):
     # per-step deltas let a postmortem correlate a slow step with the
     # hop that was retrying under it (attempts_total is deliberately
@@ -115,6 +123,7 @@ _TUNER_KEYS = (
     "fusion.wire_format_intra",
     "fusion.wire_format_inter",
     "overlap.buckets",
+    "moe.capacity_factor",
 )
 
 
@@ -366,6 +375,14 @@ class TelemetryHub:
                 "fusion_cache_hits": deltas["fusion.hits"]
                 + deltas["fusion.bucket_hits"],
                 "fusion_cycles": deltas["fusion.cycles"],
+                # expert wire (PR 12): eager alltoall dispatch/byte
+                # deltas — expert-dispatch traffic attributed to THIS
+                # step — plus the MoE capacity-gate counters the step
+                # harness published (0s without MoE traffic)
+                "alltoall.dispatches": deltas["alltoall.dispatches"],
+                "alltoall.wire_bytes": deltas["alltoall.wire_bytes"],
+                "moe.dropped_tokens": deltas["moe.dropped_tokens"],
+                "moe.routed_tokens": deltas["moe.routed_tokens"],
                 # control-plane weather during THIS step: retries the
                 # transports absorbed, rounds that exhausted, and any
                 # chaos-layer faults injected (0s on a healthy step)

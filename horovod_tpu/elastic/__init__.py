@@ -38,7 +38,9 @@ from .state import JaxState, ObjectState, State  # noqa: F401
 from .worker import (  # noqa: F401
     WorkerNotificationManager,
     WorkerNotificationService,
+    expert_loads,
     notification_manager,
+    publish_expert_load,
     rebalance_weight,
     rebalance_weights,
     run,

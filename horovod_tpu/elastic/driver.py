@@ -44,6 +44,11 @@ from .discovery import HostDiscovery, HostManager
 # flagged past HOROVOD_STRAGGLER_QUARANTINE_POLLS.
 _REBALANCE_STREAK = 2
 
+# Expert-load entries whose published ts stops advancing age out of the
+# driver's gauges after this many seconds of driver-monotonic time (a
+# departed rank's last KV blob must not skew the fleet view forever).
+_EXPERT_LOAD_STALE_S = 60.0
+
 
 class SlotAssignment:
     """One epoch's worth of placement: which ranks on which hosts."""
@@ -142,6 +147,9 @@ class ElasticDriver:
         # HOROVOD_STRAGGLER_QUARANTINE_POLLS)
         self._rebalance = _cfg.rebalance
         self._rebalance_weights: Dict[int, float] = {}
+        # expert-load freshness ledger: rank -> (last ts seen, driver
+        # monotonic stamp of the last ADVANCE) — see _poll_expert_loads
+        self._expert_load_seen: Dict[int, tuple] = {}
 
     # ---------------------------------------------------------- planning
 
@@ -439,7 +447,73 @@ class ElasticDriver:
                 _log.info("straggler ranks recovered")
             self._last_stragglers = stragglers
         self._maybe_rebalance()
+        self._poll_expert_loads()
         return self._maybe_quarantine()
+
+    def _poll_expert_loads(self) -> None:
+        """Aggregate the gang's published expert-load summaries (PR 12,
+        rendezvous EXPERT_LOAD_SCOPE — the rebalance plumbing's
+        expert-heat sibling) into driver gauges: the fleet-summed
+        per-expert histogram's imbalance (hottest / mean kept tokens)
+        and the aggregate overflow-drop rate. Observability only — the
+        SOFT remedy for expert heat is the capacity autotuner on the
+        workers; these gauges are how an operator (and the flight
+        recorder) see it working. Best-effort: a malformed or absent
+        ledger is silence, never a driver fault.
+
+        Staleness follows the heartbeat lesson: a rank's entry counts
+        only while its ``ts`` keeps ADVANCING (judged on the driver's
+        monotonic clock, so cross-host wall skew cannot drop a live
+        rank) — a departed rank's last KV blob stops advancing and
+        ages out of the gauges instead of skewing them forever."""
+        if self._server is None:
+            return
+        from ..runner.rendezvous import read_expert_loads
+
+        try:
+            loads = read_expert_loads(self._server.store)
+        except Exception:
+            return
+        if not loads:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        fresh = {}
+        for rank, payload in loads.items():
+            ts = float(payload.get("ts", 0.0))
+            prev = self._expert_load_seen.get(rank)
+            if prev is None or ts > prev[0]:
+                self._expert_load_seen[rank] = (ts, now)
+                fresh[rank] = payload
+            elif now - prev[1] <= _EXPERT_LOAD_STALE_S:
+                fresh[rank] = payload
+        # forget ranks whose blobs vanished (scope dropped on restart)
+        for rank in list(self._expert_load_seen):
+            if rank not in loads:
+                del self._expert_load_seen[rank]
+        loads = fresh
+        if not loads:
+            return
+        hist: dict = {}
+        dropped = total = 0.0
+        for payload in loads.values():
+            for i, t in enumerate(payload.get("expert_tokens", ())):
+                hist[i] = hist.get(i, 0.0) + float(t)
+            dropped += float(payload.get("dropped", 0.0))
+            total += float(payload.get("total", 0.0))
+        if not hist or total <= 0:
+            return
+        kept = sum(hist.values())
+        mean = kept / len(hist) if kept > 0 else 0.0
+        from ..common.metrics import registry as _metrics
+
+        _metrics.gauge("driver.expert_load.ranks", len(loads))
+        _metrics.gauge(
+            "driver.expert_load.imbalance",
+            max(hist.values()) / mean if mean > 0 else 1.0,
+        )
+        _metrics.gauge("driver.expert_load.drop_rate", dropped / total)
 
     def _maybe_rebalance(self) -> None:
         """Consume the straggler ledger as a SCHEDULING signal

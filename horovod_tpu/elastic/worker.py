@@ -195,15 +195,30 @@ def rebalance_weights(max_age_s: float = _REBALANCE_POLL_S) -> dict:
         and now - _rebalance_cache["ts"] < max_age_s
     ):
         return dict(_rebalance_cache["map"])
+    from ..runner.rendezvous import read_rebalance_weights
+
+    client = _kv_client()
+    if client is None:
+        return {}
+    try:
+        weights = read_rebalance_weights(client)
+    except OSError:
+        return dict(_rebalance_cache["map"])  # rendezvous going away
+    _rebalance_cache["ts"] = now
+    _rebalance_cache["map"] = weights
+    return dict(weights)
+
+
+def _kv_client():
+    """The worker's cached rendezvous-KV client (shared with the
+    rebalance reader — same endpoint, same re-dial-on-restart
+    contract), or None outside an elastic/runner job."""
     from ..common import config as config_mod
-    from ..runner.rendezvous import (
-        _client_from_cfg,
-        read_rebalance_weights,
-    )
+    from ..runner.rendezvous import _client_from_cfg
 
     cfg = config_mod.Config.from_env()
     if not (cfg.rendezvous_addr and cfg.rendezvous_port):
-        return {}
+        return None
     endpoint = (cfg.rendezvous_addr, cfg.rendezvous_port)
     if (
         _rebalance_cache["client"] is None
@@ -211,13 +226,56 @@ def rebalance_weights(max_age_s: float = _REBALANCE_POLL_S) -> dict:
     ):
         _rebalance_cache["client"] = _client_from_cfg(cfg)
         _rebalance_cache["endpoint"] = endpoint
+    return _rebalance_cache["client"]
+
+
+def publish_expert_load(
+    expert_tokens,
+    dropped: float,
+    total: float,
+    capacity_factor: Optional[float] = None,
+    rank: Optional[int] = None,
+) -> bool:
+    """Publish this rank's per-expert load summary (a fetched
+    ``MoEStats`` — host floats) into the rendezvous KV so the driver
+    and the capacity autotuner see expert heat fleet-wide (PR 12; the
+    PR 10 rebalance plumbing generalized). Call it at the MoE step
+    harness's cadence, not per micro-batch. Returns False (and stays
+    silent) outside an elastic job or when rendezvous is going away —
+    a scheduling hint must never take training down."""
+    import os
+
+    client = _kv_client()
+    if client is None:
+        return False
+    if rank is None:
+        rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+    from ..runner.rendezvous import put_expert_load
+
     try:
-        weights = read_rebalance_weights(_rebalance_cache["client"])
+        put_expert_load(
+            client, rank, expert_tokens, dropped, total, capacity_factor
+        )
     except OSError:
-        return dict(_rebalance_cache["map"])  # rendezvous going away
-    _rebalance_cache["ts"] = now
-    _rebalance_cache["map"] = weights
-    return dict(weights)
+        return False
+    return True
+
+
+def expert_loads() -> dict:
+    """Every rank's newest published expert-load summary
+    (``{rank: payload}``), or ``{}`` outside an elastic job. The
+    driver-side aggregation lives in elastic/driver.py; this is the
+    worker-side peek (a capacity harness can fold sibling ranks' heat
+    into its own decision)."""
+    client = _kv_client()
+    if client is None:
+        return {}
+    from ..runner.rendezvous import read_expert_loads
+
+    try:
+        return read_expert_loads(client)
+    except OSError:
+        return {}
 
 
 def rebalance_weight(rank: Optional[int] = None, default: float = 1.0) -> float:

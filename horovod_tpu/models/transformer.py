@@ -71,6 +71,18 @@ class TransformerConfig:
     # and the kernels read shared KV rows directly (no repeat ever
     # materializes).
     num_kv_heads: Optional[int] = None
+    # Switch-MoE FFN (PR 12): 0 = the dense FFN (param-tree-compatible
+    # with existing checkpoints). >0 replaces every block's FFN with a
+    # top-1-routed expert bank of this many experts — the serving twin
+    # of parallel/moe.py's moe_ffn. Routing is DATA (argmax over the
+    # router logits), shapes are static (every expert's weights are
+    # applied through a one-hot einsum), so the serving engine's
+    # zero-retrace invariant holds: decode_compiles==1 across rolling
+    # admissions with routing changing per token. Expert weights are
+    # stacked on a leading [E] axis — `shard_moe_params` places them
+    # over a mesh 'ep' axis for expert-sharded decode (GSPMD partitions
+    # the expert einsums; hvd.serve threads it via engine ep_axis=).
+    moe_experts: int = 0
     # LM head precision. True (default): bf16 operands on the MXU with
     # fp32 accumulation (preferred_element_type) and fp32 logits out —
     # the standard TPU head recipe; input rounding is bf16-epsilon on
@@ -444,6 +456,50 @@ class MultiHeadAttention(nn.Module):
         )(out), new_cache
 
 
+class MoEFFN(nn.Module):
+    """Switch-style top-1 MoE FFN for decode/serving: router logits in
+    fp32, argmax routing (pure DATA — shapes never depend on it), and
+    the expert bank applied through dense one-hot einsums over the
+    leading ``[E]`` axis (MXU-friendly, no gather/scatter; at decode
+    scale — slots tokens per step — the E-fold FLOPs are noise next to
+    attention over the cache, and under an 'ep'-sharded bank GSPMD
+    partitions the einsum so each shard computes only its experts).
+    Dropped-token capacity logic does not exist here: every token is
+    served by exactly its routed expert, gated by the router prob —
+    exact, static, retrace-free."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e = cfg.moe_experts
+        d, f = cfg.d_model, cfg.d_ff
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )  # [b, t, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        idx = jnp.argmax(probs, axis=-1)  # [b, t]
+        gate = jnp.take_along_axis(probs, idx[..., None], axis=-1)
+        sel = jax.nn.one_hot(idx, e, dtype=cfg.dtype)  # [b, t, E]
+        scale = nn.initializers.lecun_normal(in_axis=-2, out_axis=-1)
+        w1 = self.param("w1", scale, (e, d, f), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros, (e, f), jnp.float32)
+        w2 = self.param("w2", scale, (e, f, d), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros, (e, d), jnp.float32)
+        w1, b1 = w1.astype(cfg.dtype), b1.astype(cfg.dtype)
+        w2, b2 = w2.astype(cfg.dtype), b2.astype(cfg.dtype)
+        h = jnp.einsum("btd,edf,bte->btf", x, w1, sel)
+        h = h + jnp.einsum("ef,bte->btf", b1, sel)
+        h = nn.gelu(h)
+        y = jnp.einsum("btf,efd,bte->btd", h, w2, sel)
+        y = y + jnp.einsum("ed,bte->btd", b2, sel)
+        # cfg.dtype, not x.dtype: the input is the fp32 LayerNorm
+        # output, and the dense FFN branch this replaces emits
+        # cfg.dtype activations — the residual contract must match
+        return (y * gate).astype(cfg.dtype)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
 
@@ -463,13 +519,57 @@ class Block(nn.Module):
         h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype)(h)
-        h = nn.gelu(h)
-        h = nn.Dense(cfg.d_model, dtype=cfg.dtype)(h)
+        if cfg.moe_experts:
+            h = MoEFFN(cfg, name="moe")(h)
+        else:
+            h = nn.Dense(cfg.d_ff, dtype=cfg.dtype)(h)
+            h = nn.gelu(h)
+            h = nn.Dense(cfg.d_model, dtype=cfg.dtype)(h)
         h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         if cache is None:
             return x + h
         return x + h, new_cache
+
+
+def shard_moe_params(params, mesh, ep_axis: str = "ep"):
+    """Place every MoE expert bank (``.../moe/{w1,b1,w2,b2}`` — the
+    leading-``[E]`` stacked leaves of :class:`MoEFFN`) over the mesh's
+    ``ep_axis`` with ``NamedSharding(P(ep_axis))``, leaving everything
+    else exactly where it is — the serving engine's expert-sharding
+    hook (``InferenceEngine(ep_axis=)``): under jit, GSPMD partitions
+    the one-hot expert einsums so each shard computes only its local
+    experts' FFN — expert-sharded dispatch inside the fixed-shape
+    decode step, no shape (and so no retrace) anywhere. The router
+    stays replicated (routing is per-token data every shard needs).
+    No-op when the mesh lacks the axis, or the axis does not divide
+    the expert count (loud — silent replication would quietly undo
+    expert parallelism)."""
+    import jax as _jax
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    if mesh is None or ep_axis not in mesh.axis_names:
+        return params
+    ep = mesh.shape[ep_axis]
+    if ep <= 1:
+        return params
+
+    moe_leaves = {"w1", "b1", "w2", "b2"}
+
+    def _walk(node, path):
+        if isinstance(node, dict):
+            return {k: _walk(v, path + (k,)) for k, v in node.items()}
+        if len(path) >= 2 and path[-2] == "moe" and path[-1] in moe_leaves:
+            if node.shape[0] % ep:
+                raise ValueError(
+                    f"moe_experts ({node.shape[0]}) must divide over "
+                    f"the '{ep_axis}' mesh axis ({ep})"
+                )
+            return _jax.device_put(
+                node, NamedSharding(mesh, _P(ep_axis))
+            )
+        return node
+
+    return _walk(params, ())
 
 
 class LMHead(nn.Module):

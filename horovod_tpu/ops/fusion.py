@@ -389,9 +389,7 @@ class FusionManager:
         self.wire_min_bytes = int(wire_min_bytes)
         self.wire_tuner = None
         if self.wire == "auto":
-            from ..common.autotune import WireTuner
-
-            self.wire_tuner = WireTuner(min_int8_bytes=self.wire_min_bytes)
+            self.wire_tuner = self._make_wire_tuner()
         self.bucketing = bool(bucketing)
         if donate is None:
             # auto: donation is a no-op (plus a warning) on backends
@@ -440,6 +438,13 @@ class FusionManager:
         self.wire_bytes_saved_inter_total = 0
         self.last_wire_format_intra = "fp32"
         self.last_wire_format_inter = "fp32"
+        # eager alltoall observability (the gap PR 12 closed: these
+        # dispatches were counted in `dispatches` but never reached a
+        # metrics legend, so expert-dispatch bytes were invisible to
+        # the flight recorder). Wire bytes use the (n-1)/n·payload
+        # exchange model — the self block never leaves the chip.
+        self.alltoall_dispatches = 0
+        self.alltoall_wire_bytes_total = 0
         self.ef_residual_norm = 0.0  # L2 of the last EF residual batch
         self._seed_counter = 0  # decorrelates stochastic rounding per dispatch
         self._prev_outs = None  # queue-drain anchor for WireTuner trials
@@ -447,6 +452,24 @@ class FusionManager:
         self.cycles = 0
         self._group_depth = 0
         self._next_group_id = 0
+
+    def _make_wire_tuner(self):
+        """WireTuner construction with durable state (HOROVOD_TUNER_CACHE):
+        warm-started from the (topology-fingerprinted) cache so a
+        restarted job skips straight to exploitation, and registered
+        for persist-at-exit so this run's observations join the
+        fleet's. No cache dir configured = exactly the old in-memory
+        behavior."""
+        from ..common.autotune import (
+            WireTuner,
+            register_persist_at_exit,
+            warm_start,
+        )
+
+        tuner = WireTuner(min_int8_bytes=self.wire_min_bytes)
+        warm_start(tuner, "wire")
+        register_persist_at_exit(tuner, "wire")
+        return tuner
 
     # ------------------------------------------------------------------ queue
 
@@ -605,6 +628,13 @@ class FusionManager:
         from ..common.metrics import registry as _metrics
 
         _metrics.update("fusion", self.cache_stats())
+        # expert-dispatch legend (MOE_METRICS): cumulative values under
+        # their own prefix so StepStats _COUNTER_KEYS can delta them —
+        # the eager alltoall family finally reaches the flight recorder
+        _metrics.gauge("alltoall.dispatches", self.alltoall_dispatches)
+        _metrics.gauge(
+            "alltoall.wire_bytes", self.alltoall_wire_bytes_total
+        )
         _metrics.gauge("fusion.cycles", self.cycles)
         _metrics.gauge("fusion.last_flush_bytes", flushed_bytes)
         _metrics.gauge(
@@ -775,6 +805,8 @@ class FusionManager:
             "wire_format_inter": WIRE_FORMAT_CODES.get(
                 self.last_wire_format_inter, 0
             ),
+            "alltoall_dispatches": self.alltoall_dispatches,
+            "alltoall_wire_bytes": self.alltoall_wire_bytes_total,
         }
 
     def _shard_map(self, fn, in_specs=P(WORLD_AXIS), out_specs=P(WORLD_AXIS)):
@@ -858,11 +890,7 @@ class FusionManager:
             return "fp32", hier, False, "fp32"
         if wire == "auto":
             if self.wire_tuner is None:  # knob flipped after init
-                from ..common.autotune import WireTuner
-
-                self.wire_tuner = WireTuner(
-                    min_int8_bytes=self.wire_min_bytes
-                )
+                self.wire_tuner = self._make_wire_tuner()
             bucket_key = ("allreduce", plan.bucket, plan.dtype)
             if hier is not None:
                 # per-hop choice: the inter hop sees 1/L of the bytes
@@ -1918,6 +1946,10 @@ class FusionManager:
         fn = self._executor(key, lambda: self._build_alltoall(ranks))
         self.dispatches += 1
         self.last_cycle_dispatches += 1
+        self.alltoall_dispatches += 1
+        self.alltoall_wire_bytes_total += (
+            int(payload.nbytes) * max(n_ranks - 1, 0) // max(n_ranks, 1)
+        )
         out = fn(payload)
         if self.timeline is not None:
             self.timeline.end(e.name, "ALLTOALL")

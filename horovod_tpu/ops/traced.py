@@ -789,6 +789,154 @@ def quantized_allgather(
     return out, residual
 
 
+def quantized_alltoall(
+    tensor,
+    axis_name: str = WORLD_AXIS,
+    seed=0,
+    block_size: Optional[int] = None,
+    groups=None,
+):
+    """Block-scaled int8 alltoall of a ``[n, slots, d]`` dispatch
+    buffer (row ``j`` destined for rank ``j`` — the MoE expert-dispatch
+    layout of ``parallel/moe.py``): each (destination, slot) row is
+    quantized to int8 with one absmax scale per ``block_size`` elements
+    of ``d`` and stochastic rounding, an ``all_to_all`` moves int8 +
+    scales, and the receiver dequantizes to fp32 — the quantized-MoE
+    wire EQuARX motivates (PAPERS.md, arXiv 2506.17615), ~4x fewer
+    bytes than the fp32 dispatch at one quantum of error per element.
+
+    Pad exclusion by construction: empty dispatch slots (tokens dropped
+    by the capacity gate, slots past a destination's fill) are all-zero
+    rows — ``moe.py`` scatters into a zero-initialized buffer and
+    carries a ``-1`` expert sentinel per slot — and zeros quantize to
+    zeros without ever raising a block's absmax, so a pad slot
+    contributes nothing to any scale and arrives as exact zeros.
+
+    ``groups`` restricts the exchange to ``axis_index_groups`` of the
+    flat axis (the inter hop of :func:`hierarchical_alltoall`); then
+    ``n`` is the group size. Returns fp32 ``[n, slots, d]``.
+    """
+    _stall_check()
+    n = len(groups[0]) if groups is not None else lax.axis_size(axis_name)
+    if tensor.ndim != 3 or tensor.shape[0] != n:
+        raise ValueError(
+            f"dispatch buffer must be [n={n}, slots, d], "
+            f"got {tensor.shape}"
+        )
+    _, slots, d = tensor.shape
+    idx = lax.axis_index(axis_name)
+    x = tensor.reshape(n * slots, d).astype(jnp.float32)
+    # clamp to the row width: a block wider than d would zero-pad every
+    # row up to it and the "quantized" wire would move MORE bytes than
+    # fp32 (516 vs 256 B/row at d=64 under the default block 512)
+    block = min(int(block_size), d) if block_size else max(d, 1)
+    block = max(block, 1)
+    key = jax.random.fold_in(jax.random.PRNGKey(2), seed)
+    key = jax.random.fold_in(key, idx)
+    q, scales = _stochastic_round_blocks(x, block, key)
+    nb = scales.shape[1]
+    recv = lax.all_to_all(
+        q.reshape(n, slots, nb, block), axis_name,
+        split_axis=0, concat_axis=0, tiled=True, axis_index_groups=groups,
+    )
+    recv_s = lax.all_to_all(
+        scales.reshape(n, slots, nb), axis_name,
+        split_axis=0, concat_axis=0, tiled=True, axis_index_groups=groups,
+    )
+    out = _block_dequant(
+        recv.reshape(n * slots, nb, block), recv_s.reshape(n * slots, nb)
+    )[:, :d]
+    return out.reshape(n, slots, d)
+
+
+def hierarchical_alltoall(
+    tensor,
+    axis_name: str = WORLD_AXIS,
+    stages=None,
+    intra_wire: str = "fp32",
+    inter_wire: str = "fp32",
+    seed=0,
+    block_size: Optional[int] = None,
+):
+    """Two-level alltoall of a ``[n, slots, d]`` dispatch buffer on the
+    FLAT axis (replica groups — ``topology.hierarchy_stages()``),
+    elementwise equal to the flat ``lax.all_to_all`` for exact wires:
+
+    1. **inter hop** (DCN): same-position ranks across slices exchange
+       whole per-destination-slice sub-buffers — only blocks bound for
+       ANOTHER slice cross the wire. ``inter_wire='int8'`` rides
+       :func:`quantized_alltoall`; either lossy wire (bf16/int8)
+       restores the SELF-slice block from the local fp32 original
+       afterwards, so tokens bound for intra-slice experts never pay
+       quantization — the PR 10 placement rule (EQuARX: quantize only
+       where bytes are scarce) applied to expert dispatch.
+    2. **intra hop** (ICI): one alltoall inside each slice delivers
+       every block to its destination rank, at ``intra_wire``
+       (fp32/bf16 — never int8; ICI is fast).
+
+    The lowered module carries the two-level structure — group-limited
+    ``all_to_all`` ops only, never a monolithic world-spanning one
+    (tests/bench assert the replica-group text). Non-float payloads
+    (the MoE expert-index map) ride both hops unmodified; pass exact
+    wires for them. Requires the canonical contiguous-intra ``stages``
+    layout. Returns the input dtype (int8 inter returns fp32-rounded
+    values cast back).
+    """
+    if stages is None:
+        raise ValueError("stages is required (topology.hierarchy_stages)")
+    intra_groups, inter_groups = stages
+    L = len(intra_groups[0])
+    H = len(inter_groups[0])
+    n = L * H
+    if tensor.ndim != 3 or tensor.shape[0] != n:
+        raise ValueError(
+            f"dispatch buffer must be [n={n}, slots, d], "
+            f"got {tensor.shape}"
+        )
+    _, slots, d = tensor.shape
+    dtype = tensor.dtype
+    lossy = inter_wire in ("bf16", "int8")
+    exact = not jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+    idx = lax.axis_index(axis_name)
+    # destination blocks, slice-major: xr[h_d] = the [L·slots, d] of
+    # everything this rank sends to slice h_d
+    xr = tensor.reshape(H, L * slots, d)
+    if inter_wire == "int8" and not exact:
+        y = quantized_alltoall(
+            xr, axis_name=axis_name, seed=seed, block_size=block_size,
+            groups=inter_groups,
+        ).astype(dtype)
+    else:
+        wire = "fp32" if exact else inter_wire
+        y = lax.all_to_all(
+            _stage_cast(xr, wire), axis_name,
+            split_axis=0, concat_axis=0, tiled=True,
+            axis_index_groups=inter_groups,
+        ).astype(dtype)
+    if lossy and not exact:
+        # the self-slice block never crossed DCN: row h (this rank's
+        # position within its inter group) is its own block — restore
+        # the fp32 original so intra-bound tokens stay exact
+        pos = jnp.asarray(_group_pos_table(inter_groups))[idx]
+        own = lax.dynamic_slice_in_dim(xr, pos, 1, axis=0).astype(dtype)
+        y = lax.dynamic_update_slice_in_dim(y, own, pos, axis=0)
+    # y[h_s] = blocks from (h_s, l_self) for every (h_self, l_d);
+    # regroup by destination intra position and deliver inside the slice
+    y = y.reshape(H, L, slots, d).transpose(1, 0, 2, 3)  # [L_d, H_s, ...]
+    iw = "fp32" if exact else intra_wire
+    z = lax.all_to_all(
+        _stage_cast(y.reshape(L, H * slots, d), iw), axis_name,
+        split_axis=0, concat_axis=0, tiled=True,
+        axis_index_groups=intra_groups,
+    ).astype(dtype)
+    # z[l_s] = blocks from (h_s, l_s) — back to flat rank-major order
+    return (
+        z.reshape(L, H, slots, d).transpose(1, 0, 2, 3).reshape(
+            n, slots, d
+        )
+    )
+
+
 # Axis names for the two-level mesh built by hierarchical_mesh()
 # (canonical home: common/topology.py — re-bound here for the existing
 # import surface).

@@ -8,12 +8,26 @@ experts are sharded across the 'ep' mesh axis, tokens are routed top-1
 shapes — XLA requirement), dispatched to their expert's chip with
 `lax.all_to_all`, transformed, and returned by the inverse all_to_all.
 
+The dispatch WIRE rides the same stack every other byte family got
+(PR 12): ``wire=`` selects fp32 / bf16 / block-scaled int8 with
+stochastic rounding (``ops/traced.py quantized_alltoall`` — dropped
+and pad slots are all-zero rows with a ``-1`` expert sentinel, so they
+are excluded from every block scale by construction), ``hier=`` routes
+the exchange through the two-level (intra-ICI / inter-DCN) recipe of
+``traced.hierarchical_alltoall`` — tokens bound for intra-slice
+experts move bf16/fp32, only the DCN hop rides int8 (the PR 10
+placement rule), and ``wire="auto"`` consults the shared WireTuner's
+``("alltoall", payload-bucket, dtype, hop)`` keys at trace time.
+Routing decisions are computed on fp32 logits BEFORE any wire cast,
+so they are identical across wires — the lossy wire moves the same
+tokens to the same experts, a few quanta noisier.
+
 Per-device code for use inside shard_map.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +40,19 @@ class MoEParams(NamedTuple):
     b1: jnp.ndarray  # [E_local, F]
     w2: jnp.ndarray  # [E_local, F, D]
     b2: jnp.ndarray  # [E_local, D]
+
+
+class MoEStats(NamedTuple):
+    """Per-step expert-load counters (global — psum'd over the axis),
+    the feed for the capacity-factor autotuner (common/autotune.py
+    CapacityTuner) and the per-rank expert-load summaries published
+    through the rendezvous KV (elastic/worker.py publish_expert_load):
+    hot experts ARE stragglers, and these are how the scheduler sees
+    them."""
+
+    expert_tokens: jnp.ndarray  # [E_total] f32 — kept tokens per expert
+    dropped: jnp.ndarray  # scalar f32 — tokens past capacity (zero out)
+    total: jnp.ndarray  # scalar f32 — live tokens routed
 
 
 def init_moe_params(key, d_model: int, d_ff: int, n_experts_local: int,
@@ -42,65 +69,278 @@ def init_moe_params(key, d_model: int, d_ff: int, n_experts_local: int,
     )
 
 
+def _resolve_hier(hier, ep: int):
+    """The two-level routing decision for the expert wire: explicit
+    ``(intra_groups, inter_groups)`` stages pass through; ``None``
+    consults the HOROVOD_HIERARCHICAL default tri-state (the same
+    decision the fused dispatcher and the overlap buckets ride);
+    "on"/True force any resolvable split; "off"/False keep it flat."""
+    from ..common import topology as _topo
+
+    if hier is None:
+        return _topo.hierarchy_stages(world=ep)
+    if hier in ("off", False):
+        return None
+    if hier in ("on", True):
+        return _topo.hierarchy_stages(world=ep, mode="on")
+    return hier  # explicit stages
+
+
+def _resolve_wire(wire, intra_wire, payload_bytes: int, hier):
+    """Trace-time wire choice. ``auto`` asks the shared WireTuner's
+    ``(alltoall, hop)`` key family — a compile-time decision like the
+    OverlapTuner's bucket count: the harness feeds goodput across
+    recompiles (bench_moe.py shows the loop), the choice is frozen
+    into this trace."""
+    from ..common import basics as _basics
+
+    cfg = _basics.live_config()
+    if wire is None:
+        wire = cfg.moe_wire
+    if intra_wire is None:
+        intra_wire = cfg.moe_intra_wire
+    if wire not in ("fp32", "bf16", "int8", "auto"):
+        raise ValueError(
+            f"moe wire must be fp32/bf16/int8/auto, got {wire!r}"
+        )
+    if intra_wire not in ("fp32", "bf16"):
+        raise ValueError(
+            f"moe intra_wire must be fp32/bf16, got {intra_wire!r}"
+        )
+    if wire == "auto":
+        from ..common.autotune import shared_wire_tuner
+
+        tuner = shared_wire_tuner()
+        bucket = 1 << max(int(payload_bytes) - 1, 1).bit_length()
+        if hier is not None:
+            H = len(hier[1][0])
+            wire = tuner.choose(
+                ("alltoall", bucket, "float32", "inter"),
+                payload_bytes=payload_bytes * (H - 1) // max(H, 1),
+                itemsize=4,
+            )
+            intra_wire = tuner.choose(
+                ("alltoall", bucket, "float32", "intra"),
+                payload_bytes=payload_bytes,
+                itemsize=4,
+                candidates=("fp32", "bf16"),
+            )
+        else:
+            wire = tuner.choose(
+                ("alltoall", bucket, "float32", "flat"),
+                payload_bytes=payload_bytes,
+                itemsize=4,
+            )
+    return wire, intra_wire
+
+
+def _cast_wire(x, wire):
+    return x.astype(jnp.bfloat16) if wire == "bf16" else x
+
+
 def moe_ffn(
     params: MoEParams,
     x,
     axis_name: str = "ep",
-    capacity_factor: float = 1.25,
+    capacity_factor: Optional[float] = None,
+    wire: Optional[str] = None,
+    intra_wire: Optional[str] = None,
+    hier=None,
+    seed: int = 0,
+    block_size: Optional[int] = None,
+    mask=None,
+    process_set=None,
+    return_stats: bool = False,
 ):
     """x: [T_local, D] tokens on this chip → [T_local, D].
 
     Routing: top-1 over E_total experts; expert e lives on chip
     e // E_local of the 'ep' axis. Tokens over capacity are dropped
     (switch-style; their output is zero and the residual connection
-    carries them)."""
+    carries them).
+
+    ``capacity_factor`` (None = HOROVOD_MOE_CAPACITY_FACTOR) sizes the
+    static per-destination buffer; for a measured choice drive the
+    step harness through ``common.autotune.CapacityTuner`` — capacity
+    is a compile-time shape, so tuning happens across recompiles.
+
+    ``wire`` ∈ {fp32, bf16, int8, auto} (None = HOROVOD_MOE_WIRE) is
+    the dispatch+return wire; with a two-level split (``hier``) it
+    names the INTER hop and ``intra_wire`` ∈ {fp32, bf16} the ICI
+    legs. The expert-index map always moves exact int32. ``seed``
+    decorrelates the stochastic rounding (thread a step counter for
+    unbiasedness over time).
+
+    ``mask`` is the traced join mask ([world] bool, ``mask[r] ==
+    False`` = rank r ran out of data): a masked rank contributes no
+    tokens (its output rows are zeros) while its EXPERTS keep serving
+    the live ranks. ``process_set`` restricts routing to the member
+    ranks' experts (non-members return zeros; the wire degenerates to
+    the flat masked/ring formulation — hier and int8 need the full
+    axis). ``return_stats=True`` additionally returns :class:`MoEStats`.
+    """
+    from ..ops import traced as _traced
+    from ..common import basics as _basics
+
     ep = lax.axis_size(axis_name)
     t_local, d = x.shape
     e_local = params.w1.shape[0]
     e_total = e_local * ep
 
+    if capacity_factor is None:
+        capacity_factor = _basics.live_config().moe_capacity_factor
+    if block_size is None:
+        block_size = _basics.live_config().moe_wire_block
+
+    info = _traced._set_info(process_set, axis_name)
+    member = None
+    pos = None
+    if info is not None:
+        member, pos = _traced._member(info, axis_name)
+    live = None
+    if mask is not None:
+        live = jnp.asarray(mask)[lax.axis_index(axis_name)]
+
+    # participating-rank count and expert universe
+    k = info.size if info is not None else ep
+    hier_stages = None if info is not None else _resolve_hier(hier, ep)
+    capacity = int(max(1, round(float(capacity_factor) * t_local / k)))
+    payload_bytes = k * capacity * d * 4
+    wire, intra_wire = _resolve_wire(
+        wire, intra_wire, payload_bytes, hier_stages
+    )
+    if info is not None and wire == "int8":
+        # the ring formulation moves raw blocks; quantized + pset is
+        # not a supported combination — degrade loudly-documented
+        wire = "fp32"
+
     logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
                         params.router.astype(jnp.float32))
+    if info is not None:
+        # non-member ranks' experts are outside the set: route over
+        # member experts only (set order = member rank order)
+        owner = jnp.arange(e_total) // e_local  # [E_total] owning rank
+        allowed = jnp.asarray(info.mask)[owner]
+        logits = jnp.where(allowed[None, :], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)  # [T]
     gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
 
-    # Per-destination-chip capacity (static).
-    capacity = int(max(1, round(capacity_factor * t_local / ep)))
+    owner_rank = expert_idx // e_local  # [T] flat owning rank
+    if info is not None:
+        # position of the owning rank within the set = dispatch slot
+        dest_chip = jnp.asarray(info.pos)[owner_rank]
+    else:
+        dest_chip = owner_rank
 
-    dest_chip = expert_idx // e_local  # [T]
     # position of each token within its destination chip's buffer
-    onehot_chip = jax.nn.one_hot(dest_chip, ep, dtype=jnp.int32)  # [T, ep]
-    pos_in_chip = (jnp.cumsum(onehot_chip, axis=0) - 1)  # [T, ep]
+    onehot_chip = jax.nn.one_hot(dest_chip, k, dtype=jnp.int32)  # [T, k]
+    pos_in_chip = (jnp.cumsum(onehot_chip, axis=0) - 1)  # [T, k]
     my_pos = jnp.take_along_axis(
         pos_in_chip, dest_chip[:, None], axis=1
     )[:, 0]  # [T]
     keep = my_pos < capacity
+    if member is not None:
+        keep = jnp.logical_and(keep, member)
+    if live is not None:
+        keep = jnp.logical_and(keep, live)
 
-    # Scatter tokens into the dispatch buffer [ep, capacity, D]. Dropped
+    # Scatter tokens into the dispatch buffer [k, capacity, D]. Dropped
     # tokens get an out-of-range index → mode='drop' discards them, so
-    # empty slots keep their init value (-1 sentinel in the expert map).
-    idx_chip = jnp.where(keep, dest_chip, ep)
+    # empty slots keep their init value (zeros in the payload, -1
+    # sentinel in the expert map — the pad-exclusion contract of the
+    # quantized wire).
+    idx_chip = jnp.where(keep, dest_chip, k)
     idx_pos = jnp.where(keep, my_pos, 0)
     dispatch = (
-        jnp.zeros((ep, capacity, d), x.dtype)
+        jnp.zeros((k, capacity, d), x.dtype)
         .at[idx_chip, idx_pos]
         .set(x, mode="drop")
     )
     token_expert = (
-        jnp.full((ep, capacity), -1, jnp.int32)
+        jnp.full((k, capacity), -1, jnp.int32)
         .at[idx_chip, idx_pos]
         .set((expert_idx % e_local).astype(jnp.int32), mode="drop")
     )
 
-    # To each chip its tokens: [ep, C, D] -> all_to_all over axis 0.
-    recv = lax.all_to_all(dispatch, axis_name, split_axis=0, concat_axis=0,
-                          tiled=True)
-    recv_expert = lax.all_to_all(token_expert, axis_name, split_axis=0,
-                                 concat_axis=0, tiled=True)
-    # recv: [ep*C, D] tokens for MY local experts (concat over sources).
-    recv = recv.reshape(ep * capacity, d)
-    which_expert = recv_expert.reshape(ep * capacity)
+    def _quantized_fwd(b3, step_seed):
+        """The int8-bearing exchange of one [k, C, d] float buffer —
+        wrapped in a custom_vjp below: stochastic rounding has no
+        useful gradient (floor/compare are piecewise-flat, and the
+        absmax scales would leak a spurious one), so the cotangent
+        rides the EXACT inverse exchange instead — the alltoall's own
+        transpose, straight-through on the quantizer. Training with
+        the lossy wire therefore costs exactly one extra fp32 exchange
+        in backward, never a poisoned gradient."""
+        if hier_stages is not None:
+            return _traced.hierarchical_alltoall(
+                b3, axis_name=axis_name, stages=hier_stages,
+                intra_wire=intra_wire, inter_wire=wire,
+                seed=step_seed, block_size=block_size,
+            )
+        return _traced.quantized_alltoall(
+            b3, axis_name=axis_name, seed=step_seed,
+            block_size=block_size,
+        ).astype(b3.dtype)
+
+    @jax.custom_vjp
+    def _st_exchange(b3, step_seed):
+        return _quantized_fwd(b3, step_seed)
+
+    def _st_fwd(b3, step_seed):
+        return _quantized_fwd(b3, step_seed), None
+
+    def _st_bwd(_, ct):
+        # the exact exchange is its own transpose for the symmetric
+        # [k, C, d] split0/concat0 layout (block (i, j) ↔ (j, i));
+        # hierarchical-exact keeps the cotangent's DCN legs two-level
+        if hier_stages is not None:
+            back = _traced.hierarchical_alltoall(
+                ct, axis_name=axis_name, stages=hier_stages
+            )
+        else:
+            back = lax.all_to_all(
+                ct, axis_name, split_axis=0, concat_axis=0, tiled=True
+            )
+        return back, None
+
+    _st_exchange.defvjp(_st_fwd, _st_bwd)
+
+    def exchange(buf, step_seed):
+        """One dispatch-shaped hop of the expert wire ([k, C, ·])."""
+        floaty = jnp.issubdtype(buf.dtype, jnp.floating)
+        if info is not None:
+            flat = buf.reshape(k * capacity, -1)
+            if wire == "bf16" and floaty:
+                flat = _cast_wire(flat, wire)
+            out = _traced.alltoall(
+                flat, process_set=process_set, axis_name=axis_name
+            ).astype(buf.dtype)
+            return out.reshape(buf.shape)
+        b3 = buf.reshape(k, capacity, -1)
+        if hier_stages is not None:
+            if wire == "int8" and floaty:
+                return _st_exchange(b3, step_seed).reshape(buf.shape)
+            return _traced.hierarchical_alltoall(
+                b3, axis_name=axis_name, stages=hier_stages,
+                intra_wire=intra_wire if floaty else "fp32",
+                inter_wire=wire if floaty else "fp32",
+                seed=step_seed, block_size=block_size,
+            ).reshape(buf.shape)
+        if wire == "int8" and floaty:
+            return _st_exchange(b3, step_seed).reshape(buf.shape)
+        cast = _cast_wire(b3, wire) if floaty else b3
+        return lax.all_to_all(
+            cast, axis_name, split_axis=0, concat_axis=0, tiled=True
+        ).astype(buf.dtype).reshape(buf.shape)
+
+    # To each chip its tokens: [k, C, D] exchanged over the wire; the
+    # expert map rides exact int32 alongside.
+    recv = exchange(dispatch, seed)
+    recv_expert = exchange(token_expert[..., None], seed)[..., 0]
+    # recv: [k*C, D] tokens for MY local experts (concat over sources).
+    recv = recv.reshape(k * capacity, d)
+    which_expert = recv_expert.reshape(k * capacity)
 
     # Apply each local expert to its tokens (dense einsum over one-hot —
     # MXU-friendly, no gather/scatter in the hot loop).
@@ -110,16 +350,43 @@ def moe_ffn(
     h = jax.nn.gelu(h)
     y = jnp.einsum("nf,efd,ne->nd", h, params.w2, sel)
     y = y + jnp.einsum("ed,ne->nd", params.b2, sel)
-    # tokens that carried expert=-1 (padding) produce zeros
+    # tokens that carried expert=-1 (padding) produce zeros — which
+    # also keeps pad slots out of the return wire's block scales
     y = y * (which_expert >= 0)[:, None]
 
-    # Return to origin chips: inverse all_to_all.
-    y_back = lax.all_to_all(
-        y.reshape(ep, capacity, d), axis_name, split_axis=0, concat_axis=0,
-        tiled=True,
-    ).reshape(ep, capacity, d)
+    # Return to origin chips: inverse exchange over the same wire.
+    y_back = exchange(
+        y.reshape(k, capacity, d), seed + 0x9E37
+    ).reshape(k, capacity, d)
 
     # Un-scatter: token i's result sits at [dest_chip[i], my_pos[i]].
     out = y_back[idx_chip, idx_pos]
     out = jnp.where(keep[:, None], out, 0.0)
-    return (out * gate[:, None]).astype(x.dtype)
+    out = (out * gate[:, None]).astype(x.dtype)
+    if member is not None:
+        out = jnp.where(member, out, jnp.zeros_like(out))
+    if live is not None:
+        out = jnp.where(live, out, jnp.zeros_like(out))
+    if not return_stats:
+        return out
+
+    # Expert-load counters, psum'd so every rank holds the global view
+    # (the capacity tuner / KV publisher feed). ``total`` counts live
+    # routed tokens; ``dropped`` the capacity-gate losses among them.
+    routed = jnp.ones((t_local,), jnp.float32)
+    if member is not None:
+        routed = jnp.where(member, routed, 0.0)
+    if live is not None:
+        routed = jnp.where(live, routed, 0.0)
+    kept = jnp.where(keep, routed, 0.0)
+    hist = jnp.sum(
+        jax.nn.one_hot(expert_idx, e_total, dtype=jnp.float32)
+        * kept[:, None],
+        axis=0,
+    )
+    stats = MoEStats(
+        expert_tokens=lax.psum(hist, axis_name),
+        dropped=lax.psum(jnp.sum(routed - kept), axis_name),
+        total=lax.psum(jnp.sum(routed), axis_name),
+    )
+    return out, stats

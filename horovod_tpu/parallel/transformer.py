@@ -73,6 +73,18 @@ class ParallelTransformerConfig:
     max_len: int = 128
     n_experts: int = 4  # total; must divide by ep
     moe_capacity_factor: float = 2.0
+    # Expert wire (PR 12, parallel/moe.py): dispatch/return format of
+    # the MoE alltoall — None defers to HOROVOD_MOE_WIRE; "int8" rides
+    # the block-scaled quantized wire (routing decisions are computed
+    # on fp32 logits BEFORE the wire, so they are identical across
+    # formats). moe_hier routes the exchange two-level (intra-ICI /
+    # inter-DCN; None = the HOROVOD_HIERARCHICAL default decision,
+    # "on"/"off" force it, or explicit (intra, inter) stages) — under
+    # a split, moe_wire names the INTER hop and moe_intra_wire the
+    # ICI legs.
+    moe_wire: Any = None
+    moe_intra_wire: Any = None
+    moe_hier: Any = None
     n_microbatches: int = 2
     dtype: Any = jnp.float32
     learning_rate: float = 1e-2
@@ -274,6 +286,9 @@ def _tail_loss(tail_params, x, labels, cfg: ParallelTransformerConfig):
         flat,
         axis_name="ep",
         capacity_factor=cfg.moe_capacity_factor,
+        wire=cfg.moe_wire,
+        intra_wire=cfg.moe_intra_wire,
+        hier=cfg.moe_hier,
     ).reshape(x.shape)
 
     x = _layer_norm(x, tail_params["lnf_scale"], tail_params["lnf_bias"])

@@ -568,6 +568,63 @@ def read_rebalance_weights(store_or_client) -> Dict[int, float]:
         return {}
 
 
+EXPERT_LOAD_SCOPE = "expert_load"
+
+
+def put_expert_load(
+    store_or_client,
+    rank: int,
+    expert_tokens,
+    dropped: float,
+    total: float,
+    capacity_factor: Optional[float] = None,
+) -> None:
+    """Worker side of the expert-load ledger (PR 12 — the PR 10
+    rebalance plumbing generalized from step-time to expert load):
+    publish this rank's newest per-expert kept-token histogram plus
+    overflow counters (``parallel/moe.py`` MoEStats, host floats). One
+    KV key per rank, overwritten per publication — the driver only
+    ever aggregates the latest round. Hot experts ARE stragglers; this
+    is how the scheduler sees them before step time does."""
+    import time as _time
+
+    payload = {
+        "ts": _time.time(),
+        "expert_tokens": [float(t) for t in expert_tokens],
+        "dropped": float(dropped),
+        "total": float(total),
+    }
+    if capacity_factor is not None:
+        payload["capacity_factor"] = float(capacity_factor)
+    store_or_client.put(
+        EXPERT_LOAD_SCOPE, str(int(rank)), json.dumps(payload).encode()
+    )
+
+
+def read_expert_loads(store_or_client) -> Dict[int, dict]:
+    """Driver side: ``{rank: {"ts", "expert_tokens", "dropped",
+    "total", ...}}`` of every published load summary. Malformed
+    entries are skipped — a corrupt scheduling hint must never crash
+    the driver."""
+    out: Dict[int, dict] = {}
+    for key in store_or_client.keys(EXPERT_LOAD_SCOPE):
+        raw = store_or_client.get(EXPERT_LOAD_SCOPE, key)
+        if raw is None:
+            continue
+        try:
+            rank = int(key)
+            obj = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if (
+            isinstance(obj, dict)
+            and isinstance(obj.get("expert_tokens"), list)
+            and "total" in obj
+        ):
+            out[rank] = obj
+    return out
+
+
 def _client_from_cfg(cfg) -> "RendezvousClient":
     """Shared construction of the worker-side KV client from config
     (secret decode + endpoint) — used by the object collectives and the

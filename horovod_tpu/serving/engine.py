@@ -131,6 +131,7 @@ class InferenceEngine:
         donate: Optional[bool] = None,
         mesh=None,
         tp_axis: str = "tp",
+        ep_axis: str = "ep",
         paged: Optional[bool] = None,
         page_tokens: Optional[int] = None,
         pages: Optional[int] = None,
@@ -138,6 +139,21 @@ class InferenceEngine:
         page_watermark: Optional[int] = None,
     ) -> None:
         self._model_fn = _as_model_fn(model)
+        # MoE decode (PR 12): a model whose config carries an expert
+        # bank gets it sharded over the mesh's ep axis up front —
+        # GSPMD then partitions the expert einsums inside the SAME
+        # fixed-shape prefill/decode executables (routing is data, so
+        # the zero-retrace invariant is untouched; tests assert
+        # decode_compiles==1 across rolling admissions with MoE on).
+        model_cfg = getattr(model, "cfg", None)
+        if (
+            mesh is not None
+            and model_cfg is not None
+            and getattr(model_cfg, "moe_experts", 0)
+        ):
+            from ..models.transformer import shard_moe_params
+
+            params = shard_moe_params(params, mesh, ep_axis)
         self._params = params
         if cache_factory is None:
             cache_factory = _default_cache_factory(model)
