@@ -45,15 +45,12 @@ _SIM_NOTE = (
 )
 
 
-def _collective_counts(lowered_text: str) -> dict:
-    return {
-        "all_reduce": lowered_text.count('"stablehlo.all_reduce"'),
-        "reduce_scatter": lowered_text.count(
-            '"stablehlo.reduce_scatter"'
-        ),
-        "all_gather": lowered_text.count('"stablehlo.all_gather"'),
-        "all_to_all": lowered_text.count('"stablehlo.all_to_all"'),
-    }
+def _collective_counts(lowered) -> dict:
+    """Lowered-module collective counts via the shared
+    horovod_tpu.analysis parser (same gate as tests/test_hier_wire)."""
+    from horovod_tpu import analysis
+
+    return analysis.parse_module(lowered).counts()
 
 
 def _hop_accounting(bucket_elems, leg, L, H, block):
@@ -197,8 +194,7 @@ def main():
     for leg in ("ab_flat", "ab_hier", "ab_hier_int8"):
         step = make_step(leg)
         t = {k: jnp.asarray(v) for k, v in grads_host.items()}
-        txt = step.lower(t, jnp.int32(0)).as_text()
-        counts = _collective_counts(txt)
+        counts = _collective_counts(step.lower(t, jnp.int32(0)))
         out = step(t, jnp.int32(0))  # compile + warm
         _sync(out)
         t0 = time.perf_counter()

@@ -45,7 +45,6 @@ BENCH_ITERS / BENCH_DRYRUN / BENCH_ARTIFACT_DIR.
 
 import json
 import os
-import re
 import time
 
 _SIM_NOTE = (
@@ -54,15 +53,13 @@ _SIM_NOTE = (
 )
 
 
-def _a2a_group_sizes(lowered_text: str):
-    """Replica-group row lengths of every all_to_all in the module."""
-    sizes = []
-    for m in re.finditer(
-        r"all_to_all.*?replica_groups\s*=\s*dense<\[\[(.*?)\]\]>",
-        lowered_text,
-    ):
-        sizes.append(len(m.group(1).split("],")[0].split(",")))
-    return sizes
+def _a2a_group_sizes(lowered):
+    """Replica-group row lengths of every all_to_all in the module —
+    via the shared horovod_tpu.analysis parser (same gate as
+    tests/test_moe_wire)."""
+    from horovod_tpu import analysis
+
+    return analysis.parse_module(lowered).group_sizes("all_to_all")
 
 
 def _hop_bytes(leg, L, H, capacity, d, block):
@@ -199,8 +196,7 @@ def main():
     flat_hops = None
     for leg in ("ab_flat", "ab_hier_int8"):
         step = make_step(leg)
-        txt = step.lower(params, xd, jnp.int32(0)).as_text()
-        sizes = _a2a_group_sizes(txt)
+        sizes = _a2a_group_sizes(step.lower(params, xd, jnp.int32(0)))
         out, st = step(params, xd, jnp.int32(0))  # compile + warm
         _sync(out)
         t0 = time.perf_counter()

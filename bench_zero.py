@@ -50,14 +50,12 @@ _SIM_NOTE = (
 )
 
 
-def _collective_counts(lowered_text: str) -> dict:
-    return {
-        "all_reduce": lowered_text.count('"stablehlo.all_reduce"'),
-        "reduce_scatter": lowered_text.count(
-            '"stablehlo.reduce_scatter"'
-        ),
-        "all_gather": lowered_text.count('"stablehlo.all_gather"'),
-    }
+def _collective_counts(lowered) -> dict:
+    """Lowered-module collective counts via the shared
+    horovod_tpu.analysis parser (same gate as tests/test_zero)."""
+    from horovod_tpu import analysis
+
+    return analysis.parse_module(lowered).counts()
 
 
 def _memory_analysis(compiled):
@@ -227,7 +225,7 @@ def main():
     mem = _memory_analysis(low.compile())
     ms = timed(lambda c: z1(c, x, y), carry)
     lines["ab_zero1"] = emit(
-        "ab_zero1", ms, _collective_counts(low.as_text()), acct, mem,
+        "ab_zero1", ms, _collective_counts(low), acct, mem,
     )
 
     # ---- leg 2: ZeRO-2, in-backprop scatter into shard storage
@@ -257,7 +255,7 @@ def main():
     mem = _memory_analysis(low.compile())
     ms = timed(lambda c: z2(c, x, y), carry)
     lines["ab_zero2"] = emit(
-        "ab_zero2", ms, _collective_counts(low.as_text()), acct, mem,
+        "ab_zero2", ms, _collective_counts(low), acct, mem,
     )
 
     # ---- leg 3: ZeRO-3, sharded params + forward-interleaved gathers
@@ -289,7 +287,7 @@ def main():
     mem = _memory_analysis(low.compile())
     ms = timed(lambda c: z3(c, x, y), carry)
     lines["ab_zero3"] = emit(
-        "ab_zero3", ms, _collective_counts(low.as_text()), acct, mem,
+        "ab_zero3", ms, _collective_counts(low), acct, mem,
     )
 
     ratio = (

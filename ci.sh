@@ -23,7 +23,15 @@
 #                    /metrics scrape, then SIGTERM both workers and
 #                    assert the drain completed every accepted request
 #                    (exit 143) — the serving plane can't silently rot
-#   7. chaos-smoke — scripts/chaos_smoke.py: an integrity drill (one
+#   7. audit-smoke — scripts/hlo_audit.py: the lowered-program
+#                    invariant catalog over the canonical roster
+#                    (fused fp32/int8 wire, overlap buckets, ZeRO-2/3,
+#                    guard overhead, two-level + MoE routing, serve
+#                    donation/compile budget) must run green AND the
+#                    auditor must exit nonzero on a deliberately
+#                    broken invariant (int8 forced onto an intra hop)
+#                    — an auditor that cannot fail is not evidence
+#   8. chaos-smoke — scripts/chaos_smoke.py: an integrity drill (one
 #                    injected NaN training step that the grad guard
 #                    must SKIP and count, one injected checkpoint
 #                    bitflip that digest verification must bypass via
@@ -37,7 +45,7 @@
 #                    endpoint — neither the chaos hardening nor the
 #                    integrity plane can silently rot
 #
-# Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|serve-smoke|chaos-smoke|all]
+# Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|serve-smoke|audit-smoke|chaos-smoke|all]
 # (default: all)
 
 set -euo pipefail
@@ -46,12 +54,14 @@ cd "$(dirname "$0")"
 step() { printf '\n=== %s ===\n' "$*"; }
 
 lint() {
-  step "lint: pyflakes-level check via python -m compileall + import"
-  python -m compileall -q horovod_tpu tests bench.py bench_lm.py \
-    bench_allreduce.py bench_serve.py bench_zero.py bench_hier.py \
-    bench_moe.py __graft_entry__.py
-  # ruff/flake8 aren't in the image; compile + import-sanity is the
-  # supported floor. Import must succeed without TPU hardware.
+  step "lint: AST-based convention lint (scripts/lint.py)"
+  # scripts/lint.py parses every file (so it subsumes compileall's
+  # syntax check) and enforces the repo conventions: no os.environ
+  # reads outside common/config.py (the basics.live_config() contract),
+  # no bare except, no unused imports, no jax.debug.callback outside
+  # the approved guard/telemetry sites.
+  python scripts/lint.py
+  # Import must succeed without TPU hardware.
   JAX_PLATFORMS=cpu python -c "import horovod_tpu"
 }
 
@@ -157,6 +167,32 @@ chaos_smoke() {
   python scripts/chaos_smoke.py
 }
 
+audit_smoke() {
+  step "audit-smoke: lowered-program invariant roster (scripts/hlo_audit.py)"
+  local art_dir
+  art_dir="$(mktemp -d)"
+  JAX_PLATFORMS=cpu python scripts/hlo_audit.py \
+    --json "$art_dir/hlo_audit.json"
+  test -s "$art_dir/hlo_audit.json" \
+    || { echo "missing artifact: hlo_audit.json" >&2; exit 1; }
+  step "audit-smoke: auditor must FAIL a deliberately broken invariant"
+  # assert the SPECIFIC rejection (rule finding + violation exit), not
+  # just any nonzero exit — a breaker that crashes before evaluating
+  # the rule must not pass as "the auditor can fail"
+  local break_out
+  break_out="$art_dir/break_int8_intra.log"
+  if JAX_PLATFORMS=cpu python scripts/hlo_audit.py --break int8-intra \
+      >"$break_out" 2>&1; then
+    echo "hlo_audit accepted int8 on an intra hop — the auditor cannot fail" >&2
+    exit 1
+  fi
+  grep -q "invariant violation(s) found" "$break_out" \
+    && grep -q "WireDtype" "$break_out" \
+    || { echo "hlo_audit --break exited nonzero WITHOUT a WireDtype finding (crash, not rejection):" >&2
+         tail -20 "$break_out" >&2; exit 1; }
+  echo "audit-smoke OK: roster green, broken invariant rejected ($art_dir)"
+}
+
 case "${1:-all}" in
   lint)        lint ;;
   native)      native ;;
@@ -164,7 +200,8 @@ case "${1:-all}" in
   bench-smoke) bench_smoke ;;
   telemetry-smoke) telemetry_smoke ;;
   serve-smoke) serve_smoke ;;
+  audit-smoke) audit_smoke ;;
   chaos-smoke) chaos_smoke ;;
-  all)         lint; native; tests; bench_smoke; telemetry_smoke; serve_smoke; chaos_smoke ;;
-  *) echo "usage: $0 [lint|native|tests|bench-smoke|telemetry-smoke|serve-smoke|chaos-smoke|all]" >&2; exit 2 ;;
+  all)         lint; native; tests; bench_smoke; telemetry_smoke; serve_smoke; audit_smoke; chaos_smoke ;;
+  *) echo "usage: $0 [lint|native|tests|bench-smoke|telemetry-smoke|serve-smoke|audit-smoke|chaos-smoke|all]" >&2; exit 2 ;;
 esac

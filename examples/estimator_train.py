@@ -25,7 +25,6 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
     )
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import optax

@@ -1,0 +1,75 @@
+"""Static analysis over lowered programs + runtime schedule audit.
+
+Every structural guarantee this repo sells — N independent per-bucket
+collectives (overlap), int8 on the inter hop only (two-level wire),
+donated carries and ``decode_compiles==1`` (serving), guard overhead
+exactly zero (integrity) — used to be enforced by ad-hoc
+``lowered.as_text()`` regex asserts scattered across test files and
+bench harnesses. This package promotes program-invariant checking to a
+subsystem:
+
+* :mod:`hlo_parse` — a structured parser over ``jit(...).lower()``
+  StableHLO text producing a typed :class:`ProgramGraph`: collective
+  ops with replica groups, operand dtypes/shapes/byte counts, def-use
+  edges between collectives, and donation (``jax.buffer_donor``)
+  coverage.
+* :mod:`rules` — a declarative invariant engine over ProgramGraphs
+  (and runtime counter dicts), each rule yielding structured findings
+  with the offending HLO snippet.
+* :mod:`sched_audit` — the runtime half: every eager fused dispatch
+  folds into a per-rank rolling schedule fingerprint, published
+  through the rendezvous KV on the ``HOROVOD_AUDIT_STEPS`` cadence so
+  the elastic driver can flag a schedule-divergent rank (reason
+  ``sched_divergence``) *before* the mismatch manifests as a
+  collective hang.
+
+``scripts/hlo_audit.py`` evaluates the rule catalog over the canonical
+program roster; the five structure-asserting test files and the bench
+harnesses' lowered-module gates share this parser instead of per-file
+regex. docs/analysis.md is the catalog + runbook.
+"""
+
+from . import rules, sched_audit
+from .hlo_parse import (
+    COLLECTIVE_KINDS,
+    ArgInfo,
+    Collective,
+    ProgramGraph,
+    TensorType,
+    parse_module,
+)
+from .rules import (
+    CollectiveCount,
+    CompileBudget,
+    DonationCoverage,
+    Finding,
+    GuardOverhead,
+    NoInterCollectiveDefUse,
+    Report,
+    ReplicaGroupStructure,
+    WireDtype,
+    expect,
+    run_rules,
+)
+
+__all__ = [
+    "COLLECTIVE_KINDS",
+    "ArgInfo",
+    "Collective",
+    "ProgramGraph",
+    "TensorType",
+    "parse_module",
+    "rules",
+    "sched_audit",
+    "CollectiveCount",
+    "CompileBudget",
+    "DonationCoverage",
+    "Finding",
+    "GuardOverhead",
+    "NoInterCollectiveDefUse",
+    "Report",
+    "ReplicaGroupStructure",
+    "WireDtype",
+    "expect",
+    "run_rules",
+]

@@ -1,0 +1,454 @@
+"""Structured parser over lowered StableHLO/HLO text.
+
+``jit(fn).lower(*args).as_text()`` prints the module in MLIR generic
+form; the collectives this repo's invariants are written against all
+surface as quoted ops with their routing attributes inline::
+
+    %0 = "stablehlo.all_reduce"(%arg0) <{..., replica_groups =
+        dense<[[0, 1, 2, 3], [4, 5, 6, 7]]> : tensor<2x4xi64>, ...}> ({
+    ^bb0(%arg1: tensor<f32>, %arg2: tensor<f32>):
+      ...
+    }) : (tensor<1x16xf32>) -> tensor<1x16xf32>
+
+The parser is deliberately line-structured (the format the rest of the
+repo already greps) rather than a full MLIR frontend: it recovers
+exactly the facts the rule engine needs — per-function SSA def-use,
+the five collective kinds with replica groups / operand types /
+reduction scalar type, and donation coverage from the entry function's
+``jax.buffer_donor`` arg attributes — and attaches line-accurate
+snippets so a violated invariant can show the offending HLO.
+
+Scope notes:
+
+* Def-use edges are computed WITHIN each function body; ``call`` edges
+  are opaque. Every collective this repo lowers lives inside a single
+  ``shmap_body``/entry function, so independence questions never cross
+  a call boundary in practice.
+* Donation at the StableHLO level is the ``jax.buffer_donor`` arg
+  attribute (plus ``tf.aliasing_output`` for pre-pinned aliases); the
+  post-compile ``input_output_alias`` table is derived from it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+# The lowered-program surface the invariant catalog is written over.
+COLLECTIVE_KINDS = (
+    "all_reduce",
+    "reduce_scatter",
+    "all_gather",
+    "all_to_all",
+    "collective_permute",
+)
+
+_DTYPE_BYTES = {
+    "i1": 1, "i4": 1, "i8": 1, "i16": 2, "i32": 4, "i64": 8,
+    "ui4": 1, "ui8": 1, "ui16": 2, "ui32": 4, "ui64": 8,
+    "f8E4M3FN": 1, "f8E5M2": 1, "bf16": 2, "f16": 2, "f32": 4,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+_STMT_RE = re.compile(r"^\s*(%[\w.#:]+)\s*=\s*(.*)$")
+_SSA_RE = re.compile(r"%[\w.#]+")
+_TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+_FUNC_RE = re.compile(r"^\s*func\.func\s+(?:public|private)?\s*@([\w.]+)\s*\(")
+_GROUPS_RE = re.compile(r"replica_groups\s*=\s*dense<(.*?)>\s*:\s*tensor<")
+_PAIRS_RE = re.compile(r"source_target_pairs\s*=\s*dense<(.*?)>\s*:\s*tensor<")
+_PARTITIONS_RE = re.compile(r"mhlo.num_partitions\s*=\s*(\d+)")
+_SIG_RE = re.compile(r":\s*\((.*?)\)\s*->\s*(.*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorType:
+    """One ``tensor<...>`` type: shape, element dtype, sizes."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+
+    @property
+    def elems(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        return self.elems * _DTYPE_BYTES.get(self.dtype, 4)
+
+    @property
+    def is_scalar(self) -> bool:
+        return self.elems == 1
+
+    def __str__(self) -> str:  # tensor<1x16xf32> back-form, for messages
+        dims = "x".join(str(d) for d in self.shape)
+        return f"tensor<{dims}{'x' if dims else ''}{self.dtype}>"
+
+
+def _parse_tensor_type(inner: str) -> TensorType:
+    """``1x16xf32`` / ``f32`` / ``2x4xi64`` -> TensorType. Dynamic or
+    exotic dims parse as 0 (they never occur in this repo's modules)."""
+    parts = inner.strip().split("x")
+    dims: List[int] = []
+    dtype = parts[-1]
+    for p in parts[:-1]:
+        try:
+            dims.append(int(p))
+        except ValueError:
+            dims.append(0)
+    return TensorType(tuple(dims), dtype.strip())
+
+
+def _types_in(text: str) -> Tuple[TensorType, ...]:
+    return tuple(_parse_tensor_type(m) for m in _TENSOR_RE.findall(text))
+
+
+def _parse_groups(raw: str) -> Tuple[Tuple[int, ...], ...]:
+    """``[[0, 1, 2, 3], [4, 5, 6, 7]]`` (or a splat like ``0``) ->
+    tuple of rank rows."""
+    raw = raw.strip()
+    if not raw.startswith("["):
+        # dense splat (single scalar) — one group of one
+        try:
+            return ((int(raw),),)
+        except ValueError:
+            return ()
+    rows = []
+    for row in re.findall(r"\[([-\d,\s]*?)\]", raw.replace("[[", "[").replace("]]", "]")):
+        vals = [int(v) for v in row.replace(" ", "").split(",") if v != ""]
+        if vals:
+            rows.append(tuple(vals))
+    return tuple(rows)
+
+
+@dataclasses.dataclass
+class Statement:
+    """One SSA statement inside a function body."""
+
+    sid: str
+    func: str
+    rhs: str
+    operands: Tuple[str, ...]
+    line_no: int  # 0-based index into the module's line list
+
+
+@dataclasses.dataclass
+class Collective:
+    """A parsed collective op with its routing and payload facts."""
+
+    kind: str  # one of COLLECTIVE_KINDS
+    sid: str
+    func: str
+    index: int  # order of appearance among the module's collectives
+    replica_groups: Tuple[Tuple[int, ...], ...]
+    operand_types: Tuple[TensorType, ...]
+    result_types: Tuple[TensorType, ...]
+    reduction_dtype: Optional[str]  # region block-arg scalar type
+    line_no: int
+    snippet: str
+
+    @property
+    def group_sizes(self) -> Tuple[int, ...]:
+        return tuple(len(g) for g in self.replica_groups)
+
+    @property
+    def operand_bytes(self) -> int:
+        return sum(t.nbytes for t in self.operand_types)
+
+    @property
+    def dtypes(self) -> Tuple[str, ...]:
+        return tuple(t.dtype for t in self.operand_types)
+
+    def spans(self, world: int) -> bool:
+        """True when any replica group covers the whole world."""
+        return any(len(g) >= world for g in self.replica_groups)
+
+    def is_scalar(self) -> bool:
+        return all(t.is_scalar for t in self.operand_types)
+
+
+@dataclasses.dataclass(frozen=True)
+class ArgInfo:
+    """One entry-function argument: its type and donation marking."""
+
+    index: int
+    type: Optional[TensorType]
+    donated: bool
+    aliased_output: Optional[int]  # tf.aliasing_output target, if pinned
+
+
+class ProgramGraph:
+    """Typed view of one lowered module: collectives, def-use edges,
+    donation coverage. Built by :func:`parse_module`."""
+
+    def __init__(
+        self,
+        text: str,
+        collectives: List[Collective],
+        statements: Dict[str, Dict[str, Statement]],
+        args: Dict[str, List[ArgInfo]],
+        entry: str,
+        num_partitions: int,
+    ) -> None:
+        self.text = text
+        self._collectives = collectives
+        self._stmts = statements  # {func: {sid: Statement}}
+        self._args = args  # {func: [ArgInfo]}
+        self.entry = entry
+        self.num_partitions = num_partitions
+
+    # ------------------------------------------------------------ queries
+
+    def collectives(self, kind: Optional[str] = None) -> List[Collective]:
+        if kind is None:
+            return list(self._collectives)
+        _check_kind(kind)
+        return [c for c in self._collectives if c.kind == kind]
+
+    def count(self, kind: str) -> int:
+        return len(self.collectives(kind))
+
+    def counts(self) -> Dict[str, int]:
+        """{kind: count} over every collective kind (bench gates)."""
+        out = {k: 0 for k in COLLECTIVE_KINDS}
+        for c in self._collectives:
+            out[c.kind] += 1
+        return out
+
+    def replica_groups(
+        self, kind: Optional[str] = None
+    ) -> List[Tuple[Tuple[int, ...], ...]]:
+        return [c.replica_groups for c in self.collectives(kind)]
+
+    def group_sizes(self, kind: Optional[str] = None) -> List[int]:
+        """First-row group size of each matching collective (the
+        monolithic-exchange detector: a size == world row spans it)."""
+        out = []
+        for c in self.collectives(kind):
+            if c.replica_groups:
+                out.append(len(c.replica_groups[0]))
+        return out
+
+    def args(self, func: Optional[str] = None) -> List[ArgInfo]:
+        return list(self._args.get(func or self.entry, []))
+
+    def donated_args(self, func: Optional[str] = None) -> List[ArgInfo]:
+        return [a for a in self.args(func) if a.donated or a.aliased_output is not None]
+
+    # ---------------------------------------------------------- def-use
+
+    def _deps_of(self, stmt: Statement) -> set:
+        """Transitive SSA dependencies of one statement (within its
+        function body; call boundaries are opaque)."""
+        defs = self._stmts.get(stmt.func, {})
+        out: set = set()
+        stack = [o.split("#")[0] for o in stmt.operands]
+        while stack:
+            o = stack.pop()
+            if o in out or o not in defs:
+                continue
+            out.add(o)
+            # `%a#0` uses resolve to the multi-result def `%a`
+            stack.extend(x.split("#")[0] for x in defs[o].operands)
+        return out
+
+    def dependent_pairs(
+        self, kind: Optional[str] = None
+    ) -> List[Tuple[Collective, Collective]]:
+        """(dependent, dependency) pairs among the matching collectives:
+        empty means every matching collective is mutually independent —
+        the overlap contract (no artificial serialization between
+        buckets)."""
+        colls = self.collectives(kind)
+        by_func: Dict[str, List[Collective]] = {}
+        for c in colls:
+            by_func.setdefault(c.func, []).append(c)
+        pairs: List[Tuple[Collective, Collective]] = []
+        for func, group in by_func.items():
+            defs = self._stmts.get(func, {})
+            ids = {c.sid: c for c in group}
+            for c in group:
+                stmt = defs.get(c.sid)
+                if stmt is None:
+                    continue
+                deps = self._deps_of(stmt)
+                for other_sid, other in ids.items():
+                    if other_sid != c.sid and other_sid in deps:
+                        pairs.append((c, other))
+        return pairs
+
+    def independent(self, kind: Optional[str] = None) -> bool:
+        return not self.dependent_pairs(kind)
+
+
+def _check_kind(kind: str) -> None:
+    if kind not in COLLECTIVE_KINDS:
+        raise ValueError(
+            f"unknown collective kind {kind!r}; expected one of "
+            f"{COLLECTIVE_KINDS}"
+        )
+
+
+def _parse_func_args(sig: str) -> List[ArgInfo]:
+    """Arguments of one ``func.func`` signature line: type + donation
+    attrs. The signature is everything between the outer parens."""
+    args: List[ArgInfo] = []
+    # split on top-level commas (attr dicts `{...}` and types `<...>`
+    # carry nested commas)
+    depth = 0
+    start = 0
+    parts: List[str] = []
+    for i, ch in enumerate(sig):
+        if ch in "<{([":
+            depth += 1
+        elif ch in ">})]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(sig[start:i])
+            start = i + 1
+    tail = sig[start:].strip()
+    if tail:
+        parts.append(tail)
+    for i, part in enumerate(parts):
+        tm = _TENSOR_RE.search(part)
+        ttype = _parse_tensor_type(tm.group(1)) if tm else None
+        donated = "jax.buffer_donor" in part
+        alias = None
+        am = re.search(r"tf\.aliasing_output\s*=\s*(\d+)", part)
+        if am:
+            alias = int(am.group(1))
+        args.append(ArgInfo(i, ttype, donated, alias))
+    return args
+
+
+def parse_module(lowered) -> ProgramGraph:
+    """Parse a lowered module into a :class:`ProgramGraph`.
+
+    Accepts the module text, anything with ``.as_text()`` (a
+    ``jax.stages.Lowered``), or anything with ``.lower`` already
+    applied. This is THE shared entry point — tests and bench gates
+    pass their lowered step here instead of regexing the text."""
+    if hasattr(lowered, "as_text"):
+        text = lowered.as_text()
+    else:
+        text = str(lowered)
+    lines = text.splitlines()
+
+    num_partitions = 1
+    pm = _PARTITIONS_RE.search(text)
+    if pm:
+        num_partitions = int(pm.group(1))
+
+    statements: Dict[str, Dict[str, Statement]] = {}
+    func_args: Dict[str, List[ArgInfo]] = {}
+    collectives: List[Collective] = []
+    entry = "main"
+    current_func = ""
+
+    i = 0
+    n = len(lines)
+    while i < n:
+        line = lines[i]
+        fm = _FUNC_RE.match(line)
+        if fm:
+            current_func = fm.group(1)
+            if "public" in line.split("@")[0] and not func_args.get(entry):
+                entry = current_func
+            # signatures in as_text() print single-line
+            inner = line[line.index("(") + 1 :]
+            # cut at the matching close paren of the arg list
+            depth = 1
+            for k, ch in enumerate(inner):
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                    if depth == 0:
+                        inner = inner[:k]
+                        break
+            func_args[current_func] = _parse_func_args(inner)
+            statements.setdefault(current_func, {})
+            i += 1
+            continue
+
+        m = _STMT_RE.match(line)
+        if not m:
+            i += 1
+            continue
+        sid, rhs = m.group(1), m.group(2)
+        # a `%a:2 = ...` multi-result statement: normalize the id
+        sid = sid.split(":")[0]
+        op_lines = [line]
+        end = i
+        if rhs.rstrip().endswith("({"):
+            # region-carrying op (all_reduce / reduce_scatter): the
+            # type signature rides the closing `})` line
+            depth = 1
+            j = i + 1
+            while j < n and depth > 0:
+                op_lines.append(lines[j])
+                depth += lines[j].count("({")
+                if lines[j].lstrip().startswith("})"):
+                    depth -= 1
+                j += 1
+            end = j - 1
+            rhs_full = rhs + " " + " ".join(
+                ln.strip() for ln in op_lines[1:]
+            )
+        else:
+            rhs_full = rhs
+        operands = tuple(_SSA_RE.findall(rhs))
+        statements.setdefault(current_func, {})[sid] = Statement(
+            sid, current_func, rhs_full, operands, i
+        )
+
+        kind = None
+        for k in COLLECTIVE_KINDS:
+            if f'"stablehlo.{k}"' in rhs or f'"mhlo.{k}"' in rhs:
+                kind = k
+                break
+        if kind is not None:
+            gm = _GROUPS_RE.search(rhs)
+            if gm is None:
+                gm = _PAIRS_RE.search(rhs)
+            groups = _parse_groups(gm.group(1)) if gm else ()
+            # operand/result types: trailing `: (...) -> ...` on the
+            # closing line (region ops) or the op line itself
+            sig_line = op_lines[-1]
+            sm = _SIG_RE.search(sig_line)
+            if sm:
+                operand_types = _types_in(sm.group(1))
+                result_types = _types_in(sm.group(2))
+            else:
+                operand_types = result_types = ()
+            red_dtype = None
+            for ln in op_lines:
+                bm = re.search(r"\^bb\d+\(%[\w.#]+:\s*tensor<([^>]*)>", ln)
+                if bm:
+                    red_dtype = _parse_tensor_type(bm.group(1)).dtype
+                    break
+            snippet = op_lines[0].strip()
+            if len(snippet) > 240:
+                snippet = snippet[:237] + "..."
+            collectives.append(
+                Collective(
+                    kind=kind,
+                    sid=sid,
+                    func=current_func,
+                    index=len(collectives),
+                    replica_groups=groups,
+                    operand_types=operand_types,
+                    result_types=result_types,
+                    reduction_dtype=red_dtype,
+                    line_no=i,
+                    snippet=snippet,
+                )
+            )
+        i = end + 1
+
+    return ProgramGraph(
+        text, collectives, statements, func_args, entry, num_partitions
+    )

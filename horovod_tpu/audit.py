@@ -101,23 +101,36 @@ def tree_meta_digest(tree: Any) -> str:
     return h.hexdigest()
 
 
-def _publish(rank: int, step: int, digest: str) -> bool:
-    """Best-effort KV publication; False when there is no rendezvous
-    to publish to (single-process runs, tests)."""
+def _cached_kv_client():
+    """The process-wide rendezvous client for audit-plane publication
+    (parameter digests here, schedule fingerprints in
+    analysis/sched_audit.py — one connection, one failure posture).
+    None when no rendezvous is configured (single-process runs,
+    tests)."""
     global _kv_client, _kv_unavailable
     from .common.config import Config
-    from .runner.rendezvous import _client_from_cfg, put_audit
+    from .runner.rendezvous import _client_from_cfg
 
     with _lock:
         if _kv_unavailable:
-            return False
+            return None
         if _kv_client is None:
             cfg = Config.from_env()
             if not (cfg.rendezvous_addr and cfg.rendezvous_port):
                 _kv_unavailable = True
-                return False
+                return None
             _kv_client = _client_from_cfg(cfg)
-        client = _kv_client
+        return _kv_client
+
+
+def _publish(rank: int, step: int, digest: str) -> bool:
+    """Best-effort KV publication; False when there is no rendezvous
+    to publish to (single-process runs, tests)."""
+    from .runner.rendezvous import put_audit
+
+    client = _cached_kv_client()
+    if client is None:
+        return False
     try:
         put_audit(client, rank, step, digest)
         return True
@@ -150,6 +163,12 @@ def audit(tree: Any, step: int = 0, rank: Optional[int] = None) -> str:
     _metrics.counter("audit.digests")
     _metrics.gauge("audit.last_digest_step", step)
     _publish(int(rank), step, digest)
+    # the collective-schedule fingerprint rides the same cadence and
+    # the same KV (analysis/sched_audit.py): parameter divergence and
+    # schedule divergence are the two halves of one audit plane
+    from .analysis import sched_audit as _sched
+
+    _sched.publish(step, rank=rank)
     _log.debug("audit step %d: %s", step, digest[:16])
     return digest
 

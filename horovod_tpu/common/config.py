@@ -306,6 +306,15 @@ class Config:
     # digests every N steps (0 = off). Digest mismatches across ranks
     # surface through the rendezvous KV as a `divergence` restart.
     audit_steps: int = DEFAULT_AUDIT_STEPS
+    # collective-schedule audit (analysis/sched_audit.py): every eager
+    # fused dispatch folds (op kind, composition, wire, pset) into a
+    # per-rank rolling fingerprint, published beside the parameter
+    # digests on the HOROVOD_AUDIT_STEPS cadence; the driver flags a
+    # rank whose compiled collective schedule diverges (reason
+    # `sched_divergence`) before the mismatch becomes a hang. The fold
+    # is a sub-microsecond hash per DISPATCH (not per step), so it is
+    # on by default; 0 disables recording and publication.
+    sched_audit: bool = True
 
     # --- serving plane (horovod_tpu/serving/) ---
     # hvd.serve frontend port (0 = ephemeral, announced over the
@@ -493,6 +502,7 @@ class Config:
             audit_steps=_env_int(
                 "HOROVOD_AUDIT_STEPS", DEFAULT_AUDIT_STEPS
             ),
+            sched_audit=_env_bool("HOROVOD_SCHED_AUDIT", True),
             serve_port=_env_int("HOROVOD_SERVE_PORT", DEFAULT_SERVE_PORT),
             serve_kv_slots=_env_int(
                 "HOROVOD_SERVE_KV_SLOTS", DEFAULT_SERVE_KV_SLOTS

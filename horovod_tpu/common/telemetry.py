@@ -99,6 +99,11 @@ _COUNTER_KEYS = (
     # flight recorder pins integrity events to exact steps
     "guard.nonfinite_steps",
     "audit.digests",
+    # collective-schedule audit (analysis/sched_audit.py): a nonzero
+    # sched_published delta marks the steps whose records carried a
+    # schedule-fingerprint publish — the cadence evidence for the
+    # sched_divergence detector
+    "audit.sched_published",
     # serving plane (horovod_tpu/serving/): a decode-step record's
     # tokens-out delta is its realized batch occupancy, and a nonzero
     # admitted_mid_decode delta pins a TPOT blip to the prefill that

@@ -19,7 +19,7 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 # Lifecycle phase names, mirroring timeline.cc's event names [V].
 NEGOTIATE = "NEGOTIATE_{}"

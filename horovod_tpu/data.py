@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import collections
 import itertools
-from typing import Iterable, Iterator, Optional, Sequence
+from typing import Iterable, Iterator, Optional
 
 import numpy as np
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import subprocess
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..runner.hosts import HostInfo, parse_hosts
 

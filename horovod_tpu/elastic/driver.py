@@ -139,6 +139,13 @@ class ElasticDriver:
         # quarantines replicas that disagree with the majority
         self._last_audit_poll = 0.0
         self._last_audit_step: Optional[int] = None
+        # collective-schedule audit (analysis/sched_audit.py): workers
+        # publish rolling schedule fingerprints beside the digests; a
+        # rank whose compiled collective schedule diverges is flagged
+        # (reason `sched_divergence`) BEFORE the mismatch manifests as
+        # a collective hang the stall inspector would need minutes to
+        # escalate on
+        self._last_sched_step: Optional[int] = None
         # straggler-aware scheduling (HOROVOD_REBALANCE): instead of
         # only logging a flagged rank, publish micro-batch weights that
         # shift work away from slices whose step p50 STAYS flagged —
@@ -189,15 +196,21 @@ class ElasticDriver:
             ",".join(sorted(set(assignment.hostnames))),
         )
         server = self._rendezvous()
-        from ..runner.rendezvous import AUDIT_SCOPE, HEARTBEAT_SCOPE
+        from ..runner.rendezvous import (
+            AUDIT_SCOPE,
+            HEARTBEAT_SCOPE,
+            SCHED_SCOPE,
+        )
 
         self.stall_inspector.reset_heartbeats()
         try:
             server.store.drop_scope(HEARTBEAT_SCOPE)
             server.store.drop_scope(AUDIT_SCOPE)
+            server.store.drop_scope(SCHED_SCOPE)
         except Exception:
             pass
         self._last_audit_step = None
+        self._last_sched_step = None
         placement = self._placement
         if placement == "auto":
             placement = (
@@ -654,15 +667,23 @@ class ElasticDriver:
         return True
 
     def _poll_audit(self, now: float) -> Optional[str]:
-        """Divergence detection (audit.py): compare the gang's
-        published parameter digests once per discovery interval. A
+        """Divergence detection, both halves of the audit plane once
+        per discovery interval: parameter digests (audit.py) and
+        collective-schedule fingerprints (analysis/sched_audit.py). A
         replica disagreeing with the majority gets its host
-        quarantined and the gang restarts with reason ``divergence`` —
-        the restore re-replicates state from the root, which repairs
-        the divergence even when the capacity guard keeps the host."""
+        quarantined and the gang restarts (reason ``divergence`` /
+        ``sched_divergence``) — the restore re-replicates state from
+        the root, which repairs the divergence even when the capacity
+        guard keeps the host. The schedule half fires BEFORE a
+        mismatched collective sequence can hang: the divergent rank is
+        flagged at its next audit publish, not after the stall
+        inspector's heartbeat-silence window."""
         if self._server is None or now - self._last_audit_poll < self._interval:
             return None
         self._last_audit_poll = now
+        sched_reason = self._check_sched_divergence()
+        if sched_reason:
+            return sched_reason
         from ..audit import find_divergent
         from ..runner.rendezvous import read_audit_digests
 
@@ -698,6 +719,78 @@ class ElasticDriver:
         return (
             f"divergence: ranks {','.join(map(str, bad_ranks))} at "
             f"audit step {step}"
+        )
+
+    def _check_sched_divergence(self) -> Optional[str]:
+        """The schedule half of :meth:`_poll_audit`: compare the
+        gang's published collective-schedule fingerprints at the
+        newest quorum step (majority fingerprint wins, the
+        parameter-digest arbitration reused). A divergent rank's host
+        is quarantined through the shared blacklist gate and the gang
+        restarts with reason ``sched_divergence`` — logging the FIRST
+        divergent dispatch index recovered from the published rings,
+        so the postmortem starts at the exact dispatch."""
+        from ..analysis import sched_audit as _sched
+        from ..runner.rendezvous import read_sched_fingerprints
+
+        try:
+            entries = read_sched_fingerprints(self._server.store)
+        except Exception:
+            _log.debug("sched audit poll failed", exc_info=True)
+            return None
+        found = _sched.find_divergent(entries)
+        if found is None:
+            return None
+        step, bad_ranks = found
+        if step == self._last_sched_step:
+            return None  # this round was already judged
+        self._last_sched_step = step
+        from ..common.metrics import registry as _metrics
+
+        _metrics.counter("driver.sched_divergence_restarts")
+        good_ranks = sorted(
+            r
+            for r in entries
+            if r not in bad_ranks
+            and isinstance(entries[r], dict)
+            and entries[r].get("step") == step
+        )
+        first_idx = None
+        if good_ranks and bad_ranks:
+            first_idx = _sched.first_divergent_index(
+                entries[bad_ranks[0]], entries[good_ranks[0]]
+            )
+        counts = {
+            r: entries[r].get("dispatches")
+            for r in sorted(entries)
+            if isinstance(entries[r], dict)
+        }
+        hosts = self._hosts_of_ranks(bad_ranks)
+        quarantined = hosts and self._try_blacklist(
+            hosts, "sched divergence quarantine"
+        )
+        _log.error(
+            "collective-schedule divergence at audit step %d: ranks %s "
+            "disagree with the gang's majority fingerprint (first "
+            "divergent dispatch %s; dispatch counts %s)%s; restarting "
+            "gang before the mismatched schedule can hang a collective",
+            step, ",".join(map(str, bad_ranks)),
+            ("#%d" % first_idx) if first_idx is not None else "outside ring",
+            counts,
+            (
+                f" (hosts {','.join(hosts)} quarantined)"
+                if quarantined
+                else " (hosts kept: capacity guard — restart re-syncs)"
+            ),
+        )
+        return (
+            f"sched_divergence: ranks {','.join(map(str, bad_ranks))} at "
+            f"audit step {step}"
+            + (
+                f" (first divergent dispatch #{first_idx})"
+                if first_idx is not None
+                else ""
+            )
         )
 
     def _reset(self, reason: str) -> bool:

@@ -22,7 +22,7 @@ re-resolves it through basics rather than caching shardings.
 from __future__ import annotations
 
 import copy
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 import jax
 import numpy as np

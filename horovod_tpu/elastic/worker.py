@@ -66,6 +66,12 @@ class WorkerNotificationManager:
             _audit_reset()
             # same re-dial contract for the rebalance-weight reader
             _reset_rebalance_cache()
+            # a (re)joining gang starts a fresh collective schedule:
+            # carrying the old epoch's fingerprint would mis-flag the
+            # whole new gang as divergent from itself
+            from ..analysis import sched_audit as _sched_audit
+
+            _sched_audit.reset()
             cfg = config_mod.Config.from_env()
             if not (
                 cfg.rendezvous_addr

@@ -3,7 +3,6 @@
 [V]; BASELINE.json config #1). Same capacity, TPU-idiomatic NHWC layout."""
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class MNISTConvNet(nn.Module):

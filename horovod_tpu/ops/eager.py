@@ -38,7 +38,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common import basics
-from ..common.topology import rank_sharding
 from ..common.process_sets import ProcessSet
 from .fusion import Handle, _Entry
 from .reduction_ops import Average, ReduceOp, Sum, resolve_op

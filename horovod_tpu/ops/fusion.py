@@ -85,6 +85,7 @@ Handles reproduce the async API: `allreduce_async_` returns a handle;
 from __future__ import annotations
 
 import dataclasses
+import re
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -99,6 +100,7 @@ from ..common.compat import shard_map
 from ..common.topology import WORLD_AXIS
 from ..common.process_sets import ProcessSet
 from ..common.logging import get_logger
+from ..analysis import sched_audit as _sched_audit
 from .reduction_ops import Average, Sum, Adasum, Min, Max, Product, ReduceOp
 
 _log = get_logger("fusion")
@@ -150,6 +152,24 @@ class Handle:
             self._fusion.flush()
         assert self._done, "flush did not fulfill handle"
         return self._result
+
+
+_SCHED_NONAME = re.compile(r"^(\w+)\.noname\.\d+(\..+)?$")
+
+
+def _sched_entry_name(name: str) -> str:
+    """Schedule-fingerprint view of an entry name: auto-generated
+    ``<op>.noname.<counter>`` labels collapse to the op prefix — the
+    process-global counter only restates dispatch order (which the
+    rolling fold already encodes) and would make two identical
+    schedules diverge on counter offset alone (e.g. a rejoined worker
+    restarting its counter at 0). Grouped entries
+    (``<op>.noname.<counter>.<i>``) keep the member index ``<i>`` —
+    that part IS schedule identity. User-supplied names fold as-is."""
+    m = _SCHED_NONAME.match(name or "")
+    if m is None:
+        return name or ""
+    return m.group(1) + (m.group(2) or "")
 
 
 def _group_key(e: _Entry) -> Tuple:
@@ -1056,6 +1076,35 @@ class FusionManager:
     def _execute_batch(self, batch: List[_Entry]) -> None:
         spec = self._classify(batch)
         plan, core_key = spec.plan, spec.core_key
+        # collective-schedule audit (analysis/sched_audit.py): fold this
+        # dispatch's rank-invariant identity — kind/op, fused-entry
+        # composition, resolved wire, pset — into the rolling per-rank
+        # fingerprint. A rank whose tuner, composition, or code path
+        # diverges here is about to compile a DIFFERENT collective
+        # sequence: the deadlock precursor the driver quarantines on.
+        # (enabled() gates at the call site so a disabled audit skips
+        # the composition-tuple construction too, not just the fold)
+        if _sched_audit.enabled():
+            _sched_audit.record(
+                f"{batch[0].kind}:"
+                f"{'' if batch[0].op is None else int(batch[0].op)}",
+                (
+                    plan.family,
+                    tuple(_sched_entry_name(e.name) for e in batch),
+                    plan.shapes,
+                    plan.dtype,
+                ),
+                wire=(
+                    f"{spec.intra_wire}/{spec.wire}"
+                    if spec.hier_n
+                    else spec.wire
+                ),
+                pset=(
+                    0
+                    if batch[0].process_set is None
+                    else batch[0].process_set.process_set_id
+                ),
+            )
         # the non-finite sentinel rides only float batches (integer
         # payloads are finite by construction); the flag is an extra
         # executor output, so it is part of what the cache key already
@@ -1943,6 +1992,21 @@ class FusionManager:
                 f"participating rank count {n_ranks}"
             )
         key = ("alltoall", ranks, payload.shape, payload.dtype.name)
+        if _sched_audit.enabled():
+            _sched_audit.record(
+                "alltoall",
+                (
+                    _sched_entry_name(e.name),
+                    tuple(payload.shape),
+                    payload.dtype.name,
+                ),
+                wire=e.wire,
+                pset=(
+                    0
+                    if e.process_set is None
+                    else e.process_set.process_set_id
+                ),
+            )
         fn = self._executor(key, lambda: self._build_alltoall(ranks))
         self.dispatches += 1
         self.last_cycle_dispatches += 1

@@ -10,8 +10,7 @@ analog is its hierarchical intra/inter-node split
 from __future__ import annotations
 
 import dataclasses
-import math
-from typing import Dict, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

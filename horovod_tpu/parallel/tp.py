@@ -14,8 +14,6 @@ on the 'tp' axis, activations stay replicated across it.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 from jax import lax
 

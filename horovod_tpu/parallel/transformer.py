@@ -47,7 +47,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +56,6 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common.compat import shard_map
-from .mesh import MeshSpec
 from .moe import MoEParams, init_moe_params, moe_ffn
 from .pipeline import gpipe, pipeline_1f1b
 from .ring_attention import ring_attention, ring_flash_attention

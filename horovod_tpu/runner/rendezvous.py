@@ -526,6 +526,55 @@ def read_audit_digests(store_or_client) -> Dict[int, dict]:
     return out
 
 
+SCHED_SCOPE = "sched"
+
+
+def put_sched(
+    client: "RendezvousClient",
+    rank: int,
+    step: int,
+    fingerprint: str,
+    dispatches: int,
+    ring=None,
+) -> None:
+    """Worker side of the collective-schedule ledger
+    (analysis/sched_audit.py): publish this rank's rolling schedule
+    fingerprint, total dispatch count, and the bounded ring of recent
+    per-dispatch digests (``[[index, digest], ...]`` — how the driver
+    recovers the FIRST divergent dispatch). One KV key per rank,
+    overwritten per audit round, scope dropped per gang launch beside
+    the parameter digests."""
+    import time as _time
+
+    payload = {
+        "ts": _time.time(),
+        "step": int(step),
+        "fingerprint": str(fingerprint),
+        "dispatches": int(dispatches),
+        "ring": [[int(i), str(d)] for i, d in (ring or [])],
+    }
+    client.put(SCHED_SCOPE, str(int(rank)), json.dumps(payload).encode())
+
+
+def read_sched_fingerprints(store_or_client) -> Dict[int, dict]:
+    """Driver side: ``{rank: {"ts", "step", "fingerprint",
+    "dispatches", "ring"}}``. Malformed entries are skipped — a
+    corrupt schedule record must not crash the auditor."""
+    out: Dict[int, dict] = {}
+    for key in store_or_client.keys(SCHED_SCOPE):
+        raw = store_or_client.get(SCHED_SCOPE, key)
+        if raw is None:
+            continue
+        try:
+            rank = int(key)
+            obj = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if isinstance(obj, dict) and "fingerprint" in obj and "step" in obj:
+            out[rank] = obj
+    return out
+
+
 REBALANCE_SCOPE = "rebalance"
 
 

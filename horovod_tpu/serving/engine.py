@@ -233,7 +233,12 @@ class InferenceEngine:
         )
         return (rep, cache_sh)
 
-    def _compile(self, fn, args, kind: str):
+    def _lower(self, fn, args):
+        """THE one jit-option assembly (donated cache carry at arg 1,
+        pinned out-shardings): ``_compile`` finishes it into the
+        executable, ``lowered_decode``/``lowered_prefill`` hand the
+        Lowered to the static-analysis surface — one builder, so the
+        audited program can never drift from the executed one."""
         import jax
 
         kwargs = {}
@@ -242,10 +247,38 @@ class InferenceEngine:
         out_sh = self._out_shardings()
         if out_sh is not None:
             kwargs["out_shardings"] = out_sh
-        exe = jax.jit(fn, **kwargs).lower(*args).compile()
+        return jax.jit(fn, **kwargs).lower(*args)
+
+    def _compile(self, fn, args, kind: str):
+        exe = self._lower(fn, args).compile()
         with self._lock:
             self._counters[f"{kind}_compiles"] += 1
         return exe
+
+    def _decode_args(self, tokens):
+        lengths = self.manager.lengths_array()
+        args = (self._params, self.manager.cache, tokens, lengths)
+        if self.paged:
+            args = args + (self.manager.tables_array(),)
+        return args
+
+    def lowered_decode(self):
+        """The decode step's ``jax.stages.Lowered`` under exactly the
+        jit options the engine compiles with (shared :meth:`_lower`) —
+        the static-analysis surface ``horovod_tpu.analysis`` parses
+        for the donation / collective invariants
+        (scripts/hlo_audit.py roster)."""
+        return self._lower(
+            self._decode_fn(),
+            self._decode_args(np.zeros((self.slots,), np.int32)),
+        )
+
+    def lowered_prefill(self, width: int):
+        """A prefill executable's Lowered at ``width`` tokens, same
+        contract as :meth:`lowered_decode`."""
+        return self._lower(
+            self._prefill_fn(int(width)), self._prefill_args(int(width))
+        )
 
     def _prefill_fn(self, width: int):
         """Build the prefill computation for a fixed token width: run
@@ -517,10 +550,7 @@ class InferenceEngine:
                 if starved:
                     raise PagePoolExhausted(starved)
             self._decode_swept = False
-        lengths = self.manager.lengths_array()
-        args = (self._params, self.manager.cache, tokens, lengths)
-        if self.paged:
-            args = args + (self.manager.tables_array(),)
+        args = self._decode_args(tokens)
         if self._decode_exe is None:
             self._decode_exe = self._compile(
                 self._decode_fn(), args, "decode"

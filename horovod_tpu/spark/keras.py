@@ -16,7 +16,7 @@ depth").
 from __future__ import annotations
 
 import os
-from typing import Callable, Optional
+from typing import Optional
 
 from . import Store
 

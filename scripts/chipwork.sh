@@ -1,0 +1,138 @@
+#!/usr/bin/env bash
+# chipwork.sh — the ONE parameterized unattended chip-capture runner.
+#
+# Replaces the 16 copy-paste chipwork_r04*/r05*.sh one-offs: every
+# round shared the same skeleton (wait for earlier rounds to drain,
+# probe the backend until it answers, capture each roster entry with
+# skip-if-done + one health-gated retry, extract the JSON line into
+# bench_results/) and differed only in the round tag, the wait regex,
+# and the capture roster. Those are now parameters; the discipline
+# (docs/benchmarks.md) lives in exactly one place.
+#
+# Usage:
+#   scripts/chipwork.sh -r <round> [-w <wait-regex>] [-P] <manifest>
+#
+#   -r <round>       artifact suffix: bench_results/<name>_<round>.json
+#   -w <wait-regex>  pgrep -f pattern to wait on before starting
+#                    (earlier rounds / stray bench processes); pass ""
+#                    to start immediately. Default: any chipwork/bench
+#                    python process that is not this script.
+#   -P               skip the initial backend probe (captures still
+#                    health-gate their retry).
+#   <manifest>       file of capture lines, or "-" for stdin:
+#                      <name> <command...>
+#                    '#' comments and blank lines ignored. Commands
+#                    run from the repo root; env assignments work
+#                    (lines are executed with `env`).
+#
+# Example (what chipwork_r04k.sh used to be):
+#   scripts/chipwork.sh -r r04 - <<'EOF'
+#   vit_b16_flash BENCH_INNER=1 BENCH_MODEL=vit_b16 python bench.py
+#   vit_b16_dense BENCH_INNER=1 BENCH_MODEL=vit_b16 BENCH_VIT_FLASHPAD=0 python bench.py
+#   EOF
+#
+# Discipline (unchanged from the one-offs):
+#   * ONE TPU process at a time; a scripts/CHIP_HOLD file pauses
+#     captures while a dev session runs the pytest suite (host load
+#     confounds captures).
+#   * skip-if-done: a non-empty artifact short-circuits the entry, so
+#     a re-run after an outage resumes where it died.
+#   * probe_backend: an untimed claim attempt (a failed claim reports
+#     UNAVAILABLE on its own after ~25 min — that IS the backoff); the
+#     2h timeout is only a safety net against a half-dead backend.
+#   * one retry per entry, gated on backend health, so one mid-run
+#     backend drop cannot burn the rest of the unattended roster.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+
+R=""
+WAIT_RE='chipwork_r|python bench(_lm|_allreduce|_fusion|_int8|_seq|_overlap|_zero|_hier|_moe|_serve)?\.py'
+PROBE=1
+while getopts "r:w:P" opt; do
+  case "$opt" in
+    r) R="$OPTARG" ;;
+    w) WAIT_RE="$OPTARG" ;;
+    P) PROBE=0 ;;
+    *) echo "usage: $0 -r <round> [-w <wait-regex>] [-P] <manifest>" >&2; exit 2 ;;
+  esac
+done
+shift $((OPTIND - 1))
+MANIFEST="${1:-}"
+[ -n "$R" ] || { echo "chipwork: -r <round> is required" >&2; exit 2; }
+[ -n "$MANIFEST" ] || { echo "chipwork: manifest file (or -) required" >&2; exit 2; }
+
+echo "=== chipwork $R start $(date -u +%F' '%H:%M)" >&2
+
+if [ -n "$WAIT_RE" ]; then
+  while pgrep -f "$WAIT_RE" | grep -qv "^$$\$"; do
+    echo "waiting for earlier chip work to drain..." >&2
+    sleep 120
+  done
+fi
+
+probe_backend() {
+  timeout 7200 python - <<'PYEOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+PYEOF
+}
+
+wait_backend() {
+  echo "=== probing TPU backend $(date -u +%H:%M)" >&2
+  until probe_backend; do
+    echo "backend still down $(date -u +%H:%M); retry in 300s" >&2
+    sleep 300
+  done
+  echo "=== backend UP $(date -u +%H:%M)" >&2
+}
+
+hold_gate() {
+  while [ -e scripts/CHIP_HOLD ]; do sleep 60; done
+}
+
+run_one() {  # run_one <name> <cmd...>
+  local name="$1"; shift
+  local out="bench_results/${name}_${R}.json"
+  echo "=== $name $(date -u +%H:%M)" >&2
+  env "$@" > "bench_results/${name}_${R}.txt" \
+          2> "bench_results/${name}_${R}.err"
+  if grep -qE '^\{' "bench_results/${name}_${R}.txt"; then
+    grep -E '^\{' "bench_results/${name}_${R}.txt" > "$out"
+    rm -f "bench_results/${name}_${R}.err"
+    cat "$out" >&2
+    return 0
+  fi
+  return 1
+}
+
+cap() {  # cap <name> <cmd...>
+  local name="$1"
+  if [ -s "bench_results/${name}_${R}.json" ]; then
+    echo "=== $name already captured, skipping" >&2
+    return 0
+  fi
+  hold_gate
+  if run_one "$@"; then return 0; fi
+  echo "=== $name failed; gating on backend health before one retry" >&2
+  wait_backend
+  hold_gate
+  if run_one "$@"; then return 0; fi
+  echo "FAILED $name twice with backend up (see .err)" >&2
+  return 1
+}
+
+[ "$PROBE" = 1 ] && wait_backend
+
+failures=0
+while IFS= read -r line; do
+  case "$line" in ''|'#'*) continue ;; esac
+  # shellcheck disable=SC2086 — word-splitting the manifest line is
+  # the interface (env assignments + command)
+  set -- $line
+  cap "$@" || failures=$((failures + 1))
+done < <(cat -- "$MANIFEST")
+
+echo "=== chipwork $R complete $(date -u +%F' '%H:%M) (failures: $failures)" >&2
+exit $((failures > 0))

@@ -1,0 +1,571 @@
+#!/usr/bin/env python
+"""hlo_audit: evaluate the lowered-program invariant catalog over the
+canonical roster (docs/analysis.md).
+
+Every structural claim the repo's perf/serving planes rest on — N
+independent per-bucket collectives, group-limited two-level routing
+with int8 licensed on the inter hop only, zero guard overhead, donated
+serving carries, ``decode_compiles == 1`` — is checked here as a
+declarative rule set over real ``jit(...).lower()`` modules on an
+8-device CPU mesh. Nonzero exit on ANY violated invariant; the JSON
+report is the CI artifact (``ci.sh audit-smoke``).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/hlo_audit.py [--json out.json]
+        [--only NAME] [--break MODE] [--list]
+
+``--break MODE`` injects a deliberately-broken program (e.g.
+``int8-intra`` forces int8 onto an intra-hop group) so the gate can
+prove the auditor FAILS when it should — an auditor that cannot fail
+is not evidence.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from functools import partial  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import analysis  # noqa: E402
+from horovod_tpu.analysis import rules  # noqa: E402
+from horovod_tpu.common import topology as topo  # noqa: E402
+from horovod_tpu.ops import overlap, traced  # noqa: E402
+
+WORLD = 8
+LOCAL = 4
+INTRA = tuple(tuple(g) for g in topo.hierarchical_stage_groups(WORLD, LOCAL)[0])
+INTER = tuple(tuple(g) for g in topo.hierarchical_stage_groups(WORLD, LOCAL)[1])
+STAGES = topo.hierarchical_stage_groups(WORLD, LOCAL)
+WORLD_GROUP = (tuple(range(WORLD)),)
+
+
+def _sm(body, in_specs=(P(),), out_specs=P()):
+    return partial(
+        jax.shard_map,
+        mesh=hvd.mesh(),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )(body)
+
+
+def _tree(n_leaves=6, size=64):
+    rng = np.random.default_rng(7)
+    return {
+        f"p{i}": jnp.asarray(
+            rng.normal(size=(WORLD, size)).astype(np.float32)
+        )
+        for i in range(n_leaves)
+    }
+
+
+def _graph(fn, *args):
+    return analysis.parse_module(jax.jit(fn).lower(*args))
+
+
+def _bucketed(n_buckets, hier_stages=None, compression=None):
+    def body(tr):
+        local = jax.tree_util.tree_map(lambda x: x[0], tr)
+        kw = {}
+        if compression is not None:
+            kw["compression"] = compression
+        out = overlap.bucketed_allreduce(
+            local, op=hvd.Sum, n_buckets=n_buckets, min_bucket_bytes=0,
+            hier_stages=hier_stages, **kw
+        )
+        return jax.tree_util.tree_map(lambda x: x[None], out)
+
+    return _sm(body)
+
+
+# --------------------------------------------------------------- roster
+# Each program returns [(rule, subject), ...]; the runner evaluates
+# them into one report. Rule parameters mirror the acceptance tests
+# that ride the same analysis API (tests/test_overlap.py etc.).
+
+
+def prog_fused_allreduce_fp32():
+    """PR 1/3 premise: N buckets -> N world-spanning all_reduces, no
+    inter-bucket def-use edge, full-width wire."""
+    g = _graph(_bucketed(3), _tree())
+    return [
+        (rules.CollectiveCount("all_reduce", 3), g),
+        (rules.CollectiveCount("reduce_scatter", 0), g),
+        (rules.NoInterCollectiveDefUse("all_reduce"), g),
+        (rules.ReplicaGroupStructure("all_reduce", groups=WORLD_GROUP,
+                                     require_present=True), g),
+        (rules.WireDtype(int8_allowed=False), g),
+    ]
+
+
+def prog_fused_allreduce_int8():
+    """PR 2 premise: the flat quantized wire moves int8 payloads (the
+    fp32 payload never traverses the collective) and stays one
+    independent exchange family."""
+
+    def body(t):
+        return traced.quantized_allreduce(t[0], op=hvd.Sum, seed=3)[None]
+
+    g = _graph(_sm(body), jnp.asarray(
+        np.random.default_rng(0).normal(size=(WORLD, 4096)).astype(np.float32)
+    ))
+    int8_colls = [
+        c for c in g.collectives()
+        if any(t.dtype in ("i8", "ui8") for t in c.operand_types)
+    ]
+    report_rules = [
+        (rules.CollectiveCount("all_to_all", (1, 4)), g),
+        (rules.NoInterCollectiveDefUse("all_to_all"), g),
+        (
+            rules.CompileBudget(int8_collectives=(1, 8)),
+            {"int8_collectives": len(int8_colls)},
+        ),
+    ]
+    return report_rules
+
+
+def prog_overlap_buckets():
+    """PR 3: the overlap contract at N=3 on a 6-leaf tree."""
+    g = _graph(_bucketed(3), _tree(n_leaves=6))
+    return [
+        (rules.CollectiveCount("all_reduce", 3), g),
+        (rules.NoInterCollectiveDefUse("all_reduce"), g),
+    ]
+
+
+def _zero_graphs(stage, guard=False, n_buckets=3):
+    import optax
+
+    rng = np.random.default_rng(4)
+    params = {
+        f"w{i}": jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)
+        for i in range(6)
+    }
+    x = jnp.asarray(rng.normal(size=(WORLD, 4, 16)), jnp.float32)
+    opt = hvd.ShardedDistributedOptimizer(
+        optax.adam(1e-2), op=hvd.Sum, zero_stage=stage,
+        overlap_buckets=n_buckets, overlap_min_bytes=0, grad_guard=guard,
+    )
+
+    def loss(p, xb):
+        h = xb
+        for k in sorted(p):
+            h = jnp.tanh(h @ p[k])
+        return jnp.sum(h * h)
+
+    if stage == 3:
+        ps, st = opt.init_params(params), opt.init(params)
+
+        @partial(
+            jax.shard_map, mesh=hvd.mesh(),
+            in_specs=(opt.state_spec(), opt.state_spec(), P(hvd.WORLD_AXIS)),
+            out_specs=(opt.state_spec(), opt.state_spec()),
+            check_vma=False,
+        )
+        def step(psh, s, xb):
+            import optax as _optax
+
+            local = opt.local_shards(psh)
+            _, g_sh = opt.value_and_grad(loss)(local, xb[0])
+            u, s = opt.update(g_sh, s, local)
+            return opt.as_rows(_optax.apply_updates(local, u)), s
+
+        return _graph(step, ps, st, x)
+
+    st = opt.init(params)
+
+    @partial(
+        jax.shard_map, mesh=hvd.mesh(),
+        in_specs=(P(), opt.state_spec(), P(hvd.WORLD_AXIS)),
+        out_specs=(P(), opt.state_spec()),
+        check_vma=False,
+    )
+    def step(p, s, xb):
+        import optax as _optax
+
+        _, g_sh = opt.value_and_grad(loss)(p, xb[0])
+        u, s = opt.update(g_sh, s, p)
+        return _optax.apply_updates(p, u), s
+
+    return _graph(step, params, st, x)
+
+
+def prog_zero2():
+    """PR 9: ZeRO-2 lowers to N per-bucket reduce-scatters + N
+    all-gathers, ZERO full all-reduces, mutually independent."""
+    g = _zero_graphs(2)
+    return [
+        (rules.CollectiveCount("reduce_scatter", 3), g),
+        (rules.CollectiveCount("all_gather", 3), g),
+        (rules.CollectiveCount("all_reduce", 0), g),
+        (rules.NoInterCollectiveDefUse("reduce_scatter"), g),
+    ]
+
+
+def prog_zero3():
+    """PR 9: ZeRO-3 carries N forward-interleaved parameter
+    all-gathers (no monolithic unshard) + N gradient reduce-scatters."""
+    g = _zero_graphs(3)
+    return [
+        (rules.CollectiveCount("all_gather", 3), g),
+        (rules.CollectiveCount("reduce_scatter", 3), g),
+        (rules.CollectiveCount("all_reduce", 0), g),
+        (rules.NoInterCollectiveDefUse("all_gather"), g),
+    ]
+
+
+def prog_zero_guard_overhead():
+    """PR 7 on the sharded path: the guard costs exactly ONE extra
+    SCALAR all_reduce (the 4-byte agreement flag) and nothing else."""
+    base = _zero_graphs(2, guard=False)
+    guarded = _zero_graphs(2, guard=True)
+    return [
+        (rules.GuardOverhead(base, extra_scalar_allreduces=1), guarded),
+    ]
+
+
+def prog_guard_overhead():
+    """PR 7 on the replicated path: guard on == guard off, zero extra
+    collectives (the flag folds into the existing bucket reductions)."""
+    import optax
+
+    def graphs(guard):
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(0.1), op=hvd.Sum, grad_guard=guard,
+            overlap_buckets=3, overlap_min_bytes=0,
+        )
+        params = {
+            "a": jnp.ones((32, 8)), "b": jnp.ones((32, 8)),
+            "c": jnp.ones((32, 8)),
+        }
+        state = opt.init(params)
+        grads = {
+            k: jnp.ones((WORLD,) + tuple(np.shape(v)))
+            for k, v in params.items()
+        }
+
+        def step(g, s, p):
+            def body(g, s, p):
+                g = jax.tree_util.tree_map(lambda x: x[0], g)
+                return opt.update(g, s, p)
+
+            return partial(
+                jax.shard_map, mesh=hvd.mesh(),
+                in_specs=(P(hvd.WORLD_AXIS), P(), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(body)(g, s, p)
+
+        return _graph(step, grads, state, params)
+
+    base, guarded = graphs(False), graphs(True)
+    return [
+        (rules.CollectiveCount("all_reduce", 3), base),
+        (rules.GuardOverhead(base, extra_scalar_allreduces=0), guarded),
+    ]
+
+
+def prog_hier_allreduce():
+    """PR 10: the two-level wire — per-bucket intra RS -> inter AR ->
+    intra AG, group-limited everywhere, independent buckets."""
+    g = _graph(_bucketed(3, hier_stages=STAGES), _tree())
+    return [
+        (rules.CollectiveCount("reduce_scatter", 3), g),
+        (rules.CollectiveCount("all_reduce", 3), g),
+        (rules.CollectiveCount("all_gather", 3), g),
+        (rules.ReplicaGroupStructure("reduce_scatter", groups=INTRA), g),
+        (rules.ReplicaGroupStructure("all_gather", groups=INTRA), g),
+        (rules.ReplicaGroupStructure(
+            "all_reduce", groups=INTER, forbid_world_spanning=True), g),
+        (rules.NoInterCollectiveDefUse("all_reduce"), g),
+        (rules.WireDtype(int8_allowed=False), g),
+    ]
+
+
+def prog_hier_int8():
+    """PR 10 placement: int8 on the inter (DCN) hop ONLY — the intra
+    hops stay full-width, and no world-spanning exchange exists."""
+
+    def body(t):
+        return traced.hierarchical_allreduce_groups(
+            t[0], op=hvd.Sum, stages=STAGES, inter_wire="int8",
+            seed=5, block_size=64,
+        )[None]
+
+    g = _graph(_sm(body), jnp.asarray(
+        np.random.default_rng(1).normal(size=(WORLD, 2048)).astype(np.float32)
+    ))
+    return [
+        (rules.ReplicaGroupStructure("reduce_scatter", groups=INTRA), g),
+        # the quantized inter exchange legitimately all-gathers values
+        # and block scales across the INTER groups; the intra unshard
+        # all-gathers across INTRA — both group-limited, neither world
+        (rules.ReplicaGroupStructure(
+            "all_gather", groups_any_of=(INTRA, INTER),
+            forbid_world_spanning=True), g),
+        (rules.WireDtype(inter_groups=INTER, intra_groups=INTRA), g),
+        (rules.CompileBudget(int8_collectives=(1, 8)), {
+            "int8_collectives": sum(
+                1 for c in g.collectives()
+                if any(t.dtype in ("i8", "ui8") for t in c.operand_types)
+            )
+        }),
+    ]
+
+
+def prog_moe_alltoall():
+    """PR 12: expert dispatch is two-level — every all_to_all is
+    group-limited (intra or inter), none spans the world, and the int8
+    inter wire never touches the intra hop."""
+
+    def body(v):
+        return traced.hierarchical_alltoall(
+            v[0], axis_name=hvd.WORLD_AXIS, stages=STAGES,
+            inter_wire="int8", block_size=32,
+        )[None]
+
+    x = np.zeros((WORLD, WORLD, 4, 64), np.float32)
+    g = _graph(_sm(body), jnp.asarray(x))
+    return [
+        (rules.ReplicaGroupStructure(
+            "all_to_all", forbid_world_spanning=True,
+            require_present=True), g),
+        (rules.WireDtype(inter_groups=INTER, intra_groups=INTRA), g),
+    ]
+
+
+def _serve_engine(paged):
+    from horovod_tpu.models.transformer import Transformer, TransformerConfig
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    cfg = TransformerConfig(
+        vocab_size=61, num_layers=1, d_model=16, num_heads=2, d_ff=32,
+        max_len=64, causal=True, dtype=jnp.float32,
+    )
+    model = Transformer(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), train=False
+    )
+    return InferenceEngine(
+        model, params, slots=4, max_len=64, min_bucket=4,
+        donate=True, paged=paged,
+    )
+
+
+def prog_serve_decode():
+    """PR 8/11: the decode carry is DONATED (arg 1 = the KV cache) and
+    steady-state serving compiles the decode step exactly once across
+    rolling admissions (``decode_compiles == 1``)."""
+    eng = _serve_engine(paged=False)
+    g = analysis.parse_module(eng.lowered_decode())
+    # the donated carry is the KV-cache pytree: its leaves land
+    # flattened among the entry args, so coverage is counted, not
+    # positional
+    n_cache = len(jax.tree_util.tree_leaves(eng.manager.cache))
+    pairs = [
+        (rules.DonationCoverage(min_donated=n_cache), g),
+    ]
+    # compile-budget leg: a short rolling-admission loop on the live
+    # engine — admissions/evictions change data, never shapes
+    rng = np.random.default_rng(3)
+    for i in range(4):
+        slot = eng.manager.alloc(f"warm{i}")
+        eng.prefill(slot, rng.integers(1, 60, size=5 + i).tolist())
+    for i in range(6):
+        eng.decode_step(np.zeros(eng.slots, np.int32))
+        if i == 2:  # roll one admission mid-decode
+            eng.manager.free(1)
+            slot = eng.manager.alloc("rolled")
+            eng.prefill(slot, rng.integers(1, 60, size=9).tolist())
+    stats = eng.stats()
+    pairs.append((rules.CompileBudget(decode_compiles=1), stats))
+    return pairs
+
+
+def prog_serve_prefill():
+    """PR 8: the prefill executable donates the cache carry too, and
+    the bucket tier serves multiple lengths from one executable."""
+    eng = _serve_engine(paged=False)
+    g = analysis.parse_module(eng.lowered_prefill(8))
+    n_cache = len(jax.tree_util.tree_leaves(eng.manager.cache))
+    pairs = [
+        (rules.DonationCoverage(min_donated=n_cache), g),
+    ]
+    for i, n in enumerate((5, 6, 7, 8)):
+        slot = eng.manager.alloc(i)
+        eng.prefill(slot, list(range(1, n + 1)))
+    stats = eng.stats()
+    # four prompts in (4,8] share the ONE width-8 bucket executable
+    pairs.append(
+        (rules.CompileBudget(prefill_compiles=1, prefill_bucket_hits=3),
+         stats)
+    )
+    return pairs
+
+
+ROSTER = {
+    "fused_allreduce_fp32": prog_fused_allreduce_fp32,
+    "fused_allreduce_int8": prog_fused_allreduce_int8,
+    "overlap_buckets": prog_overlap_buckets,
+    "zero2": prog_zero2,
+    "zero3": prog_zero3,
+    "guard_overhead": prog_guard_overhead,
+    "zero_guard_overhead": prog_zero_guard_overhead,
+    "hier_allreduce": prog_hier_allreduce,
+    "hier_int8": prog_hier_int8,
+    "moe_alltoall": prog_moe_alltoall,
+    "serve_decode": prog_serve_decode,
+    "serve_prefill": prog_serve_prefill,
+}
+
+
+# ------------------------------------------------- deliberate breakage
+# `--break MODE`: programs that VIOLATE an invariant on purpose, so the
+# CI gate can assert the auditor exits nonzero when the contract rots.
+
+
+def break_int8_intra():
+    """Force int8 onto the INTRA hop: the placement rule must flag it."""
+
+    def body(v):
+        panes = jnp.tile(v[0][None], (LOCAL, 1))  # [intra, cols] pane rows
+        sh = traced.quantized_reducescatter(
+            panes, op=hvd.Sum, seed=1, block_size=64, groups=list(INTRA)
+        )
+        return sh[None]
+
+    g = _graph(
+        _sm(body),
+        jnp.asarray(
+            np.random.default_rng(2).normal(size=(WORLD, 256)).astype(
+                np.float32
+            )
+        ),
+    )
+    return [(rules.WireDtype(inter_groups=INTER, intra_groups=INTRA), g)]
+
+
+def break_serialized_buckets():
+    """Chain one bucket's exchange through another: independence gone."""
+
+    def body(t):
+        a = jax.lax.psum(t[0], hvd.WORLD_AXIS)
+        b = jax.lax.psum(a * 2.0, hvd.WORLD_AXIS)
+        return b[None]
+
+    g = _graph(_sm(body), jnp.ones((WORLD, 64), jnp.float32))
+    return [(rules.NoInterCollectiveDefUse("all_reduce"), g)]
+
+
+def break_monolithic_alltoall():
+    """A world-spanning all_to_all where the two-level contract holds."""
+
+    def body(v):
+        return jax.lax.all_to_all(
+            v[0], hvd.WORLD_AXIS, 0, 0, tiled=True
+        )[None]
+
+    x = np.zeros((WORLD, WORLD * 4, 8), np.float32)
+    g = _graph(_sm(body), jnp.asarray(x))
+    return [(
+        rules.ReplicaGroupStructure(
+            "all_to_all", forbid_world_spanning=True, require_present=True
+        ),
+        g,
+    )]
+
+
+def break_undonated_carry():
+    """Serve decode WITHOUT the donated cache carry."""
+    eng = _serve_engine(paged=False)
+    eng.donate = False
+    g = analysis.parse_module(eng.lowered_decode())
+    n_cache = len(jax.tree_util.tree_leaves(eng.manager.cache))
+    return [(rules.DonationCoverage(min_donated=n_cache), g)]
+
+
+BREAKS = {
+    "int8-intra": break_int8_intra,
+    "serialized-buckets": break_serialized_buckets,
+    "monolithic-alltoall": break_monolithic_alltoall,
+    "undonated-carry": break_undonated_carry,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default=os.environ.get("HLO_AUDIT_JSON", ""))
+    ap.add_argument("--only", default="")
+    ap.add_argument("--break", dest="break_mode", default="",
+                    choices=[""] + sorted(BREAKS))
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in ROSTER:
+            print(name)
+        for name in BREAKS:
+            print(f"--break {name}")
+        return 0
+
+    hvd.init()
+    try:
+        roster = dict(ROSTER)
+        if args.only:
+            roster = {k: v for k, v in roster.items() if args.only in k}
+            if not roster:
+                print(f"no roster program matches {args.only!r}",
+                      file=sys.stderr)
+                return 2
+        if args.break_mode:
+            roster = {f"break:{args.break_mode}": BREAKS[args.break_mode]}
+
+        report = {"programs": {}, "ok": True}
+        for name, builder in roster.items():
+            pairs = builder()
+            prog_report = rules.run_rules(pairs)
+            report["programs"][name] = prog_report.to_dict()
+            status = "OK" if prog_report.ok else "VIOLATED"
+            print(f"[{status:8s}] {name}: {len(pairs)} rule(s)")
+            for f in prog_report.findings:
+                print(f"    {f}")
+            report["ok"] = report["ok"] and prog_report.ok
+
+        if args.json:
+            tmp = args.json + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(report, fh, indent=2)
+            os.replace(tmp, args.json)
+            print(f"report: {args.json}")
+
+        if not report["ok"]:
+            print("hlo_audit: invariant violation(s) found", file=sys.stderr)
+            return 1
+        print(f"hlo_audit: {len(roster)} program(s) green")
+        return 0
+    finally:
+        hvd.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,6 @@ tests in test/parallel/test_process_sets*.py [V] (SURVEY.md §4.1), adapted
 to the 8-device single-controller world.
 """
 
-import os
 
 import pytest
 

@@ -1,7 +1,6 @@
 """Checkpoint subsystem tests — the durability layer the reference
 lacks (SURVEY.md §5.4: in-memory elastic commits only)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -14,7 +14,6 @@ import pytest
 import horovod_tpu as hvd_mod
 from horovod_tpu.elastic import (
     ElasticDriver,
-    FixedHosts,
     HostDiscovery,
     HostDiscoveryScript,
     HostManager,

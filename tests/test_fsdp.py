@@ -7,11 +7,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd_pkg
-from horovod_tpu.parallel import fsdp_shard, fsdp_sharding, fsdp_spec
+from horovod_tpu.parallel import fsdp_shard, fsdp_spec
 
 
 def test_spec_rule(hvd):

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.common.compat import shard_map
 from horovod_tpu.common import topology as topo_mod
+from horovod_tpu import analysis
 from horovod_tpu.ops import overlap, traced
 from horovod_tpu.ops.reduction_ops import Average, Sum
 
@@ -330,28 +331,11 @@ class TestMaskedDegeneration:
 # ---------------------------------- lowered-module stage structure
 
 
-def _parse_defs(lowered_text):
-    import re
+# structure gates ride the shared horovod_tpu.analysis parser — no
+# per-file regex over as_text()
 
-    defs = {}
-    for line in lowered_text.splitlines():
-        m = re.match(r"\s*(%[\w.#]+)\s*=\s*(.*)", line)
-        if not m:
-            continue
-        rid, rhs = m.group(1), m.group(2)
-        defs[rid] = (rhs, re.findall(r"%[\w.#]+", rhs))
-    return defs
-
-
-def _transitive_deps(defs, seed_ops):
-    out, stack = set(), list(seed_ops)
-    while stack:
-        o = stack.pop()
-        if o in out or o not in defs:
-            continue
-        out.add(o)
-        stack.extend(defs[o][1])
-    return out
+INTRA_84 = ((0, 1, 2, 3), (4, 5, 6, 7))
+INTER_84 = ((0, 4), (1, 5), (2, 6), (3, 7))
 
 
 def _tree(rng, shapes):
@@ -384,27 +368,27 @@ class TestLoweredStructure:
             return jax.tree_util.tree_map(lambda x: x[None], out)
 
         fn = _sm(body)
-        txt = fn.lower(t).as_text()
-        n_rs = txt.count('"stablehlo.reduce_scatter"')
-        n_ar = txt.count('"stablehlo.all_reduce"')
-        n_ag = txt.count('"stablehlo.all_gather"')
-        assert n_rs == n_ar == n_ag
-        assert n_rs >= 2  # the 3-leaf tree yields >= 2 buckets
-        # intra groups on RS/AG, inter groups on the AR
-        assert "[[0, 1, 2, 3], [4, 5, 6, 7]]" in txt
-        assert "[[0, 4], [1, 5], [2, 6], [3, 7]]" in txt
-        defs = _parse_defs(txt)
-        ar_ids = [
-            rid
-            for rid, (rhs, _) in defs.items()
-            if '"stablehlo.all_reduce"' in rhs
-        ]
-        for rid in ar_ids:
-            deps = _transitive_deps(defs, defs[rid][1])
-            for other in ar_ids:
-                assert other == rid or other not in deps, (
-                    "buckets serialized through the inter stage"
-                )
+        g = analysis.parse_module(fn.lower(t))
+        counts = g.counts()
+        assert counts["reduce_scatter"] == counts["all_reduce"]
+        assert counts["all_reduce"] == counts["all_gather"]
+        assert counts["reduce_scatter"] >= 2  # 3 leaves -> >= 2 buckets
+        # intra groups on RS/AG, inter groups on the AR; no bucket's
+        # inter stage depends on another's
+        analysis.expect(
+            g,
+            analysis.ReplicaGroupStructure(
+                "reduce_scatter", groups=INTRA_84, require_present=True
+            ),
+            analysis.ReplicaGroupStructure(
+                "all_gather", groups=INTRA_84, require_present=True
+            ),
+            analysis.ReplicaGroupStructure(
+                "all_reduce", groups=INTER_84, require_present=True,
+                forbid_world_spanning=True,
+            ),
+            analysis.NoInterCollectiveDefUse("all_reduce"),
+        )
         # and the result is bit-exact vs the flat wire
         flat = jax.device_get(
             _sm(
@@ -443,8 +427,10 @@ class TestLoweredStructure:
         b = jax.device_get(f_hier(t))
         for k in t:
             np.testing.assert_array_equal(a[k], b[k])
-        txt = f_hier.lower(t).as_text()
-        assert "[[0, 1, 2, 3], [4, 5, 6, 7]]" in txt
+        g_hier = analysis.parse_module(f_hier.lower(t))
+        # the DCN hop sees 1/L panes: the RS leg carries intra-group
+        # reduce-scatters (the inter exchange rides its own groups)
+        assert INTRA_84 in g_hier.replica_groups("reduce_scatter")
 
         def ag(tr, stages):
             local = jax.tree_util.tree_map(lambda x: x[0], tr)
@@ -715,11 +701,15 @@ class TestHierInt8TracedPath:
                 return out["g"][None]
 
             f = _sm(body)
-            txt = f.lower(jnp.asarray(g)).as_text()
             # two-level signature: an intra reduce-scatter + the intra
             # all-gather around the inter int8 recipe
-            assert txt.count('"stablehlo.reduce_scatter"') == 1
-            assert "[[0, 1, 2, 3], [4, 5, 6, 7]]" in txt
+            analysis.expect(
+                analysis.parse_module(f.lower(jnp.asarray(g))),
+                analysis.CollectiveCount("reduce_scatter", 1),
+                analysis.ReplicaGroupStructure(
+                    "reduce_scatter", groups=INTRA_84
+                ),
+            )
             out = np.asarray(f(jnp.asarray(g)))
             want = g.mean(0)
             scale = np.abs(g.sum(0)).max() / 127.0 / 8
@@ -755,9 +745,14 @@ class TestHierInt8TracedPath:
             return jax.tree_util.tree_map(lambda x: x[None], out)
 
         f = _sm(body)
-        txt = f.lower(t).as_text()
-        assert txt.count('"stablehlo.reduce_scatter"') == 1
-        assert "[[0, 1], [2, 3], [4, 5], [6, 7]]" in txt
+        analysis.expect(
+            analysis.parse_module(f.lower(t)),
+            analysis.CollectiveCount("reduce_scatter", 1),
+            analysis.ReplicaGroupStructure(
+                "reduce_scatter",
+                groups=((0, 1), (2, 3), (4, 5), (6, 7)),
+            ),
+        )
         out = jax.device_get(f(t))["a"]
         want = np.asarray(t["a"]).sum(0)
         scale = np.abs(want).max() / 127.0

@@ -39,6 +39,7 @@ import optax  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
 import horovod_tpu as hvd_mod  # noqa: E402
+from horovod_tpu import analysis  # noqa: E402
 from horovod_tpu.common import guard as guard_mod  # noqa: E402
 from horovod_tpu.common.compat import shard_map  # noqa: E402
 from horovod_tpu.common.metrics import registry  # noqa: E402
@@ -274,19 +275,20 @@ class TestGuardOverhead:
             )(g, s, p)
 
         return (
-            jax.jit(step).lower(grads, state, params).as_text(),
+            analysis.parse_module(jax.jit(step).lower(grads, state, params)),
             opt, state, grads, params,
         )
 
     def test_no_additional_collectives(self, hvd):
-        txt_off, *_ = self._lowered_text(hvd, grad_guard=False)
-        txt_on, *_ = self._lowered_text(hvd, grad_guard=True)
-        n_off = txt_off.count('"stablehlo.all_reduce"')
-        n_on = txt_on.count('"stablehlo.all_reduce"')
-        assert n_off == 3  # one per bucket
-        assert n_on == n_off  # the guard flag adds NO collective
-        for coll in ("all_gather", "all_to_all", "collective_permute"):
-            assert txt_on.count(coll) == txt_off.count(coll)
+        g_off, *_ = self._lowered_text(hvd, grad_guard=False)
+        g_on, *_ = self._lowered_text(hvd, grad_guard=True)
+        analysis.expect(
+            g_off, analysis.CollectiveCount("all_reduce", 3)
+        )  # one per bucket
+        # the guard flag adds NO collective of ANY kind
+        analysis.expect(
+            g_on, analysis.GuardOverhead(g_off, extra_scalar_allreduces=0)
+        )
 
     def test_no_host_sync_on_no_skip_path(self, hvd):
         """Run many finite steps under jit: the guard callback must
@@ -384,12 +386,15 @@ class TestShardedGuard:
                     check_vma=False,
                 )(g, s, p)
 
-            texts[g_on] = jax.jit(step).lower(
-                grads, state, params
-            ).as_text()
-        n_off = texts[False].count('"stablehlo.all_reduce"')
-        n_on = texts[True].count('"stablehlo.all_reduce"')
-        assert n_on == n_off + 1
+            texts[g_on] = analysis.parse_module(
+                jax.jit(step).lower(grads, state, params)
+            )
+        # exactly one extra all_reduce, and it is SCALAR (the 4-byte
+        # agreement flag) — GuardOverhead checks both
+        analysis.expect(
+            texts[True],
+            analysis.GuardOverhead(texts[False], extra_scalar_allreduces=1),
+        )
 
     def test_layout_migration_both_directions(self, hvd):
         """Flat state under a newly-enabled guard and guarded state

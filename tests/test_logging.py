@@ -124,8 +124,6 @@ def test_heartbeat_kv_roundtrip():
     """Workers PUT heartbeat/<rank>; the driver reads {rank: ts} back
     through the same KV the rendezvous already runs."""
     from horovod_tpu.runner.rendezvous import (
-        HEARTBEAT_SCOPE,
-        KVStore,
         RendezvousClient,
         RendezvousServer,
         put_heartbeat,

@@ -13,7 +13,6 @@ self-slice blocks bit-exact (they never cross the lossy hop).
 
 import json
 import os
-import re
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +20,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from horovod_tpu import analysis
 from horovod_tpu.common.compat import shard_map
 from horovod_tpu.common import topology as topo_mod
 from horovod_tpu.ops import traced
@@ -53,17 +53,11 @@ def _flat_a2a(axis="ep"):
     )
 
 
-def _a2a_replica_groups(lowered_text):
+def _a2a_group_sizes(lowered):
     """Replica-group row lengths of every all_to_all in a lowered
-    module (the monolithic-flat-alltoall detector)."""
-    sizes = []
-    for m in re.finditer(
-        r"all_to_all.*?replica_groups\s*=\s*dense<\[\[(.*?)\]\]>",
-        lowered_text,
-    ):
-        first_row = m.group(1).split("],")[0]
-        sizes.append(len(first_row.split(",")))
-    return sizes
+    module (the monolithic-flat-alltoall detector) — via the shared
+    ``horovod_tpu.analysis`` parser, not regex."""
+    return analysis.parse_module(lowered).group_sizes("all_to_all")
 
 
 # ---------------------------------------------------- wire primitives
@@ -193,13 +187,13 @@ class TestHierarchicalAlltoall:
 
     def test_lowered_no_monolithic_alltoall(self, hvd):
         x = np.zeros((8, 8, 4, 64), np.float32)
-        txt = _sm(
+        low = _sm(
             lambda v: traced.hierarchical_alltoall(
                 v[0], axis_name="ep", stages=STAGES_84,
                 inter_wire="int8", block_size=32,
             )[None]
-        ).lower(jnp.asarray(x)).as_text()
-        sizes = _a2a_replica_groups(txt)
+        ).lower(jnp.asarray(x))
+        sizes = _a2a_group_sizes(low)
         assert sizes, "expected group-limited all_to_all ops"
         assert all(s < 8 for s in sizes), sizes
 
@@ -411,12 +405,10 @@ class TestMoEFFN:
                 hier=STAGES_84,
             )[None]
 
-        txt = (
-            _sm(body, (_PARAM_SPEC, P("ep")), P("ep"))
-            .lower(params, jnp.asarray(x))
-            .as_text()
+        low = _sm(body, (_PARAM_SPEC, P("ep")), P("ep")).lower(
+            params, jnp.asarray(x)
         )
-        sizes = _a2a_replica_groups(txt)
+        sizes = _a2a_group_sizes(low)
         assert sizes, "expected group-limited all_to_all ops"
         assert all(s < 8 for s in sizes), sizes
 

@@ -25,6 +25,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd_mod
+from horovod_tpu import analysis
 from horovod_tpu.ops import overlap, traced
 from horovod_tpu.ops.compression import Compression
 
@@ -275,34 +276,8 @@ class TestParity:
 
 
 # ------------------------------------ compiled-program independence
-
-
-def _parse_defs(lowered_text):
-    """Def-use graph over the lowered module's SSA statements:
-    {result_id: (op_line, [operand_ids])}."""
-    import re
-
-    defs = {}
-    for line in lowered_text.splitlines():
-        m = re.match(r"\s*(%[\w.#]+)\s*=\s*(.*)", line)
-        if not m:
-            continue
-        rid, rhs = m.group(1), m.group(2)
-        ops = re.findall(r"%[\w.#]+", rhs)
-        defs[rid] = (rhs, ops)
-    return defs
-
-
-def _transitive_deps(defs, seed_ops):
-    out = set()
-    stack = list(seed_ops)
-    while stack:
-        o = stack.pop()
-        if o in out or o not in defs:
-            continue
-        out.add(o)
-        stack.extend(defs[o][1])
-    return out
+# (shared parser: horovod_tpu.analysis — the per-file regex these
+# tests used to carry lives there now, typed and rule-checked)
 
 
 class TestCompiledIndependence:
@@ -321,21 +296,12 @@ class TestCompiledIndependence:
                 p, op=hvd_mod.Sum, n_buckets=n, min_bucket_bytes=0
             ),
         )
-        txt = fn.lower(t).as_text()
-        assert txt.count('"stablehlo.all_reduce"') == n
-        defs = _parse_defs(txt)
-        ar_ids = [
-            rid
-            for rid, (rhs, _) in defs.items()
-            if '"stablehlo.all_reduce"' in rhs
-        ]
-        assert len(ar_ids) == n
-        for rid in ar_ids:
-            deps = _transitive_deps(defs, defs[rid][1])
-            for other in ar_ids:
-                assert other == rid or other not in deps, (
-                    f"{rid} depends on {other}: buckets serialized"
-                )
+        g = analysis.parse_module(fn.lower(t))
+        analysis.expect(
+            g,
+            analysis.CollectiveCount("all_reduce", n),
+            analysis.NoInterCollectiveDefUse("all_reduce"),
+        )
 
     def test_in_backprop_boundary_emits_n_collectives(self, hvd):
         mesh = hvd_mod.mesh()
@@ -360,8 +326,8 @@ class TestCompiledIndependence:
         x = jnp.asarray(
             rng.normal(size=(WORLD, 4, 16)), jnp.float32
         )
-        txt = fn.lower(params, x).as_text()
-        assert txt.count('"stablehlo.all_reduce"') == n
+        g = analysis.parse_module(fn.lower(params, x))
+        analysis.expect(g, analysis.CollectiveCount("all_reduce", n))
 
     def test_no_retrace_and_one_schedule_across_steps(self, hvd):
         """Per-bucket-config compile happens once: 4 steps of the same
@@ -803,19 +769,13 @@ class TestOptimizerIntegration:
 
         s1, s2 = o1.init(params), o2.init(params)
         st1, st2 = make(o1), make(o2)
-        txt = st2.lower(params, s2, x, y).as_text()
-        assert txt.count('"stablehlo.reduce_scatter"') == 2
-        assert txt.count('"stablehlo.all_gather"') == 2
-        defs = _parse_defs(txt)
-        rs_ids = [
-            rid
-            for rid, (rhs, _) in defs.items()
-            if '"stablehlo.reduce_scatter"' in rhs
-        ]
-        for rid in rs_ids:
-            deps = _transitive_deps(defs, defs[rid][1])
-            for other in rs_ids:
-                assert other == rid or other not in deps
+        g = analysis.parse_module(st2.lower(params, s2, x, y))
+        analysis.expect(
+            g,
+            analysis.CollectiveCount("reduce_scatter", 2),
+            analysis.CollectiveCount("all_gather", 2),
+            analysis.NoInterCollectiveDefUse("reduce_scatter"),
+        )
         p1, p2 = params, params
         for _ in range(3):
             p1, s1, l1 = st1(p1, s1, x, y)

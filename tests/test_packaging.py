@@ -4,7 +4,6 @@ The full `pip install -e . && hvdrun -np 2` transcript is exercised in
 CI-style by the runner tests; here we pin the declared contract."""
 
 import os
-import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -6,7 +6,6 @@ reference documents (scale behavior, agreement across ranks)."""
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from hypothesis import HealthCheck, given, settings, strategies as st
 
 # the hvd fixture is stable across examples (module-level init); not

@@ -191,8 +191,6 @@ def test_adasum_respects_join_mask(hvd, rng):
     """Joined ranks contribute Adasum's identity (zero), so the result
     must equal Adasum over the live ranks only (round-3 review fix:
     the Adasum branch used the unmasked payload)."""
-    from horovod_tpu.ops.adasum import adasum_tree_host
-
     vals = np.stack(
         [rng.normal(size=6).astype(np.float32) for _ in range(8)]
     )
