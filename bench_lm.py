@@ -9,6 +9,27 @@ Env: BENCH_MODEL=bert_large|gpt2_medium (default bert_large),
 BENCH_BATCH (default 8), BENCH_SEQ (default: model max 512/1024 capped
 at 512), BENCH_ITERS (default 10), BENCH_PLATFORM=cpu + tiny model for
 the harness smoke test (BENCH_TINY=1).
+
+``BENCH_AB=local_sgd`` runs the local-SGD A/B instead
+(``ab_local_sgd`` legs, PR 14 / ROADMAP item 3): the SAME tiny-LM
+training loop twice — ``k1`` (the existing path: hierarchical int8
+allreduce every step, the PR 10 wire) vs ``k8``
+(``DistributedOptimizer(local_sgd_steps=K)``: ICI-only local steps, a
+hierarchical-Adasum int8 reconciliation round every K steps via
+``hvd.local_sgd.maybe_sync``). Each leg appends one JSON artifact
+(``lm_ab_local_sgd_<leg>.json`` under BENCH_ARTIFACT_DIR) with
+ms/step, the full loss trajectory, the lowered step program's
+collective counts, and the per-hop byte ledger from the shared
+payload-width model (``FusionManager._hop_bytes`` for the every-step
+wire, ``local_sgd.round_inter_bytes`` — the VHDD model — for the
+rounds): ``inter_bytes_per_step`` and ``inter_ratio_vs_k1``.
+BENCH_DRYRUN=1 is the CI smoke shape and gates the two pre-registered
+predictions (docs/perf.md): inter bytes/step drop ≥ K/2× vs the k1
+hier-int8 row, and the K-step leg keeps ≥ half of k1's loss
+improvement. The k8 step program is additionally asserted to carry
+ZERO inter-slice replica groups (the hloaudit rule, run inline).
+Env: BENCH_LOCAL_K (default 8), BENCH_INTRA (default 4),
+BENCH_AB_STEPS (default 2·K), BENCH_BATCH/BENCH_SEQ as above.
 """
 
 import json
@@ -18,8 +39,268 @@ from functools import partial
 
 import numpy as np
 
+_SIM_NOTE = (
+    "logic-validation only (CPU simulation); step-time is NOT a TPU "
+    "wall-clock number — byte accounting, loss math and HLO shape are "
+    "exact"
+)
+
+
+def run_ab_local_sgd():
+    """The ``ab_local_sgd`` A/B legs (module docstring)."""
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from _benchlib import sync as _sync
+    from horovod_tpu import analysis, local_sgd
+    from horovod_tpu.analysis import rules
+    from horovod_tpu.common.topology import hierarchical_stage_groups
+    from horovod_tpu.models import Transformer, TransformerConfig
+    from horovod_tpu.ops.fusion import FusionManager
+
+    dryrun = os.environ.get("BENCH_DRYRUN", "").strip() in ("1", "true")
+    k = int(os.environ.get("BENCH_LOCAL_K", "8"))
+    intra = int(os.environ.get("BENCH_INTRA", "4"))
+    batch = int(os.environ.get("BENCH_BATCH", "2" if dryrun else "8"))
+    hvd.init()
+    mesh = hvd.mesh()
+    world = hvd.size()
+    if world % intra:
+        intra = 2 if world % 2 == 0 else 1
+    stages = hierarchical_stage_groups(world, intra)
+    if stages is None:
+        raise SystemExit(
+            f"no two-level split for world={world} intra={intra}"
+        )
+    L, H = intra, world // intra
+    intra_groups = tuple(tuple(g) for g in stages[0])
+    steps = int(os.environ.get("BENCH_AB_STEPS", str(2 * k)))
+    steps = max(steps, k)  # at least one full round
+    platform = jax.devices()[0].platform
+    artifact_dir = os.environ.get(
+        "BENCH_ARTIFACT_DIR", os.path.join("bench_results", "lm")
+    )
+    os.makedirs(artifact_dir, exist_ok=True)
+
+    cfg = TransformerConfig.tiny(causal=True) if dryrun else (
+        TransformerConfig.gpt2_medium()
+    )
+    seq = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_len, 32 if dryrun else 512))))
+    model = Transformer(cfg)
+    tokens0 = jnp.zeros((batch, seq), jnp.int32)
+    params0 = jax.jit(
+        lambda: model.init(jax.random.PRNGKey(0), tokens0, train=False)
+    )()
+    grad_bytes = sum(
+        int(np.prod(np.shape(l))) * 4
+        for l in jax.tree_util.tree_leaves(params0)
+    )
+    rng = np.random.default_rng(0)
+    # per-rank data: slices see DIFFERENT streams, so local phases
+    # genuinely diverge before each round reconciles them
+    toks = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(steps, world, batch, seq)),
+        jnp.int32,
+    )
+    labels = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(steps, world, batch, seq)),
+        jnp.int32,
+    )
+
+    def make_leg(leg_k):
+        if leg_k > 1:
+            opt = hvd.DistributedOptimizer(
+                optax.sgd(0.05, momentum=0.9), op=hvd.Average,
+                local_sgd_steps=leg_k, local_sgd_intra=intra,
+            )
+        else:
+            # the existing path: the PR 10 two-level wire, int8 on the
+            # DCN hop, EVERY step — the baseline the ÷K claim is
+            # measured against
+            opt = hvd.DistributedOptimizer(
+                optax.sgd(0.05, momentum=0.9), op=hvd.Average,
+                compression=hvd.Compression.hier_int8,
+            )
+
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(
+                P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS),
+                P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS),
+            ),
+            out_specs=(
+                P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS),
+            ),
+            check_vma=False,
+        )
+        def step(pm, sm, tk, lb):
+            p = jax.tree_util.tree_map(lambda x: x[0], pm)
+            s = jax.tree_util.tree_map(lambda x: x[0], sm)
+            tk, lb = tk[0], lb[0]
+
+            def loss_fn(q):
+                logits = model.apply(q, tk, train=True)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), lb
+                ).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            u, s = opt.update(grads, s, p)
+            p = optax.apply_updates(p, u)
+            add = jax.tree_util.tree_map(lambda x: x[None], (p, s))
+            # per-rank loss rides home rank-major: a cross-slice mean
+            # would put an inter-spanning collective INSIDE the
+            # local-phase program — the host averages the rows instead
+            return add[0], add[1], loss[None]
+
+        sync_step = None
+        if leg_k > 1:
+            @partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS)),
+                out_specs=(P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS)),
+                check_vma=False,
+            )
+            def sync_step(pm, sm):
+                p = jax.tree_util.tree_map(lambda x: x[0], pm)
+                s = jax.tree_util.tree_map(lambda x: x[0], sm)
+                p, s = opt.sync(p, s)
+                return jax.tree_util.tree_map(
+                    lambda x: x[None], (p, s)
+                )
+
+            sync_step = jax.jit(sync_step)
+        return opt, jax.jit(step), sync_step
+
+    def rank_major(tree):
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(
+                jnp.asarray(x)[None],
+                (world,) + tuple(np.shape(x)),
+            ),
+            tree,
+        )
+
+    block = 512
+    results = {}
+    for leg_k, leg in ((1, "k1"), (k, "k8")):
+        opt, step, sync_step = make_leg(leg_k)
+        pm = rank_major(params0)
+        sm = rank_major(opt.init(params0))
+        g = analysis.parse_module(step.lower(pm, sm, toks[0], labels[0]))
+        counts = g.counts()
+        if leg_k > 1:
+            # the lowered local-phase program must carry ZERO
+            # inter-slice replica groups (the hloaudit invariant,
+            # asserted inline on the real bench program)
+            for kind in (
+                "all_reduce", "reduce_scatter", "all_gather",
+                "all_to_all", "collective_permute",
+            ):
+                analysis.expect(
+                    g,
+                    rules.ReplicaGroupStructure(
+                        kind, groups_any_of=(intra_groups,),
+                        forbid_world_spanning=True,
+                    ),
+                )
+        losses = []
+        rounds = 0
+        # warm (compile) outside the timed loop
+        pm_w, sm_w, l0 = step(pm, sm, toks[0], labels[0])
+        _sync(l0)
+        pm, sm = pm_w, sm_w
+        losses.append(float(np.mean(np.asarray(l0))))
+        t0 = time.perf_counter()
+        for i in range(1, steps):
+            pm, sm, loss = step(pm, sm, toks[i], labels[i])
+            losses.append(float(np.mean(np.asarray(loss))))
+            if leg_k > 1 and local_sgd.due(i, leg_k):
+                out, synced = local_sgd.run_round(
+                    sync_step, pm, sm,
+                    payload_bytes=grad_bytes, stages=stages,
+                )
+                if synced:
+                    pm, sm = out
+                    rounds += 1
+        _sync(pm)
+        ms = (time.perf_counter() - t0) * 1e3 / max(steps - 1, 1)
+        # per-hop byte ledger, shared payload-width models
+        elems = grad_bytes // 4
+        if leg_k == 1:
+            # hier-int8 every step: bf16 intra legs + int8 inter on
+            # the 1/L shard (bench_hier's accounting)
+            ib, _ = FusionManager._hop_bytes(
+                -(-elems // L), "int8", 4, H, block
+            )
+            inter_per_step = ib
+        else:
+            round_bytes = local_sgd.round_inter_bytes(
+                grad_bytes, stages, "int8"
+            )
+            inter_per_step = round_bytes / leg_k
+        line = {
+            "metric": "lm_ab_local_sgd",
+            "leg": leg,
+            "k": leg_k,
+            "world": world,
+            "intra": L,
+            "slices": H,
+            "steps": steps,
+            "rounds": rounds,
+            "grad_bytes": grad_bytes,
+            "value": round(ms, 3),
+            "unit": "ms/step",
+            "platform": platform,
+            "collectives": counts,
+            "inter_bytes_per_step": round(inter_per_step, 1),
+            "loss_first": round(losses[0], 4),
+            "loss_final": round(losses[-1], 4),
+            "losses": [round(x, 4) for x in losses],
+        }
+        if platform != "tpu":
+            line["note"] = _SIM_NOTE
+        results[leg] = line
+
+    r1, r8 = results["k1"], results["k8"]
+    ratio = (
+        r1["inter_bytes_per_step"] / r8["inter_bytes_per_step"]
+        if r8["inter_bytes_per_step"]
+        else float("inf")
+    )
+    r8["inter_ratio_vs_k1"] = round(ratio, 2)
+    r1["inter_ratio_vs_k1"] = 1.0
+    for leg, line in results.items():
+        print(json.dumps(line), flush=True)
+        with open(
+            os.path.join(artifact_dir, f"lm_ab_local_sgd_{leg}.json"), "a"
+        ) as f:
+            f.write(json.dumps(line) + "\n")
+    # pre-registered gates (docs/perf.md): the sync rounds moved the
+    # expected ÷K of the every-step wire's DCN bytes, and the K-step
+    # leg kept at least half of k1's loss improvement
+    assert r8["rounds"] >= 1, "no sync round ran"
+    assert ratio >= k / 2, (
+        f"inter-byte drop {ratio:.2f}x < pre-registered K/2 = {k / 2}"
+    )
+    imp1 = r1["loss_first"] - r1["loss_final"]
+    imp8 = r8["loss_first"] - r8["loss_final"]
+    assert imp1 > 0, f"k1 leg did not learn: {imp1}"
+    assert imp8 >= 0.5 * imp1, (
+        f"k8 loss improvement {imp8:.4f} < half of k1's {imp1:.4f}"
+    )
+
 
 def main():
+    if os.environ.get("BENCH_AB", "").strip() == "local_sgd":
+        return run_ab_local_sgd()
     import jax
 
     if os.environ.get("BENCH_PLATFORM"):

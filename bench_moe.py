@@ -94,7 +94,7 @@ def main():
 
     import horovod_tpu as hvd
     from _benchlib import sync as _sync
-    from horovod_tpu.common.autotune import CapacityTuner
+    from horovod_tpu.common.autotune import shared_capacity_tuner
     from horovod_tpu.common.compat import shard_map
     from horovod_tpu.common.metrics import publish_moe
     from horovod_tpu.common.topology import hierarchical_stage_groups
@@ -222,7 +222,10 @@ def main():
         }
 
     # ------------------------------------------- capacity autotune leg
-    tuner = CapacityTuner(
+    # durable instance (HOROVOD_TUNER_CACHE): warm-started from prior
+    # runs, persisted at exit — capacity exploration is paid once per
+    # topology fingerprint, not once per process per run
+    tuner = shared_capacity_tuner(
         trials=1 if dryrun else 2,
         candidates=(1.0, 2.0) if dryrun else (1.0, 1.25, 1.5, 2.0),
     )

@@ -184,9 +184,12 @@ def main():
     # feed it the two tape legs and report its verdict (the harness IS
     # the tuner's driver — a bucket count is a compile-time property,
     # so candidates are separate jitted steps)
-    from horovod_tpu.common.autotune import OverlapTuner
+    # durable instance (HOROVOD_TUNER_CACHE): warm-started from prior
+    # runs' observations and persisted at exit — the WireTuner's
+    # persistence parity, extended to the bucket-count decision
+    from horovod_tpu.common.autotune import shared_overlap_tuner
 
-    tuner = OverlapTuner(
+    tuner = shared_overlap_tuner(
         min_bucket_bytes=0, trials=1, candidates=(1, n_buckets)
     )
     for n, ms in leg_ms.items():

@@ -140,6 +140,15 @@ bench_smoke() {
     test -s "$art_dir/moe_${leg}.json" \
       || { echo "missing artifact: moe_${leg}.json" >&2; exit 1; }
   done
+  step "bench-smoke: bench_lm.py ab_local_sgd dryrun (K=1 vs K=8 inter-byte + loss-parity gates)"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_AB=local_sgd \
+    BENCH_ARTIFACT_DIR="$art_dir" \
+    python bench_lm.py
+  for leg in k1 k8; do
+    test -s "$art_dir/lm_ab_local_sgd_${leg}.json" \
+      || { echo "missing artifact: lm_ab_local_sgd_${leg}.json" >&2; exit 1; }
+  done
   step "bench-smoke: bench_serve.py dryrun (static-vs-continuous + paged-KV + prefix-cache A/B)"
   JAX_PLATFORMS=cpu \
     BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \

@@ -110,6 +110,7 @@ from .ops.eager import (  # noqa: F401
 )
 from .optimizer import (  # noqa: F401
     DistributedOptimizer,
+    LocalSGDGradientTransformation,
     allgather_object,
     broadcast_object,
     broadcast_optimizer_state,
@@ -129,6 +130,7 @@ from .ops.overlap import (  # noqa: F401
     overlap_boundary,
 )
 from .ops.fused_xent import fused_linear_cross_entropy  # noqa: F401
+from . import local_sgd  # noqa: F401  (K-step ICI-local training regime)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State, ref [V])
 from . import callbacks  # noqa: F401  (Keras-callback parity, ref [V])
 from . import data  # noqa: F401  (DistributedSampler analog + prefetch)

@@ -741,6 +741,50 @@ def shared_wire_tuner() -> WireTuner:
     return _shared_wire_tuner
 
 
+_shared_overlap_tuner: Optional[OverlapTuner] = None
+_shared_capacity_tuner: Optional[CapacityTuner] = None
+
+
+def shared_overlap_tuner(**kwargs) -> OverlapTuner:
+    """The process-wide OverlapTuner with durable state — the tuner-
+    persistence parity the WireTuner got in PR 12, extended to the
+    bucket-count decision (ROADMAP item 1a): warm-started from
+    ``HOROVOD_TUNER_CACHE`` under the ``overlap`` name (topology-
+    fingerprinted) on first use and persisted at exit, so a restarted
+    step harness skips straight to exploitation instead of re-timing
+    every bucket-count candidate. First call's ``kwargs`` win
+    (min_bucket_bytes / trials / candidates); observations merge with
+    disk on persist like every tuner (autotune.persist)."""
+    global _shared_overlap_tuner
+    if _shared_overlap_tuner is None:
+        _shared_overlap_tuner = OverlapTuner(**kwargs)
+        warm_start(_shared_overlap_tuner, "overlap")
+        register_persist_at_exit(_shared_overlap_tuner, "overlap")
+    return _shared_overlap_tuner
+
+
+def shared_capacity_tuner(**kwargs) -> CapacityTuner:
+    """The process-wide CapacityTuner with durable state (same parity:
+    warm-start + persist-at-exit under ``capacity``, keyed by the
+    topology fingerprint). The drop-rate/imbalance load ledger rides
+    the snapshot too (CapacityTuner.state_dict), so the hard
+    ``max_drop_rate`` prior survives restarts along with the goodput
+    observations."""
+    global _shared_capacity_tuner
+    if _shared_capacity_tuner is None:
+        _shared_capacity_tuner = CapacityTuner(**kwargs)
+        warm_start(_shared_capacity_tuner, "capacity")
+        register_persist_at_exit(_shared_capacity_tuner, "capacity")
+    return _shared_capacity_tuner
+
+
+def reset_shared_tuners() -> None:
+    """Drop the shared overlap/capacity tuners (tests)."""
+    global _shared_overlap_tuner, _shared_capacity_tuner
+    _shared_overlap_tuner = None
+    _shared_capacity_tuner = None
+
+
 _persist_registry = []
 _persist_hook_installed = [False]
 

@@ -180,6 +180,13 @@ class Config:
     # only logging them. Workers read the weights via
     # hvd.elastic.rebalance_weight().
     rebalance: bool = False
+    # local-SGD mode (horovod_tpu/local_sgd.py): slices train
+    # independently on their ICI-only wire for K micro-steps, then
+    # reconcile parameter deltas across the inter (DCN) axis with
+    # hierarchical Adasum on the int8 inter wire. 1 (default) = the
+    # existing every-step sync path; the mode engages at K > 1.
+    # Explicit local_sgd_steps= per optimizer always wins.
+    local_sgd_steps: int = 1
 
     # --- ZeRO sharding stage (sharded_optimizer.py) ---
     # default zero_stage for ShardedDistributedOptimizer(zero_stage=None):
@@ -411,6 +418,7 @@ class Config:
             inter_axis=env.get("HOROVOD_INTER_AXIS", "inter").strip()
             or "inter",
             rebalance=_env_bool("HOROVOD_REBALANCE"),
+            local_sgd_steps=_env_int("HOROVOD_LOCAL_SGD_STEPS", 1),
             zero_stage=int(
                 _env_choice("HOROVOD_ZERO_STAGE", "1", ("1", "2", "3"))
             ),

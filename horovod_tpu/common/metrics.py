@@ -58,6 +58,34 @@ HIERARCHY_METRICS = (
     "driver.rebalance.updates",
 )
 
+# Local-SGD metric family (horovod_tpu/local_sgd.py — the K-step
+# semi-synchronous regime). Emitters: the host round driver
+# (local_sgd.maybe_sync / run_round), the fused dispatcher's phase
+# routing, and the elastic driver's heartbeat aggregation. One legend:
+#   local_sgd.local_steps       optimizer steps taken under the mode
+#                               (counter; local_steps / sync_rounds
+#                               ≈ the effective K)
+#   local_sgd.sync_rounds       reconciliation rounds completed
+#                               (counter)
+#   local_sgd.rounds_deferred   rounds pushed out by a DCN failure
+#                               after the retry ladder (counter —
+#                               degraded-not-stalled evidence)
+#   local_sgd.inter_bytes       modeled per-rank DCN bytes the rounds
+#                               that RAN moved (counter; the ÷K lever)
+#   fusion.local_dispatches     eager fused allreduces routed
+#                               intra-only under an active phase
+#                               (counter)
+#   driver.local_sgd.rounds_deferred  gang-max deferral count from the
+#                               heartbeat ledger (gauge)
+LOCAL_SGD_METRICS = (
+    "local_sgd.local_steps",
+    "local_sgd.sync_rounds",
+    "local_sgd.rounds_deferred",
+    "local_sgd.inter_bytes",
+    "fusion.local_dispatches",
+    "driver.local_sgd.rounds_deferred",
+)
+
 # Expert-wire metric families (PR 12 — parallel/moe.py +
 # ops/fusion.py eager alltoall). Emitters: the fusion manager's flush
 # (alltoall.*, cumulative — closes the observability gap where eager

@@ -104,6 +104,16 @@ _COUNTER_KEYS = (
     # schedule-fingerprint publish — the cadence evidence for the
     # sched_divergence detector
     "audit.sched_published",
+    # local-SGD regime (horovod_tpu/local_sgd.py): local_steps is the
+    # host-driver cadence meter, a sync_rounds delta marks the steps
+    # that closed a reconciliation round, a rounds_deferred delta pins
+    # a DCN outage to the exact step whose round it pushed out, and
+    # inter_bytes is the modeled DCN ledger of the rounds that DID run
+    # (÷K is the whole point — docs/perf.md prediction table)
+    "local_sgd.local_steps",
+    "local_sgd.sync_rounds",
+    "local_sgd.rounds_deferred",
+    "local_sgd.inter_bytes",
     # serving plane (horovod_tpu/serving/): a decode-step record's
     # tokens-out delta is its realized batch occupancy, and a nonzero
     # admitted_mid_decode delta pins a TPOT blip to the prefill that
@@ -404,6 +414,18 @@ class TelemetryHub:
                 "audit.last_digest_step": snap.get(
                     "audit.last_digest_step", 0.0
                 ),
+                # local-SGD regime (horovod_tpu/local_sgd.py): a
+                # sync_rounds delta marks the step that closed a
+                # reconciliation round, rounds_deferred pins a DCN
+                # outage to the step whose round it pushed out, and
+                # inter_bytes is the modeled DCN cost of the rounds
+                # that ran (all 0 outside the mode)
+                "local_sgd.local_steps": deltas["local_sgd.local_steps"],
+                "local_sgd.sync_rounds": deltas["local_sgd.sync_rounds"],
+                "local_sgd.rounds_deferred": deltas[
+                    "local_sgd.rounds_deferred"
+                ],
+                "local_sgd.inter_bytes": deltas["local_sgd.inter_bytes"],
                 # serving plane: tokens this record emitted and the
                 # mid-decode admissions that landed inside it (both 0
                 # on training steps)
@@ -502,11 +524,18 @@ class TelemetryHub:
         if last is None:
             return {}
         pct = self.percentiles()
-        return {
+        out = {
             "step": last["step"],
             "step_ms_p50": pct.get("p50", 0.0),
             "last_step_ts": last["ts"] + last["wall_ms"] / 1e3,
         }
+        # local-SGD deferral ledger piggybacks the heartbeat: the
+        # driver's gang view shows which workers' DCN rounds are being
+        # pushed out (degraded inter axis) while every beat stays fresh
+        deferred = _metrics.snapshot().get("local_sgd.rounds_deferred")
+        if deferred:
+            out["local_sgd_rounds_deferred"] = float(deferred)
+        return out
 
     # -------------------------------------------------- flight recorder
 

@@ -429,6 +429,7 @@ class ElasticDriver:
         except Exception:
             _log.debug("heartbeat poll failed", exc_info=True)
             return None
+        deferred_max = 0.0
         for rank, payload in heartbeats.items():
             self.stall_inspector.record_heartbeat(
                 rank,
@@ -436,6 +437,21 @@ class ElasticDriver:
                 step=payload.get("step"),
                 step_ms_p50=payload.get("step_ms_p50"),
                 last_step_ts=payload.get("last_step_ts"),
+            )
+            deferred_max = max(
+                deferred_max,
+                float(payload.get("local_sgd_rounds_deferred", 0.0)),
+            )
+        if deferred_max > 0.0:
+            # local-SGD deferral ledger (piggybacked on the heartbeat):
+            # workers whose sync rounds keep getting pushed out are
+            # training on a degraded DCN — visible in the gang view
+            # WITHOUT tripping the straggler/stall machinery (their
+            # beats are fresh and their local steps are full speed)
+            from ..common.metrics import registry as _metrics
+
+            _metrics.gauge(
+                "driver.local_sgd.rounds_deferred", deferred_max
             )
         try:
             # check() publishes stall.pending / stall.stale_ranks /

@@ -72,6 +72,14 @@ class WorkerNotificationManager:
             from ..analysis import sched_audit as _sched_audit
 
             _sched_audit.reset()
+            # local-SGD phase/driver state is per-gang too: the new
+            # world resolves its own split, and the sync retry ladder
+            # (incl. any open circuit) starts fresh — the rejoin
+            # round re-syncs params from the Adasum consensus
+            # (local_sgd.rejoin_sync), not from a root broadcast
+            from .. import local_sgd as _local_sgd
+
+            _local_sgd.reset()
             cfg = config_mod.Config.from_env()
             if not (
                 cfg.rendezvous_addr

@@ -1,0 +1,425 @@
+"""Local SGD: ICI-only local steps, K-step hierarchical-Adasum
+reconciliation across the DCN axis (ROADMAP item 3).
+
+Every training step used to pay the inter-slice DCN hop —
+hierarchically and in int8 after the two-level wire (PR 10) and the
+quantized inter formats (PR 2/12), but still EVERY step. This module
+turns the two-level world from a latency optimization into a training
+REGIME: slices train independently on their ICI-only wire for K
+micro-steps (``DistributedOptimizer(local_sgd_steps=K)`` /
+``ShardedDistributedOptimizer(local_sgd_steps=K)``, env
+``HOROVOD_LOCAL_SGD_STEPS``), then reconcile **parameter deltas since
+the last round** across the inter axis with hierarchical Adasum over
+the int8 inter wire. Inter-DCN bytes drop ~K-fold on top of the
+hierarchical+int8 wire (docs/perf.md carries the pre-registered
+prediction table).
+
+Why Adasum as the merge operator (Sergeev & Del Balso, arXiv
+1802.05799 — PAPERS.md): after K local steps the slice deltas are no
+longer IID gradient samples — they are correlated trajectories whose
+naive average shrinks the step and whose naive sum overshoots.
+Adasum's combine removes each delta's projection onto the other
+before summing: orthogonal progress adds, redundant progress
+averages, and the result is invariant to each slice's local scale —
+exactly the convergence argument the reference makes for hierarchical
+allreduce + Adasum, applied at round granularity
+(docs/design.md "semi-synchronous training").
+
+Three layers live here:
+
+* **Phase routing** — :func:`local_phase` /
+  :func:`active_intra_groups`: while a local phase is active, the
+  eager fused dispatcher (``ops/fusion.py``) restricts every fused
+  allreduce to the intra-slice replica groups, and the optimizers
+  pass the same groups to their bucketed/monolithic exchange legs.
+  Lowered local-phase step programs contain ZERO inter-spanning
+  replica groups (hloaudit-asserted:
+  ``scripts/hlo_audit.py local_sgd_phase``).
+* **The sync round** — :func:`sync_tree` (replicated params) and
+  :func:`adasum_sync_shard` (intra-sharded deltas): the traced
+  reconciliation bodies over
+  :func:`~horovod_tpu.ops.adasum.adasum_allreduce_groups`'s grouped
+  VHDD, with error-feedback residuals carried ACROSS rounds in the
+  optimizer state (the ``"local"`` layout family;
+  ``reshard_state`` migrates it across world changes).
+* **The round driver** — :func:`run_round` / :func:`maybe_sync`:
+  host-side cadence + robustness. A DCN outage during a sync round
+  retries the round WHOLE under the PR 6 ``RetryPolicy``
+  (``local_sgd.sync`` chaos site), and exhaustion DEFERS the round —
+  the local phase extends, ``local_sgd.rounds_deferred`` counts it,
+  and training continues on the ICI wire with zero gang restarts.
+  An elastic rejoin re-syncs the newcomer from the Adasum consensus:
+  a slice restored at the last anchor contributes a zero delta
+  (Adasum's identity), so the first round after the join hands it
+  the surviving slices' combined progress instead of a root
+  broadcast (:func:`rejoin_sync`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from .common.logging import get_logger
+
+_log = get_logger("local_sgd")
+
+#: wire formats the sync round's inter hop accepts
+INTER_WIRES = ("fp32", "bf16", "int8")
+
+
+def default_steps() -> int:
+    """``HOROVOD_LOCAL_SGD_STEPS`` through the live config (1 = the
+    existing every-step sync path; the mode engages at K > 1)."""
+    from .common import basics
+
+    return basics.live_config().local_sgd_steps
+
+
+_env_warned = [False]
+
+
+def warn_env_engaged(k: int) -> None:
+    """One loud warning when the env knob (not an explicit
+    ``local_sgd_steps=``) flips an optimizer into local mode: the mode
+    is only HALF a training loop — a loop that never drives the sync
+    round trains silently diverged slices forever, and an operator
+    flipping the env under an existing script is exactly the caller
+    who may not know that."""
+    if _env_warned[0]:
+        return
+    _env_warned[0] = True
+    import warnings
+
+    warnings.warn(
+        f"HOROVOD_LOCAL_SGD_STEPS={k} engaged local-SGD mode: gradient "
+        "exchange is now INTRA-SLICE ONLY, and parameters only "
+        "reconcile across slices when the training loop drives the "
+        "sync round (hvd.local_sgd.maybe_sync every step, or "
+        "opt.sync/sync_round every K-th). A loop that never syncs "
+        "trains silently diverged slices. Pass local_sgd_steps= "
+        "explicitly to silence this warning.",
+        stacklevel=3,
+    )
+
+
+def resolve_stages(world: int, intra: Optional[int] = None):
+    """The two-level ``(intra_groups, inter_groups)`` split a local-SGD
+    job trains over — ``topology.hierarchy_stages`` in explicit mode
+    (local SGD is a per-job request, not an auto decision), or a loud
+    error when no split resolves: with a single slice there is no
+    inter axis to reconcile across and the mode is meaningless."""
+    from .common import topology as _topo
+
+    stages = _topo.hierarchy_stages(world=world, mode="on", intra=intra)
+    if stages is None:
+        raise ValueError(
+            f"local_sgd_steps > 1 needs a resolvable two-level topology "
+            f"(world={world}, intra={intra}): set HOROVOD_INTRA_SIZE "
+            "(or pass local_sgd_intra=) on single-slice runtimes, or "
+            "run on a multi-slice TPU — with one slice there is no "
+            "inter (DCN) axis to reconcile across"
+        )
+    return stages
+
+
+# ------------------------------------------------------- phase routing
+# The eager fused dispatcher cannot see the optimizer's knobs — it
+# serves hvd.allreduce calls from anywhere in the process — so the
+# local phase is a process-wide flag it consults per dispatch
+# (ops/fusion.py folds it into the executor cache key, so flipping the
+# phase can never reuse a flat-wire executable).
+
+_phase = {"groups": None}
+
+
+def set_local_phase(stages) -> None:
+    """Activate local-phase routing for the EAGER fused dispatcher:
+    ``stages`` is the ``(intra_groups, inter_groups)`` pair (or the
+    intra groups alone); until cleared, every eligible fused allreduce
+    reduces within its intra group only."""
+    groups = stages[0] if isinstance(stages, tuple) and len(stages) == 2 else stages
+    _phase["groups"] = tuple(tuple(int(r) for r in g) for g in groups)
+
+
+def clear_local_phase() -> None:
+    _phase["groups"] = None
+
+
+def active_intra_groups():
+    """The intra groups of the active local phase, or None — the hook
+    ``FusionManager`` consults per allreduce dispatch."""
+    return _phase["groups"]
+
+
+@contextlib.contextmanager
+def local_phase(stages):
+    """Scoped :func:`set_local_phase`::
+
+        with hvd.local_sgd.local_phase(stages):
+            hvd.allreduce(grad)   # reduces intra-slice only
+    """
+    set_local_phase(stages)
+    try:
+        yield
+    finally:
+        clear_local_phase()
+
+
+def reset() -> None:
+    """Drop phase + driver state (gang restart / tests): the new gang
+    resolves its own split and retry ladder."""
+    clear_local_phase()
+    _round_policy[0] = None
+
+
+# ------------------------------------------------------ traced bodies
+
+
+def adasum_sync_shard(
+    shard,
+    stages,
+    axis_name: Optional[str] = None,
+    inter_wire: str = "int8",
+    seed=0,
+    residual=None,
+    return_residual: bool = False,
+):
+    """Reconcile ONE intra-position shard across slices: ``shard`` is
+    this rank's ``[cols]`` chunk of its slice's delta vector (the
+    sharded optimizer's ``"local"`` anchor geometry — each slice's
+    vector is jointly held by its L ranks). VHDD Adasum runs across
+    the inter groups with the dot products completed over the intra
+    groups, so the coefficients are exact full-vector values while
+    every DCN hop moves 1/L of the bytes. Returns the merged shard
+    (same geometry); with ``residual``/``return_residual`` the
+    error-feedback pre-quantization carry rides in shard geometry
+    (``quantized + residual' == shard + residual`` bit-exact).
+
+    Thin alias of :func:`horovod_tpu.ops.adasum.adasum_sync_shard` —
+    ONE implementation serves this and the replicated
+    :func:`~horovod_tpu.ops.adasum.adasum_allreduce_groups` path."""
+    from .common.topology import WORLD_AXIS
+    from .ops.adasum import adasum_sync_shard as _core
+
+    return _core(
+        shard, stages,
+        axis_name=axis_name if axis_name is not None else WORLD_AXIS,
+        inter_wire=inter_wire, seed=seed, residual=residual,
+        return_residual=return_residual,
+    )
+
+
+def sync_tree(
+    params,
+    anchor,
+    residual=None,
+    stages=None,
+    axis_name: Optional[str] = None,
+    inter_wire: str = "int8",
+    seed=0,
+    return_residual: bool = False,
+):
+    """The replicated-optimizer sync round body (traced, inside
+    shard_map over the flat axis): parameter deltas since the last
+    round (``params − anchor``, replicated within each slice by
+    local-phase construction) merge across slices through ONE
+    concatenated :func:`~horovod_tpu.ops.adasum.adasum_allreduce_groups`
+    and the new params land on ``anchor + merged``. Returns
+    ``(new_params, new_residual_or_None)`` — the caller re-anchors on
+    the result."""
+    import jax
+    import jax.numpy as jnp
+
+    from .common.topology import WORLD_AXIS
+    from .ops.adasum import adasum_allreduce_groups
+
+    if stages is None:
+        raise ValueError("stages is required (resolve_stages)")
+    if axis_name is None:
+        axis_name = WORLD_AXIS
+    p_leaves, treedef = jax.tree_util.tree_flatten(params)
+    a_leaves = treedef.flatten_up_to(anchor)
+    sizes = [leaf.size for leaf in p_leaves]
+    flat = jnp.concatenate(
+        [
+            (p - a.astype(p.dtype)).reshape(-1).astype(jnp.float32)
+            for p, a in zip(p_leaves, a_leaves)
+        ]
+    )
+    r_flat = None
+    if residual is not None:
+        r_leaves = treedef.flatten_up_to(residual)
+        r_flat = jnp.concatenate(
+            [r.reshape(-1).astype(jnp.float32) for r in r_leaves]
+        )
+    want_res = return_residual and inter_wire == "int8"
+    if want_res or r_flat is not None:
+        merged, new_r = adasum_allreduce_groups(
+            flat, axis_name=axis_name, stages=stages,
+            inter_wire=inter_wire, seed=seed, residual=r_flat,
+            return_residual=True,
+        )
+    else:
+        merged = adasum_allreduce_groups(
+            flat, axis_name=axis_name, stages=stages,
+            inter_wire=inter_wire, seed=seed,
+        )
+        new_r = None
+    new_p, new_res, off = [], [], 0
+    for p, a, sz in zip(p_leaves, a_leaves, sizes):
+        d = merged[off : off + sz].reshape(p.shape)
+        new_p.append((a.astype(jnp.float32) + d).astype(p.dtype))
+        if new_r is not None:
+            new_res.append(
+                new_r[off : off + sz].reshape(p.shape).astype(p.dtype)
+            )
+        off += sz
+    new_params = jax.tree_util.tree_unflatten(treedef, new_p)
+    if new_r is None:
+        return new_params, None
+    return new_params, jax.tree_util.tree_unflatten(treedef, new_res)
+
+
+# -------------------------------------------------------- round driver
+
+_round_policy = [None]
+
+
+def _policy():
+    """One RetryPolicy for the whole process's sync rounds (site
+    ``local_sgd.sync`` — the PR 6 ladder: jittered backoff, deadline,
+    HOROVOD_RETRY_* knobs). Rounds are retried WHOLE: the VHDD's
+    internal state never partially commits, so re-running the compiled
+    round is idempotent by construction."""
+    if _round_policy[0] is None:
+        from .common.retry import RetryPolicy
+
+        _round_policy[0] = RetryPolicy.from_env("local_sgd.sync")
+    return _round_policy[0]
+
+
+def round_inter_bytes(payload_bytes: int, stages, inter_wire: str = "int8") -> int:
+    """Modeled per-rank DCN bytes of ONE sync round: the VHDD
+    halving-doubling over H slices on the 1/L shard at the inter
+    wire's width (``ops.adasum.vhdd_wire_bytes`` — the same
+    payload-width model as ``FusionManager._hop_bytes``; ring/topology
+    factors cancel in every ratio docs/perf.md gates on)."""
+    from .ops.adasum import vhdd_wire_bytes
+
+    intra_groups, inter_groups = stages
+    L = len(intra_groups[0])
+    H = len(inter_groups[0])
+    elems = -(-int(payload_bytes) // 4)  # fp32 payload elements
+    width = {"int8": 1, "bf16": 2}.get(inter_wire, 4)
+    shard_wire_bytes = -(-elems // L) * width
+    return vhdd_wire_bytes(H, shard_wire_bytes)
+
+
+def due(step: int, k: int) -> bool:
+    """Sync cadence: True on every K-th step (0-based ``step``; the
+    round runs AFTER the step that completes a window)."""
+    return int(k) > 1 and (int(step) + 1) % int(k) == 0
+
+
+def run_round(
+    sync_step,
+    *args,
+    policy=None,
+    payload_bytes: Optional[int] = None,
+    stages=None,
+    inter_wire: str = "int8",
+):
+    """Execute one compiled sync round under the robustness plane.
+
+    ``sync_step(*args)`` is the jitted reconciliation program (the
+    optimizer's ``sync`` inside the caller's shard_map). Each attempt
+    first passes the ``local_sgd.sync`` chaos site (the DCN-hop fault
+    surface — testing/chaos.py) and then blocks on the round's result
+    so a transport fault surfaces INSIDE the attempt; retryable
+    failures re-run the round whole under the PR 6 RetryPolicy.
+    Exhaustion DEFERS: returns ``(None, False)``, counts
+    ``local_sgd.rounds_deferred``, and the caller keeps training on
+    the ICI wire — a DCN outage degrades to a longer local phase
+    instead of a stall or a gang restart. Success returns
+    ``(result, True)``, counts ``local_sgd.sync_rounds``, and (when
+    ``payload_bytes``/``stages`` are given) advances the
+    ``local_sgd.inter_bytes`` ledger by :func:`round_inter_bytes`."""
+    import jax
+
+    from .common.metrics import registry as _metrics
+    from .common.retry import CircuitOpenError, RetryError
+    from .testing import chaos as _chaos
+
+    pol = policy if policy is not None else _policy()
+
+    def _attempt():
+        _chaos.inject("local_sgd.sync")
+        out = sync_step(*args)
+        jax.block_until_ready(out)
+        return out
+
+    try:
+        out = pol.call(_attempt)
+    except (RetryError, CircuitOpenError) as e:
+        _metrics.counter("local_sgd.rounds_deferred")
+        _log.warning(
+            "local_sgd: sync round deferred (%s) — local phase "
+            "extends, training continues on the ICI wire", e,
+        )
+        return None, False
+    _metrics.counter("local_sgd.sync_rounds")
+    if payload_bytes is not None and stages is not None:
+        _metrics.counter(
+            "local_sgd.inter_bytes",
+            round_inter_bytes(payload_bytes, stages, inter_wire),
+        )
+    return out, True
+
+
+def maybe_sync(
+    sync_step,
+    *args,
+    step: int,
+    k: Optional[int] = None,
+    policy=None,
+    payload_bytes: Optional[int] = None,
+    stages=None,
+    inter_wire: str = "int8",
+):
+    """The per-step cadence driver a local-SGD training loop calls
+    after every optimizer step::
+
+        out, synced = hvd.local_sgd.maybe_sync(
+            sync_step, params, state, step=i, k=8)
+        if synced:
+            params, state = out
+
+    Counts ``local_sgd.local_steps`` every call; on every K-th step
+    runs :func:`run_round` (retry / defer semantics above). Returns
+    ``(result_or_None, synced)``."""
+    from .common.metrics import registry as _metrics
+
+    if k is None:
+        k = default_steps()
+    _metrics.counter("local_sgd.local_steps")
+    if not due(step, k):
+        return None, False
+    return run_round(
+        sync_step, *args, policy=policy, payload_bytes=payload_bytes,
+        stages=stages, inter_wire=inter_wire,
+    )
+
+
+def rejoin_sync(sync_step, *args, policy=None):
+    """Elastic-rejoin consensus re-sync: run ONE immediate round after
+    a membership change instead of broadcasting root's parameters. A
+    slice that restored at the last committed anchor contributes a
+    ZERO delta — Adasum's identity, so the round hands it the
+    surviving slices' combined progress while contributing nothing
+    stale; slices that kept training fold their in-flight progress in
+    at the same time. Unlike a root broadcast, no single rank's
+    trajectory is privileged. Retry/defer semantics are
+    :func:`run_round`'s — a deferred rejoin round simply leaves the
+    newcomer at the anchor until the next scheduled round."""
+    return run_round(sync_step, *args, policy=policy)

@@ -370,6 +370,322 @@ def _vhdd_allreduce(
     return piece.reshape(shape).astype(dtype)
 
 
+def adasum_allreduce_groups(
+    tensor,
+    axis_name: str = WORLD_AXIS,
+    stages=None,
+    inter_wire: str = "fp32",
+    seed: int = 0,
+    residual=None,
+    return_residual: bool = False,
+):
+    """Hierarchical Adasum on the FLAT axis via replica groups — the
+    local-SGD sync-round combiner (``topology.hierarchy_stages()``
+    layout: rank ``r = h·L + i`` is slice ``h``, intra position ``i``).
+
+    Contract: ``tensor`` is the SLICE's value (the parameter delta
+    since the last round), replicated across the slice's L ranks —
+    local-phase training keeps it so by construction. Each rank takes
+    its intra-position chunk (a static slice, NO collective — the
+    replication pays for itself here), the H slice values combine by
+    VHDD Adasum across the inter groups with every dot product
+    completed over the intra groups (exact full-vector coefficients),
+    and an intra all-gather reassembles the merged result. DCN bytes
+    per rank ≈ ``vhdd_wire_bytes(H, payload/L)`` — 1/L of the full
+    payload halving-doubled across slices, times ~4x less again at
+    ``inter_wire='int8'``.
+
+    Error feedback (``inter_wire='int8'`` + ``return_residual=True``):
+    the carry joins the chunk BEFORE the wire (``x_eff = chunk +
+    residual_chunk``), the chunk is pre-quantized through the same
+    block quantizer the wire uses, and ``residual' = x_eff −
+    dequant(quant(x_eff))`` comes back FULL-geometry (intra
+    all-gathered, so every rank of a slice holds the identical carry
+    and the state stays replicated-consistent across topology
+    changes). Conservation is bit-exact by construction:
+    ``quantized + residual' == delta + residual``. The VHDD's own
+    half-exchange roundings on intermediate COMBINED pieces are
+    zero-mean stochastic noise outside the carry — EF bounds each
+    slice's contribution error across rounds (docs/design.md,
+    "semi-synchronous training")."""
+    if stages is None:
+        raise ValueError("stages is required (topology.hierarchy_stages)")
+    if inter_wire not in ("fp32", "bf16", "int8"):
+        raise ValueError(f"unknown inter_wire {inter_wire!r}")
+    if return_residual and inter_wire != "int8":
+        raise ValueError(
+            "return_residual needs inter_wire='int8' (exact wires "
+            "transmit everything; there is no residual to carry)"
+        )
+    intra_groups, inter_groups = stages
+    L = len(intra_groups[0])
+    H = len(inter_groups[0])
+    shape, dtype = tensor.shape, tensor.dtype
+    x = tensor.astype(jnp.float32).reshape(-1)
+    m = x.shape[0]
+    p = 1 << (H.bit_length() - 1)  # VHDD power-of-two core
+    # pad so the per-rank chunk splits evenly across every halving stage
+    unit = L * max(p, 1)
+    pad = (-m) % unit
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+    chunk = x.shape[0] // L
+    idx = lax.axis_index(axis_name)
+    from ..common.topology import stage_positions
+
+    pos = jnp.asarray(stage_positions(intra_groups))[idx]
+    piece = lax.dynamic_slice(x, (pos * chunk,), (chunk,))
+    r_piece = None
+    if residual is not None:
+        r_flat = residual.astype(jnp.float32).reshape(-1)
+        if pad:
+            r_flat = jnp.concatenate(
+                [r_flat, jnp.zeros((pad,), jnp.float32)]
+            )
+        r_piece = lax.dynamic_slice(r_flat, (pos * chunk,), (chunk,))
+    want_res = return_residual and inter_wire == "int8"
+    # ONE shard-level core serves both the replicated and the sharded
+    # optimizers (adasum_sync_shard): pre-quantization is keyed by the
+    # intra POSITION here — slice replicas hold identical chunks and
+    # must pre-quantize identically, or the replicas would fork
+    out = adasum_sync_shard(
+        piece, stages, axis_name=axis_name, inter_wire=inter_wire,
+        seed=seed, residual=r_piece, return_residual=want_res,
+        key_index=pos,
+    )
+    if want_res:
+        out, res_piece = out
+        new_res = lax.all_gather(
+            res_piece, axis_name, tiled=True,
+            axis_index_groups=intra_groups,
+        )[:m].reshape(shape).astype(dtype)
+    else:
+        new_res = None
+    out = lax.all_gather(
+        out, axis_name, tiled=True, axis_index_groups=intra_groups
+    )[:m].reshape(shape).astype(dtype)
+    if not return_residual:
+        return out
+    if new_res is None:
+        new_res = jnp.zeros(shape, dtype)
+    return out, new_res
+
+
+def adasum_sync_shard(
+    shard,
+    stages,
+    axis_name: str = WORLD_AXIS,
+    inter_wire: str = "int8",
+    seed=0,
+    residual=None,
+    return_residual: bool = False,
+    key_index=None,
+):
+    """The shard-level local-SGD sync core — the ONE home of the
+    EF-pre-quantization + pad + grouped-VHDD contract
+    (:func:`adasum_allreduce_groups` delegates here for the replicated
+    case; ``ShardedDistributedOptimizer.sync_round`` calls it directly
+    on its intra-position shards). ``shard`` is this rank's ``[cols]``
+    chunk of its slice's delta vector; the merged chunk comes back in
+    the same geometry. With ``residual``/``return_residual`` (int8
+    wire) the carry satisfies ``quantized + residual' == shard +
+    residual`` bit-exactly. ``key_index`` overrides the
+    pre-quantization RNG fold (default: the rank index); the
+    replicated caller passes the intra POSITION so slice replicas
+    holding identical chunks pre-quantize identically."""
+    if stages is None:
+        raise ValueError("stages is required (topology.hierarchy_stages)")
+    if inter_wire not in ("fp32", "bf16", "int8"):
+        raise ValueError(f"unknown inter_wire {inter_wire!r}")
+    if return_residual and inter_wire != "int8":
+        raise ValueError(
+            "return_residual needs inter_wire='int8' (exact wires "
+            "transmit everything)"
+        )
+    intra_groups, inter_groups = stages
+    L = len(intra_groups[0])
+    H = len(inter_groups[0])
+    c = shard.shape[0]
+    x = shard.astype(jnp.float32)
+    new_res = None
+    if inter_wire == "int8" and (residual is not None or return_residual):
+        from .traced import _block_dequant, _stochastic_round_blocks
+
+        if residual is not None:
+            x = x + residual.astype(jnp.float32)
+        fold = (
+            lax.axis_index(axis_name) if key_index is None else key_index
+        )
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), 3571), fold
+        )
+        # EF pre-quantization: what enters the combine IS the wire
+        # resolution of this slice's signal; the carry is exactly what
+        # the wire could not represent this round
+        block = min(512, max(c, 1))
+        q, s = _stochastic_round_blocks(x[None], block, key)
+        q_x = _block_dequant(q, s)[0][:c]
+        if return_residual:
+            new_res = (x - q_x).astype(shard.dtype)
+        x = q_x
+    p = 1 << (H.bit_length() - 1)
+    pad = (-c) % max(p, 1)
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+    if H > 1:
+        x = _vhdd_grouped(x, axis_name, L, H, inter_wire, seed)
+    out = x[:c].astype(shard.dtype)
+    if not return_residual:
+        return out
+    if new_res is None:
+        new_res = jnp.zeros_like(shard)
+    return out, new_res
+
+
+def _vhdd_grouped(piece, axis_name: str, L: int, H: int, wire: str, seed):
+    """VHDD Adasum across the INTER groups of the contiguous two-level
+    layout (rank ``r = h·L + i``): the :func:`_vhdd_allreduce` dataflow
+    with slice index ``h`` playing the rank role, every half-exchange a
+    flat ``ppermute`` between same-intra-position ranks of partner
+    slices, and every combine's three dot products completed over the
+    (2d-slice-block × intra) replica groups — the full-vector Adasum of
+    the slice values whose chunks the intra members jointly hold.
+    ``piece`` is this rank's intra-position chunk; chunk length must be
+    divisible by the power-of-two slice core (callers pad)."""
+    p = 1 << (H.bit_length() - 1)
+    excess = H - p
+    world = L * H
+    idx = lax.axis_index(axis_name)
+    h = idx // L
+    x = piece
+    key = jax.random.PRNGKey(seed) if wire == "int8" else None
+
+    def _block_dots(scal, d):
+        """Complete [dot, nk, nr] over the 2d-slice block × intra."""
+        if not excess:
+            groups = [
+                [hb * L + i2
+                 for hb in range(g * 2 * d, (g + 1) * 2 * d)
+                 for i2 in range(L)]
+                for g in range(p // (2 * d))
+            ]
+            return lax.psum(scal, axis_name, axis_index_groups=groups)
+        # unequal groups (blocks + excess singleton slices) don't lower
+        # on TPU; the scalars are tiny — all_gather + static 0/1 row
+        import numpy as np
+
+        bmat = np.zeros((world, world), np.float32)
+        for g in range(p // (2 * d)):
+            hs = range(g * 2 * d, (g + 1) * 2 * d)
+            ranks = [hb * L + i2 for hb in hs for i2 in range(L)]
+            for a in ranks:
+                for b in ranks:
+                    bmat[a, b] = 1.0
+        for r2 in range(p * L, world):
+            bmat[r2, r2] = 1.0
+        gathered = lax.all_gather(scal, axis_name)  # [world, 3]
+        return jnp.asarray(bmat)[idx] @ gathered
+
+    if excess:
+        # pre-reduction: slices [p, H) fold into partner h-p chunk-wise;
+        # dots completed via the static-matrix path (pair × intra)
+        perm = [
+            ((p + e) * L + i, e * L + i)
+            for e in range(excess)
+            for i in range(L)
+        ]
+        recv = lax.ppermute(x, axis_name, perm)
+        import numpy as np
+
+        bmat = np.zeros((world, world), np.float32)
+        for e in range(excess):
+            ranks = [e * L + i for i in range(L)]
+            for a in ranks:
+                for b in ranks:
+                    bmat[a, b] = 1.0
+        for r2 in range(world):
+            if bmat[r2, r2] == 0.0:
+                bmat[r2, r2] = 1.0
+        scal = jnp.stack(
+            [jnp.sum(x * recv), jnp.sum(x * x), jnp.sum(recv * recv)]
+        )
+        tot = jnp.asarray(bmat)[idx] @ lax.all_gather(scal, axis_name)
+        dot, asq, bsq = tot[0], tot[1], tot[2]
+        acoef = 1.0 - jnp.where(asq > 0, dot / (2.0 * asq), 0.0)
+        bcoef = 1.0 - jnp.where(bsq > 0, dot / (2.0 * bsq), 0.0)
+        x = jnp.where(h < excess, acoef * x + bcoef * recv, x)
+
+    stages_n = p.bit_length() - 1  # log2(p)
+    for k in range(stages_n):
+        d = 1 << k
+        half = x.shape[0] // 2
+        low, high = x[:half], x[half:]
+        bit = (h & d) != 0
+        send = jnp.where(bit, low, high)
+        keep = jnp.where(bit, high, low)
+        perm = [
+            (hh * L + i, (hh ^ d) * L + i)
+            for hh in range(p)
+            for i in range(L)
+        ]
+        recv, _ = _wire_exchange(
+            send, perm, axis_name, wire,
+            None
+            if key is None
+            else jax.random.fold_in(jax.random.fold_in(key, 100 + k), idx),
+        )
+        dot = jnp.sum(keep * recv)
+        nk = jnp.sum(keep * keep)
+        nr = jnp.sum(recv * recv)
+        scal = jnp.stack(
+            [dot, jnp.where(bit, nr, nk), jnp.where(bit, nk, nr)]
+        )
+        tot = _block_dots(scal, d)
+        dot_t, asq, bsq = tot[0], tot[1], tot[2]
+        acoef = 1.0 - jnp.where(asq > 0, dot_t / (2.0 * asq), 0.0)
+        bcoef = 1.0 - jnp.where(bsq > 0, dot_t / (2.0 * bsq), 0.0)
+        x = (
+            jnp.where(bit, bcoef, acoef) * keep
+            + jnp.where(bit, acoef, bcoef) * recv
+        )
+
+    for k in reversed(range(stages_n)):
+        d = 1 << k
+        perm = [
+            (hh * L + i, (hh ^ d) * L + i)
+            for hh in range(p)
+            for i in range(L)
+        ]
+        # key by the piece's equivalence class: slices equal mod 2d at
+        # the same intra position hold identical pieces and must emit
+        # identical wire bits (the flat VHDD's fork-prevention rule,
+        # extended by the intra coordinate)
+        recv, self_wire = _wire_exchange(
+            x, perm, axis_name, wire,
+            None
+            if key is None
+            else jax.random.fold_in(
+                jax.random.fold_in(key, 200 + k),
+                (h & (2 * d - 1)) * L + (idx - h * L),
+            ),
+        )
+        bit = (h & d) != 0
+        x = jnp.concatenate(
+            [jnp.where(bit, recv, self_wire),
+             jnp.where(bit, self_wire, recv)]
+        )
+
+    if excess:
+        back = lax.ppermute(
+            x, axis_name,
+            [(e * L + i, (p + e) * L + i)
+             for e in range(excess)
+             for i in range(L)],
+        )
+        x = jnp.where(h >= p, back, x)
+    return x
+
+
 def vhdd_wire_bytes(n: int, payload_bytes: int) -> int:
     """Modeled per-rank wire bytes of one VHDD Adasum (both sweeps +
     non-pow2 pre/post hops, excess ranks' worst case) — the ~2P claim,

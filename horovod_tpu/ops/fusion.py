@@ -465,6 +465,10 @@ class FusionManager:
         # exchange model — the self block never leaves the chip.
         self.alltoall_dispatches = 0
         self.alltoall_wire_bytes_total = 0
+        # local-SGD phase routing (horovod_tpu/local_sgd.py): fused
+        # allreduce dispatches that ran group-limited to the intra
+        # slice while a local phase was active
+        self.local_dispatches = 0
         self.ef_residual_norm = 0.0  # L2 of the last EF residual batch
         self._seed_counter = 0  # decorrelates stochastic rounding per dispatch
         self._prev_outs = None  # queue-drain anchor for WireTuner trials
@@ -817,6 +821,7 @@ class FusionManager:
             "quant_blocks": self.quant_blocks_total,
             "wire_format": WIRE_FORMAT_CODES.get(self.last_wire_format, 0),
             "hier_dispatches": self.hier_dispatches,
+            "local_dispatches": self.local_dispatches,
             "wire_bytes_saved_intra": self.wire_bytes_saved_intra_total,
             "wire_bytes_saved_inter": self.wire_bytes_saved_inter_total,
             "wire_format_intra": WIRE_FORMAT_CODES.get(
@@ -972,6 +977,39 @@ class FusionManager:
             mask = None if e0.mask is None else tuple(bool(b) for b in e0.mask)
             plan = self._plan(batch, "allreduce", self.world)
             wire, hier, tuned, intra_wire = self._resolve_wire(e0, plan)
+            # local-SGD local phase (horovod_tpu/local_sgd.py): an
+            # active phase restricts every eligible fused allreduce to
+            # its intra group — no inter hop exists, so the two-level
+            # decomposition is moot. Masked/pset batches stay flat
+            # (a masked subgroup has no uniform replica-group shape);
+            # they are the caller's explicit cross-slice request.
+            local_groups = None
+            if (
+                pset_mask is None
+                and mask is None
+                and e0.op in (Average, Sum)
+            ):
+                from .. import local_sgd as _local_sgd
+
+                local_groups = _local_sgd.active_intra_groups()
+            if local_groups is not None:
+                hier = None
+                if tuned:
+                    # never feed an ICI-only dispatch's timing into
+                    # the WireTuner's world/hier keys (they persist via
+                    # HOROVOD_TUNER_CACHE and would poison the goodput
+                    # real DCN-crossing dispatches choose from) — and
+                    # auto never picks int8 inside a slice (the quant
+                    # tax cannot pay for itself on ICI)
+                    tuned = False
+                    if wire == "int8":
+                        wire = "fp32"
+                if (e0.wire or self.wire) == "int8_hier":
+                    # int8 was licensed for the inter hop only; with no
+                    # inter hop the placement degenerates to its intra
+                    # leg's wire
+                    wire = "bf16"
+                self.local_dispatches += 1
             if pset_mask is not None or mask is not None:
                 # masked hierarchy degenerates to flat inside the core;
                 # keep the spec (and so the wire-byte model + autotune
@@ -983,6 +1021,13 @@ class FusionManager:
             hier_key = (
                 None if hier is None else (len(hier[0]), len(hier[0][0]))
             )
+            # the local phase re-keys the executors the same way: a
+            # flat-wire executable must never serve a local dispatch
+            local_key = (
+                None
+                if local_groups is None
+                else (len(local_groups), len(local_groups[0]))
+            )
             if wire == "int8":
                 # a compressor's block_size (Compression.int8_block
                 # subclasses) beats the manager knob, matching the
@@ -991,11 +1036,12 @@ class FusionManager:
                 core_key = (
                     "allreduce_q", int(e0.op), e0.prescale, e0.postscale,
                     pset_mask, mask, plan.bucket, plan.dtype, block,
-                    e0.want_residual, hier_key, intra_wire,
+                    e0.want_residual, hier_key, intra_wire, local_key,
                 )
                 builder = lambda: self._core_allreduce_q(
                     e0.op, e0.prescale, e0.postscale, pset_mask, mask,
                     block, e0.want_residual, hier, intra_wire,
+                    local_groups,
                 )
                 return _ExecSpec(
                     plan, core_key, builder, needs_seed=True,
@@ -1007,11 +1053,12 @@ class FusionManager:
             core_key = (
                 "allreduce", int(e0.op), e0.prescale, e0.postscale,
                 pset_mask, mask, plan.bucket, plan.dtype, wire,
-                hier_key, intra_wire,
+                hier_key, intra_wire, local_key,
             )
             builder = lambda: self._core_allreduce(
                 e0.op, e0.prescale, e0.postscale, pset_mask, mask,
                 wire=wire, hier_stages=hier, intra_wire=intra_wire,
+                local_groups=local_groups,
             )
             return _ExecSpec(
                 plan, core_key, builder, wire=wire, tuned=tuned,
@@ -1574,7 +1621,7 @@ class FusionManager:
 
     def _core_allreduce(
         self, op, prescale, postscale, pset_mask, mask, wire="fp32",
-        hier_stages=None, intra_wire=None,
+        hier_stages=None, intra_wire=None, local_groups=None,
     ):
         world = self.world
         op = ReduceOp(op)
@@ -1599,6 +1646,12 @@ class FusionManager:
         # batches arrive with hier_stages=None (degenerate to flat).
         # Only the unrestricted Sum/Average path qualifies.
         if active_arr is not None or op not in (Average, Sum):
+            hier_stages = None
+            local_groups = None  # masked local phase degenerates flat
+        if local_groups is not None:
+            # local-SGD local phase (horovod_tpu/local_sgd.py): the
+            # collective never leaves the slice — and a two-level
+            # decomposition would reintroduce the inter hop
             hier_stages = None
         if intra_wire is None:
             intra_wire = wire if bf16_wire else "fp32"
@@ -1625,6 +1678,22 @@ class FusionManager:
                     stages=hier_stages, intra_wire=intra_wire,
                     inter_wire=wire,
                 )[None]
+            elif op in (Average, Sum) and local_groups is not None:
+                # local phase: one group-limited psum per slice; the
+                # divisor is the slice width (masks/psets never reach
+                # this branch — they degenerate to flat above)
+                if bf16_wire:
+                    contrib = contrib.astype(jnp.bfloat16)
+                out = lax.psum(
+                    contrib, WORLD_AXIS,
+                    axis_index_groups=[list(g) for g in local_groups],
+                )
+                if bf16_wire:
+                    out = out.astype(x.dtype)
+                if op == Average:
+                    out = out / jnp.asarray(
+                        len(local_groups[0]), out.dtype
+                    )
             elif op in (Average, Sum):
                 # bf16 wire: the cast is the compression — XLA fuses it
                 # into the collective's producer/consumer, so the wire
@@ -1686,7 +1755,7 @@ class FusionManager:
 
     def _core_allreduce_q(
         self, op, prescale, postscale, pset_mask, mask, block,
-        want_res, hier_stages, intra_wire="bf16",
+        want_res, hier_stages, intra_wire="bf16", local_groups=None,
     ):
         """The quantized fused wire: the whole fused buffer traverses
         the collective as block-scaled int8, entirely inside the
@@ -1737,9 +1806,15 @@ class FusionManager:
             active_arr = mask_arr & pset_arr
         else:
             active_arr = mask_arr if mask_arr is not None else pset_arr
+        if active_arr is not None:
+            local_groups = None  # masked local phase degenerates flat
+        if local_groups is not None:
+            hier_stages = None  # the local phase has no inter hop
         # divisor is static: the single controller knows the join mask
         n_active = (
-            world if active_arr is None else max(int(active_arr.sum()), 1)
+            (len(local_groups[0]) if local_groups is not None else world)
+            if active_arr is None
+            else max(int(active_arr.sum()), 1)
         )
         if hier_stages is not None and active_arr is not None:
             hier_stages = None  # masked hierarchy degenerates to flat
@@ -1777,6 +1852,11 @@ class FusionManager:
                 ).astype(jnp.float32)
                 n = len(inter_groups[0])
                 groups = inter_groups
+            elif local_groups is not None:
+                # local phase: the whole two-stage int8 recipe runs
+                # inside the slice (chunk ownership by group position)
+                n = len(local_groups[0])
+                groups = [list(g) for g in local_groups]
             else:
                 n = world
                 groups = None
@@ -1853,10 +1933,17 @@ class FusionManager:
                 e2 = e2 * jnp.asarray(n_active, e2.dtype)
             if prescale != 1.0:
                 e2 = e2 / jnp.asarray(prescale, e2.dtype)
+            if local_groups is not None:
+                # chunk ownership = position within the intra group
+                from .traced import _group_pos_table
+
+                own = jnp.asarray(_group_pos_table(local_groups))[idx]
+            else:
+                own = idx
             res_flat = lax.dynamic_update_slice(
                 res_flat,
-                lax.dynamic_slice(res_flat, (idx * chunk,), (chunk,)) + e2,
-                (idx * chunk,),
+                lax.dynamic_slice(res_flat, (own * chunk,), (chunk,)) + e2,
+                (own * chunk,),
             )
             res = res_flat[:m].astype(x.dtype)[None]
             if pset_arr is not None:

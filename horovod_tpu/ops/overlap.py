@@ -285,9 +285,18 @@ def bucketed_allreduce(
     schedule: Optional[BucketSchedule] = None,
     return_finite: bool = False,
     hier_stages="auto",
+    groups=None,
 ):
     """Allreduce a gradient pytree as N independent per-bucket
     collectives (module docstring).
+
+    ``groups`` restricts every bucket's collective to
+    ``axis_index_groups`` of the flat axis (the local-SGD local phase:
+    each slice reduces among its own ranks, zero inter-slice bytes).
+    Mutually exclusive with the two-level routing (``hier_stages`` is
+    ignored — there IS no inter hop), with process sets and with join
+    masks; ``Average`` divides by the group size. Quantized wires ride
+    the grouped two-stage recipe with the same EF residual contract.
 
     ``hier_stages`` routes each bucket through the TWO-LEVEL recipe
     (``traced.hierarchical_allreduce_groups``: intra RS -> inter
@@ -353,6 +362,14 @@ def bucketed_allreduce(
     _publish(schedule)
 
     quantized = getattr(compression, "quantized_wire", False)
+    if groups is not None and (
+        mask is not None
+        or (process_set is not None and process_set.process_set_id != 0)
+    ):
+        raise NotImplementedError(
+            "bucketed_allreduce(groups=) composes with neither "
+            "process sets nor join masks"
+        )
     if quantized:
         if process_set is not None and process_set.process_set_id != 0:
             raise NotImplementedError(
@@ -381,9 +398,14 @@ def bucketed_allreduce(
         if r_leaves is not None:
             res_leaves[i] = r_leaves[i]
 
-    stages = _auto_stages(hier_stages, jax.lax.axis_size(axis_name))
+    stages = (
+        None
+        if groups is not None
+        else _auto_stages(hier_stages, jax.lax.axis_size(axis_name))
+    )
     if (
         stages is None
+        and groups is None
         and hier_stages == "auto"
         and getattr(compression, "wire_format", None) == "int8_hier"
     ):
@@ -444,6 +466,7 @@ def bucketed_allreduce(
                         flat + r_flat, op=op, axis_name=axis_name,
                         seed=bseed, return_residual=True,
                         prescale_factor=prescale_factor, block_size=block,
+                        groups=groups,
                     )
             elif stages is not None:
                 # the two-level placement: int8 on the DCN hop only
@@ -458,6 +481,7 @@ def bucketed_allreduce(
                 out_flat = traced.quantized_allreduce(
                     flat, op=op, axis_name=axis_name, seed=bseed,
                     prescale_factor=prescale_factor, block_size=block,
+                    groups=groups,
                 )
                 new_r = None
             if postscale_factor != 1.0:
@@ -486,6 +510,7 @@ def bucketed_allreduce(
                 process_set=process_set,
                 axis_name=axis_name,
                 mask=mask,
+                groups=groups,
             )
             out_flat = compression.decompress(red, ctx)
             new_r = None
@@ -623,11 +648,19 @@ def bucketed_reduce_scatter(
     min_bucket_bytes: Optional[int] = None,
     schedule: Optional[BucketSchedule] = None,
     hier_stages="auto",
+    groups=None,
 ):
     """Reduce-scatter a pytree as N independent per-bucket collectives,
     returning per-leaf SHARD slices (nonscalar leaf → its ``[cols]``
     rank shard, ``cols = ceil(size/world)``; 0-d leaf → replicated
-    psum) — the ZeRO-2 gradient leg. Elementwise identical to a
+    psum) — the ZeRO-2 gradient leg.
+
+    ``groups`` (local-SGD local phase) restricts every collective to
+    ``axis_index_groups`` of the flat axis: panes are ``[L, cols]``
+    (L = group size), each group scatters among its own members —
+    rank r receives the shard of its POSITION within its group — and
+    ``Average`` divides by L. ``hier_stages`` is ignored (no inter
+    hop exists inside a slice). Elementwise identical to a
     per-leaf ``psum_scatter`` for the fp32 wire (same per-element
     cross-replica sums), so shard values are bit-exact vs the
     monolithic ZeRO-1 path.
@@ -658,8 +691,13 @@ def bucketed_reduce_scatter(
         n_buckets = default_buckets() or 1
     if min_bucket_bytes is None:
         min_bucket_bytes = default_min_bytes()
-    n = jax.lax.axis_size(axis_name)
-    stages = _auto_stages(hier_stages, n)
+    if groups is not None:
+        n = len(groups[0])
+        groups = [list(g) for g in groups]
+        stages = None
+    else:
+        n = jax.lax.axis_size(axis_name)
+        stages = _auto_stages(hier_stages, n)
     if residuals is not None:
         stages = None  # EF carries are defined against the flat wire
     hier_L = None if stages is None else len(stages[0][0])
@@ -688,7 +726,7 @@ def bucketed_reduce_scatter(
         ):
             out[i] = g  # passthrough (float0 cotangents etc.)
         else:
-            red = jax.lax.psum(g, axis_name)
+            red = jax.lax.psum(g, axis_name, axis_index_groups=groups)
             out[i] = red / n if op == Average else red
         if r_leaves is not None:
             res_out[i] = r_leaves[i]
@@ -744,18 +782,20 @@ def bucketed_reduce_scatter(
                 red, new_r = traced.quantized_reducescatter(
                     buf, op=Sum, axis_name=axis_name, seed=bseed,
                     block_size=wire_block, return_residual=True,
+                    groups=groups,
                 )
             else:
                 red = traced.quantized_reducescatter(
                     buf, op=Sum, axis_name=axis_name, seed=bseed,
-                    block_size=wire_block,
+                    block_size=wire_block, groups=groups,
                 )
             if op == Average:
                 red = red / jnp.asarray(n, red.dtype)
         else:
             wire_buf = buf.astype(jnp.bfloat16) if bw == "bf16" else buf
             red = jax.lax.psum_scatter(
-                wire_buf, axis_name, scatter_dimension=0, tiled=False
+                wire_buf, axis_name, scatter_dimension=0, tiled=False,
+                axis_index_groups=groups,
             ).astype(buf.dtype)
             if op == Average:
                 red = red / jnp.asarray(n, red.dtype)
@@ -800,6 +840,7 @@ def bucketed_shard_all_gather(
     min_bucket_bytes: Optional[int] = None,
     schedule: Optional[BucketSchedule] = None,
     hier_stages="auto",
+    groups=None,
 ):
     """The dual of :func:`bucketed_reduce_scatter`: per-leaf shard
     slices → full leaves with ``like``'s shapes, as N independent
@@ -807,6 +848,11 @@ def bucketed_shard_all_gather(
     bucket → per-leaf columns → unpad/reshape). The schedule is keyed
     on ``like``'s (full) leaf geometry, so a matched reduce-scatter /
     all-gather pair shares ONE cached schedule.
+
+    ``groups`` mirrors :func:`bucketed_reduce_scatter`'s local-phase
+    contract: shards are the ``[cols = ceil(size/L)]`` group-position
+    slices and every gather runs inside its ``axis_index_groups``
+    group only (``hier_stages`` ignored).
 
     ``residuals`` (tree in SHARD geometry — leaf ``[cols]``) is the
     error-feedback carry for lossy buckets on this leg: it joins the
@@ -817,8 +863,13 @@ def bucketed_shard_all_gather(
         n_buckets = default_buckets() or 1
     if min_bucket_bytes is None:
         min_bucket_bytes = default_min_bytes()
-    n = jax.lax.axis_size(axis_name)
-    stages = _auto_stages(hier_stages, n)
+    if groups is not None:
+        n = len(groups[0])
+        groups = [list(g) for g in groups]
+        stages = None
+    else:
+        n = jax.lax.axis_size(axis_name)
+        stages = _auto_stages(hier_stages, n)
     if residuals is not None:
         stages = None  # EF carries are defined against the flat wire
     hier_L = None if stages is None else len(stages[0][0])
@@ -851,7 +902,8 @@ def bucketed_shard_all_gather(
                 i = nonscalar[j]
                 l = l_leaves[i]
                 full = jax.lax.all_gather(
-                    s_leaves[i], axis_name, axis=0
+                    s_leaves[i], axis_name, axis=0,
+                    axis_index_groups=groups,
                 ).reshape(-1)
                 size = int(np.prod(np.shape(l), dtype=np.int64))
                 out[i] = (
@@ -908,16 +960,17 @@ def bucketed_shard_all_gather(
                 full, new_r = traced.quantized_allgather(
                     buf, axis_name=axis_name, seed=bseed,
                     block_size=wire_block, return_residual=True,
+                    groups=groups,
                 )
             else:
                 full = traced.quantized_allgather(
                     buf, axis_name=axis_name, seed=bseed,
-                    block_size=wire_block,
+                    block_size=wire_block, groups=groups,
                 )
         else:
             wire_buf = buf.astype(jnp.bfloat16) if bw == "bf16" else buf
             full = jax.lax.all_gather(
-                wire_buf, axis_name, axis=0
+                wire_buf, axis_name, axis=0, axis_index_groups=groups,
             ).astype(buf.dtype)  # [n, C]
             if r_leaves is not None:
                 new_r = (

@@ -122,6 +122,7 @@ def allreduce(
     process_set: Optional[ProcessSet] = None,
     axis_name: str = WORLD_AXIS,
     mask=None,
+    groups=None,
 ):
     """Allreduce across the mesh axis (ref: hvd.allreduce,
     horovod/torch/mpi_ops.py + MPI/NCCL Allreduce ops [V]).
@@ -144,6 +145,14 @@ def allreduce(
     retrace), and every participating rank receives the live
     reduction. Sum/Average only (a dynamic live-count has no analog
     for min/max/product); composes with a process set by intersection.
+
+    ``groups`` restricts the reduction to ``axis_index_groups`` of the
+    flat axis (uniform group sizes — the intra-slice groups of
+    ``topology.hierarchy_stages()``): each group reduces among its own
+    members and ``Average`` divides by the GROUP size. This is the
+    local-SGD local-phase wire (every gradient byte stays on ICI);
+    Sum/Average only, and it composes with neither process sets nor
+    join masks (a masked subgroup has no uniform replica-group shape).
     """
     _stall_check()
     op = resolve_op(op, average)
@@ -151,6 +160,30 @@ def allreduce(
         raise ValueError(
             "allreduce(mask=) supports op=Sum/Average only"
         )
+    if groups is not None:
+        if op not in (Average, Sum):
+            raise ValueError(
+                "allreduce(groups=) supports op=Sum/Average only"
+            )
+        if mask is not None or (
+            process_set is not None and process_set.process_set_id != 0
+        ):
+            raise NotImplementedError(
+                "allreduce(groups=) composes with neither process "
+                "sets nor join masks"
+            )
+        if prescale_factor != 1.0:
+            tensor = tensor * jnp.asarray(
+                prescale_factor, dtype=tensor.dtype
+            )
+        out = lax.psum(
+            tensor, axis_name, axis_index_groups=[list(g) for g in groups]
+        )
+        if op == Average:
+            out = out / jnp.asarray(len(groups[0]), out.dtype)
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+        return out
     info = _set_info(process_set, axis_name)
     n = info.size if info is not None else lax.axis_size(axis_name)
     raw = tensor
@@ -545,6 +578,7 @@ def quantized_allreduce(
     return_residual: bool = False,
     prescale_factor: float = 1.0,
     block_size: Optional[int] = None,
+    groups=None,
 ):
     """Allreduce moving int8 across ICI — the quantized-collective
     recipe of EQuARX (PAPERS.md), built from primitives the reference
@@ -595,6 +629,39 @@ def quantized_allreduce(
     op = resolve_op(op, None)
     if op not in (Average, Sum):
         raise ValueError("quantized_allreduce supports Sum/Average only")
+    if groups is not None:
+        # group-limited wire (the local-SGD local phase: int8 that
+        # never leaves the slice): the two-stage grouped recipe with
+        # the SAME residual contracts as the flat path below —
+        # prescale folded into the wire scales, Average's stage-2
+        # error surfaced ×n, carry in input units
+        gn = len(groups[0])
+        shape, dtype = tensor.shape, tensor.dtype
+        flat = tensor.reshape(-1).astype(jnp.float32)
+        if prescale_factor != 1.0:
+            # the grouped core has no scale-fold hook; at group sizes
+            # the pre-multiply is one fused producer op, not a
+            # separate HBM pass worth optimizing around
+            flat = flat * jnp.asarray(prescale_factor, jnp.float32)
+        gidx = lax.axis_index(axis_name)
+        gkey = jax.random.fold_in(jax.random.PRNGKey(seed), gidx)
+        gblock = int(block_size) if block_size else max(
+            -(-flat.shape[0] // gn), 1
+        )
+        out, res = _quantized_sum_groups(
+            flat, axis_name, [list(g) for g in groups], gn, gblock,
+            gkey, want_residual=return_residual,
+        )
+        if op == Average:
+            out = out / jnp.asarray(gn, out.dtype)
+        out = out.reshape(shape).astype(dtype)
+        if not return_residual:
+            return out
+        if prescale_factor == 0.0:
+            return out, jnp.zeros(shape, dtype)
+        if prescale_factor != 1.0:
+            res = res / jnp.asarray(prescale_factor, res.dtype)
+        return out, res.reshape(shape).astype(dtype)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     shape, dtype = tensor.shape, tensor.dtype
@@ -976,7 +1043,7 @@ def _group_pos_table(groups):
 
 
 def _quantized_sum_groups(
-    row, axis_name, groups, n, block, key, pos=None, want_residual=False
+    row, axis_name, groups, n, block, key, pos=None, want_residual=False,
 ):
     """The two-stage block-scaled int8 allreduce recipe of
     :func:`quantized_allreduce`, over ``axis_index_groups`` of the flat
@@ -1019,6 +1086,11 @@ def _quantized_sum_groups(
     else:
         p = pos
     res_flat = (chunks - _block_dequant(q, scales)[:, :chunk]).reshape(-1)
+    # e2 stays UN-scaled even when the caller averages afterwards:
+    # this recipe quantizes the SUM shard (the /n happens outside), so
+    # the stage-2 error and an input correction both reach the output
+    # through the same later divide — unlike the flat path, which
+    # divides BEFORE stage 2 and therefore multiplies its e2 by n
     e2 = (shard - _block_dequant(q2, s2)[0])[:chunk]
     res_flat = lax.dynamic_update_slice(
         res_flat,

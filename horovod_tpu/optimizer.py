@@ -52,6 +52,7 @@ def _allreduce_grads(
     axis_name: str,
     seed=0,
     residuals=None,
+    groups=None,
 ):
     """Compress → allreduce → decompress, leaf-wise over the grad pytree.
 
@@ -73,9 +74,14 @@ def _allreduce_grads(
         # Compression.hier_int8 on the traced/optimizer path: the real
         # two-level recipe (bf16 intra hops, int8 on the inter hop
         # only — the eager placement, no longer a flat degeneration)
-        # whenever a slice split is resolvable for this axis.
+        # whenever a slice split is resolvable for this axis. A
+        # local-SGD local phase (groups=) has NO inter hop — the
+        # quantized wire stays inside the slice instead.
         hier_stages = None
-        if getattr(compression, "wire_format", None) == "int8_hier":
+        if (
+            groups is None
+            and getattr(compression, "wire_format", None) == "int8_hier"
+        ):
             from .common import topology as _topo
 
             hier_stages = _topo.hierarchy_stages(
@@ -122,6 +128,7 @@ def _allreduce_grads(
                 out = traced.quantized_allreduce(
                     g, op=op, axis_name=axis_name, seed=seed,
                     prescale_factor=prescale_factor, block_size=block,
+                    groups=groups,
                 )
                 new_r = None
             else:
@@ -129,6 +136,7 @@ def _allreduce_grads(
                     g + r.astype(g.dtype), op=op, axis_name=axis_name,
                     seed=seed, return_residual=True,
                     prescale_factor=prescale_factor, block_size=block,
+                    groups=groups,
                 )
                 # carry keeps its init dtype: a flip (e.g. bf16 params,
                 # f32 grads) would change the state pytree mid-scan
@@ -170,6 +178,7 @@ def _allreduce_grads(
             postscale_factor=postscale_factor,
             process_set=process_set,
             axis_name=axis_name,
+            groups=groups,
         )
         return compression.decompress(red, ctx)
 
@@ -184,6 +193,26 @@ class _AccumulationState(NamedTuple):
     residual: Any = None  # error-feedback carry (quantized wire only)
     guard_skips: Any = None  # total non-finite skipped steps (guard on)
     guard_streak: Any = None  # CONSECUTIVE skips — escalation trigger
+    # local-SGD round state (local_sgd_steps > 1 only; None leaves keep
+    # plain jobs' state structure and checkpoints byte-stable):
+    local_anchor: Any = None  # params at the last sync round
+    local_residual: Any = None  # EF carry of the int8 inter wire
+
+
+class LocalSGDGradientTransformation(NamedTuple):
+    """An optax ``GradientTransformation`` plus the local-SGD sync
+    round: ``sync(params, state) -> (new_params, new_state)`` is the
+    SEPARATE traced reconciliation body — call it inside the same
+    shard_map context as ``update`` but compile it as its OWN program
+    (the local-phase step program must carry zero inter-slice replica
+    groups; a ``lax.cond`` would bake the inter exchange into every
+    step). Drive the cadence with :func:`horovod_tpu.local_sgd
+    .maybe_sync`, which owns the retry/defer robustness contract."""
+
+    init: Callable
+    update: Callable
+    sync: Callable
+    local_sgd_steps: int = 1
 
 
 def DistributedOptimizer(
@@ -204,6 +233,9 @@ def DistributedOptimizer(
     overlap_min_bytes: Optional[int] = None,
     grad_guard: Optional[bool] = None,
     guard_max_skips: Optional[int] = None,
+    local_sgd_steps: Optional[int] = None,
+    local_sgd_inter_wire: str = "int8",
+    local_sgd_intra: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transform with distributed gradient reduction
     (ref: hvd.DistributedOptimizer [V]).
@@ -254,8 +286,55 @@ def DistributedOptimizer(
     sync — the callback lives inside the skip branch only. The guard
     conds the whole inner update, so it requires a dtype-preserving
     inner transform (every elementwise optax chain is).
+
+    ``local_sgd_steps=K`` (``None`` defers to
+    ``HOROVOD_LOCAL_SGD_STEPS``; the mode engages at K > 1) switches
+    the optimizer into local-SGD mode (horovod_tpu/local_sgd.py,
+    ROADMAP item 3): every ``update`` exchanges gradients over the
+    INTRA-slice replica groups only — fused, bucketed and monolithic
+    paths alike, so the compiled step program carries zero
+    inter-slice replica groups and every gradient byte stays on ICI —
+    and the returned transformation gains a ``sync`` callable (see
+    :class:`LocalSGDGradientTransformation`) that reconciles the
+    parameter DELTAS since the last round across the inter (DCN) axis
+    with hierarchical Adasum on the ``local_sgd_inter_wire``
+    (default ``int8`` — EF residuals carried across rounds in the
+    state's ``local_residual`` leaf). Params must ride the training
+    loop RANK-MAJOR (``in_specs=P(hvd.WORLD_AXIS)``): slices diverge
+    during the local phase, so a replicated ``P()`` spec would be a
+    lie. K = 1 IS the existing path (bit-identical by construction).
+    Sum/Average only; process sets don't compose. ``local_sgd_intra``
+    injects an explicit chips-per-slice for the split (tests/bench on
+    single-slice hosts; normal jobs let the topology resolve it).
     """
     op = resolve_op(op, average)
+    from . import local_sgd as _local_sgd
+
+    local_k = int(
+        local_sgd_steps
+        if local_sgd_steps is not None
+        else _local_sgd.default_steps()
+    )
+    local_on = local_k > 1
+    if local_on:
+        if local_sgd_steps is None:
+            # engaged via env: the caller may be an existing loop that
+            # never drives the sync round — warn loudly once
+            _local_sgd.warn_env_engaged(local_k)
+        if op not in (Sum, Average):
+            raise ValueError(
+                "local_sgd_steps > 1 requires op=Sum/Average for the "
+                "local phase (Adasum is the ROUND combiner, not the "
+                "per-step gradient op)"
+            )
+        if process_set is not None and process_set.process_set_id != 0:
+            raise NotImplementedError(
+                "local_sgd_steps does not compose with process sets"
+            )
+        if local_sgd_inter_wire not in _local_sgd.INTER_WIRES:
+            raise ValueError(
+                f"unknown local_sgd_inter_wire {local_sgd_inter_wire!r}"
+            )
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError(
             "gradient_predivide_factor requires op=Average (ref parity)"
@@ -304,14 +383,26 @@ def DistributedOptimizer(
         post = postscale_factor if postscale_factor is not None else 1.0
         return op, pre, post
 
+    def _local_stages():
+        """The two-level split for the traced axis (local mode only;
+        raises when no split resolves — a one-slice local phase is
+        the caller asking for a mode that cannot exist)."""
+        return _local_sgd.resolve_stages(
+            int(jax.lax.axis_size(axis_name)), intra=local_sgd_intra
+        )
+
     def communicate(grads, seed, residuals=None):
         """Exchange + optional guard flag. Returns a uniform
         ``(reduced, new_residuals_or_None, finite_or_None)`` triple so
         the update paths never re-derive the unpacking rules."""
+        groups = _local_stages()[0] if local_on else None
         n = (
             process_set.size
             if process_set is not None and process_set.process_set_id != 0
-            else jax.lax.axis_size(axis_name)
+            else (
+                len(groups[0]) if groups is not None
+                else jax.lax.axis_size(axis_name)
+            )
         )
         eff_op, pre, post = reduce_op_factors(n)
         if overlap_buckets:
@@ -322,6 +413,7 @@ def DistributedOptimizer(
                 axis_name=axis_name, seed=seed, residuals=residuals,
                 min_bucket_bytes=overlap_min_bytes,
                 return_finite=guard_on,
+                groups=groups,
             )
             if guard_on:
                 if residuals is not None:
@@ -334,7 +426,7 @@ def DistributedOptimizer(
             return out, None, None
         out = _allreduce_grads(
             grads, eff_op, compression, pre, post, process_set, axis_name,
-            seed=seed, residuals=residuals,
+            seed=seed, residuals=residuals, groups=groups,
         )
         if residuals is not None:
             reduced, new_r = out
@@ -388,16 +480,31 @@ def DistributedOptimizer(
         # the exact state structure (and checkpoints) they had
         gskips = zero if guard_on else None
         gstreak = zero if guard_on else None
+        # local-SGD round state: the anchor starts AT the initial
+        # params (round 0's delta measures from here); the EF carry of
+        # the int8 inter wire starts empty
+        anchor = (
+            jax.tree_util.tree_map(jnp.asarray, params)
+            if local_on
+            else None
+        )
+        local_res = (
+            jax.tree_util.tree_map(jnp.zeros_like, params)
+            if local_on and local_sgd_inter_wire == "int8"
+            else None
+        )
         if k == 1:
             return _AccumulationState(
                 inner=inner, accum=None, counter=zero, step=zero,
                 residual=residual, guard_skips=gskips,
-                guard_streak=gstreak,
+                guard_streak=gstreak, local_anchor=anchor,
+                local_residual=local_res,
             )
         accum = jax.tree_util.tree_map(jnp.zeros_like, params)
         return _AccumulationState(
             inner=inner, accum=accum, counter=zero, step=zero,
             residual=residual, guard_skips=gskips, guard_streak=gstreak,
+            local_anchor=anchor, local_residual=local_res,
         )
 
     def update_fn(grads, state: _AccumulationState, params=None):
@@ -424,11 +531,15 @@ def DistributedOptimizer(
                     inner=inner, accum=None, counter=state.counter,
                     step=state.step + 1, residual=residual,
                     guard_skips=skips, guard_streak=streak,
+                    local_anchor=state.local_anchor,
+                    local_residual=state.local_residual,
                 )
             updates, inner = optimizer.update(reduced, state.inner, params)
             return updates, _AccumulationState(
                 inner=inner, accum=None, counter=state.counter,
                 step=state.step + 1, residual=residual,
+                local_anchor=state.local_anchor,
+                local_residual=state.local_residual,
             )
 
         # Local aggregation (`backward_passes_per_step` [V]): accumulate k
@@ -485,9 +596,34 @@ def DistributedOptimizer(
             inner=inner, accum=accum_out, counter=counter_out,
             step=state.step + 1, residual=residual_out,
             guard_skips=skips_out, guard_streak=streak_out,
+            local_anchor=state.local_anchor,
+            local_residual=state.local_residual,
         )
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    if not local_on:
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    def sync_fn(params, state: _AccumulationState):
+        """The K-step reconciliation round (compile as its OWN program
+        — see LocalSGDGradientTransformation): parameter deltas since
+        the last anchor merge across slices by hierarchical Adasum on
+        the inter wire; params and anchor land on the consensus, the
+        EF carry rolls to the next round."""
+        stages = _local_stages()
+        new_params, new_res = _local_sgd.sync_tree(
+            params, state.local_anchor,
+            residual=state.local_residual,
+            stages=stages, axis_name=axis_name,
+            inter_wire=local_sgd_inter_wire, seed=state.step,
+            return_residual=local_sgd_inter_wire == "int8",
+        )
+        return new_params, state._replace(
+            local_anchor=new_params, local_residual=new_res
+        )
+
+    return LocalSGDGradientTransformation(
+        init_fn, update_fn, sync_fn, local_k
+    )
 
 
 # ---------------------------------------------------------------- tape API

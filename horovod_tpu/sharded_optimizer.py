@@ -227,6 +227,9 @@ class ShardedDistributedOptimizer:
         wire_block: Optional[int] = None,
         error_feedback: bool = False,
         hierarchical: Optional[bool] = None,
+        local_sgd_steps: Optional[int] = None,
+        local_sgd_inter_wire: str = "int8",
+        local_sgd_intra: Optional[int] = None,
     ):
         """``zero_stage`` selects the sharding stage (module docstring);
         ``None`` defers to ``HOROVOD_ZERO_STAGE`` (default 1). Stage 3
@@ -271,6 +274,29 @@ class ShardedDistributedOptimizer:
         ``False`` pins the flat wire regardless of topology.
         Error-feedback buckets always ride the flat wire (the carry is
         defined against the flat pane quantization).
+
+        ``local_sgd_steps=K`` (``None`` defers to
+        ``HOROVOD_LOCAL_SGD_STEPS``; the mode engages at K > 1)
+        switches stages 1-2 into local-SGD mode
+        (horovod_tpu/local_sgd.py): optimizer state shards over the
+        INTRA axis only (each slice's L ranks jointly hold that
+        slice's moments — slices' trajectories diverge during the
+        local phase), every exchange leg routes over the intra
+        replica groups (the compiled step carries zero inter-slice
+        groups), and :meth:`sync_round` — a SEPARATE traced program —
+        reconciles parameter deltas since the last round across the
+        inter axis with hierarchical Adasum on
+        ``local_sgd_inter_wire`` (EF residuals carried across rounds
+        in the state's ``"local"`` layout family, which
+        ``reshard_state`` migrates across world changes). Stage 3 is
+        rejected: its parameters shard over the WORLD axis, so a
+        slice cannot even hold its own model during an independent
+        local phase. Params must ride the training loop rank-major
+        (``P(hvd.WORLD_AXIS)``) — slices diverge, so a replicated
+        spec would be a lie. ``hierarchical`` two-level routing is
+        moot in local mode (there IS no inter hop in the local
+        phase). ``local_sgd_intra`` injects an explicit
+        chips-per-slice (tests/bench on single-slice hosts).
 
         ``grad_guard=True`` (``None`` defers to ``HOROVOD_GUARD``)
         adds the non-finite skip-step sentinel (common/guard.py).
@@ -320,6 +346,37 @@ class ShardedDistributedOptimizer:
         # two-level routing of the exchange legs: "auto" = the
         # HOROVOD_HIERARCHICAL topology decision; None pins flat
         self._hier_arg = None if hierarchical is False else "auto"
+        from . import local_sgd as _local_sgd
+
+        self._local_k = int(
+            local_sgd_steps
+            if local_sgd_steps is not None
+            else _local_sgd.default_steps()
+        )
+        self._local_on = self._local_k > 1
+        self._local_wire = local_sgd_inter_wire
+        self._local_intra = local_sgd_intra
+        if self._local_on:
+            if local_sgd_steps is None:
+                # engaged via env: warn once — the mode needs a loop
+                # that drives sync_round (see local_sgd.maybe_sync)
+                _local_sgd.warn_env_engaged(self._local_k)
+            if self._stage >= 3:
+                raise NotImplementedError(
+                    "local_sgd_steps composes with zero_stage<=2 only: "
+                    "stage-3 parameters shard over the WORLD axis, so "
+                    "a slice cannot hold its own model during an "
+                    "independent local phase — run stage 1/2, or keep "
+                    "every-step sync at stage 3"
+                )
+            if local_sgd_inter_wire not in _local_sgd.INTER_WIRES:
+                raise ValueError(
+                    f"unknown local_sgd_inter_wire "
+                    f"{local_sgd_inter_wire!r}"
+                )
+            # the local phase has no inter hop; two-level routing of
+            # the exchange legs would reintroduce one
+            self._hier_arg = None
         self._ef = bool(error_feedback)
         if self._ef and self._wire not in ("int8", "auto"):
             raise ValueError(
@@ -383,16 +440,33 @@ class ShardedDistributedOptimizer:
                 "stochastic noise)."
             )
 
+    # -- local-SGD topology ------------------------------------------------
+    def _local_stages(self, world: int):
+        from . import local_sgd as _local_sgd
+
+        return _local_sgd.resolve_stages(
+            int(world), intra=self._local_intra
+        )
+
+    def _shard_width(self, world: int) -> int:
+        """How many ways the flat shard geometry splits: the whole
+        world normally; the intra size L in local-SGD mode (each
+        slice's L ranks jointly hold that slice's state)."""
+        if not self._local_on:
+            return int(world)
+        return len(self._local_stages(world)[0][0])
+
     # -- init (outside jit) ------------------------------------------------
     def init(self, params):
         from .common import basics
 
         n = self._world or basics.size()
         self._world = n
+        width = self._shard_width(n)
         shard_states = [
             self._inner.init(
                 jax.tree_util.tree_map(
-                    lambda p: _shard_host(p, n, r), params
+                    lambda p: _shard_host(p, width, r % width), params
                 )
             )
             for r in range(n)
@@ -412,11 +486,18 @@ class ShardedDistributedOptimizer:
             z = jnp.zeros((n,), jnp.int32)
             guard_rows = {"skips": z, "streak": z, "step": z}
         wire_rows = (
-            self._init_wire_rows(params, n)
+            self._init_wire_rows(params, n, width)
             if self._wants_wire_rows()
             else None
         )
-        return self._compose_state(stacked, guard_rows, wire_rows)
+        local_rows = (
+            self._init_local_rows(params, n, width)
+            if self._local_on
+            else None
+        )
+        return self._compose_state(
+            stacked, guard_rows, wire_rows, local_rows
+        )
 
     def _wants_wire_rows(self) -> bool:
         """A quantized-capable wire on the update-internal legs needs
@@ -430,12 +511,15 @@ class ShardedDistributedOptimizer:
             self._ef or self._wire in ("int8", "auto")
         )
 
-    def _init_wire_rows(self, params, n):
+    def _init_wire_rows(self, params, n, width: Optional[int] = None):
         """Wire-seed counter (+ error-feedback carries when EF is on),
         rank-major: ``rs`` rows mirror the FULL gradient geometry (each
         rank's quantization error is over its own full local
         contribution), ``ag`` rows the shard geometry (the update-leg
-        error lives on the shard its rank owns — genuinely 1/N)."""
+        error lives on the shard its rank owns — genuinely 1/N, or 1/L
+        in local-SGD mode where the shard splits intra-slice)."""
+        if width is None:
+            width = n
         rows = {"step": jnp.zeros((n,), jnp.int32)}
         if not self._ef:
             return rows
@@ -452,46 +536,101 @@ class ShardedDistributedOptimizer:
                 return jnp.zeros((n,), jnp.result_type(p))
             size = int(np.prod(shape, dtype=np.int64))
             return jnp.zeros(
-                (n, shard_cols(size, n)), jnp.result_type(p)
+                (n, shard_cols(size, width)), jnp.result_type(p)
             )
 
         rows["rs"] = jax.tree_util.tree_map(_full_rows, params)
         rows["ag"] = jax.tree_util.tree_map(_shard_rows, params)
         return rows
 
+    def _init_local_rows(self, params, n, width):
+        """The ``"local"`` layout family (local-SGD mode): the anchor —
+        params at the last sync round — in intra-position-major shard
+        rows (rank ``r`` holds chunk ``r % L``; every slice's L ranks
+        jointly hold one full anchor copy, 1/L per rank), the EF
+        residual of the int8 inter wire in the same geometry, the
+        round counter, and the split width the rows were cut at (the
+        ``reshard_state`` migration reads it back — an 8→6 resize may
+        change L)."""
+        def _rows(p):
+            if np.ndim(p) == 0:
+                return jnp.stack(
+                    [jnp.asarray(p) for _ in range(n)]
+                )
+            return jnp.stack(
+                [_shard_host(jnp.asarray(p), width, r % width)
+                 for r in range(n)]
+            )
+
+        rows = {
+            "anchor": jax.tree_util.tree_map(_rows, params),
+            "round": jnp.zeros((n,), jnp.int32),
+            "intra": jnp.full((n,), width, jnp.int32),
+        }
+        if self._local_wire == "int8":
+            rows["residual"] = jax.tree_util.tree_map(
+                lambda a: jnp.zeros_like(a), rows["anchor"]
+            )
+        return rows
+
     # -- state layout ------------------------------------------------------
     @staticmethod
     def _layout(state):
-        """Decompose a state into (inner, guard_rows, wire_rows) without
-        enforcing the optimizer's flags (the reshard migration point)."""
+        """Decompose a state into (inner, guard_rows, wire_rows,
+        local_rows) without enforcing the optimizer's flags (the
+        reshard migration point)."""
         if (
             isinstance(state, dict)
             and "state" in state
-            and set(state) <= {"state", "guard", "wire"}
+            and set(state) <= {"state", "guard", "wire", "local"}
         ):
-            return state["state"], state.get("guard"), state.get("wire")
-        return state, None, None
+            return (
+                state["state"], state.get("guard"),
+                state.get("wire"), state.get("local"),
+            )
+        return state, None, None, None
 
     @staticmethod
-    def _compose_state(inner, guard_rows, wire_rows):
+    def _compose_state(inner, guard_rows, wire_rows, local_rows=None):
         extras = {}
         if guard_rows is not None:
             extras["guard"] = guard_rows
         if wire_rows is not None:
             extras["wire"] = wire_rows
+        if local_rows is not None:
+            extras["local"] = local_rows
         if not extras:
             return inner
         return {"state": inner, **extras}
 
     @staticmethod
     def _is_guarded_layout(state) -> bool:
-        inner, guard_rows, _ = ShardedDistributedOptimizer._layout(state)
+        guard_rows = ShardedDistributedOptimizer._layout(state)[1]
         return guard_rows is not None
 
     def _split_state(self, state):
         """Layout split + flag validation (update path: mismatches are
         hard errors pointing at the reshard_state migration)."""
-        inner, guard_rows, wire_rows = self._layout(state)
+        inner, guard_rows, wire_rows, local_rows = self._layout(state)
+        if self._local_on and local_rows is None:
+            raise ValueError(
+                "local_sgd_steps > 1 but the optimizer state has no "
+                '"local" layout family (anchor/residual/round rows) — '
+                "it was created without local-SGD mode. Migrate it "
+                "once with reshard_state(state, params, world) "
+                "(params must carry concrete values: the anchor IS "
+                "the params), or re-run init(params)."
+            )
+        if not self._local_on and local_rows is not None:
+            raise ValueError(
+                'the optimizer state carries a "local" layout family '
+                "but local_sgd_steps <= 1 — it was checkpointed by a "
+                "local-SGD run. Re-enable local_sgd_steps, or "
+                "downgrade the state once with reshard_state(state, "
+                "params, world) (which strips the family AND its "
+                "intra-width shard geometry — the moments are re-cut "
+                "to the flat world split)."
+            )
         if self._guard_on and guard_rows is None:
             raise ValueError(
                 "grad_guard is on but the optimizer state has the "
@@ -545,7 +684,7 @@ class ShardedDistributedOptimizer:
                 "the state once with reshard_state(state, params, "
                 "world)."
             )
-        return inner, guard_rows, wire_rows
+        return inner, guard_rows, wire_rows, local_rows
 
     # -- gradient classification -------------------------------------------
     def _grads_are_shards(self, grads, params, n) -> bool:
@@ -590,7 +729,9 @@ class ShardedDistributedOptimizer:
 
     # -- update (inside shard_map over axis_name) --------------------------
     def update(self, grads, state, params):
-        inner_rows, guard_rows, wire_rows = self._split_state(state)
+        inner_rows, guard_rows, wire_rows, local_rows = (
+            self._split_state(state)
+        )
         n = jax.lax.axis_size(self._axis)
         if self._world is not None and n != self._world:
             raise ValueError(
@@ -600,6 +741,19 @@ class ShardedDistributedOptimizer:
                 "over (re-running init would reset them)"
             )
         idx = jax.lax.axis_index(self._axis)
+        # local-SGD mode: shard geometry and every collective restrict
+        # to the intra groups — the compiled step carries ZERO
+        # inter-slice replica groups (hloaudit-asserted)
+        if self._local_on:
+            from .common.topology import stage_positions
+
+            intra_groups = self._local_stages(n)[0]
+            width = len(intra_groups[0])
+            pos = jnp.asarray(stage_positions(intra_groups))[idx]
+        else:
+            intra_groups = None
+            width = n
+            pos = idx
         # shard_map hands each rank its [1, ...] state slice
         local_state = jax.tree_util.tree_map(lambda x: x[0], inner_rows)
         local_wire = (
@@ -624,9 +778,9 @@ class ShardedDistributedOptimizer:
             p_sh = params
             shard_in = True
         else:
-            shard_in = self._grads_are_shards(grads, params, n)
+            shard_in = self._grads_are_shards(grads, params, width)
             p_sh = jax.tree_util.tree_map(
-                lambda p: p if p.ndim == 0 else _shard_dyn(p, n, idx),
+                lambda p: p if p.ndim == 0 else _shard_dyn(p, width, pos),
                 params,
             )
         if shard_in and self._ef:
@@ -653,6 +807,7 @@ class ShardedDistributedOptimizer:
                     residuals=local_wire["rs"],
                     min_bucket_bytes=self._overlap_min_bytes,
                     hier_stages=self._hier_arg,
+                    groups=intra_groups,
                 )
             else:
                 g_sh = _overlap.bucketed_reduce_scatter(
@@ -661,6 +816,7 @@ class ShardedDistributedOptimizer:
                     wire_block=self._wire_block, seed=wire_seed,
                     min_bucket_bytes=self._overlap_min_bytes,
                     hier_stages=self._hier_arg,
+                    groups=intra_groups,
                 )
         else:
             # 0-d leaves (scalar temperature etc.) stay replicated —
@@ -669,14 +825,17 @@ class ShardedDistributedOptimizer:
             # and break donation)
             def rs(g):
                 if g.ndim == 0:
-                    red = jax.lax.psum(g, self._axis)
-                    return red / n if self._op == Average else red
-                flat = _pad_to(g.reshape(-1), n).reshape(n, -1)
+                    red = jax.lax.psum(
+                        g, self._axis, axis_index_groups=intra_groups
+                    )
+                    return red / width if self._op == Average else red
+                flat = _pad_to(g.reshape(-1), width).reshape(width, -1)
                 red = jax.lax.psum_scatter(
-                    flat, self._axis, scatter_dimension=0, tiled=False
+                    flat, self._axis, scatter_dimension=0, tiled=False,
+                    axis_index_groups=intra_groups,
                 )
                 if self._op == Average:
-                    red = red / n
+                    red = red / width
                 return red
 
             g_sh = jax.tree_util.tree_map(rs, grads)
@@ -688,10 +847,15 @@ class ShardedDistributedOptimizer:
             # the scattered shards DIVERGE per rank (a NaN lands in
             # exactly one shard), so the flag must be agreed: one
             # 4-byte scalar psum — the only collective the guard adds
+            # Local-SGD mode agrees the flag INTRA-slice only: slices
+            # train independently, so a slice skips its own poisoned
+            # step without stalling the others (and the local-phase
+            # program stays free of inter-slice groups).
             ok_local = tree_finite(g_sh)
             bad = jax.lax.psum(
                 jnp.where(ok_local, 0.0, 1.0).astype(jnp.float32),
                 self._axis,
+                axis_index_groups=intra_groups,
             )
             finite = bad == 0
             # feed the inner transform clean zeros on a bad step; its
@@ -737,6 +901,7 @@ class ShardedDistributedOptimizer:
                     residuals=local_wire["ag"],
                     min_bucket_bytes=self._overlap_min_bytes,
                     hier_stages=self._hier_arg,
+                    groups=intra_groups,
                 )
             else:
                 upd = _overlap.bucketed_shard_all_gather(
@@ -745,13 +910,15 @@ class ShardedDistributedOptimizer:
                     wire_block=self._wire_block, seed=wire_seed,
                     min_bucket_bytes=self._overlap_min_bytes,
                     hier_stages=self._hier_arg,
+                    groups=intra_groups,
                 )
         else:
             def gather(u, p):
                 if p.ndim == 0:
                     return u
                 full = jax.lax.all_gather(
-                    u, self._axis, axis=0
+                    u, self._axis, axis=0,
+                    axis_index_groups=intra_groups,
                 ).reshape(-1)
                 return full[: p.size].reshape(p.shape).astype(u.dtype)
 
@@ -787,7 +954,9 @@ class ShardedDistributedOptimizer:
                     new_ag_res, local_wire["ag"],
                 )
         if not self._guard_on:
-            return upd, self._compose_state(new_inner, None, new_wire)
+            return upd, self._compose_state(
+                new_inner, None, new_wire, local_rows
+            )
         import functools
 
         from .common import guard as _guard
@@ -819,9 +988,157 @@ class ShardedDistributedOptimizer:
             "streak": jnp.where(finite, zero, streak_next)[None],
             "step": (step + one)[None],
         }
-        return upd, self._compose_state(new_inner, new_guard, new_wire)
+        return upd, self._compose_state(
+            new_inner, new_guard, new_wire, local_rows
+        )
+
+    # -- local-SGD sync round (inside shard_map, its OWN program) ----------
+    def sync_round(self, params, state):
+        """The K-step reconciliation round for local-SGD mode (stages
+        1-2): parameter deltas since the last anchor — computed in the
+        intra-shard geometry the ``"local"`` family stores (each
+        slice's L ranks jointly hold one delta copy, 1/L per rank) —
+        merge across slices by VHDD Adasum over the inter groups
+        (:func:`horovod_tpu.local_sgd.adasum_sync_shard`: dots
+        completed over intra, ``local_sgd_inter_wire`` on the DCN
+        half-exchanges, EF residuals chained across rounds), then one
+        intra all-gather reassembles the consensus parameters. Call
+        INSIDE shard_map over the world axis, but compile it as a
+        SEPARATE program from ``update`` — the local-phase step must
+        carry zero inter-slice replica groups. Returns
+        ``(new_params, new_state)``; drive the cadence and the
+        retry/defer robustness contract with
+        :func:`horovod_tpu.local_sgd.maybe_sync`."""
+        if not self._local_on:
+            raise ValueError(
+                "sync_round requires local_sgd_steps > 1"
+            )
+        from . import local_sgd as _local_sgd
+        from .common.topology import stage_positions
+
+        inner_rows, guard_rows, wire_rows, local_rows = (
+            self._split_state(state)
+        )
+        n = jax.lax.axis_size(self._axis)
+        stages = self._local_stages(n)
+        intra_groups = stages[0]
+        L = len(intra_groups[0])
+        idx = jax.lax.axis_index(self._axis)
+        pos = jnp.asarray(stage_positions(intra_groups))[idx]
+        local = jax.tree_util.tree_map(lambda x: x[0], local_rows)
+        anchor = local["anchor"]
+        residual = local.get("residual")
+        rnd = local["round"]
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        a_leaves = treedef.flatten_up_to(anchor)
+        r_leaves = (
+            treedef.flatten_up_to(residual)
+            if residual is not None
+            else None
+        )
+        # per-leaf shard deltas; 0-d leaves ride at intra position 0
+        # only (zeros elsewhere — the concat across positions must
+        # contain each scalar exactly once, or its dot-product weight
+        # would inflate L-fold)
+        segs, a_segs, meta = [], [], []
+        for p, a in zip(p_leaves, a_leaves):
+            if p.ndim == 0:
+                d = (p - a).astype(jnp.float32).reshape(1)
+                segs.append(jnp.where(pos == 0, d, jnp.zeros_like(d)))
+                a_segs.append(a.astype(jnp.float32).reshape(1))
+                meta.append((True, 1, 1, (), p.dtype))
+            else:
+                sh = _shard_dyn(p, L, pos).astype(jnp.float32)
+                a_segs.append(a.astype(jnp.float32))
+                segs.append(sh - a_segs[-1])
+                meta.append(
+                    (False, int(a.shape[0]), int(p.size), p.shape,
+                     p.dtype)
+                )
+        flat = jnp.concatenate(segs)
+        a_flat = jnp.concatenate(a_segs)
+        r_flat = None
+        if r_leaves is not None:
+            rsegs = []
+            for r, m in zip(r_leaves, meta):
+                rr = r.astype(jnp.float32).reshape(-1)
+                if m[0]:
+                    rr = jnp.where(pos == 0, rr, jnp.zeros_like(rr))
+                rsegs.append(rr)
+            r_flat = jnp.concatenate(rsegs)
+        want_res = self._local_wire == "int8"
+        if want_res:
+            merged, new_r = _local_sgd.adasum_sync_shard(
+                flat, stages, axis_name=self._axis,
+                inter_wire=self._local_wire, seed=rnd,
+                residual=r_flat, return_residual=True,
+            )
+        else:
+            merged = _local_sgd.adasum_sync_shard(
+                flat, stages, axis_name=self._axis,
+                inter_wire=self._local_wire, seed=rnd,
+            )
+            new_r = None
+        new_anchor_flat = a_flat + merged
+        gathered = jax.lax.all_gather(
+            new_anchor_flat, self._axis, axis_index_groups=intra_groups
+        )  # [L, C] — position-major chunks of the consensus params
+        new_p, new_a, new_res = [], [], []
+        off = 0
+        for (p, a), m in zip(zip(p_leaves, a_leaves), meta):
+            is_scalar, cols, size, shape, dtype = m
+            seg = gathered[:, off : off + cols]
+            if is_scalar:
+                val = seg[0, 0]  # position 0 holds the scalar
+                new_p.append(val.astype(dtype))
+                new_a.append(val.astype(jnp.result_type(a)))
+                if new_r is not None:
+                    new_res.append(
+                        new_r[off].astype(jnp.result_type(a))
+                    )
+            else:
+                full = seg.reshape(-1)[:size].reshape(shape)
+                new_p.append(full.astype(dtype))
+                new_a.append(
+                    new_anchor_flat[off : off + cols].astype(
+                        jnp.result_type(a)
+                    )
+                )
+                if new_r is not None:
+                    new_res.append(
+                        new_r[off : off + cols].astype(
+                            jnp.result_type(a)
+                        )
+                    )
+            off += cols
+        new_local = {
+            "anchor": jax.tree_util.tree_unflatten(treedef, new_a),
+            "round": rnd + jnp.int32(1),
+            "intra": local["intra"],
+        }
+        if residual is not None:
+            new_local["residual"] = jax.tree_util.tree_unflatten(
+                treedef, new_res
+            )
+        new_local_rows = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x)[None], new_local
+        )
+        new_state = self._compose_state(
+            inner_rows, guard_rows, wire_rows, new_local_rows
+        )
+        return jax.tree_util.tree_unflatten(treedef, new_p), new_state
 
     # -- in-backprop scatter / forward gather boundaries -------------------
+    def _traced_intra_groups(self):
+        """The intra groups for this trace's axis size (local mode),
+        or None — resolved lazily so the boundary kwargs can be built
+        inside shard_map where the axis exists."""
+        if not self._local_on:
+            return None
+        return self._local_stages(
+            int(jax.lax.axis_size(self._axis))
+        )[0]
+
     def _scatter_kw(self, seed):
         return dict(
             op=self._op,
@@ -832,6 +1149,7 @@ class ShardedDistributedOptimizer:
             seed=seed,
             min_bucket_bytes=self._overlap_min_bytes,
             hier_stages=self._hier_arg,
+            groups=self._traced_intra_groups(),
         )
 
     def _gather_kw(self, seed):
@@ -843,6 +1161,7 @@ class ShardedDistributedOptimizer:
             seed=seed,
             min_bucket_bytes=self._overlap_min_bytes,
             hier_stages=self._hier_arg,
+            groups=self._traced_intra_groups(),
         )
 
     def _carrier_call(self, psh, pfull, seed):
@@ -959,9 +1278,17 @@ class ShardedDistributedOptimizer:
                 return jax.value_and_grad(wrapped, has_aux=has_aux)(p)
             n = jax.lax.axis_size(self._axis)
             idx = jax.lax.axis_index(self._axis)
+            if self._local_on:
+                from .common.topology import stage_positions
+
+                intra_groups = self._local_stages(n)[0]
+                width = len(intra_groups[0])
+                pos = jnp.asarray(stage_positions(intra_groups))[idx]
+            else:
+                width, pos = n, idx
             pc = jax.tree_util.tree_map(jax.lax.stop_gradient, p)
             psh = jax.tree_util.tree_map(
-                lambda x: x if x.ndim == 0 else _shard_dyn(x, n, idx),
+                lambda x: x if x.ndim == 0 else _shard_dyn(x, width, pos),
                 pc,
             )
 
@@ -1120,10 +1447,52 @@ class ShardedDistributedOptimizer:
         re-split bit-exactly like the moments; ``rs`` residuals are
         per-rank FULL-geometry errors, so the carry preserves the
         TOTAL un-transmitted signal exactly (summed onto rank 0 — the
-        reduction only ever consumes the sum)."""
+        reduction only ever consumes the sum).
+
+        Local-SGD (``"local"`` family): the anchor and EF-residual
+        rows are re-cut from the OLD split width (read back from the
+        family's ``intra`` leaf) to the new topology's — every
+        parameter value carries over bit-exactly (only zero-pad tail
+        is re-cut). Optimizer MOMENTS under local mode diverge per
+        slice; a resize cannot preserve every slice's trajectory, so
+        the new gang seeds every slice from OLD SLICE 0's moments
+        (deterministic, and consistent with the post-restart rejoin
+        round that re-syncs params from the Adasum consensus —
+        docs/design.md)."""
         if new_world < 1:
             raise ValueError(f"new_world must be >= 1, got {new_world}")
-        inner, guard_rows, wire_rows = self._layout(state)
+        inner, guard_rows, wire_rows, local_rows = self._layout(state)
+        _lead = jax.tree_util.tree_leaves(
+            (inner, guard_rows, wire_rows, local_rows)
+        )
+        old_world = (
+            int(np.asarray(_lead[0]).shape[0]) if _lead else new_world
+        )
+        old_width = (
+            int(np.asarray(local_rows["intra"]).reshape(-1)[0])
+            if local_rows is not None
+            else old_world
+        )
+        new_width = self._shard_width(new_world)
+
+        def _rows_recut(rows, cols_new, dtype):
+            """[old_world, cols_old] rows (chunks repeat every
+            ``old_width`` rows) → [new_world, cols_new]: slice 0's
+            chunks reassemble the full padded vector, re-cut at the
+            new width and tiled across the new slices. Bit-exact for
+            every real entry (only zero-pad tail moves)."""
+            rows = np.asarray(rows)
+            full = np.concatenate(
+                [np.asarray(rows[i]).reshape(-1) for i in range(old_width)]
+            )
+            need = int(cols_new) * new_width
+            flat = np.zeros((need,), rows.dtype)
+            k = min(full.shape[0], need)
+            flat[:k] = full[:k]
+            chunks = flat.reshape(new_width, int(cols_new))
+            return jnp.asarray(
+                np.stack([chunks[r % new_width] for r in range(new_world)])
+            ).astype(dtype)
         if self._guard_on and guard_rows is None:
             # legacy flat state under a NEWLY-enabled guard: resharding
             # is the migration point — synthesize zero counters so the
@@ -1150,7 +1519,7 @@ class ShardedDistributedOptimizer:
             if not shape:
                 return jnp.zeros((), dt)
             size = int(np.prod(shape, dtype=np.int64))
-            return jnp.zeros((shard_cols(size, new_world),), dt)
+            return jnp.zeros((shard_cols(size, new_width),), dt)
 
         template = self._inner.init(
             jax.tree_util.tree_map(_shard_zeros, params)
@@ -1174,13 +1543,19 @@ class ShardedDistributedOptimizer:
                     )
                 )
                 continue
-            # padded full length: per-rank re-split lands exactly on
-            # the template's shard size (parallel.fsdp.reshard_rows —
-            # the ONE re-split implementation, shared with
-            # reshard_params and the ag residuals)
-            out.append(
-                reshard_rows(o, t.size * new_world, new_world, t.dtype)
-            )
+            if old_width == old_world and new_width == new_world:
+                # flat → flat: per-rank re-split lands exactly on the
+                # template's shard size (parallel.fsdp.reshard_rows —
+                # the ONE re-split implementation, shared with
+                # reshard_params and the ag residuals)
+                out.append(
+                    reshard_rows(o, t.size * new_world, new_world, t.dtype)
+                )
+            else:
+                # a local-SGD split is involved (either side): re-cut
+                # from slice 0's chunks at the new width (moments
+                # diverge per slice — see the docstring's policy)
+                out.append(_rows_recut(o, t.size, t.dtype))
         self._world = new_world
         resharded = jax.tree_util.tree_unflatten(treedef, out)
         new_guard = None
@@ -1196,14 +1571,94 @@ class ShardedDistributedOptimizer:
             }
         new_wire = None
         if synthesize_wire:
-            new_wire = self._init_wire_rows(params, new_world)
+            new_wire = self._init_wire_rows(params, new_world, new_width)
         elif wire_rows is not None:
             new_wire = self._reshard_wire_rows(
-                wire_rows, params, new_world
+                wire_rows, params, new_world, new_width, _rows_recut,
+                flat_ok=(old_width == old_world and new_width == new_world),
+                old_width=old_width,
             )
-        return self._compose_state(resharded, new_guard, new_wire)
+        new_local = None
+        if self._local_on:
+            if local_rows is None:
+                # local mode newly enabled: the anchor IS the params,
+                # so the migration needs concrete values
+                if any(
+                    not isinstance(l, (jnp.ndarray, np.ndarray))
+                    and not hasattr(l, "__array__")
+                    for l in jax.tree_util.tree_leaves(params)
+                ):
+                    raise ValueError(
+                        "enabling local_sgd_steps against a state "
+                        "without the \"local\" family needs concrete "
+                        "parameter VALUES (the anchor is the params); "
+                        "a jax.eval_shape template cannot seed it"
+                    )
+                new_local = self._init_local_rows(
+                    params, new_world, new_width
+                )
+            else:
+                new_local = self._reshard_local_rows(
+                    local_rows, params, new_world, new_width, _rows_recut
+                )
+        return self._compose_state(
+            resharded, new_guard, new_wire, new_local
+        )
 
-    def _reshard_wire_rows(self, wire_rows, params, new_world: int):
+    def _reshard_local_rows(
+        self, local_rows, params, new_world, new_width, recut
+    ):
+        """Migrate the ``"local"`` family across a topology change:
+        anchor chunks re-cut bit-exactly at the new width (anchors are
+        identical across slices by the sync contract — slice 0's rows
+        reassemble the one true copy); EF residual chunks re-cut the
+        same way, which ADOPTS slice 0's carry (per-slice carries
+        cannot survive a re-slicing; the loss is bounded by one
+        quantum per element); the round counter re-broadcast; the
+        width leaf refreshed."""
+        def _leaf(rows, p):
+            if np.ndim(p) == 0:
+                return jnp.broadcast_to(
+                    jnp.asarray(np.asarray(rows).reshape(-1)[0]),
+                    (new_world,),
+                )
+            size = int(np.prod(np.shape(p), dtype=np.int64))
+            return recut(
+                rows, shard_cols(size, new_width),
+                jnp.result_type(np.asarray(rows)),
+            )
+
+        out = {
+            "anchor": jax.tree_util.tree_map(
+                _leaf, local_rows["anchor"], params
+            ),
+            "round": jnp.broadcast_to(
+                jnp.asarray(
+                    np.asarray(local_rows["round"]).reshape(-1)[0],
+                    jnp.int32,
+                ),
+                (new_world,),
+            ),
+            "intra": jnp.full((new_world,), new_width, jnp.int32),
+        }
+        if self._local_wire == "int8":
+            if "residual" in local_rows:
+                out["residual"] = jax.tree_util.tree_map(
+                    _leaf, local_rows["residual"], params
+                )
+            else:
+                out["residual"] = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros_like(a), out["anchor"]
+                )
+        return out
+
+    def _reshard_wire_rows(
+        self, wire_rows, params, new_world: int,
+        new_width: Optional[int] = None, recut=None, flat_ok: bool = True,
+        old_width: Optional[int] = None,
+    ):
+        if new_width is None:
+            new_width = new_world
         step = jnp.broadcast_to(
             jnp.asarray(
                 np.asarray(wire_rows["step"]).reshape(-1)[0], jnp.int32
@@ -1216,7 +1671,7 @@ class ShardedDistributedOptimizer:
             # EF newly enabled against a seed-only wire state: the
             # migration point synthesizes zero carries, keeping the
             # seed counter
-            out = self._init_wire_rows(params, new_world)
+            out = self._init_wire_rows(params, new_world, new_width)
             out["step"] = step
             return out
 
@@ -1224,13 +1679,24 @@ class ShardedDistributedOptimizer:
             # per-rank FULL-geometry error: the future wire only ever
             # consumes the cross-rank SUM, so carrying Σ over the old
             # gang onto rank 0 (zeros elsewhere) preserves the
-            # un-transmitted signal exactly across the resize
+            # un-transmitted signal exactly across the resize. Under a
+            # LOCAL-SGD split the carry is defined against each
+            # slice's OWN intra sum — a gang-wide Σ would inject
+            # foreign slices' error into slice 0's next reduction —
+            # so only slice 0's rows are summed (its total preserved;
+            # other slices' carries are dropped like their moments,
+            # the documented resize policy).
             rows = np.asarray(rows)
             if np.ndim(p) == 0:
                 return jnp.broadcast_to(
                     jnp.asarray(rows.reshape(-1)[0]), (new_world,)
                 )
-            total = rows.sum(axis=0)
+            n_sum = (
+                rows.shape[0]
+                if flat_ok or old_width is None
+                else old_width
+            )
+            total = rows[:n_sum].sum(axis=0)
             out = np.zeros((new_world,) + total.shape, rows.dtype)
             out[0] = total
             return jnp.asarray(out)
@@ -1242,8 +1708,16 @@ class ShardedDistributedOptimizer:
                     (new_world,),
                 )
             size = int(np.prod(np.shape(p), dtype=np.int64))
-            return reshard_rows(
-                rows, size, new_world, np.asarray(rows).dtype
+            if flat_ok or recut is None:
+                return reshard_rows(
+                    rows, size, new_world, np.asarray(rows).dtype
+                )
+            # a local-SGD width is involved: re-cut from slice 0's
+            # chunks like the moments (per-slice carries cannot
+            # survive a re-slicing; the loss is bounded by one quantum)
+            return recut(
+                rows, shard_cols(size, new_width),
+                np.asarray(rows).dtype,
             )
 
         return {

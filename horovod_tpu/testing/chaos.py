@@ -26,6 +26,12 @@ site                      where it fires
 ``fusion.dispatch``       eager fusion flush entry (transport faults
                           surface as ``HorovodInternalError`` — the
                           elastic contract)
+``local_sgd.sync``        each attempt of a local-SGD sync round's
+                          inter (DCN) hop (``local_sgd.run_round``;
+                          transport faults retry the round WHOLE under
+                          the RetryPolicy, exhaustion DEFERS the round
+                          — ``local_sgd.rounds_deferred`` — instead of
+                          stalling or restarting the gang)
 ========================  ====================================================
 
 Sites the library doesn't own (a bench/smoke script's training loop)

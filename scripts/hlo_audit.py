@@ -372,6 +372,80 @@ def _serve_engine(paged):
     )
 
 
+def prog_local_sgd_phase():
+    """PR 14: the local-SGD local-phase step program carries ZERO
+    inter-slice replica groups — every collective (the bucketed
+    gradient exchange AND anything else the update folds in) routes
+    over the intra groups only, full-width wire, N independent
+    buckets. The sync round is a SEPARATE program and is allowed its
+    inter groups; the local phase is not."""
+    import optax
+
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.1), op=hvd.Sum, local_sgd_steps=8,
+        local_sgd_intra=LOCAL, overlap_buckets=3, overlap_min_bytes=0,
+    )
+    params = {
+        "a": jnp.ones((32, 8)), "b": jnp.ones((32, 8)),
+        "c": jnp.ones((32, 8)),
+    }
+    state = opt.init(params)
+    pm = jax.tree_util.tree_map(
+        lambda p: jnp.broadcast_to(p[None], (WORLD,) + p.shape), params
+    )
+    sm = jax.tree_util.tree_map(
+        lambda s: jnp.broadcast_to(
+            jnp.asarray(s)[None],
+            (WORLD,) + tuple(np.shape(jnp.asarray(s))),
+        ),
+        state,
+    )
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.ones((WORLD,) + tuple(np.shape(p))), params
+    )
+
+    @partial(
+        jax.shard_map, mesh=hvd.mesh(),
+        in_specs=(
+            P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS),
+        ),
+        out_specs=(P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS)),
+        check_vma=False,
+    )
+    def step(pm, sm, gm):
+        import optax as _optax
+
+        p = jax.tree_util.tree_map(lambda x: x[0], pm)
+        s = jax.tree_util.tree_map(lambda x: x[0], sm)
+        g = jax.tree_util.tree_map(lambda x: x[0], gm)
+        u, s = opt.update(g, s, p)
+        p = _optax.apply_updates(p, u)
+        return jax.tree_util.tree_map(lambda x: x[None], (p, s))
+
+    g = _graph(step, pm, sm, grads)
+    pairs = [
+        (rules.CollectiveCount("all_reduce", 3), g),
+        (rules.NoInterCollectiveDefUse("all_reduce"), g),
+        (rules.WireDtype(int8_allowed=False), g),
+    ]
+    # the tentpole invariant: no collective of ANY kind spans slices
+    for kind in (
+        "all_reduce", "reduce_scatter", "all_gather", "all_to_all",
+        "collective_permute",
+    ):
+        pairs.append(
+            (
+                rules.ReplicaGroupStructure(
+                    kind, groups_any_of=(INTRA,),
+                    forbid_world_spanning=True,
+                    require_present=(kind == "all_reduce"),
+                ),
+                g,
+            )
+        )
+    return pairs
+
+
 def prog_serve_decode():
     """PR 8/11: the decode carry is DONATED (arg 1 = the KV cache) and
     steady-state serving compiles the decode step exactly once across
@@ -434,6 +508,7 @@ ROSTER = {
     "hier_allreduce": prog_hier_allreduce,
     "hier_int8": prog_hier_int8,
     "moe_alltoall": prog_moe_alltoall,
+    "local_sgd_phase": prog_local_sgd_phase,
     "serve_decode": prog_serve_decode,
     "serve_prefill": prog_serve_prefill,
 }

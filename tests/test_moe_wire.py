@@ -586,6 +586,96 @@ class TestTunerPersistence:
             ("alltoall", 4096, "float32", "inter"), "int8"
         )
 
+    def test_overlap_tuner_persistence_parity(
+        self, tmp_path, monkeypatch
+    ):
+        """PR 14 satellite (ROADMAP item 1a slice): the OverlapTuner
+        rides the same warm_start/persist machinery as the WireTuner —
+        roundtrip skips trials, and persist MERGES with disk (the
+        WireTuner merge test, overlap edition)."""
+        from horovod_tpu.common.autotune import (
+            OverlapTuner,
+            persist,
+            warm_start,
+        )
+
+        monkeypatch.setenv("HOROVOD_TUNER_CACHE", str(tmp_path))
+        a = OverlapTuner(min_bucket_bytes=0, trials=1, candidates=(1, 4))
+        a.record(("step",), 1, 1 << 20, 2.0)
+        a.record(("step",), 4, 1 << 20, 1.0)
+        assert persist(a, "overlap") is not None
+        b = OverlapTuner(min_bucket_bytes=0, trials=1, candidates=(1, 8))
+        b.record(("step",), 8, 1 << 20, 0.5)
+        persist(b, "overlap")  # never saw a's entries: must merge
+        c = OverlapTuner(
+            min_bucket_bytes=0, trials=1, candidates=(1, 4, 8)
+        )
+        assert warm_start(c, "overlap") == 3
+        for cand in (1, 4, 8):
+            assert not c.needs_trial(("step",), cand)
+        assert c.choose(("step",), 1 << 20) == 8
+
+    def test_capacity_tuner_merge_on_persist(
+        self, tmp_path, monkeypatch
+    ):
+        """Capacity edition of the merge test — including the load
+        ledger (drop-rate prior survives the merge)."""
+        from horovod_tpu.common.autotune import (
+            CapacityTuner,
+            persist,
+            warm_start,
+        )
+
+        monkeypatch.setenv("HOROVOD_TUNER_CACHE", str(tmp_path))
+        a = CapacityTuner(trials=1, candidates=(1.0, 2.0))
+        a.observe_load(("m",), 1.0, [50.0, 50.0], 30.0, 130.0, seconds=0.1)
+        persist(a, "capacity")
+        b = CapacityTuner(trials=1, candidates=(1.0, 2.0))
+        b.observe_load(("m",), 2.0, [65.0, 65.0], 0.0, 130.0, seconds=0.2)
+        persist(b, "capacity")
+        c = CapacityTuner(trials=1, candidates=(1.0, 2.0))
+        assert warm_start(c, "capacity") == 2
+        assert not c.needs_trial(("m",), 1.0)
+        assert not c.needs_trial(("m",), 2.0)
+        assert c.drop_rate(("m",), 1.0) == pytest.approx(30.0 / 130.0)
+
+    def test_shared_accessors_warm_start_and_register(
+        self, tmp_path, monkeypatch
+    ):
+        """shared_overlap_tuner / shared_capacity_tuner warm-start
+        from the fingerprinted cache on first use and are registered
+        for persist-at-exit (the FusionManager's WireTuner contract,
+        extended)."""
+        from horovod_tpu.common import autotune
+        from horovod_tpu.common.autotune import (
+            CapacityTuner,
+            OverlapTuner,
+            persist,
+        )
+
+        monkeypatch.setenv("HOROVOD_TUNER_CACHE", str(tmp_path))
+        seed_o = OverlapTuner(min_bucket_bytes=0, trials=1)
+        seed_o.record(("k",), 4, 100, 1.0)
+        persist(seed_o, "overlap")
+        seed_c = CapacityTuner(trials=1)
+        seed_c.record(("k",), 1.25, 100, 1.0)
+        persist(seed_c, "capacity")
+        autotune.reset_shared_tuners()
+        try:
+            ot = autotune.shared_overlap_tuner(
+                min_bucket_bytes=0, trials=1
+            )
+            assert not ot.needs_trial(("k",), 4)
+            assert autotune.shared_overlap_tuner() is ot
+            ct = autotune.shared_capacity_tuner(trials=1)
+            assert not ct.needs_trial(("k",), 1.25)
+            registered = {
+                name for _, (_, name) in autotune._persist_registry
+            }
+            assert {"overlap", "capacity"} <= registered
+        finally:
+            autotune.reset_shared_tuners()
+
     def test_corrupt_cache_reads_zero(self, tmp_path, monkeypatch):
         from horovod_tpu.common.autotune import (
             WireTuner,
