@@ -20,9 +20,14 @@
 #                    mixed-length prompts routed through the
 #                    rendezvous-KV capacity announcements, TTFT/TPOT
 #                    quantiles + slot gauges asserted on the live
-#                    /metrics scrape, then SIGTERM both workers and
-#                    assert the drain completed every accepted request
-#                    (exit 143) — the serving plane can't silently rot
+#                    /metrics scrape; then a role-split fleet (1
+#                    prefill + 2 decode workers) streams KV pages over
+#                    the transfer wire with per-role routing asserted
+#                    on live scrapes and one decode worker SIGTERMed
+#                    mid-burst (reservations fail over); finally
+#                    SIGTERM the unified workers and assert the drain
+#                    completed every accepted request (exit 143) — the
+#                    serving plane can't silently rot
 #   7. audit-smoke — scripts/hlo_audit.py: the lowered-program
 #                    invariant catalog over the canonical roster
 #                    (fused fp32/int8 wire, overlap buckets, ZeRO-2/3,
@@ -149,11 +154,11 @@ bench_smoke() {
     test -s "$art_dir/lm_ab_local_sgd_${leg}.json" \
       || { echo "missing artifact: lm_ab_local_sgd_${leg}.json" >&2; exit 1; }
   done
-  step "bench-smoke: bench_serve.py dryrun (static-vs-continuous + paged-KV + prefix-cache A/B)"
+  step "bench-smoke: bench_serve.py dryrun (static-vs-continuous + paged-KV + prefix-cache + disaggregated A/B)"
   JAX_PLATFORMS=cpu \
     BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
     python bench_serve.py
-  for leg in static continuous paged prefix; do
+  for leg in static continuous paged prefix disagg; do
     test -s "$art_dir/serve_ab_${leg}.json" \
       || { echo "missing artifact: serve_ab_${leg}.json" >&2; exit 1; }
   done
@@ -161,7 +166,7 @@ bench_smoke() {
 }
 
 serve_smoke() {
-  step "serve-smoke: 2-worker fleet, routed mixed-length prompts, SLO scrape, SIGTERM drain"
+  step "serve-smoke: routed fleet (unified + role-split prefill/decode), SLO + transfer scrapes, SIGTERM drains"
   python scripts/serve_smoke.py
 }
 

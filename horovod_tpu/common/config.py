@@ -70,6 +70,17 @@ DEFAULT_SERVE_PAGE_TOKENS = 16
 DEFAULT_SERVE_PAGES = 0
 DEFAULT_SERVE_PREFIX_CACHE = True
 DEFAULT_SERVE_PAGE_WATERMARK = -1
+# Disaggregated prefill/decode fleet (serving/kv_transfer.py): the
+# worker's role in the fleet (unified = classic single-engine worker,
+# the default — single-worker deployments are untouched), the KV-page
+# wire format for prefill→decode transfers (int8 = block-scaled
+# quantized pages, the headline; fp32 = lossless pool-dtype
+# passthrough, the bit-parity reference; bf16 = the middle ground),
+# and the decode worker's transfer-ingest port (0 = ephemeral,
+# announced through the capacity blobs either way).
+DEFAULT_SERVE_ROLE = "unified"
+DEFAULT_SERVE_KV_WIRE = "int8"
+DEFAULT_SERVE_TRANSFER_PORT = 0
 
 
 def _env_bool(name: str, default: bool = False) -> bool:
@@ -343,6 +354,11 @@ class Config:
     serve_pages: int = DEFAULT_SERVE_PAGES
     serve_prefix_cache: bool = DEFAULT_SERVE_PREFIX_CACHE
     serve_page_watermark: int = DEFAULT_SERVE_PAGE_WATERMARK
+    # disaggregated fleet: worker role, KV transfer wire format, and
+    # the transfer-ingest port (serving/kv_transfer.py)
+    serve_role: str = DEFAULT_SERVE_ROLE
+    serve_kv_wire: str = DEFAULT_SERVE_KV_WIRE
+    serve_transfer_port: int = DEFAULT_SERVE_TRANSFER_PORT
 
     # --- logging ---
     log_level: str = "warning"
@@ -536,6 +552,18 @@ class Config:
             serve_page_watermark=_env_int(
                 "HOROVOD_SERVE_PAGE_WATERMARK",
                 DEFAULT_SERVE_PAGE_WATERMARK,
+            ),
+            serve_role=_env_choice(
+                "HOROVOD_SERVE_ROLE", DEFAULT_SERVE_ROLE,
+                ("unified", "prefill", "decode"),
+            ),
+            serve_kv_wire=_env_choice(
+                "HOROVOD_SERVE_KV_WIRE", DEFAULT_SERVE_KV_WIRE,
+                ("fp32", "bf16", "int8"),
+            ),
+            serve_transfer_port=_env_int(
+                "HOROVOD_SERVE_TRANSFER_PORT",
+                DEFAULT_SERVE_TRANSFER_PORT,
             ),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_timestamp=_env_bool("HOROVOD_LOG_TIMESTAMP", True),

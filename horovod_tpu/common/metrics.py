@@ -182,6 +182,48 @@ SERVING_PAGE_METRICS = (
     "serve.paused_pages_reclaimed",
 )
 
+# KV-transfer wire families (serving/kv_transfer.py — the
+# disaggregated-fleet stream; legend for docs/observability.md's
+# transfer table, rendered as `hvd_serve_*` on /metrics):
+#   sender (prefill worker):
+#   serve.kv_transfer_bytes / _pages / _ms   framed bytes, pages and
+#                                    wall-ms streamed out (counters —
+#                                    bytes/pages is the wire's realized
+#                                    compression ratio)
+#   serve.transfers                  requests successfully streamed out
+#   serve.transfer_local             no decode capacity at reserve time
+#                                    → decoded locally, never streamed
+#   serve.transfer_fallbacks         stream/decode FAILED after
+#                                    prefill → request came home for a
+#                                    pointer-cheap local decode
+#   serve.handed_off                 remote decode completed and the
+#                                    waiter was released
+#   receiver (decode worker):
+#   serve.kv_transfer_bytes_in / _pages_in   framed bytes / pages landed
+#   serve.transfer_admits            ingested requests pointer-attached
+#                                    into decode slots (counter)
+#   serve.transfer_reservations / _reserve_denied
+#                                    page reservations granted / denied
+#   serve.transfer_pages_in          pool pages taken by ingests
+#                                    (PagedKVCacheManager counter)
+#   serve.transfer_ingests           engine-level ingest writes
+SERVING_TRANSFER_METRICS = (
+    "serve.kv_transfer_bytes",
+    "serve.kv_transfer_pages",
+    "serve.kv_transfer_ms",
+    "serve.transfers",
+    "serve.transfer_local",
+    "serve.transfer_fallbacks",
+    "serve.handed_off",
+    "serve.kv_transfer_bytes_in",
+    "serve.kv_transfer_pages_in",
+    "serve.transfer_admits",
+    "serve.transfer_reservations",
+    "serve.transfer_reserve_denied",
+    "serve.transfer_pages_in",
+    "serve.transfer_ingests",
+)
+
 
 class MetricsRegistry:
     def __init__(self) -> None:

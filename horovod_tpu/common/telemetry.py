@@ -126,6 +126,15 @@ _COUNTER_KEYS = (
     # cached prefix pages instead of prefilling them
     "serve.page_allocs",
     "serve.prefix_hits",
+    # KV-transfer wire (serving/kv_transfer.py): bytes/pages/ms deltas
+    # meter the inter-slice KV stream a disaggregated fleet pays per
+    # handed-off request (the int8-vs-fp32 wire trade in byte units),
+    # and a transfer_fallbacks delta pins a decode-capacity outage to
+    # the step whose request came home to decode locally
+    "serve.kv_transfer_bytes",
+    "serve.kv_transfer_pages",
+    "serve.kv_transfer_ms",
+    "serve.transfer_fallbacks",
 )
 
 # Gauges copied into the record's ``tuner`` dict — the autotune /

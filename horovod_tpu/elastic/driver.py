@@ -157,6 +157,9 @@ class ElasticDriver:
         # expert-load freshness ledger: rank -> (last ts seen, driver
         # monotonic stamp of the last ADVANCE) — see _poll_expert_loads
         self._expert_load_seen: Dict[int, tuple] = {}
+        # serve-capacity freshness ledger, same contract — see
+        # _poll_serve_capacity
+        self._serve_cap_seen: Dict[int, tuple] = {}
 
     # ---------------------------------------------------------- planning
 
@@ -477,6 +480,7 @@ class ElasticDriver:
             self._last_stragglers = stragglers
         self._maybe_rebalance()
         self._poll_expert_loads()
+        self._poll_serve_capacity()
         return self._maybe_quarantine()
 
     def _poll_expert_loads(self) -> None:
@@ -543,6 +547,63 @@ class ElasticDriver:
             max(hist.values()) / mean if mean > 0 else 1.0,
         )
         _metrics.gauge("driver.expert_load.drop_rate", dropped / total)
+
+    def _poll_serve_capacity(self) -> None:
+        """Aggregate the serving fleet's capacity announcements
+        (serving/frontend.py, rendezvous scope ``serve``) into per-ROLE
+        driver gauges — the disaggregated fleet's operator view: how
+        many prefill vs decode workers are live, and how much admission
+        headroom (slots / pages) each side of the wire has left. An
+        empty decode side with a busy prefill side is the signature of
+        a fleet about to fall back wholesale
+        (``serve.transfer_fallbacks`` on the workers). Best-effort and
+        staleness-guarded exactly like :meth:`_poll_expert_loads`:
+        entries count while their ts ADVANCES on the driver's clock.
+        Blobs with no ``role`` field (old workers mid-rollout) count as
+        ``unified`` — the Router's parsing rule, applied fleet-wide."""
+        if self._server is None:
+            return
+        try:
+            from ..serving.frontend import read_announcements
+            from ..serving.kv_transfer import worker_role
+
+            anns = read_announcements(self._server.store)
+        except Exception:
+            return
+        if not anns:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        fresh = {}
+        for rank, ann in anns.items():
+            ts = float(ann.get("ts", 0.0))
+            prev = self._serve_cap_seen.get(rank)
+            if prev is None or ts > prev[0]:
+                self._serve_cap_seen[rank] = (ts, now)
+                fresh[rank] = ann
+            elif now - prev[1] <= _EXPERT_LOAD_STALE_S:
+                fresh[rank] = ann
+        for rank in list(self._serve_cap_seen):
+            if rank not in anns:
+                del self._serve_cap_seen[rank]
+        if not fresh:
+            return
+        per_role: dict = {}
+        for ann in fresh.values():
+            agg = per_role.setdefault(
+                worker_role(ann),
+                {"workers": 0.0, "free_slots": 0.0, "free_pages": 0.0},
+            )
+            agg["workers"] += 1.0
+            if not ann.get("draining"):
+                agg["free_slots"] += float(ann.get("free_slots", 0))
+                agg["free_pages"] += float(ann.get("free_pages", 0))
+        from ..common.metrics import registry as _metrics
+
+        for role, agg in per_role.items():
+            for key, val in agg.items():
+                _metrics.gauge(f"driver.serve.{role}.{key}", val)
 
     def _maybe_rebalance(self) -> None:
         """Consume the straggler ledger as a SCHEDULING signal

@@ -22,13 +22,22 @@ decode model contract (paged or slab cache layout); `engine` the
 compiled prefill/decode split; `paged_kv` the block pool + prefix
 cache; `kv_cache` the slab baseline + the manager factory; `batcher`
 the scheduler (page-gated admission, pause-on-exhaustion); `slo` the
-latency meters; `frontend` HTTP + fleet routing.
+latency meters; `frontend` HTTP + fleet routing; `kv_transfer` the
+disaggregated prefill/decode wire (role-split fleets, streamed int8
+paged-KV transfer — ``HOROVOD_SERVE_ROLE``).
 """
 
 from .batcher import (  # noqa: F401
     ContinuousBatcher,
     Rejected,
     Request,
+)
+from .kv_transfer import (  # noqa: F401
+    KVTransferServer,
+    TransferCoordinator,
+    pack_raw_pages,
+    unpack_pages,
+    worker_role,
 )
 from .engine import InferenceEngine  # noqa: F401
 from .frontend import (  # noqa: F401

@@ -36,6 +36,17 @@ Draining (``drain()``, wired to SIGTERM via
 ``preemption.register_drain``) stops ADMISSION of new submissions but
 runs queue + in-flight to completion — every accepted request finishes
 before the worker leaves the gang.
+
+Role-split fleets (``HOROVOD_SERVE_ROLE``, serving/kv_transfer.py): a
+``prefill``-role batcher reserves decode capacity BEFORE each fresh
+prefill, then detaches the finished pages and hands them to the
+transfer coordinator — the request never occupies a decode slot here
+unless the transfer plane has no capacity (local fallback, the
+unified path). A ``decode``-role batcher admits transferred requests
+through :meth:`submit_ingested`: the foreign pages pointer-attach
+exactly like a pause-resume, so admission changes data, never shapes —
+``decode_compiles`` stays 1. In-flight handoffs count against drain:
+SIGTERM waits for streamed requests to finish or fall back.
 """
 
 from __future__ import annotations
@@ -90,6 +101,10 @@ class Request:
     kept_pages: Optional[list] = None
     resume_length: int = 0
     admit_seq: int = -1
+    # KV-transfer ingest payload (serving/kv_transfer.py): host page
+    # arrays + logical indices waiting for their admit-time device
+    # write. Dropped (None) once attached — the arrays are large.
+    ingest: Optional[dict] = dataclasses.field(default=None, repr=False)
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
@@ -124,10 +139,25 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         policy: str = "continuous",
         recorder: Optional[LatencyRecorder] = None,
+        role: str = "unified",
     ) -> None:
         if policy not in ("continuous", "static"):
             raise ValueError(f"unknown policy {policy!r}")
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown serve role {role!r}")
+        if role != "unified" and not engine.paged:
+            raise ValueError(
+                "prefill/decode roles need the paged KV plane "
+                "(HOROVOD_SERVE_KV=paged) — the transfer wire moves "
+                "pool pages, not slab slots"
+            )
         self.engine = engine
+        self.role = role
+        # TransferCoordinator (prefill role), wired by serve() after
+        # construction — None means no transfer plane: every request
+        # decodes locally (the unified path)
+        self.transfer = None
+        self._handoffs = 0  # requests streamed out, result not back yet
         self.max_admit_per_step = max(int(max_admit_per_step), 1)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.default_deadline_ms = float(default_deadline_ms)
@@ -153,6 +183,14 @@ class ContinuousBatcher:
         max_new_tokens: Optional[int] = None,
         deadline_ms: Optional[float] = None,
     ) -> Request:
+        if self.role == "decode":
+            # the Router never sends prompts here (role-aware pick);
+            # this guard keeps a misconfigured client from tripping the
+            # engine's role gate deep inside the scheduler thread
+            _metrics.counter("serve.rejected")
+            raise Rejected(
+                "decode-role worker takes KV transfers, not prompts"
+            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             _metrics.counter("serve.rejected")
@@ -210,6 +248,111 @@ class ContinuousBatcher:
         self._publish_gauges()
         return req
 
+    # ------------------------------------------------- transfer plane hooks
+
+    def submit_ingested(
+        self,
+        prompt,
+        first_token: int,
+        max_new_tokens: int,
+        logical,
+        arrays,
+        length: int,
+        hashes=(),
+        deadline_ms: Optional[float] = None,
+    ) -> Request:
+        """Admit a KV-transferred request (serving/kv_transfer.py
+        receiver). Called from an HTTP handler thread: only host-side
+        bookkeeping happens here — the device write (ingest_attach)
+        runs at admit time on the scheduler thread, like every other
+        pool touch. The first token was already emitted by the remote
+        prefill, so ``out_tokens`` starts seeded and decode produces
+        the remaining ``max_new_tokens - 1``."""
+        if not self.engine.paged:
+            raise Rejected("KV ingest needs the paged plane")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pages = list(logical)
+        if len(pages) > self.engine.manager.num_pages:
+            _metrics.counter("serve.rejected")
+            raise Rejected(
+                f"ingest of {len(pages)} pages exceeds the "
+                f"{self.engine.manager.num_pages}-page pool"
+            )
+        req = Request(
+            id=next(self._ids),
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            deadline_ts=(
+                time.monotonic() + float(deadline_ms) / 1e3
+                if deadline_ms and float(deadline_ms) > 0
+                else None
+            ),
+        )
+        req.out_tokens.append(int(first_token))
+        req.ingest = {
+            "logical": [int(lp) for lp in pages],
+            "arrays": arrays,
+            "length": int(length),
+            "hashes": list(hashes),
+        }
+        with self._cond:
+            if self._draining:
+                _metrics.counter("serve.rejected")
+                raise Rejected("worker is draining (shutdown in progress)")
+            self._queue.append(req)
+            self._cond.notify_all()
+        _metrics.counter("serve.requests_total")
+        self._publish_gauges()
+        return req
+
+    def requeue_fallback(self, req: Request, kept, length: int) -> None:
+        """Transfer failed after the prefill (retries exhausted, or the
+        decode worker answered with an error status): bring the request
+        home. Its pages are still held, so it re-queues paused at the
+        FRONT for a pointer-cheap local decode — even while draining
+        (it was accepted; accepted work completes). Called from the
+        handoff thread."""
+        req.kept_pages = kept
+        req.resume_length = int(length)
+        req.paused = True
+        req.status = QUEUED
+        with self._cond:
+            self._handoffs -= 1
+            if self._draining and not self._running and self._thread is None:
+                # scheduler crashed or already stopped: nothing will
+                # ever serve the queue — fail loudly, don't park waiters
+                req.kept_pages = None
+                self.engine.manager.release_kept(kept)
+                req.status = ERROR
+                req._done.set()
+                _metrics.counter("serve.errored")
+                return
+            self._queue.appendleft(req)
+            self._cond.notify_all()
+        _metrics.counter("serve.transfer_fallbacks")
+        _log.info(
+            "request %d fell back to local decode after transfer failure",
+            req.id,
+        )
+
+    def complete_handoff(self, req: Request, result: Dict) -> None:
+        """Remote decode finished: copy the decode worker's output into
+        the local request and release its waiter. TTFT stays the value
+        measured HERE (the client's clock); gen_ms is the decode
+        worker's. Called from the handoff thread."""
+        req.out_tokens = [int(t) for t in result.get("tokens", ())]
+        req.gen_ms = float(result.get("gen_ms", 0.0))
+        req.status = DONE if result.get("status") == "done" else DEADLINE
+        with self._cond:
+            self._handoffs -= 1
+            self._cond.notify_all()
+        if req.status == DONE:
+            _metrics.counter("serve.completed")
+        else:
+            _metrics.counter("serve.expired")
+        _metrics.counter("serve.handed_off")
+        req._done.set()
+
     # ------------------------------------------------------------- the loop
 
     def start(self) -> None:
@@ -239,13 +382,20 @@ class ContinuousBatcher:
             self._cond.notify_all()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if not self._queue and not self._slot_req:
+            if (
+                not self._queue and not self._slot_req
+                and not self._handoffs
+            ):
                 return True
             if self._running:
                 time.sleep(0.005)
-            else:
-                self.step()
-        return not self._queue and not self._slot_req
+            elif not self.step():
+                # idle but handoffs still in flight: they finish (or
+                # fall back into the queue) on their own threads
+                time.sleep(0.005)
+        return (
+            not self._queue and not self._slot_req and not self._handoffs
+        )
 
     def _run(self) -> None:
         while True:
@@ -345,6 +495,8 @@ class ContinuousBatcher:
         mgr = self.engine.manager
         if req.kept_pages is not None:
             return 0
+        if req.ingest is not None:
+            return len(req.ingest["logical"])
         if req.paused and req.out_tokens:
             return mgr.pages_needed(self._resume_seq(req).size)
         return mgr.pages_needed(int(req.prompt.size))
@@ -390,6 +542,28 @@ class ContinuousBatcher:
                 req.paused = False
                 req.status = RUNNING
                 _metrics.counter("serve.resumed")
+            elif req.ingest is not None:
+                # KV-transfer ingest: foreign pages land in the pool
+                # and pointer-attach — data changes, shapes don't, so
+                # this admission path never retraces (decode_compiles
+                # stays 1 across streamed admissions)
+                ing = req.ingest
+                kept = self.engine.ingest_attach(
+                    slot, ing["logical"], ing["arrays"],
+                    ing["length"], ing["hashes"],
+                )
+                if kept is None:
+                    # pool raced dry between the gate and the alloc
+                    # (reserve TTL expiry, prefix churn): put the head
+                    # back and stop admitting this round
+                    self.engine.manager.free(slot)
+                    with self._cond:
+                        self._queue.appendleft(req)
+                    break
+                req.ingest = None
+                req.status = RUNNING
+                _metrics.counter("serve.transfer_admits")
+                _metrics.counter("serve.tokens_out")
             else:
                 if req.paused and req.out_tokens:
                     # pages were reclaimed while paused: rebuild the
@@ -402,6 +576,25 @@ class ContinuousBatcher:
                     req.status = RUNNING
                     _metrics.counter("serve.resumed")
                 else:
+                    reservation = None
+                    if (
+                        self.role == "prefill"
+                        and self.transfer is not None
+                        and req.max_new_tokens > 1
+                    ):
+                        # reserve decode capacity BEFORE spending the
+                        # prefill — a prefill whose pages have nowhere
+                        # to go is work wasted (docs/serving.md
+                        # reservation protocol)
+                        need = self.engine.manager.pages_needed(
+                            int(req.prompt.size) + req.max_new_tokens
+                        )
+                        reservation = self.transfer.reserve(need)
+                        if reservation is None:
+                            # no decode capacity anywhere: the unified
+                            # path — decode locally (this role compiles
+                            # its decode table lazily, only here)
+                            _metrics.counter("serve.transfer_local")
                     first = self.engine.prefill(slot, req.prompt)
                     req.status = RUNNING
                     req.ttft_ms = (time.monotonic() - req.submitted) * 1e3
@@ -411,6 +604,22 @@ class ContinuousBatcher:
                         "serve.prefill_tokens", int(req.prompt.size)
                     )
                     _metrics.counter("serve.tokens_out")
+                    if reservation is not None:
+                        # hand the finished pages to the transfer
+                        # coordinator: detach_keep frees the slot (the
+                        # refcounts move to the handoff), the stream +
+                        # result-wait run off-thread, and this worker's
+                        # decode plane never sees the request
+                        kept, length = self.engine.manager.detach_keep(
+                            slot
+                        )
+                        with self._cond:
+                            self._handoffs += 1
+                        self.transfer.start_handoff(
+                            self, req, kept, length, reservation
+                        )
+                        admitted += 1
+                        continue
             if mid_decode:
                 # counted for every admission path — fresh prefill,
                 # reprefill-resume AND pointer reattach-resume alike
@@ -459,6 +668,11 @@ class ContinuousBatcher:
         deadline headroom (most likely to expire unserved anyway);
         holders with no deadline are spared longest. The victim stays
         queued — it re-prefills on resume."""
+        if self.role == "decode":
+            # a decode-role worker has no prefill executables: dropped
+            # pages could never be rebuilt, so kept holds are pinned —
+            # pause (pointer resume) remains the only remedy here
+            return False
         with self._cond:
             holders = [r for r in self._queue if r.kept_pages]
         if not holders:
@@ -571,6 +785,7 @@ class ContinuousBatcher:
             "queue_depth": self.queue_depth(),
             "decode_steps": self._decode_steps,
             "draining": 1.0 if self._draining else 0.0,
+            "handoffs_inflight": float(self._handoffs),
         }
         out.update(self.engine.manager.stats())
         return out

@@ -137,7 +137,22 @@ class InferenceEngine:
         pages: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
         page_watermark: Optional[int] = None,
+        role: str = "unified",
     ) -> None:
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be unified/prefill/decode, got {role!r}"
+            )
+        # Role-gated executable tables (disaggregated fleet,
+        # serving/kv_transfer.py): a decode-role engine NEVER builds
+        # prefill executables (its sequences arrive as ingested pages —
+        # prefill() raises), and a prefill-role engine compiles the
+        # decode step only on the transfer-fallback path (normal
+        # operation hands finished pages off before any decode, so
+        # ``decode_compiles == 0`` is the assertable steady state —
+        # scripts/hlo_audit.py serve_prefill_role). Each role carries
+        # only its own tables: half the compile time and executable HBM.
+        self.role = role
         self._model_fn = _as_model_fn(model)
         # MoE decode (PR 12): a model whose config carries an expert
         # bank gets it sharded over the mesh's ep axis up front —
@@ -463,6 +478,13 @@ class InferenceEngine:
         The remaining pages are allocated here (allocate-on-write);
         after the prefill the slot's full prompt pages are published
         back into the prefix index."""
+        if self.role == "decode":
+            raise RuntimeError(
+                "prefill on a decode-role engine: decode workers take "
+                "finished KV pages over the transfer wire "
+                "(serving/kv_transfer.py), never prompts — the prefill "
+                "executable table is role-gated out"
+            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n = prompt.size
         if not 0 < n <= self.max_len:
@@ -559,6 +581,76 @@ class InferenceEngine:
         self._counters["decode_steps"] += 1
         return np.asarray(out)
 
+    # ----------------------------------------------- KV transfer primitives
+
+    def extract_pages(self, kept, length: int):
+        """Host copies of a detached slot's pages for the transfer wire
+        (serving/kv_transfer.py): one ``[n_pages, page_tokens, kv_heads,
+        head_dim]`` ndarray per cache leaf, in ``tree_leaves`` order,
+        with every position at or past ``length`` zeroed — the tail
+        page's garbage rows must not travel (and must not raise an int8
+        block scale: zeros never move an absmax, so pad positions are
+        excluded from the wire's quantization by construction).
+
+        Scheduler-thread only, like every other touch of the pool: the
+        gather materializes FRESH buffers, so the handoff thread that
+        serializes them afterwards shares no device state with the
+        executables' donated carry."""
+        if not self.paged:
+            raise RuntimeError("extract_pages needs the paged plane")
+        import jax
+
+        mgr = self.manager
+        idx = np.asarray([p for _, p in kept], np.int32)
+        pt = mgr.page_tokens
+        tail_valid = int(length) - (len(kept) - 1) * pt
+        out = []
+        for leaf in jax.tree_util.tree_leaves(mgr.cache):
+            arr = np.array(leaf[idx])  # copy: the tail zeroing writes
+            if 0 <= tail_valid < pt:
+                arr[-1, tail_valid:] = 0
+            out.append(arr)
+        return out
+
+    def ingest_attach(self, slot, logical, arrays, length, hashes=()):
+        """Receiver side of a KV transfer: land foreign page payloads
+        as refcounted LOCAL pages and point the slot's table at them.
+        ``arrays`` are the per-leaf ``[n_pages, page_tokens, ...]``
+        payloads (``extract_pages`` order, already dequantized to the
+        pool dtype); ``hashes`` are the sender's chained prefix hashes
+        so this worker's prefix cache warms from the transfer.
+
+        Returns the kept-pages list now backing the slot, or None when
+        the pool is dry (the server's 503 → the sender falls back).
+        Pure data plane: the writes are eager device ops on the pool
+        (the ``_cow`` pattern) and the table update is bookkeeping —
+        shapes never change, so the decode executable compiled for the
+        first admission serves every later ingest (zero retraces).
+        Scheduler-thread only (single consumer of the pool)."""
+        if not self.paged:
+            raise RuntimeError("ingest_attach needs the paged plane")
+        import jax
+
+        mgr = self.manager
+        phys = mgr.ingest_alloc(len(logical))
+        if phys is None:
+            return None
+        idx = np.asarray(phys, np.int32)
+        leaves = jax.tree_util.tree_leaves(mgr.cache)
+        treedef = jax.tree_util.tree_structure(mgr.cache)
+        new_leaves = [
+            leaf.at[idx].set(np.asarray(arr, dtype=leaf.dtype))
+            for leaf, arr in zip(leaves, arrays)
+        ]
+        mgr.cache = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        kept = list(zip([int(lp) for lp in logical], phys))
+        mgr.reattach(slot, kept, int(length))
+        if hashes:
+            mgr.publish_hashes(kept, list(hashes))
+        with self._lock:
+            self._counters["transfer_ingests"] += 1
+        return kept
+
     # ----------------------------------------------------------------- stats
 
     def stats(self) -> Dict[str, float]:
@@ -569,7 +661,7 @@ class InferenceEngine:
             "decode_steps", "prefill_exact_hits", "prefill_bucket_hits",
             "prefill_promotions", "prefill_pad_tokens",
             "chunked_prefill_chunks", "prefill_chunks_skipped",
-            "prefill_tokens_skipped",
+            "prefill_tokens_skipped", "transfer_ingests",
         ):
             out.setdefault(key, 0)
         out["prefill_exact_entries"] = len(self._prefill_exact)

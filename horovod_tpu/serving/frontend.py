@@ -30,6 +30,15 @@ are announced (pages are what admission actually gates on); old
 ``free_slots``-only blobs keep parsing, so mixed fleets mid-rollout
 stay routable.
 
+**Disaggregated fleets** (``HOROVOD_SERVE_ROLE``, docs/serving.md):
+announcements carry ``role`` and — on decode workers — the
+``transfer_port`` of the KV-ingest endpoint (serving/kv_transfer.py).
+The Router sends ``/generate`` traffic to PREFILL workers when any
+exist (unified workers otherwise) and NEVER to decode workers — their
+requests arrive as streamed KV pages, not prompts. Blobs with no
+``role`` field at all (old workers mid-rollout) parse as ``unified``
+and stay routable.
+
 **Drain:** ``serve()`` registers the frontend's drain with
 ``preemption.register_drain``, so a SIGTERM under ``GracefulShutdown``
 (or the handler ``serve()`` installs itself) finishes every accepted
@@ -95,8 +104,13 @@ class ServeFrontend:
         rank: Optional[int] = None,
         announce_client=None,
         announce_interval_s: float = DEFAULT_ANNOUNCE_INTERVAL_S,
+        transfer_server=None,
     ) -> None:
         self.batcher = batcher
+        # KVTransferServer on decode-role workers: its port travels in
+        # the capacity blob, its unexpired reservations debit the
+        # announced page headroom
+        self.transfer_server = transfer_server
         self.advertise_addr = advertise_addr
         self.rank = self._resolve_rank(rank)
         self._announce_client = announce_client
@@ -248,12 +262,15 @@ class ServeFrontend:
             "rank": self.rank,
             "addr": self.advertise_addr,
             "port": self.port,
+            "role": getattr(self.batcher, "role", "unified"),
             "free_slots": mgr["slots_free"],
             "slots_total": mgr["slots_total"],
             "queue_depth": self.batcher.queue_depth(),
             "draining": draining,
             "ts": time.time(),
         }
+        if self.transfer_server is not None:
+            cap["transfer_port"] = self.transfer_server.port
         if "pages_total" in mgr:
             # paged memory plane: page headroom is the truthful
             # capacity signal (admission is gated on it, not on
@@ -264,6 +281,12 @@ class ServeFrontend:
             # that would only queue the request.
             manager = self.batcher.engine.manager
             free_pages = manager.admission_headroom()
+            if self.transfer_server is not None:
+                # pages promised to in-flight transfers are spoken for:
+                # two senders must not both be told the same headroom
+                free_pages = max(
+                    free_pages - self.transfer_server.reserved_pages(), 0
+                )
             cap["free_pages"] = free_pages
             cap["pages_total"] = mgr["pages_total"]
             cap["prefix_hit_rate"] = round(mgr["prefix_hit_rate"], 4)
@@ -443,9 +466,26 @@ class Router:
         the straggler ledger; flagged workers are only used when they
         are ALL that is left (degraded beats down). ``exclude`` drops
         ranks a caller already failed against in this routing round."""
+        from .kv_transfer import worker_role
+
         workers = self.snapshot()
         for rank in exclude:
             workers.pop(rank, None)
+        # role split: decode workers take KV transfers, never prompts —
+        # they are not /generate candidates. When prefill workers exist
+        # they take every fresh admission (that IS the disaggregation);
+        # unified workers carry the traffic otherwise. worker_role()
+        # maps blobs with NO role field (old workers mid-rollout) to
+        # "unified", so a mixed-version fleet keeps routing.
+        workers = {
+            r: w for r, w in workers.items()
+            if worker_role(w) != "decode"
+        }
+        prefill = {
+            r: w for r, w in workers.items()
+            if worker_role(w) == "prefill"
+        }
+        workers = prefill or workers
         if not workers:
             return None
         flagged = set(self.straggler_ranks())
@@ -562,10 +602,14 @@ class Router:
 class ServeHandle:
     """What ``hvd.serve`` returns: the running plane + its lifecycle."""
 
-    def __init__(self, engine, batcher, frontend, shutdown_ctx=None):
+    def __init__(
+        self, engine, batcher, frontend, shutdown_ctx=None,
+        transfer_server=None,
+    ):
         self.engine = engine
         self.batcher = batcher
         self.frontend = frontend
+        self.transfer_server = transfer_server
         self._shutdown_ctx = shutdown_ctx
         self._stopped = threading.Event()
 
@@ -587,6 +631,8 @@ class ServeHandle:
         preemption.unregister_drain(self._drain_hook)
         self.frontend.stop()
         self.batcher.stop()
+        if self.transfer_server is not None:
+            self.transfer_server.stop()
         if self._shutdown_ctx is not None:
             self._shutdown_ctx.__exit__(None, None, None)
             self._shutdown_ctx = None
@@ -615,6 +661,9 @@ def serve(
     announce_client=None,
     mesh=None,
     handle_sigterm: bool = True,
+    role: Optional[str] = None,
+    kv_wire: Optional[str] = None,
+    transfer_port: Optional[int] = None,
     **engine_kwargs,
 ) -> ServeHandle:
     """Start the inference plane on this worker: engine + continuous
@@ -650,6 +699,22 @@ def serve(
         deadline_ms = cfg.serve_deadline_ms
     if max_admit_per_step is None:
         max_admit_per_step = cfg.serve_max_batch
+    if role is None:
+        role = cfg.serve_role
+    if kv_wire is None:
+        kv_wire = cfg.serve_kv_wire
+    else:
+        # Validate here even though only prefill workers build the
+        # TransferCoordinator — a typo'd wire on a decode/unified worker
+        # must fail at serve() time, not when the fleet is re-roled.
+        from .kv_transfer import WIRE_FORMATS
+
+        if kv_wire not in WIRE_FORMATS:
+            raise ValueError(
+                f"kv wire must be one of {WIRE_FORMATS}, got {kv_wire!r}"
+            )
+    if transfer_port is None:
+        transfer_port = cfg.serve_transfer_port
     if max_len is None:
         model_cfg = getattr(model, "cfg", None)
         max_len = getattr(model_cfg, "max_len", None)
@@ -660,7 +725,7 @@ def serve(
             )
     engine = InferenceEngine(
         model, params, slots=slots, max_len=max_len, mesh=mesh,
-        **engine_kwargs,
+        role=role, **engine_kwargs,
     )
     batcher = ContinuousBatcher(
         engine,
@@ -669,17 +734,40 @@ def serve(
         default_deadline_ms=deadline_ms,
         eos_id=eos_id,
         policy=policy,
+        role=role,
     )
+    transfer_server = None
+    if role == "decode":
+        from .kv_transfer import KVTransferServer
+
+        transfer_server = KVTransferServer(
+            batcher, port=transfer_port, addr=addr
+        )
+        transfer_server.start()
     frontend = ServeFrontend(
         batcher, port=port, addr=addr,
         advertise_addr=advertise_addr, rank=rank,
         announce_client=announce_client,
+        transfer_server=transfer_server,
     )
+    if role == "prefill":
+        from .kv_transfer import TransferCoordinator
+
+        # the coordinator reads the same serve-scope announcements the
+        # frontend publishes into — resolved lazily so a fleet-less
+        # prefill worker (no rendezvous) just decodes locally
+        batcher.transfer = TransferCoordinator(
+            engine, wire=kv_wire,
+            client_factory=frontend._resolve_announce_client,
+        )
     shutdown_ctx = None
     if handle_sigterm:
         shutdown_ctx = preemption.GracefulShutdown(None)
         shutdown_ctx.__enter__()
-    handle = ServeHandle(engine, batcher, frontend, shutdown_ctx)
+    handle = ServeHandle(
+        engine, batcher, frontend, shutdown_ctx,
+        transfer_server=transfer_server,
+    )
     preemption.register_drain(handle._drain_hook)
     batcher.start()
     frontend.start()

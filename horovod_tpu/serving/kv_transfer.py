@@ -1,0 +1,662 @@
+"""Streamed KV-page transfer: the disaggregated fleet's inter-slice wire.
+
+Role-split serving (docs/serving.md "disaggregated fleet"): a PREFILL
+worker runs the chunked bucket-cached prefill into its local page pool,
+then ships the finished pages to a DECODE worker, where the request
+enters continuous batching with its page table rebuilt by pointer
+(`paged_kv.py` ingest-attach). The long-prompt admission therefore
+never runs on the worker holding in-flight decode streams — the
+TTFT-vs-TPOT interference the Gemma-on-TPU serving comparison removes
+by construction (PAPERS.md arXiv 2605.25645).
+
+Wire format (``HOROVOD_SERVE_KV_WIRE``): pages travel as block-scaled
+int8 by default — the PR 2 ``int8_block`` kernels, EQuARX-style
+placement (PAPERS.md arXiv 2506.17615) — at ~¼ the bytes of the pool
+dtype; ``fp32`` is the lossless pool-dtype passthrough (bit-identical
+decode to a unified worker — the parity gate tests/test_kv_transfer.py
+holds), ``bf16`` the middle ground. Quantization blocks never straddle
+a page (the block size divides the per-page element count), and the
+tail page's pad rows are zeroed BEFORE quantization — zeros never
+raise a block's absmax, so pad positions are excluded from the scales
+by construction.
+
+Transport: stdlib HTTP in the MetricsServer mold (no new
+dependencies). The decode worker runs a :class:`KVTransferServer` on
+``HOROVOD_SERVE_TRANSFER_PORT`` (announced through the capacity
+blobs):
+
+* ``POST /kv/reserve`` — capacity reservation BEFORE the sender spends
+  a prefill: pages are promised against the decode worker's admission
+  headroom with a TTL, so a crashed sender cannot leak them.
+* ``POST /kv/ingest`` — the framed page payload; admits the request
+  into the decode batcher and replies its id immediately (idempotent
+  by sender request id, so a retried stream cannot double-admit).
+* ``GET /kv/result`` — long-poll for the finished decode.
+
+The sender side (:class:`TransferCoordinator`, driven by the prefill
+batcher) picks the least-loaded announced decode worker, reserves,
+streams under a ``RetryPolicy`` with the ``serve.kv_transfer`` chaos
+site fired on every attempt, and on exhaustion FALLS BACK to decoding
+locally in unified mode (``serve.transfer_fallbacks``) — a transfer
+outage degrades to PR 11 behavior, it never errors the request.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.logging import get_logger
+from ..common.metrics import registry as _metrics
+from ..common.retry import RetryPolicy
+from ..testing import chaos as _chaos
+
+_log = get_logger("serve.kv_transfer")
+
+CHAOS_SITE = "serve.kv_transfer"
+WIRE_FORMATS = ("fp32", "bf16", "int8")
+# int8 block granularity cap: clamped DOWN to the per-page element
+# count so a scale never spans two pages (the per-page quantize
+# contract); pages bigger than this use the largest divisor <= cap.
+DEFAULT_WIRE_BLOCK = 512
+DEFAULT_RESERVATION_TTL_S = 30.0
+DEFAULT_RESULT_TIMEOUT_S = 300.0
+
+
+def wire_block_size(page_elems: int, cap: int = DEFAULT_WIRE_BLOCK) -> int:
+    """Largest block size <= ``cap`` that divides the per-page element
+    count — blocks tile pages exactly, so no scale mixes two pages'
+    dynamic ranges (and none mixes k with v or layer with layer: each
+    leaf is quantized separately)."""
+    if page_elems <= cap:
+        return page_elems
+    for b in range(cap, 0, -1):
+        if page_elems % b == 0:
+            return b
+    return 1
+
+
+def worker_role(ann: dict) -> str:
+    """The role a capacity announcement claims. Blobs from OLD workers
+    (rolling upgrade) carry no ``role`` field at all — they are unified
+    workers and MUST stay routable, so missing or unrecognized values
+    parse as ``"unified"`` (the Router regression test)."""
+    role = ann.get("role", "unified")
+    return role if role in ("prefill", "decode", "unified") else "unified"
+
+
+# ------------------------------------------------------------ pack/unpack
+
+
+def pack_pages(
+    engine, kept, length: int, *, wire: str = "int8", seed: int = 0,
+) -> Tuple[dict, bytes]:
+    """Serialize a detached slot's pages for the wire. Returns
+    ``(meta, blob)``: ``meta`` is the JSON-able frame header (wire
+    format, page geometry, per-leaf segment table), ``blob`` the
+    concatenated per-leaf payloads (int8 values + float32 block scales,
+    or raw bf16/pool-dtype bytes).
+
+    The device gather (``engine.extract_pages``) must already have
+    happened on the scheduler thread when this runs off-thread — pass
+    its result via ``raw=``; quantization itself is thread-safe (fresh
+    host arrays through jitted kernels)."""
+    return pack_raw_pages(
+        engine.extract_pages(kept, length),
+        [lp for lp, _ in kept], length,
+        page_tokens=engine.manager.page_tokens, wire=wire, seed=seed,
+    )
+
+
+def pack_raw_pages(
+    raw: List[np.ndarray], logical: List[int], length: int, *,
+    page_tokens: int, wire: str = "int8", seed: int = 0,
+) -> Tuple[dict, bytes]:
+    if wire not in WIRE_FORMATS:
+        raise ValueError(f"wire must be one of {WIRE_FORMATS}, got {wire!r}")
+    segments = []
+    parts: List[bytes] = []
+    for arr in raw:
+        seg: Dict[str, object] = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+        if wire == "int8":
+            from ..ops.pallas_kernels import int8_block_quantize
+
+            page_elems = int(np.prod(arr.shape[1:]))
+            block = wire_block_size(page_elems)
+            vals, scales = int8_block_quantize(
+                arr.astype(np.float32), block_size=block, seed=seed
+            )
+            vals = np.asarray(vals)
+            scales = np.asarray(scales, np.float32)
+            seg["block"] = block
+            seg["nscales"] = int(scales.size)
+            parts.append(vals.tobytes())
+            parts.append(scales.tobytes())
+        elif wire == "bf16":
+            import ml_dtypes
+
+            parts.append(arr.astype(ml_dtypes.bfloat16).tobytes())
+        else:  # fp32: lossless pool-dtype passthrough
+            parts.append(arr.tobytes())
+        segments.append(seg)
+    meta = {
+        "wire": wire,
+        "length": int(length),
+        "page_tokens": int(page_tokens),
+        "pages": [int(lp) for lp in logical],
+        "segments": segments,
+    }
+    return meta, b"".join(parts)
+
+
+def unpack_pages(meta: dict, blob: bytes) -> List[np.ndarray]:
+    """Inverse of :func:`pack_raw_pages`: per-leaf page payloads in the
+    pool dtype, pad rows exact zeros (zeros quantize and dequantize to
+    zeros — the pad-exclusion contract round-trips)."""
+    wire = meta["wire"]
+    out: List[np.ndarray] = []
+    off = 0
+    for seg in meta["segments"]:
+        shape = tuple(seg["shape"])
+        dtype = np.dtype(seg["dtype"])
+        n = int(np.prod(shape))
+        if wire == "int8":
+            from ..ops.pallas_kernels import int8_block_dequantize
+
+            vals = np.frombuffer(
+                blob, np.int8, count=n, offset=off
+            ).reshape(shape)
+            off += n
+            nscales = int(seg["nscales"])
+            scales = np.frombuffer(blob, np.float32, count=nscales,
+                                   offset=off)
+            off += nscales * 4
+            arr = np.asarray(int8_block_dequantize(
+                vals, scales, block_size=int(seg["block"]),
+            )).astype(dtype)
+        elif wire == "bf16":
+            import ml_dtypes
+
+            arr = np.frombuffer(
+                blob, ml_dtypes.bfloat16, count=n, offset=off
+            ).reshape(shape).astype(dtype)
+            off += 2 * n
+        else:
+            arr = np.frombuffer(
+                blob, dtype, count=n, offset=off
+            ).reshape(shape)
+            off += n * dtype.itemsize
+        out.append(arr)
+    return out
+
+
+def frame(meta: dict, blob: bytes) -> bytes:
+    """One HTTP body: 4-byte big-endian header length + JSON header +
+    raw payload."""
+    head = json.dumps(meta).encode()
+    return struct.pack(">I", len(head)) + head + blob
+
+
+def unframe(body: bytes) -> Tuple[dict, bytes]:
+    if len(body) < 4:
+        raise ValueError("transfer frame too short")
+    (hlen,) = struct.unpack(">I", body[:4])
+    if len(body) < 4 + hlen:
+        raise ValueError("transfer frame truncated")
+    meta = json.loads(body[4:4 + hlen].decode())
+    return meta, body[4 + hlen:]
+
+
+# -------------------------------------------------------- receiver (decode)
+
+
+class KVTransferServer:
+    """Decode-worker ingest endpoint: stdlib ThreadingHTTPServer (the
+    MetricsServer mold — no new dependencies) owning the reservation
+    ledger and the rid → request table. The HTTP threads only parse,
+    dequantize and enqueue — every device write happens on the
+    batcher's scheduler thread (ingest admission), preserving the
+    single-consumer contract the donated carry depends on."""
+
+    def __init__(
+        self,
+        batcher,
+        port: int = 0,
+        addr: str = "0.0.0.0",
+        reservation_ttl_s: float = DEFAULT_RESERVATION_TTL_S,
+    ) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.batcher = batcher
+        self._ttl = float(reservation_ttl_s)
+        self._lock = threading.Lock()
+        self._reservations: Dict[str, Tuple[int, float]] = {}
+        self._by_request: Dict[str, str] = {}  # sender request id -> rid
+        self._results: Dict[str, object] = {}  # rid -> batcher Request
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                _log.debug("kv_transfer http " + fmt, *args)
+
+            def _json(self, code, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                path = self.path.split("?", 1)[0]
+                if path == "/kv/reserve":
+                    return self._json(*outer._handle_reserve(body))
+                if path == "/kv/ingest":
+                    return self._json(*outer._handle_ingest(body))
+                return self._json(404, {"error": "not found"})
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                if path == "/kv/result":
+                    params = dict(
+                        kv.split("=", 1)
+                        for kv in query.split("&") if "=" in kv
+                    )
+                    return self._json(*outer._handle_result(params))
+                return self._json(404, {"error": "not found"})
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((addr, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="hvd-kv-transfer", daemon=True,
+            )
+            self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------ reservations
+
+    def reserved_pages(self) -> int:
+        """Unexpired reserved pages — debited from the announced
+        capacity so two senders can't both be promised the same
+        headroom between announce refreshes."""
+        now = time.monotonic()
+        with self._lock:
+            for rid in [
+                r for r, (_, exp) in self._reservations.items()
+                if exp < now
+            ]:
+                del self._reservations[rid]
+            return sum(p for p, _ in self._reservations.values())
+
+    def _handle_reserve(self, body: bytes):
+        try:
+            payload = json.loads(body or b"{}")
+            pages = int(payload["pages"])
+        except (ValueError, KeyError):
+            return 400, {"error": "bad reserve request"}
+        if self.batcher.draining:
+            return 503, {"error": "draining"}
+        mgr = self.batcher.engine.manager
+        headroom = mgr.admission_headroom() - self.reserved_pages()
+        if pages > headroom:
+            _metrics.counter("serve.transfer_reserve_denied")
+            return 503, {"error": "no decode capacity", "free": headroom}
+        rid = uuid.uuid4().hex
+        with self._lock:
+            self._reservations[rid] = (
+                pages, time.monotonic() + self._ttl
+            )
+        _metrics.counter("serve.transfer_reservations")
+        return 200, {"reservation": rid, "pages": pages}
+
+    # ----------------------------------------------------------------- ingest
+
+    def _handle_ingest(self, body: bytes):
+        try:
+            meta, blob = unframe(body)
+        except (ValueError, json.JSONDecodeError) as e:
+            return 400, {"error": f"bad transfer frame: {e}"}
+        request_id = str(meta.get("request_id", ""))
+        with self._lock:
+            rid = self._by_request.get(request_id)
+            if rid is not None:
+                # retried stream after a mid-flight reset: the first
+                # frame already admitted — idempotent, never twice
+                return 200, {"rid": rid, "duplicate": True}
+            if meta.get("reservation"):
+                self._reservations.pop(meta["reservation"], None)
+        if self.batcher.draining:
+            return 503, {"error": "draining"}
+        try:
+            arrays = unpack_pages(meta, blob)
+            req = self.batcher.submit_ingested(
+                prompt=meta.get("prompt", ()),
+                first_token=int(meta["first_token"]),
+                max_new_tokens=int(meta["max_new_tokens"]),
+                deadline_ms=meta.get("deadline_ms"),
+                logical=meta["pages"],
+                arrays=arrays,
+                length=int(meta["length"]),
+                hashes=[bytes.fromhex(h) for h in meta.get("hashes", ())],
+            )
+        except Exception as e:  # Rejected, malformed frames
+            _log.warning("kv transfer ingest rejected: %s", e)
+            return 503, {"error": str(e)}
+        rid = uuid.uuid4().hex
+        with self._lock:
+            if request_id:
+                self._by_request[request_id] = rid
+            self._results[rid] = req
+        _metrics.counter("serve.kv_transfer_bytes_in", len(body))
+        _metrics.counter("serve.kv_transfer_pages_in", len(meta["pages"]))
+        return 200, {"rid": rid}
+
+    def _handle_result(self, params: dict):
+        rid = params.get("rid", "")
+        with self._lock:
+            req = self._results.get(rid)
+        if req is None:
+            return 404, {"error": f"unknown rid {rid!r}"}
+        timeout = float(params.get("timeout", 30.0))
+        if not req.wait(timeout=timeout):
+            return 202, {"done": False}
+        with self._lock:
+            self._results.pop(rid, None)
+            for k, v in list(self._by_request.items()):
+                if v == rid:
+                    del self._by_request[k]
+        return 200, dict(req.result(), done=True)
+
+
+# --------------------------------------------------------- sender (prefill)
+
+
+class TransferCoordinator:
+    """Prefill-worker side: decode-target selection, capacity
+    reservation BEFORE the prefill runs, and the retried page stream.
+    Driven by the batcher's scheduler thread (reserve, page extraction)
+    plus one short-lived handoff thread per streamed request (the
+    quantize + HTTP leg — no device state crosses the boundary)."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        client=None,
+        client_factory=None,
+        wire: Optional[str] = None,
+        retry: Optional[RetryPolicy] = None,
+        reserve_timeout_s: float = 5.0,
+        result_timeout_s: float = DEFAULT_RESULT_TIMEOUT_S,
+    ) -> None:
+        from ..common import basics
+
+        cfg = basics.live_config()
+        self.engine = engine
+        self.wire = cfg.serve_kv_wire if wire is None else str(wire)
+        if self.wire not in WIRE_FORMATS:
+            raise ValueError(
+                f"kv wire must be one of {WIRE_FORMATS}, got {self.wire!r}"
+            )
+        self._client = client
+        self._client_factory = client_factory
+        self._retry = retry or RetryPolicy.from_env(CHAOS_SITE)
+        self._reserve_timeout = float(reserve_timeout_s)
+        self._result_timeout = float(result_timeout_s)
+        self._lock = threading.Lock()
+        # local in-flight debits per decode rank (reserved pages not
+        # yet reflected in the target's announcements) — the Router's
+        # debit idea applied to the transfer plane
+        self._debits: Dict[int, int] = {}
+
+    # ------------------------------------------------------------- targets
+
+    def _resolve_client(self):
+        if self._client is None and self._client_factory is not None:
+            self._client = self._client_factory()
+        return self._client
+
+    def decode_targets(self, exclude=()) -> List[dict]:
+        """Announced decode workers, least-loaded first (announced page
+        headroom minus local reservation debits)."""
+        from .frontend import read_announcements
+
+        client = self._resolve_client()
+        if client is None:
+            return []
+        try:
+            anns = read_announcements(client)
+        except (OSError, RuntimeError):
+            return []
+        with self._lock:
+            debits = dict(self._debits)
+
+        def load(item):
+            rank, ann = item
+            free = int(ann.get("free_pages", ann.get("free_slots", 0)))
+            return (-(free - debits.get(rank, 0)), rank)
+
+        return [
+            dict(ann, rank=rank)
+            for rank, ann in sorted(anns.items(), key=load)
+            if worker_role(ann) == "decode"
+            and not ann.get("draining")
+            and ann.get("transfer_port")
+            and rank not in exclude
+        ]
+
+    # ------------------------------------------------------------- reserve
+
+    def reserve(self, pages: int) -> Optional[dict]:
+        """Reserve ``pages`` on the best decode worker, failing over
+        across candidates in-call; None when NO decode capacity exists
+        anywhere — the sender's cue to take the unified/local path."""
+        import urllib.error
+        import urllib.request
+
+        failed: set = set()
+        for _ in range(4):
+            targets = self.decode_targets(exclude=failed)
+            if not targets:
+                return None
+            ann = targets[0]
+            url = (
+                f"http://{ann.get('addr', '127.0.0.1')}"
+                f":{ann['transfer_port']}/kv/reserve"
+            )
+            body = json.dumps({"pages": int(pages)}).encode()
+            try:
+                req = urllib.request.Request(
+                    url, data=body, method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self._reserve_timeout
+                ) as resp:
+                    out = json.loads(resp.read().decode())
+            except (OSError, ValueError, urllib.error.HTTPError) as e:
+                _log.debug(
+                    "reserve on rank %s failed: %s", ann.get("rank"), e
+                )
+                failed.add(ann["rank"])
+                continue
+            with self._lock:
+                self._debits[ann["rank"]] = (
+                    self._debits.get(ann["rank"], 0) + int(pages)
+                )
+            return {
+                "rank": ann["rank"],
+                "addr": ann.get("addr", "127.0.0.1"),
+                "port": int(ann["transfer_port"]),
+                "rid": out["reservation"],
+                "pages": int(pages),
+            }
+        return None
+
+    def _credit(self, reservation: dict) -> None:
+        with self._lock:
+            rank = reservation["rank"]
+            left = self._debits.get(rank, 0) - reservation["pages"]
+            if left > 0:
+                self._debits[rank] = left
+            else:
+                self._debits.pop(rank, None)
+
+    # -------------------------------------------------------------- handoff
+
+    def start_handoff(
+        self, batcher, req, kept, length: int, reservation: dict,
+    ) -> None:
+        """Scheduler-thread entry: gather the pages to host NOW (fresh
+        buffers — nothing the executables' donated carry can invalidate
+        later), then stream + await the decode result off-thread."""
+        raw = self.engine.extract_pages(kept, length)
+        threading.Thread(
+            target=self._stream,
+            args=(batcher, req, kept, length, reservation, raw),
+            name=f"hvd-kv-handoff-{req.id}",
+            daemon=True,
+        ).start()
+
+    def _post(self, url: str, body: bytes, timeout: float) -> dict:
+        """One chaos-instrumented HTTP attempt (the RetryPolicy's unit
+        of work): 5xx and transport faults raise — retryable; 4xx is
+        the frame's own fault and surfaces immediately."""
+        import urllib.error
+        import urllib.request
+
+        try:
+            _chaos.inject(CHAOS_SITE)
+        except _chaos.InjectedServerError:
+            raise  # retryable=True already
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code == 429 or 500 <= e.code <= 599:
+                raise OSError(f"transfer target HTTP {e.code}") from e
+            raise RuntimeError(
+                f"transfer rejected (HTTP {e.code})"
+            ) from e
+
+    def _stream(self, batcher, req, kept, length, reservation, raw):
+        base = f"http://{reservation['addr']}:{reservation['port']}"
+        t0 = time.perf_counter()
+        try:
+            meta, blob = pack_raw_pages(
+                raw, [lp for lp, _ in kept], length,
+                page_tokens=self.engine.manager.page_tokens,
+                wire=self.wire, seed=req.id,
+            )
+            from .paged_kv import page_hashes
+
+            remaining_ms = None
+            if req.deadline_ts is not None:
+                remaining_ms = max(
+                    (req.deadline_ts - time.monotonic()) * 1e3, 1.0
+                )
+            meta.update(
+                request_id=f"{id(self)}-{req.id}",
+                reservation=reservation["rid"],
+                prompt=[int(t) for t in req.prompt],
+                first_token=int(req.out_tokens[-1]),
+                max_new_tokens=int(req.max_new_tokens),
+                deadline_ms=remaining_ms,
+                hashes=[
+                    h.hex() for h in page_hashes(
+                        req.prompt, self.engine.manager.page_tokens
+                    )
+                ],
+            )
+            body = frame(meta, blob)
+            out = self._retry.call(
+                self._post, base + "/kv/ingest", body,
+                self._retry.attempt_timeout_s, peer=base,
+            )
+            transfer_ms = (time.perf_counter() - t0) * 1e3
+            _metrics.counter("serve.kv_transfer_bytes", len(body))
+            _metrics.counter("serve.kv_transfer_pages", len(kept))
+            _metrics.counter("serve.kv_transfer_ms", transfer_ms)
+            _metrics.counter("serve.transfers")
+            result = self._await_result(base, out["rid"], req)
+        except Exception as e:  # noqa: BLE001 — any wire failure falls back
+            _log.warning(
+                "kv transfer of request %d to rank %s failed (%s); "
+                "falling back to local decode", req.id,
+                reservation.get("rank"), e,
+            )
+            self._credit(reservation)
+            batcher.requeue_fallback(req, kept, length)
+            return
+        self._credit(reservation)
+        if result.get("status") not in ("done", "deadline"):
+            _log.warning(
+                "decode worker returned status %r for request %d; "
+                "falling back to local decode",
+                result.get("status"), req.id,
+            )
+            batcher.requeue_fallback(req, kept, length)
+            return
+        # remote decode finished: the local page holds are no longer
+        # needed (the prefix index may still pin published pages)
+        self.engine.manager.release_kept(kept)
+        batcher.complete_handoff(req, result)
+
+    def _await_result(self, base: str, rid: str, req) -> dict:
+        """Long-poll the decode result. Idempotent by construction, so
+        transport faults simply re-poll until the coordinator-level
+        deadline."""
+        import urllib.request
+
+        deadline = time.monotonic() + self._result_timeout
+        poll = f"{base}/kv/result?rid={rid}&timeout=30"
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(poll, timeout=45) as resp:
+                    out = json.loads(resp.read().decode())
+            except (OSError, ValueError) as e:
+                last = e
+                time.sleep(0.2)
+                continue
+            if out.get("done"):
+                return out
+        raise TimeoutError(
+            f"decode result for rid {rid} never arrived: {last}"
+        )

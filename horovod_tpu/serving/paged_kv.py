@@ -364,6 +364,56 @@ class PagedKVCacheManager(KVCacheManager):
                 self._ref[phys] += 1
                 self._counters["prefix_published"] += 1
 
+    # ------------------------------------------------ transfer-ingest surface
+
+    def ingest_alloc(self, count: int) -> Optional[List[int]]:
+        """Allocate ``count`` pool pages for a KV transfer ingest
+        (serving/kv_transfer.py), each with ONE caller-held refcount —
+        the same convention as :meth:`detach_keep`'s kept pages, so the
+        ingested pages slot straight into :meth:`reattach`. All-or-
+        nothing: on a dry pool every page allocated so far goes back
+        and None is returned (the sender's cue to fall back)."""
+        got: List[int] = []
+        with self._lock:
+            for _ in range(int(count)):
+                page = self._alloc_page_locked()
+                if page is None:
+                    for p in got:
+                        self._ref[p] = 0
+                        self._free.append(p)
+                    return None
+                self._ref[page] = 1
+                got.append(page)
+            self._counters["page_allocs"] += len(got)
+            self._counters["transfer_pages_in"] += len(got)
+        self._publish()
+        return got
+
+    def publish_hashes(
+        self, kept: List[Tuple[int, int]], hashes: List[bytes]
+    ) -> None:
+        """Warm the prefix index from TRANSFERRED pages: the sender's
+        chained page hashes travel with the payload, so the decode
+        worker's cache serves future shared-prefix admissions without
+        ever having prefilled them. Only full prompt pages carry a
+        hash (``hashes[lp]``); the partial tail page is skipped by
+        construction. First publisher wins, same as
+        :meth:`publish_prefix`."""
+        if not self.prefix_cache_enabled:
+            return
+        with self._lock:
+            for lp, phys in kept:
+                if lp >= len(hashes):
+                    continue
+                h = hashes[lp]
+                if h in self._index:
+                    continue
+                self._index[h] = phys
+                self._index.move_to_end(h)
+                self._page_hash[phys] = h
+                self._ref[phys] += 1
+                self._counters["prefix_published"] += 1
+
     # ------------------------------------------------- pause/resume surface
 
     def detach_keep(self, slot: int) -> Tuple[List[Tuple[int, int]], int]:

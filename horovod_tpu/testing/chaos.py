@@ -32,6 +32,12 @@ site                      where it fires
                           the RetryPolicy, exhaustion DEFERS the round
                           — ``local_sgd.rounds_deferred`` — instead of
                           stalling or restarting the gang)
+``serve.kv_transfer``     each HTTP attempt of a disaggregated-fleet
+                          KV-page stream (serving/kv_transfer.py;
+                          transport faults retry under the RetryPolicy,
+                          exhaustion falls the request back to LOCAL
+                          decode — ``serve.transfer_fallbacks`` — never
+                          a client-visible 500)
 ========================  ====================================================
 
 Sites the library doesn't own (a bench/smoke script's training loop)

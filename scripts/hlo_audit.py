@@ -354,7 +354,7 @@ def prog_moe_alltoall():
     ]
 
 
-def _serve_engine(paged):
+def _serve_engine(paged, role="unified"):
     from horovod_tpu.models.transformer import Transformer, TransformerConfig
     from horovod_tpu.serving.engine import InferenceEngine
 
@@ -368,7 +368,7 @@ def _serve_engine(paged):
     )
     return InferenceEngine(
         model, params, slots=4, max_len=64, min_bucket=4,
-        donate=True, paged=paged,
+        donate=True, paged=paged, role=role,
     )
 
 
@@ -497,6 +497,85 @@ def prog_serve_prefill():
     return pairs
 
 
+def prog_serve_prefill_role():
+    """PR 16: a prefill-role worker's executable table carries ONLY
+    prefill executables. Finished pages leave over the transfer wire
+    (serving/kv_transfer.py) before any decode step runs, so after a
+    full prefill-and-extract workload ``decode_compiles == 0`` — the
+    decode table's compile time and executable HBM are never paid.
+    The prefill carry stays donated and the bucket tier still serves
+    multiple lengths from one executable, exactly as on unified."""
+    eng = _serve_engine(paged=True, role="prefill")
+    g = analysis.parse_module(eng.lowered_prefill(8))
+    n_cache = len(jax.tree_util.tree_leaves(eng.manager.cache))
+    pairs = [
+        (rules.DonationCoverage(min_donated=n_cache), g),
+    ]
+    rng = np.random.default_rng(5)
+    for i, n in enumerate((5, 6, 7, 8)):
+        slot = eng.manager.alloc(i)
+        eng.prefill(slot, rng.integers(1, 60, size=n).tolist())
+        # the handoff path: detach the finished slot, gather its pages
+        # to host for the wire — no decode executable involved
+        kept, length = eng.manager.detach_keep(slot)
+        eng.extract_pages(kept, length)
+        eng.manager.release_kept(kept)
+    stats = eng.stats()
+    pairs.append(
+        (rules.CompileBudget(decode_compiles=0, prefill_compiles=1),
+         stats)
+    )
+    return pairs
+
+
+def prog_serve_decode_role():
+    """PR 16: a decode-role worker admits sequences as INGESTED pages
+    (serving/kv_transfer.py), never as prompts — its table carries only
+    the decode executable, the decode carry stays donated, and rolling
+    streamed admissions change data, never shapes: the executable
+    compiled for the first ingest serves every later one
+    (``decode_compiles == 1``, ``prefill_compiles == 0``)."""
+    from horovod_tpu.serving.kv_transfer import pack_raw_pages, unpack_pages
+
+    # a unified source engine plays the prefill fleet: prefill, detach,
+    # extract — then the payload crosses the (in-process) wire into the
+    # decode-role engine via the same pack/unpack codec the fleet uses
+    src = _serve_engine(paged=True, role="unified")
+    eng = _serve_engine(paged=True, role="decode")
+    g = analysis.parse_module(eng.lowered_decode())
+    n_cache = len(jax.tree_util.tree_leaves(eng.manager.cache))
+    pairs = [
+        (rules.DonationCoverage(min_donated=n_cache), g),
+    ]
+    rng = np.random.default_rng(6)
+    pt = src.manager.page_tokens
+    for i in range(3):  # >=3 streamed admissions across decode steps
+        prompt = rng.integers(1, 60, size=5 + i).tolist()
+        slot = src.manager.alloc(f"src{i}")
+        src.prefill(slot, prompt)
+        kept, length = src.manager.detach_keep(slot)
+        raw = src.extract_pages(kept, length)
+        meta, blob = pack_raw_pages(
+            raw, [lp for lp, _ in kept], length,
+            page_tokens=pt, wire="fp32",
+        )
+        arrays = unpack_pages(meta, blob)
+        dslot = eng.manager.alloc(f"dst{i}")
+        assert eng.ingest_attach(
+            dslot, meta["pages"], arrays, meta["length"]
+        ) is not None
+        src.manager.release_kept(kept)
+        eng.decode_step(np.zeros(eng.slots, np.int32))
+        eng.decode_step(np.zeros(eng.slots, np.int32))
+    stats = eng.stats()
+    pairs.append(
+        (rules.CompileBudget(
+            decode_compiles=1, prefill_compiles=0, transfer_ingests=3),
+         stats)
+    )
+    return pairs
+
+
 ROSTER = {
     "fused_allreduce_fp32": prog_fused_allreduce_fp32,
     "fused_allreduce_int8": prog_fused_allreduce_int8,
@@ -511,6 +590,8 @@ ROSTER = {
     "local_sgd_phase": prog_local_sgd_phase,
     "serve_decode": prog_serve_decode,
     "serve_prefill": prog_serve_prefill,
+    "serve_prefill_role": prog_serve_prefill_role,
+    "serve_decode_role": prog_serve_decode_role,
 }
 
 

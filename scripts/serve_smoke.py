@@ -14,9 +14,18 @@ capacity announcements against a driver-hosted RendezvousServer), then:
    ONE worker and asserts ``hvd_serve_prefix_hits`` > 0 on its live
    ``/metrics`` scrape — the paged memory plane's prefix cache can't
    silently rot;
-4. fires a burst of in-flight requests, SIGTERMs both workers
-   mid-service, and asserts the drain contract: every ACCEPTED request
-   completes with its full token budget, both workers exit 143.
+4. stands up a SECOND, role-split fleet (1 prefill + 2 decode workers,
+   ``HOROVOD_SERVE_ROLE`` via env, own rendezvous KV): a routed burst
+   must land every prompt on the prefill worker, stream its finished
+   KV pages over the transfer wire (``hvd_serve_kv_transfer_pages`` >
+   0 on the prefill worker's live scrape, transfer admits spread over
+   BOTH decode workers), then one decode worker is SIGTERMed
+   mid-burst — reservations fail over, every accepted request still
+   completes, the killed worker exits 143;
+5. fires a burst of in-flight requests at the unified fleet, SIGTERMs
+   both workers mid-service, and asserts the drain contract: every
+   ACCEPTED request completes with its full token budget, both
+   workers exit 143.
 
 Exit 0 on success; any assertion failure is a CI failure.
 """
@@ -74,6 +83,14 @@ def _get_json(url, timeout=10):
 def _get_text(url, timeout=10):
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read().decode()
+
+
+def _scrape_counter(port, name):
+    """One ``hvd_*`` gauge/counter value off a live /metrics scrape."""
+    for line in _get_text(f"http://127.0.0.1:{port}/metrics").splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return 0.0
 
 
 def main() -> int:
@@ -220,7 +237,159 @@ def main() -> int:
         print(f"phase 2.5 OK: shared-prefix burst hit the prefix cache "
               f"({int(hits)} pages attached)")
 
-        # ---- phase 3: SIGTERM drain — every accepted request finishes
+        # ---- phase 3: role-split fleet — prefill/decode disaggregation
+        # (own rendezvous KV so unified announcements can't leak in)
+        server2 = RendezvousServer()
+        port2 = server2.start()
+        env2 = dict(env)
+        env2["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port2)
+        roles = {0: "prefill", 1: "decode", 2: "decode"}
+        fleet = {}
+        try:
+            for rank, role in roles.items():
+                wenv = dict(
+                    env2, HOROVOD_RANK=str(rank), HOROVOD_SERVE_ROLE=role,
+                )
+                fleet[rank] = subprocess.Popen(
+                    [sys.executable, script],
+                    env=wenv,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            fports = {}
+            for rank, proc in fleet.items():
+                line = proc.stdout.readline()
+                assert "SERVING" in line, (
+                    f"{roles[rank]} worker {rank} failed to start: "
+                    f"{line!r}\n{proc.stderr.read()[-2000:]}"
+                )
+                fports[rank] = int(line.split()[1])
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                anns = read_announcements(server2.store)
+                if set(anns) >= set(roles):
+                    break
+                time.sleep(0.05)
+            anns = read_announcements(server2.store)
+            assert set(anns) >= set(roles), f"fleet missing: {anns}"
+            for rank, role in roles.items():
+                assert anns[rank].get("role") == role, (rank, anns[rank])
+            assert all(
+                anns[r].get("transfer_port") for r in (1, 2)
+            ), anns
+
+            router2 = Router(server2.store)
+            dis_prompts = [
+                [3 + i, 5, 7, 11, 13, 17][: 3 + i % 4]
+                for i in range(8)
+            ]
+            dis_results = [None] * len(dis_prompts)
+
+            def dis_one(i):
+                dis_results[i] = router2.route(
+                    dis_prompts[i], max_tokens=GEN_TOKENS, timeout=120
+                )
+
+            dthreads = [
+                threading.Thread(target=dis_one, args=(i,))
+                for i in range(len(dis_prompts))
+            ]
+            for t in dthreads:
+                t.start()
+            for t in dthreads:
+                t.join(timeout=180)
+            for i, res in enumerate(dis_results):
+                assert res is not None, f"disagg request {i} never done"
+                assert res["status"] == "done", res
+                assert len(res["tokens"]) == GEN_TOKENS, res
+            # per-role routing on the LIVE scrapes: every prompt hit
+            # the prefill worker, its pages left over the wire, and
+            # the streamed admissions spread across BOTH decode workers
+            # (engine stats publish on an interval — poll, don't race)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if _scrape_counter(
+                    fports[0], "hvd_serve_prefills"
+                ) >= len(dis_prompts):
+                    break
+                time.sleep(0.1)
+            assert _scrape_counter(
+                fports[0], "hvd_serve_prefills"
+            ) >= len(dis_prompts), "prompts leaked past the prefill worker"
+            pages_out = _scrape_counter(
+                fports[0], "hvd_serve_kv_transfer_pages"
+            )
+            assert pages_out > 0, (
+                "prefill worker streamed no KV pages:\n" + "\n".join(
+                    ln for ln in _get_text(
+                        f"http://127.0.0.1:{fports[0]}/metrics"
+                    ).splitlines() if "transfer" in ln
+                )
+            )
+            admits = {
+                r: _scrape_counter(fports[r], "hvd_serve_transfer_admits")
+                for r in (1, 2)
+            }
+            assert all(v > 0 for v in admits.values()), (
+                f"streamed admissions did not spread: {admits}"
+            )
+            for r in (1, 2):
+                assert _scrape_counter(
+                    fports[r], "hvd_serve_prefills"
+                ) == 0, f"decode worker {r} ran a prefill"
+            print(f"phase 3 OK: {len(dis_prompts)} disagg completions, "
+                  f"{int(pages_out)} pages streamed, "
+                  f"decode spread {admits}")
+
+            # mid-burst decode-worker death: reservations fail over,
+            # every accepted request still completes
+            kill_results = [None] * 6
+
+            def kill_one(i):
+                kill_results[i] = router2.route(
+                    [2 + i, 4, 6, 8][: 2 + i % 3],
+                    max_tokens=GEN_TOKENS, timeout=120,
+                )
+
+            kthreads = [
+                threading.Thread(target=kill_one, args=(i,))
+                for i in range(len(kill_results))
+            ]
+            for t in kthreads:
+                t.start()
+            # SIGTERM a decode worker once the burst is in flight on
+            # the prefill side (accepted = occupied slots + queue)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                h = _get_json(f"http://127.0.0.1:{fports[0]}/healthz")
+                if h["slots_total"] - h["free_slots"] + h["queue_depth"]:
+                    break
+                time.sleep(0.01)
+            fleet[1].send_signal(signal.SIGTERM)
+            for t in kthreads:
+                t.join(timeout=180)
+            for i, res in enumerate(kill_results):
+                assert res is not None, f"failover request {i} lost"
+                assert res["status"] == "done", res
+                assert len(res["tokens"]) == GEN_TOKENS, res
+            assert fleet[1].wait(timeout=120) == 143, (
+                "SIGTERMed decode worker did not drain-exit 143"
+            )
+            print(f"phase 3 OK: decode worker SIGTERM mid-burst, "
+                  f"{len(kill_results)}/{len(kill_results)} completions "
+                  f"after failover")
+            for rank in (0, 2):
+                fleet[rank].send_signal(signal.SIGTERM)
+            rcs2 = [fleet[r].wait(timeout=120) for r in (0, 2)]
+            assert rcs2 == [143, 143], f"fleet exit codes: {rcs2}"
+        finally:
+            for proc in fleet.values():
+                if proc.poll() is None:
+                    proc.kill()
+            server2.stop()
+
+        # ---- phase 4: SIGTERM drain — every accepted request finishes
         burst = [[5, 6], [7, 8, 9], [1] * 12, [2, 3, 4, 5]]
         burst_results = [None] * len(burst)
 
@@ -267,7 +436,7 @@ def main() -> int:
             assert len(res["tokens"]) == BURST_TOKENS, res
         rcs = [proc.wait(timeout=120) for proc in procs]
         assert rcs == [143, 143], f"worker exit codes: {rcs}"
-        print(f"phase 3 OK: drain completed {len(burst)}/{len(burst)} "
+        print(f"phase 4 OK: drain completed {len(burst)}/{len(burst)} "
               f"in-flight requests, workers exited {rcs}")
         print("serve-smoke OK")
         return 0

@@ -1,0 +1,685 @@
+"""Disaggregated prefill/decode tests (serving/kv_transfer.py): wire
+codec round-trips (fp32 bit-exact, int8 bounded, pad exclusion),
+fp32-wire bit-parity of transferred decode vs a unified worker (incl.
+RoPE/GQA and staggered multi-slot), the int8 divergence/greedy-match
+gate, role-gated compile counts (decode_compiles==1 on decode workers
+across streamed admissions, 0 on pure-prefill), chaos-injected
+mid-transfer resets absorbed by the RetryPolicy, exhaustion falling
+back to local decode with zero client-visible 500s, and the Router's
+role split including the mixed-version (missing ``role``) regression.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.common.metrics import registry as _metrics
+from horovod_tpu.common.retry import RetryPolicy, _reset_breakers
+from horovod_tpu.testing import chaos
+
+
+def _cfg(**kw):
+    from horovod_tpu.models.transformer import TransformerConfig
+
+    base = dict(
+        vocab_size=61,
+        num_layers=1,
+        d_model=16,
+        num_heads=2,
+        d_ff=32,
+        max_len=64,
+        causal=True,
+        dtype=jnp.float32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _toy(**cfg_kw):
+    from horovod_tpu.models.transformer import Transformer
+
+    model = Transformer(_cfg(**cfg_kw))
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), train=False
+    )
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def toy():
+    return _toy()
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    chaos.reset()
+    _reset_breakers()
+    yield
+    chaos.reset()
+    _reset_breakers()
+
+
+def _engine(model, params, role="unified", **kw):
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("paged", True)
+    return InferenceEngine(model, params, role=role, **kw)
+
+
+def _batcher(engine, role="unified", **kw):
+    from horovod_tpu.serving.batcher import ContinuousBatcher
+
+    kw.setdefault("default_max_new_tokens", 8)
+    return ContinuousBatcher(engine, role=role, **kw)
+
+
+def _unified_tokens(model, params, prompt, n, **engine_kw):
+    """Reference: the same prompt decoded end-to-end on one worker."""
+    bat = _batcher(_engine(model, params, **engine_kw))
+    req = bat.submit(prompt, max_new_tokens=n)
+    while not req.finished():
+        bat.step()
+    assert req.status == "done"
+    return req.result()["tokens"]
+
+
+class _FakeAnnounceClient:
+    """Serve-scope announcement reader over a dict — what the
+    TransferCoordinator sees instead of a live rendezvous KV."""
+
+    def __init__(self, anns):
+        self.anns = dict(anns)
+
+    def keys(self, scope):
+        return [str(r) for r in self.anns]
+
+    def get(self, scope, key):
+        return json.dumps(self.anns[int(key)]).encode()
+
+
+def _decode_ann(rank, transfer_port, free_pages=100, **extra):
+    ann = {
+        "port": 1,
+        "addr": "127.0.0.1",
+        "role": "decode",
+        "transfer_port": transfer_port,
+        "free_pages": free_pages,
+        "free_slots": 4,
+        "ts": time.time(),
+    }
+    ann.update(extra)
+    return ann
+
+
+def _fleet(model, params, wire="fp32", retry=None, decode_kw=None,
+           prefill_kw=None):
+    """One prefill + one decode worker wired through a real
+    KVTransferServer on localhost. Returns (pbat, dbat, server,
+    coordinator); caller stops server/dbat."""
+    from horovod_tpu.serving.kv_transfer import (
+        KVTransferServer,
+        TransferCoordinator,
+    )
+
+    deng = _engine(model, params, role="decode", **(decode_kw or {}))
+    dbat = _batcher(deng, role="decode")
+    server = KVTransferServer(dbat, port=0, addr="127.0.0.1")
+    server.start()
+    peng = _engine(model, params, role="prefill", **(prefill_kw or {}))
+    pbat = _batcher(peng, role="prefill")
+    coord = TransferCoordinator(
+        peng,
+        client=_FakeAnnounceClient({0: _decode_ann(0, server.port)}),
+        wire=wire,
+        retry=retry,
+    )
+    pbat.transfer = coord
+    dbat.start()
+    return pbat, dbat, server, coord
+
+
+def _pump(pbat, reqs, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while (
+        not all(r.finished() for r in reqs)
+        and time.monotonic() < deadline
+    ):
+        pbat.step()
+        time.sleep(0.005)
+    assert all(r.finished() for r in reqs), "transfer never completed"
+
+
+# ---------------------------------------------------------------- codec
+
+
+def test_fp32_wire_roundtrip_bit_exact():
+    from horovod_tpu.serving.kv_transfer import (
+        frame,
+        pack_raw_pages,
+        unframe,
+        unpack_pages,
+    )
+
+    rng = np.random.default_rng(0)
+    raw = [
+        rng.standard_normal((3, 8, 2, 4)).astype(np.float32)
+        for _ in range(2)
+    ]
+    meta, blob = pack_raw_pages(
+        raw, [0, 1, 2], length=20, page_tokens=8, wire="fp32"
+    )
+    meta2, blob2 = unframe(frame(meta, blob))
+    assert meta2 == meta
+    out = unpack_pages(meta2, blob2)
+    for a, b in zip(raw, out):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+def test_int8_wire_bounded_error_and_pad_exclusion():
+    from horovod_tpu.serving.kv_transfer import (
+        pack_raw_pages,
+        unpack_pages,
+        wire_block_size,
+    )
+
+    rng = np.random.default_rng(1)
+    page = rng.standard_normal((4, 8, 2, 4)).astype(np.float32)
+    # tail page: only the first 3 token rows valid, rest zero (pad) —
+    # plus a huge valid value so a pad-inclusive scale would be obvious
+    page[-1, 3:] = 0.0
+    page[0, 0, 0, 0] = 50.0
+    meta, blob = pack_raw_pages(
+        [page], [0, 1, 2, 3], length=27, page_tokens=8, wire="int8"
+    )
+    (out,) = unpack_pages(meta, blob)
+    block = wire_block_size(int(np.prod(page.shape[1:])))
+    # per-block bound: |err| <= scale/2 + stochastic rounding, scale =
+    # blockmax/127 — check against the loose 2*blockmax/127 envelope
+    flat_in = page.reshape(page.shape[0], -1)
+    flat_out = out.reshape(out.shape[0], -1)
+    for p in range(page.shape[0]):
+        for b0 in range(0, flat_in.shape[1], block):
+            seg_in = flat_in[p, b0:b0 + block]
+            seg_out = flat_out[p, b0:b0 + block]
+            bound = 2.0 * np.abs(seg_in).max() / 127.0 + 1e-6
+            assert np.abs(seg_in - seg_out).max() <= bound
+    # pad rows are exact zeros on the far side — zero never raises a
+    # block absmax, so pads are excluded from scales by construction
+    np.testing.assert_array_equal(out[-1, 3:], 0.0)
+
+
+def test_int8_wire_is_smaller_than_fp32():
+    from horovod_tpu.serving.kv_transfer import frame, pack_raw_pages
+
+    rng = np.random.default_rng(2)
+    # realistic page volume (the toy tests above keep pages tiny, but
+    # the byte-ratio claim is about real payloads where the JSON meta
+    # is noise): 8 KiB of fp32 per page per leaf
+    raw = [
+        rng.standard_normal((6, 8, 8, 32)).astype(np.float32)
+        for _ in range(2)
+    ]
+    sizes = {}
+    for wire in ("fp32", "int8"):
+        meta, blob = pack_raw_pages(
+            raw, list(range(6)), length=48, page_tokens=8, wire=wire
+        )
+        sizes[wire] = len(frame(meta, blob))
+    assert sizes["fp32"] / sizes["int8"] >= 3.5
+
+
+def test_bf16_wire_roundtrip():
+    from horovod_tpu.serving.kv_transfer import (
+        pack_raw_pages,
+        unpack_pages,
+    )
+
+    raw = [np.linspace(-2, 2, 64, dtype=np.float32).reshape(1, 8, 2, 4)]
+    meta, blob = pack_raw_pages(
+        raw, [0], length=8, page_tokens=8, wire="bf16"
+    )
+    (out,) = unpack_pages(meta, blob)
+    assert out.dtype == np.float32
+    assert np.abs(out - raw[0]).max() <= 0.02  # bf16 mantissa
+
+
+def test_wire_block_size_never_straddles_pages():
+    from horovod_tpu.serving.kv_transfer import wire_block_size
+
+    for elems in (64, 500, 512, 513, 1024, 4096):
+        b = wire_block_size(elems)
+        assert elems % b == 0
+        assert b <= max(512, 1)
+
+
+# ------------------------------------------------------------ bit parity
+
+
+def test_fp32_transfer_bit_parity_with_unified(toy):
+    model, params = toy
+    prompt = list(range(1, 11))
+    ref = _unified_tokens(model, params, prompt, 8)
+    pbat, dbat, server, _ = _fleet(model, params, wire="fp32")
+    try:
+        req = pbat.submit(prompt, max_new_tokens=8)
+        _pump(pbat, [req])
+        assert req.status == "done"
+        assert req.result()["tokens"] == ref
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_fp32_transfer_bit_parity_rope_gqa():
+    """The parity gate on the attention variants most sensitive to KV
+    placement: rotary embeddings + grouped-query heads."""
+    model, params = _toy(rope=True, num_kv_heads=1)
+    prompt = list(range(2, 14))
+    ref = _unified_tokens(model, params, prompt, 6)
+    pbat, dbat, server, _ = _fleet(model, params, wire="fp32")
+    try:
+        req = pbat.submit(prompt, max_new_tokens=6)
+        _pump(pbat, [req])
+        assert req.result()["tokens"] == ref
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_fp32_transfer_bit_parity_staggered_multislot(toy):
+    """Three prompts streamed at staggered times share the decode
+    worker's slots; every one must still match its unified reference
+    bit for bit — cross-slot KV isolation survives the wire."""
+    model, params = toy
+    prompts = [list(range(1, 8)), list(range(3, 15)), [7, 5, 3, 2, 9]]
+    refs = [_unified_tokens(model, params, p, 6) for p in prompts]
+    pbat, dbat, server, _ = _fleet(model, params, wire="fp32")
+    try:
+        reqs = []
+        for p in prompts:
+            reqs.append(pbat.submit(p, max_new_tokens=6))
+            for _ in range(3):  # stagger: admissions land mid-decode
+                pbat.step()
+                time.sleep(0.002)
+        _pump(pbat, reqs)
+        for req, ref in zip(reqs, refs):
+            assert req.status == "done"
+            assert req.result()["tokens"] == ref
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_int8_transfer_bounded_divergence_and_greedy_match(toy):
+    """The lossy-wire gate: transferred-int8 decode must greedy-match
+    the unified reference on nearly every step of a batch of prompts
+    (logit perturbations are bounded by the per-block quantization
+    error, so argmax flips only near ties)."""
+    model, params = toy
+    prompts = [list(range(1, 10)), list(range(5, 17)), [9, 1, 4, 4, 8]]
+    refs = [_unified_tokens(model, params, p, 8) for p in prompts]
+    pbat, dbat, server, _ = _fleet(model, params, wire="int8")
+    try:
+        reqs = [pbat.submit(p, max_new_tokens=8) for p in prompts]
+        _pump(pbat, reqs)
+        total = matched = 0
+        for req, ref in zip(reqs, refs):
+            assert req.status == "done"
+            got = req.result()["tokens"]
+            assert len(got) == len(ref)
+            total += len(ref)
+            matched += sum(g == r for g, r in zip(got, ref))
+        assert matched / total >= 0.9, (matched, total)
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+# ------------------------------------------------- role-gated executables
+
+
+def test_decode_role_rejects_prompts_and_prefill_raises(toy):
+    model, params = toy
+    from horovod_tpu.serving.batcher import Rejected
+
+    eng = _engine(model, params, role="decode")
+    bat = _batcher(eng, role="decode")
+    with pytest.raises(Rejected):
+        bat.submit([1, 2, 3])
+    with pytest.raises(RuntimeError, match="decode-role"):
+        eng.prefill(eng.manager.alloc(), [1, 2, 3])
+
+
+def test_roles_require_paged_plane(toy):
+    model, params = toy
+    eng = _engine(model, params, paged=False)
+    with pytest.raises(ValueError, match="paged"):
+        _batcher(eng, role="prefill")
+
+
+def test_decode_compiles_once_across_streamed_admissions(toy):
+    """The zero-retrace invariant on the transfer path: >=3 streamed
+    admissions on a decode worker leave decode_compiles == 1 (ingest
+    changes data, never shapes), and the pure-prefill worker that fed
+    it never compiled a decode step at all."""
+    model, params = toy
+    pbat, dbat, server, _ = _fleet(model, params, wire="fp32")
+    try:
+        reqs = [
+            pbat.submit(list(range(1, 6 + i)), max_new_tokens=6)
+            for i in range(3)
+        ]
+        _pump(pbat, reqs)
+        assert all(r.status == "done" for r in reqs)
+        assert dbat.engine.stats()["decode_compiles"] == 1
+        assert dbat.engine.stats()["transfer_ingests"] >= 3
+        assert pbat.engine.stats()["decode_compiles"] == 0
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+# ------------------------------------------------------ chaos + fallback
+
+
+def test_mid_transfer_reset_is_retried(toy):
+    """Satellite: one injected connection reset mid-stream; the
+    RetryPolicy absorbs it and the request completes remotely."""
+    model, params = toy
+    chaos.configure("serve.kv_transfer@1:reset")
+    before = _metrics.snapshot().get("serve.transfer_fallbacks", 0)
+    retry = RetryPolicy(
+        "serve.kv_transfer", attempts=3, backoff_ms=1.0,
+        attempt_timeout_s=10.0,
+    )
+    pbat, dbat, server, _ = _fleet(model, params, wire="fp32",
+                                   retry=retry)
+    try:
+        req = pbat.submit(list(range(1, 9)), max_new_tokens=5)
+        _pump(pbat, [req])
+        assert req.status == "done"
+        snap = _metrics.snapshot()
+        assert snap.get("chaos.serve.kv_transfer.reset", 0) >= 1
+        # absorbed, not fallen back
+        assert snap.get("serve.transfer_fallbacks", 0) == before
+        assert dbat.engine.stats()["transfer_ingests"] >= 1
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_transfer_exhaustion_falls_back_to_local_decode(toy):
+    """Satellite: every stream attempt dies mid-transfer (chaos resets
+    past the retry budget) AFTER the reservation and prefill; the
+    request comes home — completes locally, counted in
+    serve.transfer_fallbacks, and the waiter sees a normal result (the
+    zero-500s contract is asserted end-to-end below)."""
+    model, params = toy
+    ref = _unified_tokens(model, params, list(range(1, 9)), 5)
+    chaos.configure("serve.kv_transfer:p=1:reset")
+    retry = RetryPolicy(
+        "serve.kv_transfer", attempts=2, backoff_ms=1.0,
+        deadline_s=5.0, attempt_timeout_s=0.5,
+    )
+    pbat, dbat, server, _ = _fleet(model, params, wire="fp32",
+                                   retry=retry)
+    before = _metrics.snapshot().get("serve.transfer_fallbacks", 0)
+    try:
+        req = pbat.submit(list(range(1, 9)), max_new_tokens=5)
+        _pump(pbat, [req], timeout=60.0)
+        assert req.status == "done"
+        assert req.result()["tokens"] == ref  # local decode, same model
+        snap = _metrics.snapshot()
+        assert snap.get("serve.transfer_fallbacks", 0) == before + 1
+        # the ingest never landed on the decode worker
+        assert dbat.engine.stats()["transfer_ingests"] == 0
+        # the prefill worker compiled its decode table lazily, only now
+        assert pbat.engine.stats()["decode_compiles"] == 1
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_no_decode_capacity_takes_local_path_without_prefill_waste(toy):
+    """Reservation BEFORE prefill: with no decode workers announced the
+    request never detours through the transfer plane at all."""
+    from horovod_tpu.serving.kv_transfer import TransferCoordinator
+
+    model, params = toy
+    peng = _engine(model, params, role="prefill")
+    pbat = _batcher(peng, role="prefill")
+    pbat.transfer = TransferCoordinator(
+        peng, client=_FakeAnnounceClient({}), wire="fp32"
+    )
+    before = _metrics.snapshot().get("serve.transfer_local", 0)
+    req = pbat.submit(list(range(1, 7)), max_new_tokens=4)
+    while not req.finished():
+        pbat.step()
+    assert req.status == "done"
+    assert (
+        _metrics.snapshot().get("serve.transfer_local", 0) == before + 1
+    )
+
+
+def test_generate_zero_500s_under_transfer_outage(toy):
+    """The client-facing contract: a prefill worker whose transfer
+    plane is down still answers POST /generate with HTTP 200."""
+    from horovod_tpu.serving.frontend import ServeFrontend
+    from horovod_tpu.serving.kv_transfer import TransferCoordinator
+
+    model, params = toy
+    peng = _engine(model, params, role="prefill")
+    pbat = _batcher(peng, role="prefill")
+    # dead target on a port nothing listens on
+    pbat.transfer = TransferCoordinator(
+        peng,
+        client=_FakeAnnounceClient({0: _decode_ann(0, 1)}),
+        wire="fp32",
+        retry=RetryPolicy(
+            "serve.kv_transfer", attempts=1, backoff_ms=1.0,
+            deadline_s=2.0, attempt_timeout_s=0.3,
+        ),
+        reserve_timeout_s=0.3,
+    )
+    fe = ServeFrontend(pbat, port=0, addr="127.0.0.1")
+    pbat.start()
+    fe.start()
+    try:
+        body = json.dumps(
+            {"tokens": list(range(1, 8)), "max_tokens": 4}
+        ).encode()
+        http = urllib.request.Request(
+            f"http://127.0.0.1:{fe.port}/generate", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(http, timeout=60) as resp:
+            assert resp.status == 200
+            out = json.loads(resp.read().decode())
+        assert out["status"] == "done"
+        assert len(out["tokens"]) == 4
+    finally:
+        fe.stop()
+        pbat.stop()
+
+
+# --------------------------------------------------------------- routing
+
+
+class _DictStore:
+    def __init__(self, anns):
+        self.anns = {
+            str(r): json.dumps(a).encode() for r, a in anns.items()
+        }
+
+    def keys(self, scope):
+        return list(self.anns) if scope == "serve" else []
+
+    def get(self, scope, key):
+        return self.anns.get(key)
+
+
+def _ann(rank, role=None, **extra):
+    ann = {
+        "port": 9000 + rank,
+        "addr": "127.0.0.1",
+        "free_slots": 4,
+        "free_pages": 50,
+        "queue_depth": 0,
+        "ts": time.time(),
+    }
+    if role is not None:
+        ann["role"] = role
+    ann.update(extra)
+    return ann
+
+
+def test_router_mixed_version_blobs_missing_role_stay_routable():
+    """Satellite regression: old workers announce without any ``role``
+    field — they must parse as unified and keep taking traffic."""
+    from horovod_tpu.serving.frontend import Router
+
+    router = Router(_DictStore({0: _ann(0), 1: _ann(1)}))
+    picked = router.pick()
+    assert picked is not None and picked["rank"] in (0, 1)
+
+
+def test_router_excludes_decode_and_prefers_prefill():
+    from horovod_tpu.serving.frontend import Router
+
+    # decode-only fleet: nothing to route prompts to
+    router = Router(_DictStore({0: _ann(0, "decode")}))
+    assert router.pick() is None
+
+    # mixed fleet: decode never picked; prefill outranks unified (and
+    # the roleless legacy blob counts as unified)
+    store = _DictStore({
+        0: _ann(0, "decode", free_pages=500),
+        1: _ann(1),  # legacy, no role field
+        2: _ann(2, "prefill", free_slots=1, free_pages=1),
+        3: _ann(3, "unified", free_slots=9, free_pages=90),
+    })
+    router = Router(store)
+    for _ in range(4):
+        picked = router.pick()
+        assert picked["rank"] == 2  # prefill wins even when less free
+        router.credit(2)
+
+
+def test_capacity_blob_carries_role_and_transfer_port(toy):
+    model, params = toy
+    from horovod_tpu.serving.frontend import ServeFrontend
+    from horovod_tpu.serving.kv_transfer import KVTransferServer
+
+    deng = _engine(model, params, role="decode")
+    dbat = _batcher(deng, role="decode")
+    server = KVTransferServer(dbat, port=0, addr="127.0.0.1")
+    server.start()
+    fe = ServeFrontend(dbat, port=0, addr="127.0.0.1",
+                       transfer_server=server)
+    try:
+        cap = fe.capacity()
+        assert cap["role"] == "decode"
+        assert cap["transfer_port"] == server.port
+        free_before = cap["free_pages"]
+        # a reservation debits the announced headroom
+        body = json.dumps({"pages": 3}).encode()
+        http = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/kv/reserve", data=body,
+            method="POST",
+        )
+        with urllib.request.urlopen(http, timeout=10) as resp:
+            assert resp.status == 200
+        assert fe.capacity()["free_pages"] == free_before - 3
+    finally:
+        fe.stop()
+        server.stop()
+
+
+def test_reserve_denied_when_draining_or_over_headroom(toy):
+    model, params = toy
+    import urllib.error
+
+    from horovod_tpu.serving.kv_transfer import KVTransferServer
+
+    deng = _engine(model, params, role="decode")
+    dbat = _batcher(deng, role="decode")
+    server = KVTransferServer(dbat, port=0, addr="127.0.0.1")
+    server.start()
+    try:
+        headroom = deng.manager.admission_headroom()
+        body = json.dumps({"pages": headroom + 1}).encode()
+        http = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/kv/reserve", data=body,
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(http, timeout=10)
+        assert ei.value.code == 503
+    finally:
+        server.stop()
+
+
+def test_reservation_failover_to_second_decode_worker(toy):
+    """A denied/unreachable first target is skipped in-call — the
+    coordinator reserves on the next candidate."""
+    from horovod_tpu.serving.kv_transfer import (
+        KVTransferServer,
+        TransferCoordinator,
+    )
+
+    model, params = toy
+    deng = _engine(model, params, role="decode")
+    dbat = _batcher(deng, role="decode")
+    server = KVTransferServer(dbat, port=0, addr="127.0.0.1")
+    server.start()
+    peng = _engine(model, params, role="prefill")
+    coord = TransferCoordinator(
+        peng,
+        client=_FakeAnnounceClient({
+            # rank 5 looks best (more free pages) but nothing listens
+            5: _decode_ann(5, 1, free_pages=500),
+            0: _decode_ann(0, server.port, free_pages=10),
+        }),
+        wire="fp32",
+        reserve_timeout_s=0.3,
+    )
+    try:
+        res = coord.reserve(2)
+        assert res is not None and res["rank"] == 0
+    finally:
+        server.stop()
+
+
+def test_driver_per_role_capacity_gauges():
+    """elastic/driver.py satellite wiring: per-role worker counts and
+    headroom land as driver.serve.<role>.* gauges, with the missing-
+    role blob counted as unified."""
+    import types
+
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    store = _DictStore({
+        0: _ann(0, "prefill"),
+        1: _ann(1, "decode", free_pages=7, free_slots=2),
+        2: _ann(2),  # legacy blob -> unified
+    })
+    fake = types.SimpleNamespace(
+        _server=types.SimpleNamespace(store=store),
+        _serve_cap_seen={},
+    )
+    ElasticDriver._poll_serve_capacity(fake)
+    snap = _metrics.snapshot()
+    assert snap.get("driver.serve.prefill.workers") == 1.0
+    assert snap.get("driver.serve.decode.workers") == 1.0
+    assert snap.get("driver.serve.unified.workers") == 1.0
+    assert snap.get("driver.serve.decode.free_pages") == 7.0
