@@ -580,8 +580,125 @@ def main():
             "note": _SIM_NOTE if platform == "cpu" else "on-chip",
         }
 
+    def run_paged_attn_leg() -> dict:
+        """Tentpole A/B (paged flash-attention): the SAME paged engine
+        twice — gather read (``paged_attn=off``, the transient
+        contiguous view) vs fused kernel read (``paged_attn=on``,
+        K/V streamed from the pool) — on a long-context, decode-heavy
+        trace. Greedy outputs must be identical (the ≤1-ulp online
+        softmax is absorbed by argmax), and the pre-registered decode
+        HBM-byte model must hold: the kernel reads each slot's LIVE
+        pages only, the gather re-reads slots × max_len every step.
+        The byte model is analytic from the per-step live lengths
+        (exact for both arms' reads — docs/perf.md); wall/TPOT are
+        reported but gated on-chip only (CPU runs the kernel in
+        interpret mode, which measures nothing about HBM)."""
+        page_tokens = 16
+        gen = max(gen_tokens, 8)  # decode-heavy
+        long_lens = rng.integers(
+            cfg.max_len // 2, cfg.max_len - gen, size=n_requests
+        )
+        trace = [
+            list(rng.integers(1, cfg.vocab_size, size=int(n)))
+            for n in long_lens
+        ]
+        kvh = cfg.num_kv_heads or cfg.num_heads
+        hd = cfg.d_model // cfg.num_heads
+        per_tok = 2 * kvh * hd * 4 * cfg.num_layers  # k+v fp32, all layers
+        arms = {}
+        outs = {}
+        for arm, pa in (("gather", "off"), ("kernel", "on")):
+            engine = InferenceEngine(
+                model, params, slots=slots, max_len=cfg.max_len,
+                paged=True, page_tokens=page_tokens,
+                prefix_cache=False, paged_attn=pa,
+            )
+            b = ContinuousBatcher(
+                engine,
+                max_admit_per_step=max(slots // 2, 1),
+                default_max_new_tokens=gen,
+            )
+            reqs = [b.submit(p) for p in trace]
+            kernel_bytes = 0
+            gather_bytes = 0
+            guard = 0
+            t0 = time.monotonic()
+            while not all(r.finished() for r in reqs):
+                before = engine.stats()["decode_steps"]
+                b.step()
+                if engine.stats()["decode_steps"] > before:
+                    # post-step lengths == kv_len each slot attended:
+                    # the kernel DMAs exactly ceil(kv_len/pt) pages,
+                    # the gather re-materializes the full table width
+                    lens = engine.manager.lengths_array()
+                    live_pages = sum(
+                        -(-int(n) // page_tokens) for n in lens if n > 0
+                    )
+                    kernel_bytes += live_pages * page_tokens * per_tok
+                    gather_bytes += slots * cfg.max_len * per_tok
+                guard += 1
+                assert guard < 100_000, "trace failed to complete"
+            wall_s = time.monotonic() - t0
+            assert all(r.status == "done" for r in reqs), [
+                r.status for r in reqs
+            ]
+            outs[arm] = [r.out_tokens for r in reqs]
+            st = engine.stats()
+            arms[arm] = {
+                "wall_s": round(wall_s, 4),
+                "decode_steps": st["decode_steps"],
+                "decode_compiles": st["decode_compiles"],
+                "paged_attn_calls": st["paged_attn_calls"],
+                "paged_attn_fallbacks": st["paged_attn_fallbacks"],
+                "model_decode_read_bytes": (
+                    kernel_bytes if arm == "kernel" else gather_bytes
+                ),
+            }
+        # the acceptance gates (dryrun and on-chip alike): bit-identical
+        # greedy tokens, the byte model, one executable, zero fallbacks
+        assert outs["gather"] == outs["kernel"], (
+            "kernel-path decode diverged from the gather oracle"
+        )
+        assert (
+            arms["kernel"]["model_decode_read_bytes"]
+            < arms["gather"]["model_decode_read_bytes"]
+        ), "kernel byte model not under the gather's max_len reads"
+        assert arms["kernel"]["paged_attn_calls"] > 0, arms
+        assert arms["kernel"]["paged_attn_fallbacks"] == 0, arms
+        assert arms["kernel"]["decode_compiles"] == 1, arms
+        assert arms["gather"]["paged_attn_calls"] == 0, arms
+        return {
+            "metric": "serve_ab_paged_attn",
+            "leg": "ab_paged_attn",
+            "platform": platform,
+            "requests": n_requests,
+            "slots": slots,
+            "gen_tokens": gen,
+            "page_tokens": page_tokens,
+            "max_len": cfg.max_len,
+            "read_bytes_ratio": round(
+                arms["kernel"]["model_decode_read_bytes"]
+                / max(arms["gather"]["model_decode_read_bytes"], 1),
+                4,
+            ),
+            "tpot_wall_ratio": round(
+                arms["kernel"]["wall_s"] / max(arms["gather"]["wall_s"],
+                                               1e-9),
+                4,
+            ),
+            "arms": arms,
+            "outputs_identical": True,
+            "dryrun": dryrun,
+            "note": (
+                "byte model analytic; kernel runs in Pallas interpret "
+                "mode on CPU — wall/TPOT not meaningful off-chip"
+                if platform == "cpu" else "on-chip"
+            ),
+        }
+
     for leg_fn, name in ((run_paged_leg, "paged"), (run_prefix_leg, "prefix"),
-                         (run_disagg_leg, "disagg")):
+                         (run_disagg_leg, "disagg"),
+                         (run_paged_attn_leg, "paged_attn")):
         line = leg_fn()
         path = os.path.join(artifact_dir, f"serve_ab_{name}.json")
         with open(path, "w") as f:

@@ -25,6 +25,9 @@ The catalog (docs/analysis.md has the prose version):
   agreement flag, PR 7).
 * :class:`CompileBudget` — expected executable counts per cache
   (``decode_compiles == 1`` and friends).
+* :class:`TransientBuffer` — a tensor shape prefix must be absent
+  (kernel-path paged attention deletes the gather view) or present
+  (the gather baseline — matcher falsifiability).
 """
 
 from __future__ import annotations
@@ -398,6 +401,59 @@ class GuardOverhead(Rule):
                         )
                     )
         return out
+
+
+class TransientBuffer(Rule):
+    """Presence/absence of a tensor shape in the lowered module: the
+    paged-attention memory-plane gate. ``forbid=True`` (the default)
+    asserts NO tensor whose leading dims match ``shape_prefix`` exists
+    anywhere in the program — e.g. ``(slots, max_len)`` catches the
+    transient contiguous ``[slots, max_len, kvh, hd]`` gather view the
+    fused kernel is supposed to delete. ``forbid=False`` is the
+    falsifiability twin: the gather-path program MUST still carry it,
+    proving the matcher actually detects the buffer it bans."""
+
+    def __init__(self, shape_prefix: Sequence[int], forbid: bool = True):
+        self.shape_prefix = tuple(int(d) for d in shape_prefix)
+        self.forbid = bool(forbid)
+
+    @property
+    def name(self) -> str:
+        dims = "x".join(str(d) for d in self.shape_prefix)
+        mode = "absent" if self.forbid else "present"
+        return f"TransientBuffer[{dims}* {mode}]"
+
+    def check(self, graph: ProgramGraph) -> List[Finding]:
+        needle = "tensor<" + "".join(f"{d}x" for d in self.shape_prefix)
+        line_no = None
+        for i, line in enumerate(graph.text.splitlines()):
+            if needle in line:
+                line_no = i
+                break
+        if self.forbid and line_no is not None:
+            return [
+                Finding(
+                    rule=self.name,
+                    message=(
+                        f"module materializes a {needle}...> buffer — "
+                        "the transient gather view the kernel path must "
+                        "not carry"
+                    ),
+                    line_no=line_no,
+                )
+            ]
+        if not self.forbid and line_no is None:
+            return [
+                Finding(
+                    rule=self.name,
+                    message=(
+                        f"module carries no {needle}...> buffer — the "
+                        "gather-path baseline should materialize the "
+                        "view (matcher falsifiability check)"
+                    ),
+                )
+            ]
+        return []
 
 
 class CompileBudget(Rule):

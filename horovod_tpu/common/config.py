@@ -81,6 +81,11 @@ DEFAULT_SERVE_PAGE_WATERMARK = -1
 DEFAULT_SERVE_ROLE = "unified"
 DEFAULT_SERVE_KV_WIRE = "int8"
 DEFAULT_SERVE_TRANSFER_PORT = 0
+# Paged-attention kernel read (ops/paged_attention.py): auto = fuse the
+# pool read on real TPU backends and keep the gather read (the numerics
+# oracle) elsewhere; on = force the kernel (interpret-mode on CPU —
+# what the parity tests and the A/B bench run); off = always gather.
+DEFAULT_SERVE_PAGED_ATTN = "auto"
 
 
 def _env_bool(name: str, default: bool = False) -> bool:
@@ -359,6 +364,8 @@ class Config:
     serve_role: str = DEFAULT_SERVE_ROLE
     serve_kv_wire: str = DEFAULT_SERVE_KV_WIRE
     serve_transfer_port: int = DEFAULT_SERVE_TRANSFER_PORT
+    # paged-attention kernel read: auto / on / off
+    serve_paged_attn: str = DEFAULT_SERVE_PAGED_ATTN
 
     # --- logging ---
     log_level: str = "warning"
@@ -564,6 +571,10 @@ class Config:
             serve_transfer_port=_env_int(
                 "HOROVOD_SERVE_TRANSFER_PORT",
                 DEFAULT_SERVE_TRANSFER_PORT,
+            ),
+            serve_paged_attn=_env_choice(
+                "HOROVOD_SERVE_PAGED_ATTN", DEFAULT_SERVE_PAGED_ATTN,
+                ("auto", "on", "off"),
             ),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_timestamp=_env_bool("HOROVOD_LOG_TIMESTAMP", True),

@@ -224,6 +224,22 @@ SERVING_TRANSFER_METRICS = (
     "serve.transfer_ingests",
 )
 
+# Paged-attention kernel path (ops/paged_attention.py through
+# serving/engine.py and models/transformer.py — legend for the
+# docs/observability.md counter table):
+#   serve.paged_attn_calls       executable invocations (decode steps +
+#                                prefill chunks) that ran the fused
+#                                pool-read kernel (counter; engine
+#                                stats → `serve.` prefix)
+#   serve.paged_attn_fallbacks   kernel requested but the fallback
+#                                ladder rode the gather read instead —
+#                                bumped once at engine resolution and
+#                                at model trace time (counter)
+SERVING_PAGED_ATTN_METRICS = (
+    "serve.paged_attn_calls",
+    "serve.paged_attn_fallbacks",
+)
+
 
 class MetricsRegistry:
     def __init__(self) -> None:

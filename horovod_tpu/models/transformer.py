@@ -235,7 +235,7 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, lengths=None, cache=None,
-                 cache_index=None, pages=None):
+                 cache_index=None, pages=None, paged_attn=False):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
         if cache is not None:
@@ -280,7 +280,8 @@ class MultiHeadAttention(nn.Module):
         if cache is not None:
             return self._cached_attention(cfg, x, q, k, v, cache,
                                           cache_index, head_dim,
-                                          pages=pages)
+                                          pages=pages,
+                                          paged_attn=paged_attn)
         # lengths (right-padding) stays on the flash path — the kernels
         # take it natively; only ARBITRARY masks force dense.
         use_flash = cfg.uses_flash(mask, seq=x.shape[1])
@@ -355,7 +356,7 @@ class MultiHeadAttention(nn.Module):
         )(out)
 
     def _cached_attention(self, cfg, x, q, k, v, cache, cache_index,
-                          head_dim, pages=None):
+                          head_dim, pages=None, paged_attn=False):
         """Incremental-decode attention: write this call's k/v into the
         per-slot cache at ``cache_index`` (each batch row at its own
         position — prefill passes t=prompt tokens at index 0, decode
@@ -384,6 +385,18 @@ class MultiHeadAttention(nn.Module):
           the SAME values at every attendable position as the slab
           row, so the attention below is bit-identical between
           layouts — the serving plane's paged-parity contract.
+
+        ``paged_attn=True`` (paged layout only) replaces the
+        gather-then-attend READ with the fused Pallas kernel
+        (`ops/paged_attention.py`): the kernel's grid walks the page
+        table and streams K/V blocks straight from the pool, so the
+        transient contiguous view never exists in the lowered program.
+        The write scatter above is unchanged, the gather path stays the
+        default-off numerics oracle, and unsupported geometries fall
+        back to it loudly (``serve.paged_attn_fallbacks``). Outputs
+        agree with the oracle to ≤1 ulp of the fp32 softmax (the online
+        softmax reassociates the denominator sum) — greedy argmax
+        tokens are identical.
         """
         b, t = x.shape[0], x.shape[1]
         idx = jnp.asarray(cache_index, jnp.int32)
@@ -422,6 +435,39 @@ class MultiHeadAttention(nn.Module):
             k_cache = _scatter(cache["k"], k)
             v_cache = _scatter(cache["v"], v)
         new_cache = {"k": k_cache, "v": v_cache}
+        if pages is not None and paged_attn:
+            from ..ops import paged_attention as _pa
+
+            r = cfg.num_heads // (cfg.num_kv_heads or cfg.num_heads)
+            reason = _pa.unsupported_reason(
+                head_dim, page_tokens, queries=t * r
+            )
+            if reason is None and cfg.sliding_window:
+                reason = (
+                    "sliding_window is not implemented by the paged "
+                    "kernel"
+                )
+            if reason is None:
+                out = _pa.paged_attention(
+                    q, k_cache, v_cache, pages, idx, causal=True
+                )
+                return nn.DenseGeneral(
+                    cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+                    name="out",
+                )(out), new_cache
+            # loud fallback ladder: requested the kernel, geometry (or
+            # backend) can't take it — warn at trace time, count it,
+            # and ride the gather oracle below
+            import warnings
+
+            from ..common.metrics import registry as _metrics
+
+            warnings.warn(
+                f"paged_attn=True but the kernel path is unsupported "
+                f"({reason}); falling back to the gather read",
+                stacklevel=2,
+            )
+            _metrics.counter("serve.paged_attn_fallbacks")
         if pages is None:
             kk, vv = k_cache, v_cache
         else:
@@ -505,7 +551,8 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True, lengths=None,
-                 cache=None, cache_index=None, pages=None):
+                 cache=None, cache_index=None, pages=None,
+                 paged_attn=False):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         new_cache = None
@@ -514,7 +561,7 @@ class Block(nn.Module):
         else:
             h, new_cache = MultiHeadAttention(cfg)(
                 h, mask, lengths, cache=cache, cache_index=cache_index,
-                pages=pages,
+                pages=pages, paged_attn=paged_attn,
             )
         h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         x = x + h
@@ -611,7 +658,7 @@ class Transformer(nn.Module):
     def __call__(
         self, tokens, mask=None, train: bool = True,
         return_hidden: bool = False, lengths=None,
-        cache=None, cache_index=None, pages=None,
+        cache=None, cache_index=None, pages=None, paged_attn=False,
     ):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype)(tokens)
@@ -645,7 +692,7 @@ class Transformer(nn.Module):
                 x, layer_cache = Block(cfg, name=f"block_{i}")(
                     x, mask, train, lengths,
                     cache=cache[i], cache_index=cache_index,
-                    pages=pages,
+                    pages=pages, paged_attn=paged_attn,
                 )
                 new_cache.append(layer_cache)
             x = nn.LayerNorm(dtype=jnp.float32)(x)

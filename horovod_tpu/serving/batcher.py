@@ -105,6 +105,14 @@ class Request:
     # arrays + logical indices waiting for their admit-time device
     # write. Dropped (None) once attached — the arrays are large.
     ingest: Optional[dict] = dataclasses.field(default=None, repr=False)
+    # per-request sampling (engine.set_sampling — pure DATA through the
+    # one decode executable): temperature 0 = bit-identical greedy,
+    # top_k 0 = no truncation, seed None = derived from the request id
+    # (stable across replays). Armed at every admission (fresh, resume
+    # and ingest alike), cleared when the slot retires.
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: Optional[int] = None
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
@@ -182,6 +190,9 @@ class ContinuousBatcher:
         prompt,
         max_new_tokens: Optional[int] = None,
         deadline_ms: Optional[float] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: Optional[int] = None,
     ) -> Request:
         if self.role == "decode":
             # the Router never sends prompts here (role-aware pick);
@@ -231,6 +242,9 @@ class ContinuousBatcher:
                 if deadline_ms and deadline_ms > 0
                 else None
             ),
+            temperature=float(temperature),
+            top_k=int(top_k),
+            seed=seed,
         )
         with self._cond:
             # drain check and enqueue under ONE lock: a submit racing
@@ -260,6 +274,9 @@ class ContinuousBatcher:
         length: int,
         hashes=(),
         deadline_ms: Optional[float] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: Optional[int] = None,
     ) -> Request:
         """Admit a KV-transferred request (serving/kv_transfer.py
         receiver). Called from an HTTP handler thread: only host-side
@@ -287,6 +304,9 @@ class ContinuousBatcher:
                 if deadline_ms and float(deadline_ms) > 0
                 else None
             ),
+            temperature=float(temperature),
+            top_k=int(top_k),
+            seed=seed,
         )
         req.out_tokens.append(int(first_token))
         req.ingest = {
@@ -625,6 +645,15 @@ class ContinuousBatcher:
                 # reprefill-resume AND pointer reattach-resume alike
                 _metrics.counter("serve.admitted_mid_decode")
             admitted += 1
+            # arm the slot's sampling knobs for every admission path
+            # (fresh, resume, ingest): data writes, never a retrace
+            if req.temperature > 0 or req.top_k > 0:
+                self.engine.set_sampling(
+                    slot, req.temperature, req.top_k,
+                    seed=req.id if req.seed is None else req.seed,
+                )
+            else:
+                self.engine.clear_sampling(slot)
             self._slot_req[slot] = req
             if self._req_complete(req, now):
                 self._retire(slot, req)
@@ -754,6 +783,7 @@ class ContinuousBatcher:
 
     def _retire(self, slot: int, req: Request) -> None:
         self.engine.manager.free(slot)
+        self.engine.clear_sampling(slot)
         self._slot_req.pop(slot, None)
         if req.status == DEADLINE:
             _metrics.counter("serve.expired")

@@ -66,7 +66,10 @@ def _as_model_fn(model) -> Callable:
     to the positional model contract; pass callables through. With the
     paged memory plane the contract grows a ``pages=`` kwarg (the
     per-row page table, `serving/paged_kv.py`) — custom callables only
-    need to accept it when they are served with ``paged=True``."""
+    need to accept it when they are served with ``paged=True``, and a
+    ``paged_attn=`` kwarg only when the fused pool-read kernel is
+    resolved on (``HOROVOD_SERVE_PAGED_ATTN``) — both are forwarded
+    only when engaged, so existing callables keep working."""
     apply = getattr(model, "apply", None)
     if apply is None:
         if not callable(model):
@@ -75,14 +78,21 @@ def _as_model_fn(model) -> Callable:
                 f"got {type(model)!r}"
             )
 
-        def passthrough(params, tokens, cache, cache_index, pages=None):
+        def passthrough(params, tokens, cache, cache_index, pages=None,
+                        paged_attn=False):
             if pages is None:
                 return model(params, tokens, cache, cache_index)
+            if paged_attn:
+                return model(
+                    params, tokens, cache, cache_index, pages=pages,
+                    paged_attn=True,
+                )
             return model(params, tokens, cache, cache_index, pages=pages)
 
         return passthrough
 
-    def model_fn(params, tokens, cache, cache_index, pages=None):
+    def model_fn(params, tokens, cache, cache_index, pages=None,
+                 paged_attn=False):
         variables = (
             params
             if isinstance(params, dict) and "params" in params
@@ -91,9 +101,45 @@ def _as_model_fn(model) -> Callable:
         kwargs = dict(train=False, cache=cache, cache_index=cache_index)
         if pages is not None:
             kwargs["pages"] = pages
+        if paged_attn:
+            kwargs["paged_attn"] = True
         return apply(variables, tokens, **kwargs)
 
     return model_fn
+
+
+def _sample_next(row, greedy, temps, topks, keys):
+    """Per-slot sampled next token as pure DATA inside the ONE decode
+    executable (the ROADMAP "parallel sampling" on-ramp): ``temps`` /
+    ``topks`` are per-slot ``[slots]`` inputs, ``keys`` are per-slot
+    raw uint32 PRNG keys riding the donated carry. Temperature 0 takes
+    the UNTOUCHED greedy argmax branch through a ``jnp.where`` — the
+    greedy token stream is bit-identical to the pre-sampling engine —
+    and top-k 0 means no truncation. Keys split every step regardless
+    of temperature (a constant-shape op; sampled slots stay
+    reproducible however their neighbors are configured). Returns
+    ``(next_tokens, new_keys)``."""
+    import jax
+    import jax.numpy as jnp
+
+    vocab = row.shape[-1]
+    # top-k truncation as data: threshold at the k-th largest logit
+    # (k<=0 disables), then mask below it before temperature scaling
+    srt = jnp.sort(row, axis=-1)[:, ::-1]
+    kk = jnp.clip(topks, 1, vocab) - 1
+    thr = jnp.take_along_axis(srt, kk[:, None], axis=-1)
+    keep = jnp.where(topks[:, None] > 0, row >= thr, True)
+    scaled = jnp.where(keep, row, -1e30) / jnp.maximum(
+        temps, 1e-6
+    )[:, None]
+
+    def one(key, logits):
+        next_key, sample_key = jax.random.split(key)
+        return next_key, jax.random.categorical(sample_key, logits)
+
+    new_keys, sampled = jax.vmap(one)(keys, scaled)
+    nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+    return nxt, new_keys
 
 
 def _default_cache_factory(model):
@@ -138,6 +184,7 @@ class InferenceEngine:
         prefix_cache: Optional[bool] = None,
         page_watermark: Optional[int] = None,
         role: str = "unified",
+        paged_attn=None,
     ) -> None:
         if role not in ("unified", "prefill", "decode"):
             raise ValueError(
@@ -230,13 +277,85 @@ class InferenceEngine:
         self._decode_swept = False
         self._lock = threading.Lock()  # guards counters for stats readers
         self._counters = collections.Counter()
+        # per-slot sampling state (DATA through the one decode
+        # executable — see _sample_next): temperature 0 / top-k 0 =
+        # greedy, the boot default for every slot
+        import jax.numpy as jnp
+
+        self._sample_temps = np.zeros((self.slots,), np.float32)
+        self._sample_topks = np.zeros((self.slots,), np.int32)
+        self._sample_keys = jnp.zeros((self.slots, 2), jnp.uint32)
+        # fused paged-attention read (ops/paged_attention.py): resolve
+        # the tri-state once — the decision is baked into the traced
+        # executables, so it cannot flip mid-flight and retrace
+        self.paged_attn = self._resolve_paged_attn(
+            cfg.serve_paged_attn if paged_attn is None else paged_attn,
+            model_cfg,
+        )
+
+    def _resolve_paged_attn(self, requested, model_cfg) -> bool:
+        """Resolve the ``HOROVOD_SERVE_PAGED_ATTN`` tri-state against
+        the fallback ladder (ops/paged_attention.py): ``auto`` engages
+        the kernel only on real TPU backends (interpret mode is for
+        tests, not production CPU decode — and the gather oracle keeps
+        CPU serving bit-comparable with the slab baseline), ``on``
+        forces it anywhere Pallas can run it, ``off`` — and the slab
+        plane — always ride the gather read. A requested-but-impossible
+        kernel falls back LOUDLY: warn log + the
+        ``serve.paged_attn_fallbacks`` counter. The check here uses the
+        decode geometry (one token per slot); wider prefill chunks are
+        re-checked per trace inside ``_cached_attention`` and fall back
+        per-executable the same loud way."""
+        if isinstance(requested, bool):
+            requested = "on" if requested else "off"
+        requested = str(requested).lower()
+        if requested not in ("auto", "on", "off"):
+            raise ValueError(
+                f"paged_attn must be auto/on/off, got {requested!r}"
+            )
+        if not self.paged or requested == "off":
+            return False
+        import jax
+
+        backend = jax.default_backend()
+        if requested == "auto" and backend != "tpu":
+            return False
+        from ..ops import paged_attention as _pa
+
+        leaf = jax.tree_util.tree_leaves(self.manager.cache)[0]
+        page_tokens, kv_heads, head_dim = leaf.shape[1:4]
+        heads = (
+            getattr(model_cfg, "num_heads", 0) or kv_heads
+            if model_cfg is not None else kv_heads
+        )
+        group = max(int(heads) // int(kv_heads), 1)
+        reason = _pa.unsupported_reason(
+            int(head_dim), int(page_tokens), queries=group,
+            backend=backend,
+        )
+        if reason is None and model_cfg is not None and getattr(
+            model_cfg, "sliding_window", 0
+        ):
+            reason = "sliding_window is not implemented by the paged kernel"
+        if reason is None:
+            return True
+        _log.warning(
+            "paged_attn=%s requested but the kernel path is "
+            "unsupported (%s); serving on the gather read",
+            requested, reason,
+        )
+        with self._lock:
+            self._counters["paged_attn_fallbacks"] += 1
+        _metrics.counter("serve.paged_attn_fallbacks")
+        return False
 
     # -------------------------------------------------------- compile layer
 
-    def _out_shardings(self):
+    def _out_shardings(self, decode: bool = False):
         """With a tp-sharded cache, pin the outputs: the cache keeps
         its sharding (a changed output sharding would break the donated
-        carry on the NEXT call), the token output is replicated."""
+        carry on the NEXT call), the token output — and the decode
+        step's PRNG-key carry — replicated."""
         if self.manager.sharding is None:
             return None
         import jax
@@ -246,26 +365,32 @@ class InferenceEngine:
         cache_sh = jax.tree_util.tree_map(
             lambda _: self.manager.sharding, self.manager.cache
         )
-        return (rep, cache_sh)
+        return (rep, cache_sh, rep) if decode else (rep, cache_sh)
 
-    def _lower(self, fn, args):
+    def _lower(self, fn, args, decode: bool = False):
         """THE one jit-option assembly (donated cache carry at arg 1,
         pinned out-shardings): ``_compile`` finishes it into the
         executable, ``lowered_decode``/``lowered_prefill`` hand the
         Lowered to the static-analysis surface — one builder, so the
-        audited program can never drift from the executed one."""
+        audited program can never drift from the executed one. The
+        decode step additionally donates its last argument — the
+        per-slot PRNG keys, which carry exactly like the cache — and
+        returns ``(tokens, cache, keys)``."""
         import jax
 
         kwargs = {}
         if self.donate:
-            kwargs["donate_argnums"] = (1,)  # the cache carry
-        out_sh = self._out_shardings()
+            donate = (1,)  # the cache carry
+            if decode:
+                donate = donate + (len(args) - 1,)  # the key carry
+            kwargs["donate_argnums"] = donate
+        out_sh = self._out_shardings(decode=decode)
         if out_sh is not None:
             kwargs["out_shardings"] = out_sh
         return jax.jit(fn, **kwargs).lower(*args)
 
-    def _compile(self, fn, args, kind: str):
-        exe = self._lower(fn, args).compile()
+    def _compile(self, fn, args, kind: str, decode: bool = False):
+        exe = self._lower(fn, args, decode=decode).compile()
         with self._lock:
             self._counters[f"{kind}_compiles"] += 1
         return exe
@@ -275,7 +400,11 @@ class InferenceEngine:
         args = (self._params, self.manager.cache, tokens, lengths)
         if self.paged:
             args = args + (self.manager.tables_array(),)
-        return args
+        return args + (
+            self._sample_temps.copy(),
+            self._sample_topks.copy(),
+            self._sample_keys,
+        )
 
     def lowered_decode(self):
         """The decode step's ``jax.stages.Lowered`` under exactly the
@@ -286,6 +415,7 @@ class InferenceEngine:
         return self._lower(
             self._decode_fn(),
             self._decode_args(np.zeros((self.slots,), np.int32)),
+            decode=True,
         )
 
     def lowered_prefill(self, width: int):
@@ -312,10 +442,12 @@ class InferenceEngine:
         model_fn = self._model_fn
 
         if self.paged:
+            paged_attn = self.paged_attn
+
             def fn(params, cache, tokens, table_row, start, last_pos):
                 logits, cache = model_fn(
                     params, tokens, cache, jnp.reshape(start, (1,)),
-                    pages=table_row[None],
+                    pages=table_row[None], paged_attn=paged_attn,
                 )
                 row = lax.dynamic_index_in_dim(
                     logits[0], last_pos, axis=0, keepdims=False
@@ -352,26 +484,29 @@ class InferenceEngine:
         model_fn = self._model_fn
 
         if self.paged:
-            def fn(params, cache, tokens, lengths, tables):
+            paged_attn = self.paged_attn
+
+            def fn(params, cache, tokens, lengths, tables, temps, topks,
+                   keys):
                 logits, cache = model_fn(
                     params, tokens[:, None], cache, lengths,
-                    pages=tables,
+                    pages=tables, paged_attn=paged_attn,
                 )
-                return (
-                    jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32),
-                    cache,
-                )
+                row = logits[:, 0, :]
+                greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                nxt, keys = _sample_next(row, greedy, temps, topks, keys)
+                return nxt, cache, keys
 
             return fn
 
-        def fn(params, cache, tokens, lengths):
+        def fn(params, cache, tokens, lengths, temps, topks, keys):
             logits, cache = model_fn(
                 params, tokens[:, None], cache, lengths
             )
-            return (
-                jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32),
-                cache,
-            )
+            row = logits[:, 0, :]
+            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            nxt, keys = _sample_next(row, greedy, temps, topks, keys)
+            return nxt, cache, keys
 
         return fn
 
@@ -523,6 +658,8 @@ class InferenceEngine:
                 np.int32(start),
                 np.int32(ceiling - 1),
             )
+            if self.paged_attn:
+                self._counters["paged_attn_calls"] += 1
             start += ceiling
         tail = n - start
         exe, width = self._get_prefill_exe(tail, avail=self.max_len - start)
@@ -536,6 +673,8 @@ class InferenceEngine:
             np.int32(start),
             np.int32(tail - 1),
         )
+        if self.paged_attn:
+            self._counters["paged_attn_calls"] += 1
         self.manager.set_length(slot, n)
         self._counters["prefills"] += 1
         if self.paged and hashes:
@@ -575,42 +714,99 @@ class InferenceEngine:
         args = self._decode_args(tokens)
         if self._decode_exe is None:
             self._decode_exe = self._compile(
-                self._decode_fn(), args, "decode"
+                self._decode_fn(), args, "decode", decode=True
             )
-        out, self.manager.cache = self._decode_exe(*args)
+        out, self.manager.cache, self._sample_keys = self._decode_exe(
+            *args
+        )
         self._counters["decode_steps"] += 1
+        if self.paged_attn:
+            self._counters["paged_attn_calls"] += 1
         return np.asarray(out)
 
+    # ------------------------------------------------------------- sampling
+
+    def set_sampling(self, slot: int, temperature: float = 0.0,
+                     top_k: int = 0, seed: Optional[int] = None) -> None:
+        """Arm a slot's sampling knobs (pure DATA into the one decode
+        executable — never a retrace): ``temperature<=0`` keeps the
+        bit-identical greedy branch, ``top_k<=0`` disables truncation.
+        ``seed`` re-seeds the slot's PRNG key (an eager ``.at[].set``
+        data op on the key carry); the batcher derives a stable
+        per-request default so replays reproduce."""
+        import jax
+
+        self._sample_temps[slot] = float(temperature)
+        self._sample_topks[slot] = int(top_k)
+        if seed is not None:
+            self._sample_keys = self._sample_keys.at[int(slot)].set(
+                jax.random.key_data(jax.random.PRNGKey(int(seed)))
+            )
+
+    def clear_sampling(self, slot: int) -> None:
+        """Back to greedy on slot free — the next occupant inherits
+        nothing."""
+        self._sample_temps[slot] = 0.0
+        self._sample_topks[slot] = 0
+
     # ----------------------------------------------- KV transfer primitives
+
+    def gather_pages(self, kept):
+        """Device-side gather of a detached slot's pages — the cheap,
+        scheduler-thread half of :meth:`extract_pages`: one indexed
+        read per cache leaf, dispatched asynchronously, materializing
+        FRESH device buffers that share no storage with the
+        executables' donated carry (so later decode steps can donate
+        the pool away freely while these wait to be serialized).
+        Returns per-leaf device arrays in ``tree_leaves`` order; hand
+        them to :meth:`pages_to_host` OFF the scheduler thread."""
+        if not self.paged:
+            raise RuntimeError("gather_pages needs the paged plane")
+        import jax
+
+        idx = np.asarray([p for _, p in kept], np.int32)
+        return [
+            leaf[idx] for leaf in jax.tree_util.tree_leaves(
+                self.manager.cache
+            )
+        ]
+
+    def pages_to_host(self, raw, kept, length: int):
+        """The blocking half of :meth:`extract_pages`: ONE batched
+        ``jax.device_get`` over every leaf's gathered pages (not a
+        device round-trip per page or per leaf), then zero the tail
+        page at and past ``length`` — garbage rows must not travel and
+        must not raise an int8 block scale (zeros never move an
+        absmax). Thread-safe: ``raw`` are the fresh buffers
+        :meth:`gather_pages` made, so this runs on the transfer
+        handoff thread without touching engine state — an in-flight
+        transfer can no longer stall decode admission rounds."""
+        import jax
+
+        pt = self.manager.page_tokens
+        tail_valid = int(length) - (len(kept) - 1) * pt
+        out = []
+        for arr in jax.device_get(raw):
+            arr = np.asarray(arr)
+            if 0 <= tail_valid < pt:
+                if not arr.flags.writeable:
+                    arr = arr.copy()
+                arr[-1, tail_valid:] = 0
+            out.append(arr)
+        return out
 
     def extract_pages(self, kept, length: int):
         """Host copies of a detached slot's pages for the transfer wire
         (serving/kv_transfer.py): one ``[n_pages, page_tokens, kv_heads,
         head_dim]`` ndarray per cache leaf, in ``tree_leaves`` order,
-        with every position at or past ``length`` zeroed — the tail
-        page's garbage rows must not travel (and must not raise an int8
-        block scale: zeros never move an absmax, so pad positions are
-        excluded from the wire's quantization by construction).
-
-        Scheduler-thread only, like every other touch of the pool: the
-        gather materializes FRESH buffers, so the handoff thread that
-        serializes them afterwards shares no device state with the
-        executables' donated carry."""
-        if not self.paged:
-            raise RuntimeError("extract_pages needs the paged plane")
-        import jax
-
-        mgr = self.manager
-        idx = np.asarray([p for _, p in kept], np.int32)
-        pt = mgr.page_tokens
-        tail_valid = int(length) - (len(kept) - 1) * pt
-        out = []
-        for leaf in jax.tree_util.tree_leaves(mgr.cache):
-            arr = np.array(leaf[idx])  # copy: the tail zeroing writes
-            if 0 <= tail_valid < pt:
-                arr[-1, tail_valid:] = 0
-            out.append(arr)
-        return out
+        with every position at or past ``length`` zeroed. Composed from
+        :meth:`gather_pages` (scheduler-thread device gather) +
+        :meth:`pages_to_host` (one batched ``device_get``) — the
+        transfer sender splits the two halves across threads so only
+        the async gather rides the scheduler hot path; this one-call
+        form serves synchronous users (pack_pages, the audit
+        roster)."""
+        return self.pages_to_host(self.gather_pages(kept), kept, length)
 
     def ingest_attach(self, slot, logical, arrays, length, hashes=()):
         """Receiver side of a KV transfer: land foreign page payloads
@@ -662,6 +858,7 @@ class InferenceEngine:
             "prefill_promotions", "prefill_pad_tokens",
             "chunked_prefill_chunks", "prefill_chunks_skipped",
             "prefill_tokens_skipped", "transfer_ingests",
+            "paged_attn_calls", "paged_attn_fallbacks",
         ):
             out.setdefault(key, 0)
         out["prefill_exact_entries"] = len(self._prefill_exact)

@@ -6,7 +6,8 @@ The MetricsServer mold (common/telemetry.py): a stdlib
 Routes:
 
 * ``POST /generate`` — body ``{"tokens": [...], "max_tokens"?,
-  "deadline_ms"?}``; blocks until the request completes (the handler
+  "deadline_ms"?, "temperature"?, "top_k"?, "seed"?}``; blocks until
+  the request completes (the handler
   thread parks on the request's event; the batcher's decode thread
   does the work) and replies the result JSON (tokens, status, TTFT,
   generation wall). 503 while draining; 429 when rejected.
@@ -197,6 +198,11 @@ class ServeFrontend:
                             tokens,
                             max_new_tokens=payload.get("max_tokens"),
                             deadline_ms=payload.get("deadline_ms"),
+                            temperature=float(
+                                payload.get("temperature", 0.0)
+                            ),
+                            top_k=int(payload.get("top_k", 0)),
+                            seed=payload.get("seed"),
                         )
                     except Rejected as e:
                         # draining (planned or crash) is the WORKER's
@@ -533,9 +539,15 @@ class Router:
         deadline_ms: Optional[float] = None,
         timeout: float = 60.0,
         attempts: int = 3,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: Optional[int] = None,
     ) -> dict:
         """POST /generate on the picked worker; a dead or draining pick
-        fails over to the next candidate."""
+        fails over to the next candidate. Sampling knobs ride the
+        payload verbatim (temperature 0 = greedy; a caller-pinned seed
+        keeps a retried/failed-over request reproducible on whichever
+        worker serves it)."""
         import urllib.error
         import urllib.request
 
@@ -544,6 +556,12 @@ class Router:
             payload["max_tokens"] = int(max_tokens)
         if deadline_ms is not None:
             payload["deadline_ms"] = float(deadline_ms)
+        if temperature:
+            payload["temperature"] = float(temperature)
+        if top_k:
+            payload["top_k"] = int(top_k)
+        if seed is not None:
+            payload["seed"] = int(seed)
         body = json.dumps(payload).encode()
         last_err: Optional[Exception] = None
         failed: set = set()

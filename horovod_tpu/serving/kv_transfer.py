@@ -370,6 +370,9 @@ class KVTransferServer:
                 arrays=arrays,
                 length=int(meta["length"]),
                 hashes=[bytes.fromhex(h) for h in meta.get("hashes", ())],
+                temperature=float(meta.get("temperature", 0.0)),
+                top_k=int(meta.get("top_k", 0)),
+                seed=meta.get("seed"),
             )
         except Exception as e:  # Rejected, malformed frames
             _log.warning("kv transfer ingest rejected: %s", e)
@@ -539,10 +542,15 @@ class TransferCoordinator:
     def start_handoff(
         self, batcher, req, kept, length: int, reservation: dict,
     ) -> None:
-        """Scheduler-thread entry: gather the pages to host NOW (fresh
-        buffers — nothing the executables' donated carry can invalidate
-        later), then stream + await the decode result off-thread."""
-        raw = self.engine.extract_pages(kept, length)
+        """Scheduler-thread entry: only the DEVICE-side page gather
+        runs here (``engine.gather_pages`` — an async indexed read into
+        fresh buffers nothing the executables' donated carry can
+        invalidate later); the blocking host materialization — one
+        batched ``device_get`` over every leaf — happens on the handoff
+        thread (``_stream`` → ``engine.pages_to_host``), so an
+        in-flight transfer never stalls the scheduler's decode
+        admission rounds."""
+        raw = self.engine.gather_pages(kept)
         threading.Thread(
             target=self._stream,
             args=(batcher, req, kept, length, reservation, raw),
@@ -579,6 +587,9 @@ class TransferCoordinator:
         base = f"http://{reservation['addr']}:{reservation['port']}"
         t0 = time.perf_counter()
         try:
+            # blocking half of the page extraction: one batched
+            # device_get + tail zeroing, OFF the scheduler thread
+            raw = self.engine.pages_to_host(raw, kept, length)
             meta, blob = pack_raw_pages(
                 raw, [lp for lp, _ in kept], length,
                 page_tokens=self.engine.manager.page_tokens,
@@ -598,6 +609,12 @@ class TransferCoordinator:
                 first_token=int(req.out_tokens[-1]),
                 max_new_tokens=int(req.max_new_tokens),
                 deadline_ms=remaining_ms,
+                # sampling knobs ride the wire; the seed is resolved
+                # HERE (sender request id when unpinned) so the decode
+                # worker reproduces what a local decode would have drawn
+                temperature=float(req.temperature),
+                top_k=int(req.top_k),
+                seed=int(req.id if req.seed is None else req.seed),
                 hashes=[
                     h.hex() for h in page_hashes(
                         req.prompt, self.engine.manager.page_tokens

@@ -354,7 +354,7 @@ def prog_moe_alltoall():
     ]
 
 
-def _serve_engine(paged, role="unified"):
+def _serve_engine(paged, role="unified", paged_attn=None):
     from horovod_tpu.models.transformer import Transformer, TransformerConfig
     from horovod_tpu.serving.engine import InferenceEngine
 
@@ -368,7 +368,7 @@ def _serve_engine(paged, role="unified"):
     )
     return InferenceEngine(
         model, params, slots=4, max_len=64, min_bucket=4,
-        donate=True, paged=paged, role=role,
+        donate=True, paged=paged, role=role, paged_attn=paged_attn,
     )
 
 
@@ -576,6 +576,44 @@ def prog_serve_decode_role():
     return pairs
 
 
+def prog_serve_paged_attn():
+    """PR 17: with the fused paged-attention read (``paged_attn=on``),
+    the decode program streams K/V straight from the page pool — the
+    transient contiguous ``[slots, max_len, kvh, hd]`` gather view is
+    GONE from the lowered module (TransientBuffer forbid), while the
+    gather-path baseline still carries it (falsifiability: the same
+    matcher detects the buffer it bans). The pool carry stays donated
+    and the compile budget is untouched: ``decode_compiles == 1``
+    across rolling admissions on the kernel path, zero fallbacks."""
+    eng = _serve_engine(paged=True, paged_attn="on")
+    base = _serve_engine(paged=True, paged_attn="off")
+    gk = analysis.parse_module(eng.lowered_decode())
+    gb = analysis.parse_module(base.lowered_decode())
+    n_cache = len(jax.tree_util.tree_leaves(eng.manager.cache))
+    shape = (eng.slots, eng.max_len)
+    pairs = [
+        (rules.TransientBuffer(shape, forbid=True), gk),
+        (rules.TransientBuffer(shape, forbid=False), gb),
+        (rules.DonationCoverage(min_donated=n_cache), gk),
+    ]
+    rng = np.random.default_rng(7)
+    for i in range(4):
+        slot = eng.manager.alloc(f"warm{i}")
+        eng.prefill(slot, rng.integers(1, 60, size=5 + i).tolist())
+    for i in range(6):
+        eng.decode_step(np.zeros(eng.slots, np.int32))
+        if i == 2:  # roll one admission mid-decode
+            eng.manager.free(1)
+            slot = eng.manager.alloc("rolled")
+            eng.prefill(slot, rng.integers(1, 60, size=9).tolist())
+    stats = eng.stats()
+    pairs.append(
+        (rules.CompileBudget(decode_compiles=1, paged_attn_fallbacks=0),
+         stats)
+    )
+    return pairs
+
+
 ROSTER = {
     "fused_allreduce_fp32": prog_fused_allreduce_fp32,
     "fused_allreduce_int8": prog_fused_allreduce_int8,
@@ -592,6 +630,7 @@ ROSTER = {
     "serve_prefill": prog_serve_prefill,
     "serve_prefill_role": prog_serve_prefill_role,
     "serve_decode_role": prog_serve_decode_role,
+    "serve_paged_attn": prog_serve_paged_attn,
 }
 
 

@@ -279,7 +279,9 @@ def test_bench_fusion_harness_smoke():
         },
     )
     modes = {l["mode"] for l in lines if l["metric"] == "eager_fusion"}
-    assert modes == {"unfused", "fused", "default", "traced"}
+    # later PRs added modes (host_pack, bucketing_*, gather_*); the
+    # original quartet must still be present
+    assert modes >= {"unfused", "fused", "default", "traced"}
     assert any(l["metric"] == "eager_fusion_speedup" for l in lines)
     auto = [l for l in lines if l["metric"] == "fusion_autotune"]
     assert auto and auto[0]["trials"] == 2

@@ -6,6 +6,9 @@ reference documents (scale behavior, agreement across ranks)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
 from hypothesis import HealthCheck, given, settings, strategies as st
 
 # the hvd fixture is stable across examples (module-level init); not
