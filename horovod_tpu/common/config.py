@@ -393,6 +393,15 @@ class Config:
 
     # --- elastic ---
     elastic_discovery_interval: float = DEFAULT_ELASTIC_DISCOVERY_INTERVAL
+    # persistent executable cache root (common/exe_cache.py): serialized
+    # AOT executables keyed by (topology fp, HLO fp, wire, donation);
+    # None = disk tier off everywhere
+    exe_cache: Optional[str] = None
+    # warm-standby hosts the elastic driver holds OUT of the gang,
+    # pre-initialized (rendezvous-registered, executables deserialized,
+    # params staged) so restarts/scale-ups swap one in instead of
+    # cold-starting; 0 = off
+    warm_standby: int = 0
 
     # --- TPU mesh ---
     mesh_shape: Optional[str] = None  # e.g. "dp=8" or "dp=4,tp=2"
@@ -626,6 +635,8 @@ class Config:
                 "HOROVOD_ELASTIC_DISCOVERY_INTERVAL",
                 DEFAULT_ELASTIC_DISCOVERY_INTERVAL,
             ),
+            exe_cache=env.get("HOROVOD_EXE_CACHE") or None,
+            warm_standby=_env_int("HOROVOD_WARM_STANDBY", 0),
             mesh_shape=env.get("HOROVOD_TPU_MESH"),
             num_streams=_env_int("HOROVOD_NUM_STREAMS", 1),
         )

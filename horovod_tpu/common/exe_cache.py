@@ -1,0 +1,585 @@
+"""Persistent compiled-executable store (``HOROVOD_EXE_CACHE``).
+
+Every gang restart and every serve scale-up used to pay full
+recompilation of the executor caches — the gap between the elastic
+plane's "self-healing" and healing *fast* (ROADMAP item 5). The
+executables are already held by exact key (the PR 1 fusion two-tier
+cache, the serving engine's AOT prefill/decode tables), so the repo
+knows precisely what to persist: this module gives those tables a disk
+tier with the same contract the tuner cache established in PR 12 —
+best-effort, tmp+rename writes, corrupt or version-mismatched entries
+read as a counted cold start, never an error.
+
+Entry key anatomy (also docs/elastic.md):
+
+* **topology fingerprint** ``w<world>-l<intra>-<platform>`` (shared
+  with :func:`..common.autotune.topology_fingerprint`) — an executable
+  compiled for an 8-world mesh must never load into a 6-world one; the
+  elastic 8→6 reshard warm-starts from the 6-world entries captured in
+  prior epochs precisely because they live under a different prefix.
+* **HLO fingerprint** — sha256 of the lowered program's StableHLO
+  text. This is the semantic key: model weights' *shapes*, the wire
+  recipe, sharding, and the jit options all land in the lowered text,
+  so any drift misses cleanly instead of loading a wrong program.
+* **wire format** — the resolved wire string for collective
+  executables (``fp32``/``int8``/``bf16``/``intra/inter``); ``none``
+  for serving programs. Redundant with the HLO text, kept explicit so
+  operators can read a cache directory listing.
+* **donation signature** — ``d<argnums>`` of the donated buffers. Two
+  programs with identical HLO but different donation would alias
+  differently; they must not share an entry.
+
+On top of the key, the header pins ``jax``/``jaxlib`` versions and the
+platform: a deserialized executable is only ever loaded into the exact
+software it was serialized from. Anything else — torn file, flipped
+bit (chaos site ``exe_cache.load``), version skew — degrades to a
+counted cold compile (``exe_cache.corrupt`` / ``exe_cache.rejected``).
+
+File format, one entry per file::
+
+    HVDEXE1\\n | u32 header_len | header JSON | pickled
+    (payload, in_tree, out_tree) from
+    jax.experimental.serialize_executable.serialize
+
+Writes ride a background writer thread (serialization happens on the
+caller, only the file I/O is deferred) and are flushed by
+``preemption`` drain hooks and atexit — persist-on-drain, so a
+SIGTERM'd worker leaves its compiles behind for the standby that
+replaces it.
+
+Telemetry: ``exe_cache.{hits,misses,corrupt,rejected,stores,bytes,
+deserialize_ms}`` ride the counter plane (StepStats deltas +
+``/metrics``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import json
+import os
+import pickle
+import queue
+import struct
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .logging import get_logger
+from .metrics import registry as _metrics
+
+_log = get_logger("exe_cache")
+
+FORMAT_VERSION = 1
+MAGIC = b"HVDEXE1\n"
+_SUFFIX = ".hvdexe"
+
+# --------------------------------------------------------------------- keys
+
+
+def cache_dir(base: Optional[str] = None) -> Optional[str]:
+    """The resolved cache directory, or None when the disk tier is off
+    (no ``HOROVOD_EXE_CACHE`` and no explicit base) — every caller
+    gates on this so the no-cache path stays byte-identical to the
+    pre-disk-tier engine."""
+    if base:
+        return base
+    return os.environ.get("HOROVOD_EXE_CACHE") or None
+
+
+def topology_fingerprint() -> str:
+    """``w<world>-l<intra>-<platform>`` — the same namespace the tuner
+    cache uses (one fleet, one fingerprint vocabulary)."""
+    from .autotune import topology_fingerprint as _fp
+
+    return _fp()
+
+
+def hlo_fingerprint(lowered_or_text) -> str:
+    """sha256 of the lowered program text (``jax.stages.Lowered`` or a
+    pre-rendered string)."""
+    text = (
+        lowered_or_text
+        if isinstance(lowered_or_text, str)
+        else lowered_or_text.as_text()
+    )
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def donation_signature(donate_argnums) -> str:
+    """``d<i>.<j>`` for donated argument indices; ``none`` without
+    donation."""
+    nums = tuple(int(i) for i in (donate_argnums or ()))
+    return "d" + ".".join(str(i) for i in nums) if nums else "none"
+
+
+def _entry_hash(hlo_fp: str, wire: str, donation: str) -> str:
+    return hashlib.sha256(
+        f"{hlo_fp}|{wire}|{donation}".encode()
+    ).hexdigest()[:24]
+
+
+def entry_path(
+    family: str,
+    hlo_fp: str,
+    wire: str = "none",
+    donation: str = "none",
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+) -> Optional[str]:
+    """The entry file for one executable key, or None when the disk
+    tier is off."""
+    root = cache_dir(base)
+    if not root:
+        return None
+    if fingerprint is None:
+        fingerprint = topology_fingerprint()
+    name = (
+        f"{family.replace('/', '_')}-{fingerprint}"
+        f"-{_entry_hash(hlo_fp, wire, donation)}{_SUFFIX}"
+    )
+    return os.path.join(root, name)
+
+
+def _software() -> Dict[str, str]:
+    import jax
+    import jaxlib
+
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": platform,
+    }
+
+
+# ----------------------------------------------------------- write side
+
+
+class _Writer:
+    """Background entry writer: serialization already happened on the
+    caller; this thread only owns the tmp+rename file I/O, so a slow
+    disk never blocks a decode step. ``flush`` drains it — registered
+    as a preemption drain hook and at exit (persist-on-drain)."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="exe-cache-writer"
+                )
+                self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            path, blob = self._q.get()
+            try:
+                _write_atomic(path, blob)
+            except OSError as e:  # best-effort by contract
+                _metrics.counter("exe_cache.store_errors")
+                _log.warning("exe cache write failed for %s: %s", path, e)
+            finally:
+                self._q.task_done()
+
+    def submit(self, path: str, blob: bytes) -> None:
+        self._ensure_thread()
+        self._q.put((path, blob))
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Drain queued writes; True when the queue emptied in time."""
+        if self._thread is None:
+            return True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+
+_writer = _Writer()
+_drain_registered = False
+_drain_lock = threading.Lock()
+
+
+def flush(timeout: Optional[float] = None) -> bool:
+    """Drain pending entry writes (drain hooks, tests)."""
+    return _writer.flush(timeout)
+
+
+def _register_drain() -> None:
+    """Lazy one-shot: writes survive SIGTERM (preemption drain) and
+    normal exit."""
+    global _drain_registered
+    with _drain_lock:
+        if _drain_registered:
+            return
+        _drain_registered = True
+    atexit.register(flush, 5.0)
+    try:
+        from .. import preemption
+
+        preemption.register_drain(lambda: flush(5.0))
+    except Exception:  # pragma: no cover — import-order edge
+        pass
+
+
+def _write_atomic(path: str, blob: bytes) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path), prefix=".tmp-", suffix=_SUFFIX
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def store(
+    compiled,
+    family: str,
+    hlo_fp: str,
+    wire: str = "none",
+    donation: str = "none",
+    meta: Optional[Dict[str, Any]] = None,
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+    sync: bool = False,
+) -> Optional[str]:
+    """Serialize ``compiled`` and persist it under its key. Returns
+    the entry path, or None when the disk tier is off or serialization
+    is unsupported on this backend. Never raises — persistence must
+    never take a serving loop down."""
+    path = entry_path(family, hlo_fp, wire, donation, fingerprint, base)
+    if path is None:
+        return None
+    try:
+        from jax.experimental import serialize_executable as _se
+
+        payload, in_tree, out_tree = _se.serialize(compiled)
+        body = pickle.dumps(
+            (payload, in_tree, out_tree), protocol=pickle.HIGHEST_PROTOCOL
+        )
+    except Exception as e:
+        _metrics.counter("exe_cache.serialize_errors")
+        _log.warning("exe cache serialize failed (%s): %s", family, e)
+        return None
+    header = dict(_software())
+    header.update(
+        format=FORMAT_VERSION,
+        family=family,
+        topology=fingerprint or topology_fingerprint(),
+        hlo=hlo_fp,
+        wire=wire,
+        donation=donation,
+        meta=dict(meta or {}),
+        payload_sha256=hashlib.sha256(body).hexdigest(),
+        payload_bytes=len(body),
+    )
+    hdr = json.dumps(header, sort_keys=True).encode()
+    blob = MAGIC + struct.pack(">I", len(hdr)) + hdr + body
+    _metrics.counter("exe_cache.stores")
+    _register_drain()
+    if sync:
+        try:
+            _write_atomic(path, blob)
+        except OSError as e:
+            _metrics.counter("exe_cache.store_errors")
+            _log.warning("exe cache write failed for %s: %s", path, e)
+            return None
+    else:
+        _writer.submit(path, blob)
+    return path
+
+
+# ------------------------------------------------------------ read side
+
+
+def _read_header(blob: bytes) -> Tuple[Dict[str, Any], bytes]:
+    if not blob.startswith(MAGIC):
+        raise ValueError("bad magic")
+    off = len(MAGIC)
+    (hlen,) = struct.unpack(">I", blob[off:off + 4])
+    off += 4
+    header = json.loads(blob[off:off + hlen].decode())
+    return header, blob[off + hlen:]
+
+
+def _header_mismatch(
+    header: Dict[str, Any],
+    hlo_fp: str,
+    wire: str,
+    donation: str,
+    fingerprint: str,
+) -> Optional[str]:
+    """The invalidation rules: every pinned field must match the
+    reader exactly. Returns the first offending field, or None."""
+    want = dict(_software())
+    want.update(
+        format=FORMAT_VERSION,
+        topology=fingerprint,
+        hlo=hlo_fp,
+        wire=wire,
+        donation=donation,
+    )
+    for field, expect in want.items():
+        if header.get(field) != expect:
+            return field
+    return None
+
+
+def load(
+    family: str,
+    hlo_fp: str,
+    wire: str = "none",
+    donation: str = "none",
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+):
+    """Load one executable by key; None on any miss. Misses are always
+    safe: absent file (``exe_cache.misses``), torn/bitflipped payload
+    (``exe_cache.corrupt``), or a header that fails the invalidation
+    rules — wrong JAX/jaxlib version, platform, topology, wire, or
+    donation signature (``exe_cache.rejected``; the payload is never
+    deserialized into a mismatched runtime). Hits count bytes and
+    deserialize wall-ms."""
+    if fingerprint is None:
+        fingerprint = topology_fingerprint()
+    path = entry_path(family, hlo_fp, wire, donation, fingerprint, base)
+    if path is None:
+        return None
+    from ..testing import chaos as _chaos
+
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        _metrics.counter("exe_cache.misses")
+        return None
+    # the chaos site: ``bitflip`` corrupts the just-read payload (the
+    # caller-owns-the-corruption DATA contract), ``delay`` stalls the
+    # deserialization inside fire()
+    if _chaos.inject("exe_cache.load") == "bitflip":
+        flip = len(blob) - 1  # payload tail: past magic + header
+        blob = blob[:flip] + bytes([blob[flip] ^ 0x40]) + blob[flip:][1:]
+    t0 = time.monotonic()
+    try:
+        header, body = _read_header(blob)
+    except (ValueError, struct.error, UnicodeDecodeError):
+        _metrics.counter("exe_cache.corrupt")
+        _log.warning("exe cache entry %s is corrupt (header)", path)
+        return None
+    bad = _header_mismatch(header, hlo_fp, wire, donation, fingerprint)
+    if bad is not None:
+        _metrics.counter("exe_cache.rejected")
+        _log.warning(
+            "exe cache entry %s rejected: %s mismatch (%r != reader)",
+            path, bad, header.get(bad),
+        )
+        return None
+    if (
+        hashlib.sha256(body).hexdigest() != header.get("payload_sha256")
+        or len(body) != header.get("payload_bytes")
+    ):
+        _metrics.counter("exe_cache.corrupt")
+        _log.warning("exe cache entry %s is corrupt (payload)", path)
+        return None
+    try:
+        from jax.experimental import serialize_executable as _se
+
+        payload, in_tree, out_tree = pickle.loads(body)
+        exe = _se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception as e:
+        _metrics.counter("exe_cache.corrupt")
+        _log.warning("exe cache entry %s failed to deserialize: %s",
+                     path, e)
+        return None
+    _metrics.counter("exe_cache.hits")
+    _metrics.counter("exe_cache.bytes", len(blob))
+    _metrics.counter(
+        "exe_cache.deserialize_ms",
+        max((time.monotonic() - t0) * 1e3, 0.0),
+    )
+    return exe
+
+
+def get_or_compile(
+    lowered,
+    family: str,
+    wire: str = "none",
+    donation: str = "none",
+    meta: Optional[Dict[str, Any]] = None,
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+):
+    """The one-call disk tier: try the entry for ``lowered``'s key,
+    else ``.compile()`` and persist. Returns ``(exe, hit)``; counts a
+    miss only when the file could have existed (disk tier on)."""
+    fp = hlo_fingerprint(lowered)
+    exe = load(family, fp, wire, donation, fingerprint, base)
+    if exe is not None:
+        return exe, True
+    exe = lowered.compile()
+    store(exe, family, fp, wire, donation, meta, fingerprint, base)
+    return exe, False
+
+
+def scan(
+    family: Optional[str] = None,
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """Headers (never payloads) of every readable entry for this
+    topology — the warm-start enumeration: an engine cannot know which
+    prompt widths past runs promoted, so it scans its family, re-lowers
+    each candidate from the entry's ``meta``, and loads by exact key
+    (the fingerprint match happens in :func:`load`). Unreadable files
+    are skipped, not raised."""
+    root = cache_dir(base)
+    if not root or not os.path.isdir(root):
+        return []
+    if fingerprint is None:
+        fingerprint = topology_fingerprint()
+    out = []
+    for name in sorted(os.listdir(root)):
+        if not name.endswith(_SUFFIX) or name.startswith(".tmp-"):
+            continue
+        if fingerprint not in name:
+            continue
+        if family is not None and not name.startswith(
+            family.replace("/", "_") + "-"
+        ):
+            continue
+        path = os.path.join(root, name)
+        try:
+            with open(path, "rb") as f:
+                head = f.read(len(MAGIC) + 4)
+                if not head.startswith(MAGIC):
+                    continue
+                (hlen,) = struct.unpack(">I", head[len(MAGIC):])
+                header = json.loads(f.read(hlen).decode())
+        except (OSError, ValueError, struct.error, UnicodeDecodeError):
+            continue
+        if header.get("topology") != fingerprint:
+            continue
+        if family is not None and header.get("family") != family:
+            continue
+        header["path"] = path
+        out.append(header)
+    return out
+
+
+def preload(
+    family: Optional[str] = None,
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+    limit: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Deserialize every readable entry for this topology — the warm-
+    standby staging step: a standby host pays the deserialization (and
+    page-cache fault-in) cost BEFORE it is swapped into a gang, so the
+    swap-in itself starts with validated, warm entries. Returns
+    ``(loaded, bytes)``; corrupt/mismatched entries count through the
+    usual :func:`load` counters and are skipped, never raised."""
+    if fingerprint is None:
+        fingerprint = topology_fingerprint()
+    loaded = total = 0
+    for header in scan(family, fingerprint, base):
+        if limit is not None and loaded >= limit:
+            break
+        exe = load(
+            header.get("family", ""),
+            header.get("hlo", ""),
+            header.get("wire", "none"),
+            header.get("donation", "none"),
+            fingerprint,
+            base,
+        )
+        if exe is not None:
+            loaded += 1
+            total += int(header.get("payload_bytes", 0))
+    return loaded, total
+
+
+# ------------------------------------------- schedule-decision sidecars
+#
+# The overlap/ZeRO schedule caches persist their partition decisions
+# BESIDE the executables: a restarted worker re-derives the same
+# buckets from the same inputs today, but the sidecar makes the
+# decision durable against heuristic drift (a code change reads the
+# recorded partition and its exe-cache entries still hit) and gives
+# operators the partition that produced each persisted executable.
+
+
+def sidecar_path(
+    name: str,
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+) -> Optional[str]:
+    root = cache_dir(base)
+    if not root:
+        return None
+    if fingerprint is None:
+        fingerprint = topology_fingerprint()
+    return os.path.join(root, f"{name}-{fingerprint}.json")
+
+
+def load_json(
+    name: str,
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Best-effort sidecar read: {} when off, absent, or corrupt."""
+    path = sidecar_path(name, fingerprint, base)
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        _metrics.counter("exe_cache.corrupt")
+        return {}
+    return obj if isinstance(obj, dict) else {}
+
+
+def persist_json(
+    name: str,
+    entries: Dict[str, Any],
+    fingerprint: Optional[str] = None,
+    base: Optional[str] = None,
+) -> Optional[str]:
+    """Merge-and-write a sidecar (own entries win, disk's other keys
+    survive — the tuner-cache merge contract), tmp+rename."""
+    path = sidecar_path(name, fingerprint, base)
+    if not path:
+        return None
+    merged = dict(load_json(name, fingerprint, base))
+    merged.update(entries)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), prefix=".tmp-", suffix=".json"
+        )
+        with os.fdopen(fd, "w") as f:
+            json.dump(merged, f, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:
+        _metrics.counter("exe_cache.store_errors")
+        _log.warning("exe cache sidecar write failed for %s: %s", path, e)
+        return None
+    return path

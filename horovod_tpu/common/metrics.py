@@ -240,6 +240,51 @@ SERVING_PAGED_ATTN_METRICS = (
     "serve.paged_attn_fallbacks",
 )
 
+# Persistent-executable-cache + warm-restart families (PR 18 —
+# common/exe_cache.py, elastic/driver.py + standby.py, elastic/worker
+# init; legend for docs/observability.md's warm-restart table):
+#   exe_cache.hits / misses       disk-tier lookups that deserialized /
+#                                 found no entry (counters)
+#   exe_cache.corrupt             torn/bitflipped entries degraded to a
+#                                 cold compile (counter; chaos site
+#                                 `exe_cache.load`)
+#   exe_cache.rejected            entries refused by the invalidation
+#                                 rules (version/platform/topology/
+#                                 wire/donation skew) — never
+#                                 deserialized (counter)
+#   exe_cache.stores              entries serialized + queued (counter)
+#   exe_cache.bytes               bytes deserialized on hits (counter)
+#   exe_cache.deserialize_ms      wall-ms spent deserializing (counter)
+#   elastic.restart_ms            gang-teardown → this worker's re-init
+#                                 wall-ms (gauge, per worker)
+#   elastic.restart_warm          1.0 when a warm standby absorbed the
+#                                 restart (gauge)
+#   serve.scaleup_ms              restart_ms of a serve-saturation
+#                                 grow restart (gauge)
+#   serve.warm_start_ms / warm_started_exes
+#                                 engine init disk warm-start cost and
+#                                 entries loaded (gauge / counter)
+#   driver.standby.reserved       hosts currently held as warm
+#                                 standbys (gauge)
+#   driver.standby.swapins        standbys released into a gang
+#                                 (counter)
+EXE_CACHE_METRICS = (
+    "exe_cache.hits",
+    "exe_cache.misses",
+    "exe_cache.corrupt",
+    "exe_cache.rejected",
+    "exe_cache.stores",
+    "exe_cache.bytes",
+    "exe_cache.deserialize_ms",
+    "elastic.restart_ms",
+    "elastic.restart_warm",
+    "serve.scaleup_ms",
+    "serve.warm_start_ms",
+    "serve.warm_started_exes",
+    "driver.standby.reserved",
+    "driver.standby.swapins",
+)
+
 
 class MetricsRegistry:
     def __init__(self) -> None:

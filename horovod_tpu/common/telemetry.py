@@ -135,6 +135,18 @@ _COUNTER_KEYS = (
     "serve.kv_transfer_pages",
     "serve.kv_transfer_ms",
     "serve.transfer_fallbacks",
+    # persistent executable cache (common/exe_cache.py): a step whose
+    # record shows a hits/misses delta paid a disk-tier lookup (a
+    # promotion or a fresh bucket landed on that step), and a corrupt
+    # delta pins a degraded-to-cold-compile entry to the exact step
+    # that read it
+    "exe_cache.hits",
+    "exe_cache.misses",
+    "exe_cache.corrupt",
+    "exe_cache.rejected",
+    "exe_cache.stores",
+    "exe_cache.bytes",
+    "exe_cache.deserialize_ms",
 )
 
 # Gauges copied into the record's ``tuner`` dict — the autotune /

@@ -160,6 +160,17 @@ class ElasticDriver:
         # serve-capacity freshness ledger, same contract — see
         # _poll_serve_capacity
         self._serve_cap_seen: Dict[int, tuple] = {}
+        # warm standby (HOROVOD_WARM_STANDBY): hosts held OUT of the
+        # gang, pre-initialized by elastic/standby.py warmers, swapped
+        # in on quarantine/divergence restarts and serve saturation
+        # instead of cold-starting fresh capacity
+        self._warm_standby = _cfg.warm_standby
+        self._standby_current: set = set()  # reserved this epoch
+        self._standby_released: set = set()  # folded back into the pool
+        self._standby_warmers: Dict[str, Optional[subprocess.Popen]] = {}
+        self._standby_swapins = 0
+        self._scaleup_reason: Optional[str] = None
+        self._last_scaleup = 0.0
 
     # ---------------------------------------------------------- planning
 
@@ -170,18 +181,55 @@ class ElasticDriver:
         hosts = self.host_manager.current_hosts()
         if self._slots_per_host is not None:
             hosts = [HostInfo(h.hostname, self._slots_per_host) for h in hosts]
-        capacity = sum(h.slots for h in hosts)
+        reserved = self._reserve_standbys(hosts)
+        active = [h for h in hosts if h.hostname not in reserved]
+        capacity = sum(h.slots for h in active)
         if capacity < self._min_np:
             return None
+        self._standby_current = reserved
         np_ = min(capacity, self._max_np)
         return SlotAssignment(
             self._epoch if epoch is None else epoch,
-            assign_slots(hosts, np_),
+            assign_slots(active, np_),
         )
 
+    def _reserve_standbys(self, hosts) -> set:
+        """Up to ``HOROVOD_WARM_STANDBY`` hosts held OUT of the
+        assignment — only while the remaining capacity clears min_np (a
+        warm standby is a luxury; a gang below min_np is an outage).
+        Released hosts (swapped in by a restart or scale-up) are never
+        re-reserved; existing reservations are kept stable so a warmer
+        mid-staging is not churned away; new reservations come from the
+        tail of the sorted host list (rank-0 placement stays put)."""
+        if self._warm_standby <= 0:
+            return set()
+        by_name = {h.hostname: h for h in hosts}
+        # stability first: existing reservations still in the pool
+        candidates = [
+            hn for hn in self._standby_warmers
+            if hn in by_name and hn not in self._standby_released
+        ]
+        for hn in sorted(by_name, reverse=True):
+            if hn not in candidates and hn not in self._standby_released:
+                candidates.append(hn)
+        capacity = sum(h.slots for h in hosts)
+        reserved: set = set()
+        for hn in candidates:
+            if len(reserved) >= self._warm_standby:
+                break
+            slots = by_name[hn].slots
+            if capacity - slots >= self._min_np:
+                reserved.add(hn)
+                capacity -= slots
+        return reserved
+
     def handle_host_failure(self, hostname: str) -> None:
-        """Blacklist + force re-plan (ref: blacklist on worker failure)."""
+        """Blacklist + force re-plan (ref: blacklist on worker failure).
+        With a warm standby held, the lost capacity is backfilled by
+        releasing one standby into the pool — the restart that follows
+        swaps it in instead of shrinking the world."""
         self.host_manager.blacklist(hostname)
+        self._release_standby(f"host {hostname} failed")
 
     # ---------------------------------------------------------- gang ops
 
@@ -297,6 +345,156 @@ class ElasticDriver:
                 assignment.epoch,
                 [int(b["HOROVOD_RANK"]) for b in blocks],
             )
+        self._sync_standby_warmers(assignment, addr, server.port)
+
+    # ------------------------------------------------------ warm standby
+
+    def _sync_standby_warmers(
+        self, assignment: SlotAssignment, addr: str, kv_port: int
+    ) -> None:
+        """Reconcile warmer processes with the current reservation:
+        launch a warmer (elastic/standby.py) on each newly reserved
+        LOCAL host, reap warmers whose host left the reservation.
+        Remote reserved hosts are announced-only (the operator runs the
+        warmer there; the reservation itself still holds the capacity
+        out of the gang)."""
+        from ..common.metrics import registry as _metrics
+
+        reserved = set(self._standby_current)
+        for hn in list(self._standby_warmers):
+            if hn not in reserved:
+                proc = self._standby_warmers.pop(hn)
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+        for hn in sorted(reserved):
+            proc = self._standby_warmers.get(hn)
+            if proc is not None and proc.poll() is None:
+                continue  # warmer already running
+            launched = None
+            if _is_local(hn):
+                env = dict(os.environ)
+                env.update(self._extra_env)
+                env.update(
+                    HOROVOD_GLOO_RENDEZVOUS_ADDR=addr,
+                    HOROVOD_GLOO_RENDEZVOUS_PORT=str(kv_port),
+                    HOROVOD_SECRET_KEY=self._secret.hex(),
+                    HOROVOD_STANDBY_HOSTNAME=hn,
+                    # the gang's world size: the warmer's preload must
+                    # target the fingerprint of the world it would JOIN,
+                    # not its own single-process view
+                    HOROVOD_SIZE=str(assignment.world_size),
+                )
+                cwd = os.getcwd()
+                prior = env.get("PYTHONPATH")
+                env["PYTHONPATH"] = (
+                    cwd if not prior else cwd + os.pathsep + prior
+                )
+                try:
+                    launched = subprocess.Popen(
+                        [
+                            sys.executable, "-m",
+                            "horovod_tpu.elastic.standby",
+                        ],
+                        env=env,
+                    )
+                except OSError:
+                    _log.warning(
+                        "standby warmer launch failed on %s", hn,
+                        exc_info=True,
+                    )
+            else:
+                _log.info(
+                    "host %s reserved as warm standby (remote: warmer "
+                    "not auto-launched)", hn,
+                )
+            self._standby_warmers[hn] = launched
+            _log.info("warm standby reserved on %s", hn)
+        _metrics.gauge("driver.standby.reserved", len(reserved))
+
+    def standby_status(self) -> Dict[str, dict]:
+        """``{hostname: announcement}`` of every standby the warmers
+        have published (rendezvous ``standby`` scope) — the operator /
+        test view of the announce → stage → armed lifecycle."""
+        if self._server is None:
+            return {}
+        from ..runner.rendezvous import read_standbys
+
+        try:
+            return read_standbys(self._server.store)
+        except Exception:
+            return {}
+
+    def _release_standby(self, reason: str) -> Optional[str]:
+        """Swap-in: fold one reserved standby back into the discovery
+        pool so the NEXT assignment includes it. Prefers an ``armed``
+        host (staging done) over one still staging. Returns the
+        released hostname, or None when no standby is held."""
+        candidates = [
+            hn for hn in sorted(self._standby_warmers)
+            if hn not in self._standby_released
+        ]
+        if not candidates:
+            return None
+        status = self.standby_status()
+        armed = [
+            hn for hn in candidates
+            if status.get(hn, {}).get("state") == "armed"
+        ]
+        hostname = (armed or candidates)[0]
+        self._standby_released.add(hostname)
+        self._standby_swapins += 1
+        if self._server is not None:
+            from ..runner.rendezvous import STANDBY_SCOPE
+
+            try:  # tell the warmer to stand down and exit
+                self._server.store.put(
+                    STANDBY_SCOPE, f"release.{hostname}", b"1"
+                )
+            except Exception:
+                pass
+        from ..common.metrics import registry as _metrics
+
+        _metrics.counter("driver.standby.swapins")
+        _metrics.gauge(
+            "driver.standby.reserved",
+            len(candidates) - 1,
+        )
+        _log.info(
+            "releasing warm standby %s into the gang (%s); swap-in #%d",
+            hostname, reason, self._standby_swapins,
+        )
+        return hostname
+
+    def _maybe_scale_up(self, per_role: Dict[str, dict]) -> None:
+        """Router-observed serve saturation: a role with live workers
+        and ZERO admission headroom (free slots AND free pages) while a
+        standby is armed releases the standby and schedules a grow
+        restart (reason ``serve scaleup``). Rate-limited to one
+        scale-up per staleness window so one saturated poll cannot
+        drain the whole standby pool."""
+        if self._scaleup_reason is not None or not self._standby_warmers:
+            return
+        if time.monotonic() - self._last_scaleup < _EXPERT_LOAD_STALE_S:
+            return
+        saturated = [
+            role
+            for role, agg in per_role.items()
+            if agg["workers"] > 0
+            and agg["free_slots"] <= 0
+            and agg["free_pages"] <= 0
+        ]
+        if not saturated:
+            return
+        released = self._release_standby(
+            f"serve saturation: role(s) {','.join(sorted(saturated))}"
+        )
+        if released is None:
+            return
+        self._last_scaleup = time.monotonic()
+        self._scaleup_reason = (
+            f"serve scaleup: standby {released} absorbs saturated "
+            f"role(s) {','.join(sorted(saturated))}"
+        )
 
     def _terminate_gang(self, grace: float = 10.0) -> None:
         with self._lock:
@@ -481,7 +679,13 @@ class ElasticDriver:
         self._maybe_rebalance()
         self._poll_expert_loads()
         self._poll_serve_capacity()
-        return self._maybe_quarantine()
+        reason = self._maybe_quarantine()
+        if reason is not None:
+            return reason
+        # serve-saturation scale-up queued by _maybe_scale_up: restart
+        # the gang with the released standby folded in (grow restart)
+        reason, self._scaleup_reason = self._scaleup_reason, None
+        return reason
 
     def _poll_expert_loads(self) -> None:
         """Aggregate the gang's published expert-load summaries (PR 12,
@@ -604,6 +808,7 @@ class ElasticDriver:
         for role, agg in per_role.items():
             for key, val in agg.items():
                 _metrics.gauge(f"driver.serve.{role}.{key}", val)
+        self._maybe_scale_up(per_role)
 
     def _maybe_rebalance(self) -> None:
         """Consume the straggler ledger as a SCHEDULING signal
@@ -741,6 +946,7 @@ class ElasticDriver:
         for hostname in hosts:
             self.host_manager.blacklist(hostname)
             _metrics.counter("driver.quarantined_hosts")
+            self._release_standby(f"{why}: {hostname}")
         return True
 
     def _poll_audit(self, now: float) -> Optional[str]:
@@ -886,6 +1092,24 @@ class ElasticDriver:
         _metrics.counter("driver.gang_restarts")
         self._epoch += 1
         _metrics.gauge("driver.epoch", self._epoch)
+        # the restart clock: the NEXT epoch's workers read this stamp
+        # at init and publish elastic.restart_ms / serve.scaleup_ms —
+        # the telemetry that shows a warm swap-in beating a cold start
+        if self._server is not None:
+            from ..runner.rendezvous import put_restart_stamp
+
+            try:
+                put_restart_stamp(
+                    self._server.store,
+                    epoch=self._epoch,
+                    reason=reason,
+                    warm=bool(self._standby_released),
+                    kind=(
+                        "scaleup" if "scaleup" in reason else "restart"
+                    ),
+                )
+            except Exception:
+                pass
         with self._lock:
             self._assignment = None
             self._procs = []
@@ -918,6 +1142,14 @@ class ElasticDriver:
     def shutdown(self) -> None:
         self.stop()
         self._terminate_gang()
+        for hn, proc in list(self._standby_warmers.items()):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        self._standby_warmers.clear()
         if self._server is not None:
             self._server.stop()
             self._server = None

@@ -1,0 +1,184 @@
+"""Warm-standby host warmer (``HOROVOD_WARM_STANDBY``).
+
+A standby host is capacity the elastic driver deliberately holds OUT of
+the gang so a quarantine / sched-divergence restart or a Router-observed
+serve saturation can swap it in WITHOUT a cold start. The warmer is a
+small process the driver launches on each reserved host; its lifecycle
+(docs/elastic.md) is three KV announcements in the rendezvous
+``standby`` scope:
+
+``announce``
+    Registered with the driver's rendezvous — the host is reachable and
+    the warmer is alive.
+``staging``
+    Paying the cold-start costs ahead of time: every persistent
+    executable-cache entry for this topology is deserialized
+    (``exe_cache.preload`` — validates headers, faults the files into
+    the page cache, exercises the exact deserialization path the
+    swapped-in worker will take) and, when a checkpoint directory is
+    configured, the latest digest-verified checkpoint is staged through
+    ``CheckpointManager.restore_latest_good``.
+``armed``
+    Ready. The announcement carries what was staged
+    (``exes``/``exe_bytes``/``ckpt_step``) and the warmer settles into
+    a keepalive loop, refreshing its ``ts`` so the driver can age out a
+    dead warmer.
+
+The driver releases a standby by writing ``release`` under the host's
+key in the same scope (or by SIGTERM); the warmer acknowledges with a
+``released`` announcement and exits 0, at which point the host is plain
+discovery capacity again and the next gang launch includes it.
+
+Runs as ``python -m horovod_tpu.elastic.standby`` with the same
+rendezvous env contract as a worker (``HOROVOD_GLOO_RENDEZVOUS_ADDR`` /
+``PORT`` / ``HOROVOD_SECRET_KEY``) plus ``HOROVOD_STANDBY_HOSTNAME``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from ..common.logging import get_logger
+
+_log = get_logger("standby")
+
+# keepalive cadence for the armed announcement (driver ages out entries
+# whose ts stops advancing, same contract as the heartbeat ledger)
+KEEPALIVE_S = 5.0
+
+
+class StandbyWarmer:
+    """One standby host's announce → stage → armed lifecycle."""
+
+    def __init__(
+        self,
+        client,
+        hostname: str,
+        exe_cache_base: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+        fingerprint: Optional[str] = None,
+    ) -> None:
+        self._client = client
+        self.hostname = str(hostname)
+        self._exe_base = exe_cache_base
+        self._ckpt_dir = checkpoint_dir
+        self._fingerprint = fingerprint
+        self._stop = threading.Event()
+        self.staged: dict = {}
+
+    # ------------------------------------------------------ lifecycle
+
+    def _announce(self, state: str, detail: Optional[dict] = None) -> None:
+        from ..runner.rendezvous import put_standby
+
+        try:
+            put_standby(self._client, self.hostname, state, detail)
+        except Exception:
+            # rendezvous going away = job ending; a standby must never
+            # crash because the driver it serves is mid-teardown
+            _log.debug("standby announce %s failed", state, exc_info=True)
+
+    def stage(self) -> dict:
+        """Deserialize cached executables + stage the latest checkpoint.
+        Best-effort on every leg: staging is an optimization of the
+        swap-in, never a gate on it."""
+        self._announce("staging")
+        detail: dict = {"exes": 0, "exe_bytes": 0, "ckpt_step": None}
+        if self._exe_base:
+            try:
+                from ..common import exe_cache as _exe_cache
+
+                loaded, nbytes = _exe_cache.preload(
+                    fingerprint=self._fingerprint, base=self._exe_base
+                )
+                detail["exes"] = loaded
+                detail["exe_bytes"] = nbytes
+            except Exception:
+                _log.warning("standby exe preload failed", exc_info=True)
+        if self._ckpt_dir and os.path.isdir(self._ckpt_dir):
+            try:
+                from ..checkpoint import CheckpointManager
+
+                mgr = CheckpointManager(self._ckpt_dir, async_save=False)
+                step, _ = mgr.restore_latest_good()
+                detail["ckpt_step"] = int(step)
+            except FileNotFoundError:
+                pass  # no checkpoint yet: nothing to stage
+            except Exception:
+                _log.warning("standby checkpoint stage failed",
+                             exc_info=True)
+        self.staged = detail
+        return detail
+
+    def _released(self) -> bool:
+        """Has the driver released this standby? (``release`` written
+        under our key, or the whole scope dropped with a release
+        marker.)"""
+        from ..runner.rendezvous import STANDBY_SCOPE
+
+        try:
+            raw = self._client.get(
+                STANDBY_SCOPE, f"release.{self.hostname}"
+            )
+        except OSError:
+            return True  # driver gone: stop holding the host
+        return raw is not None
+
+    def run(self) -> int:
+        """announce → stage → armed → keepalive until released."""
+        self._announce("announce")
+        detail = self.stage()
+        self._announce("armed", detail)
+        _log.info(
+            "standby %s armed: %d cached executable(s) (%d bytes), "
+            "checkpoint step %s",
+            self.hostname, detail["exes"], detail["exe_bytes"],
+            detail["ckpt_step"],
+        )
+        while not self._stop.is_set():
+            if self._released():
+                self._announce("released", detail)
+                _log.info("standby %s released", self.hostname)
+                return 0
+            self._announce("armed", detail)
+            self._stop.wait(KEEPALIVE_S)
+        self._announce("released", detail)
+        return 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None) -> int:
+    """``python -m horovod_tpu.elastic.standby`` entry point."""
+    from ..common import config as config_mod
+    from ..runner.rendezvous import _client_from_cfg
+
+    cfg = config_mod.Config.from_env()
+    if not (cfg.rendezvous_addr and cfg.rendezvous_port):
+        _log.error("standby warmer needs the rendezvous env contract")
+        return 2
+    hostname = os.environ.get(
+        "HOROVOD_STANDBY_HOSTNAME", os.uname().nodename
+    )
+    warmer = StandbyWarmer(
+        _client_from_cfg(cfg),
+        hostname,
+        exe_cache_base=cfg.exe_cache,
+        checkpoint_dir=os.environ.get("HOROVOD_CHECKPOINT_DIR") or None,
+        fingerprint=os.environ.get("HOROVOD_STANDBY_FINGERPRINT") or None,
+    )
+
+    def _term(signum, frame):  # release on SIGTERM: driver teardown
+        warmer.stop()
+
+    signal.signal(signal.SIGTERM, _term)
+    return warmer.run()
+
+
+if __name__ == "__main__":  # pragma: no cover — subprocess entry
+    raise SystemExit(main())

@@ -110,6 +110,7 @@ class WorkerNotificationManager:
                 f"workers.{epoch}", process_id,
                 f"{hostname}:{port}".encode(),
             )
+            self._publish_restart_ms(client, epoch)
 
             # Liveness for the driver's stall inspector: stamp
             # heartbeat/<rank> every 10s until shutdown (the rebuilt
@@ -145,6 +146,33 @@ class WorkerNotificationManager:
                 target=_beat, name="hvd-heartbeat", daemon=True
             )
             t.start()
+
+    def _publish_restart_ms(self, client, epoch: str) -> None:
+        """Close the restart clock: the driver stamped wall time at
+        gang teardown (``_reset``); a worker of the stamped epoch
+        publishes ``now − ts`` as ``elastic.restart_ms`` (and
+        ``serve.scaleup_ms`` for a scale-up restart) — the per-worker
+        measurement of how fast the gang healed, warm vs cold. Best-
+        effort: a missing/foreign stamp is a first launch, not an
+        error."""
+        import time as _time
+
+        from ..common.metrics import registry as _metrics
+        from ..runner.rendezvous import read_restart_stamp
+
+        try:
+            stamp = read_restart_stamp(client)
+        except Exception:
+            return
+        if stamp is None or str(stamp.get("epoch")) != str(epoch):
+            return  # stale stamp from an older epoch, or first launch
+        ms = max((_time.time() - float(stamp["ts"])) * 1e3, 0.0)
+        _metrics.gauge("elastic.restart_ms", ms)
+        _metrics.gauge(
+            "elastic.restart_warm", 1.0 if stamp.get("warm") else 0.0
+        )
+        if stamp.get("kind") == "scaleup":
+            _metrics.gauge("serve.scaleup_ms", ms)
 
     def _on_hosts_updated(self, request: dict) -> dict:
         self._updated.set()

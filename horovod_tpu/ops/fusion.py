@@ -433,6 +433,17 @@ class FusionManager:
         self.cache_hits = 0  # dispatched a cached executor for the key
         self.cache_misses = 0  # executor builds (exact or bucket tier)
         self.cache_evictions = 0
+        # persistent disk tier below exact/bucket (common/exe_cache.py,
+        # HOROVOD_EXE_CACHE): a "miss" above may deserialize instead of
+        # compile — disk_hits counts those. With no cache dir
+        # configured both stay 0 and every build path is byte-identical
+        # to the memory-only manager.
+        from ..common import exe_cache as _exe_cache
+
+        self._exe_base = _exe_cache.cache_dir()
+        self._exe_fp = None  # resolved lazily: topology may not be up
+        self.disk_hits = 0
+        self.disk_misses = 0
         self.bucket_hits = 0  # exact miss served by the bucket tier
         self.promotions = 0  # compositions promoted to an exact executable
         self.dispatches = 0  # executor invocations, cumulative
@@ -810,6 +821,8 @@ class FusionManager:
             "hits": self.cache_hits,
             "misses": self.cache_misses,
             "evictions": self.cache_evictions,
+            "disk_hits": self.disk_hits,
+            "disk_misses": self.disk_misses,
             "bucket_hits": self.bucket_hits,
             "promotions": self.promotions,
             "recompiles": self.cache_misses,
@@ -1252,6 +1265,12 @@ class FusionManager:
                     fn = self._build_fused(
                         exact_plan, spec.builder(), spec, guarded
                     )
+                    fn = self._finalize_exe(
+                        fn, "fusion.fused", spec,
+                        lambda: [e.payload for e in batch]
+                        + self._extra_args(keep, seed),
+                        donate_n=len(exact_plan.shapes),
+                    )
                     self._cache_put(exact_key, fn)
                     outs = self._dispatch_fused(
                         fn, batch, exact_plan, keep, seed, guarded
@@ -1265,6 +1284,12 @@ class FusionManager:
                         self.cache_misses += 1
                         core = self._build_core(
                             plan, spec.builder(), spec, guarded
+                        )
+                        core = self._finalize_exe(
+                            core, "fusion.core", spec,
+                            lambda: [
+                                _pack([e.payload for e in batch], plan)
+                            ] + self._extra_args(keep, seed),
                         )
                         self._cache_put(core_key, core)
                     self.bucket_hits += 1
@@ -1553,6 +1578,54 @@ class FusionManager:
         return self._shard_map(
             per_shard, in_specs=tuple(in_specs), out_specs=out_specs
         )
+
+    def _finalize_exe(
+        self, jitted, family: str, spec: "_ExecSpec", args_thunk,
+        donate_n: int = 0,
+    ):
+        """Disk tier below the exact/bucket tiers (HOROVOD_EXE_CACHE,
+        common/exe_cache.py): AOT-lower the freshly built program with
+        its first dispatch's argument avals, then load a previously
+        persisted executable by (topology, HLO, wire, donation) key —
+        or compile and persist for the next process/standby. Includes
+        bucket→exact promotions: a recurring composition promotes from
+        disk instead of paying the promotion compile. No cache dir →
+        the jitted callable is returned untouched (zero behavior
+        change); any AOT/serialization failure falls back the same
+        way — the disk tier is an accelerator, never a dependency."""
+        if self._exe_base is None:
+            return jitted
+        from ..common import exe_cache as _exe_cache
+
+        if self._exe_fp is None:
+            self._exe_fp = _exe_cache.topology_fingerprint()
+        wire = (
+            f"{spec.intra_wire}/{spec.wire}" if spec.hier_n else spec.wire
+        )
+        donation = _exe_cache.donation_signature(
+            tuple(range(donate_n)) if (self.donate and donate_n) else ()
+        )
+        try:
+            lowered = jitted.lower(*args_thunk())
+            exe, hit = _exe_cache.get_or_compile(
+                lowered,
+                family=family,
+                wire=wire,
+                donation=donation,
+                fingerprint=self._exe_fp,
+                base=self._exe_base,
+            )
+        except Exception as e:
+            _log.warning(
+                "exe disk tier unavailable for %s (%s); serving the "
+                "jit path", family, e,
+            )
+            return jitted
+        if hit:
+            self.disk_hits += 1
+        else:
+            self.disk_misses += 1
+        return exe
 
     def _build_core(
         self, plan: _BatchPlan, per_shard, spec: "_ExecSpec",

@@ -99,7 +99,16 @@ def _is_float0(leaf) -> bool:
 
 _CACHE: dict = {}
 _CACHE_CAP = 256
-_STATS = {"hits": 0, "misses": 0}
+_STATS = {"hits": 0, "misses": 0, "disk_hits": 0}
+
+# Persisted beside the executables (HOROVOD_EXE_CACHE sidecar,
+# common/exe_cache.py): the partition DECISION that produced each
+# persisted bucketed executable. A restarted worker re-derives the
+# same buckets from the same inputs today; the sidecar makes the
+# decision durable against heuristic drift — a recorded partition is
+# replayed verbatim, so its exe-cache entries keep hitting even if
+# build_bucket_schedule's balancing rule changes underneath it.
+_SIDECAR = "overlap_schedule"
 
 
 def schedule_cache_stats() -> dict:
@@ -110,6 +119,40 @@ def reset_schedule_cache() -> None:
     _CACHE.clear()
     _STATS["hits"] = 0
     _STATS["misses"] = 0
+    _STATS["disk_hits"] = 0
+
+
+def _sidecar_key(key: tuple) -> str:
+    import hashlib
+
+    return hashlib.sha256(repr(key).encode()).hexdigest()[:24]
+
+
+def _schedule_from_record(rec) -> Optional[BucketSchedule]:
+    """A sidecar record → BucketSchedule, or None when malformed (a
+    corrupt sidecar entry must read as a plain rebuild)."""
+    try:
+        return BucketSchedule(
+            buckets=tuple(tuple(int(i) for i in b) for b in rec["buckets"]),
+            bucket_bytes=tuple(int(b) for b in rec["bucket_bytes"]),
+            total_bytes=int(rec["total_bytes"]),
+            passthrough=tuple(int(i) for i in rec.get("passthrough", ())),
+        )
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _schedule_record(key: tuple, sched: BucketSchedule) -> dict:
+    return {
+        "buckets": [list(b) for b in sched.buckets],
+        "bucket_bytes": list(sched.bucket_bytes),
+        "total_bytes": int(sched.total_bytes),
+        "passthrough": list(sched.passthrough),
+        "n_leaves": sum(len(b) for b in sched.buckets)
+        + len(sched.passthrough),
+        "n_buckets": int(key[2]),
+        "min_bucket_bytes": int(key[3]),
+    }
 
 
 def build_bucket_schedule(
@@ -221,7 +264,24 @@ def schedule_for(
         _STATS["hits"] += 1
         return sched
     _STATS["misses"] += 1
+    from ..common import exe_cache as _exe_cache
+
+    disk = _exe_cache.cache_dir()
+    if disk:
+        rec = _exe_cache.load_json(_SIDECAR).get(_sidecar_key(key))
+        if rec is not None:
+            sched = _schedule_from_record(rec)
+            if sched is not None:
+                _STATS["disk_hits"] += 1
+                if len(_CACHE) >= _CACHE_CAP:
+                    _CACHE.pop(next(iter(_CACHE)))
+                _CACHE[key] = sched
+                return sched
     sched = build_bucket_schedule(leaves, n_buckets, min_bucket_bytes)
+    if disk:
+        _exe_cache.persist_json(
+            _SIDECAR, {_sidecar_key(key): _schedule_record(key, sched)}
+        )
     if len(_CACHE) >= _CACHE_CAP:
         _CACHE.pop(next(iter(_CACHE)))
     _CACHE[key] = sched

@@ -674,6 +674,94 @@ def read_expert_loads(store_or_client) -> Dict[int, dict]:
     return out
 
 
+STANDBY_SCOPE = "standby"
+RESTART_SCOPE = "restart"
+
+
+def put_standby(
+    store_or_client,
+    hostname: str,
+    state: str,
+    detail: Optional[dict] = None,
+) -> None:
+    """Standby-warmer side of the warm-standby lifecycle
+    (elastic/standby.py): publish this host's standby state —
+    ``announce`` (registered, staging not started), ``staging``
+    (deserializing executables / loading the checkpoint), ``armed``
+    (ready to swap in), ``released`` (the driver folded it into a
+    gang). One KV key per hostname, overwritten per transition, ``ts``
+    refreshed by the warmer's keepalive loop so the driver can age out
+    a dead warmer."""
+    import time as _time
+
+    payload = {"ts": _time.time(), "state": str(state)}
+    if detail:
+        payload.update(detail)
+    store_or_client.put(
+        STANDBY_SCOPE, str(hostname), json.dumps(payload).encode()
+    )
+
+
+def read_standbys(store_or_client) -> Dict[str, dict]:
+    """Driver side: ``{hostname: {"ts", "state", ...}}`` of every
+    published standby announcement. Malformed entries are skipped — a
+    corrupt announcement must never crash the driver's poll loop."""
+    out: Dict[str, dict] = {}
+    for key in store_or_client.keys(STANDBY_SCOPE):
+        raw = store_or_client.get(STANDBY_SCOPE, key)
+        if raw is None:
+            continue
+        try:
+            obj = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if isinstance(obj, dict) and "state" in obj:
+            out[key] = obj
+    return out
+
+
+def put_restart_stamp(
+    store_or_client,
+    epoch: int,
+    reason: str,
+    warm: bool = False,
+    kind: str = "restart",
+) -> None:
+    """Driver side of the restart clock: stamped at gang-teardown time
+    (``_reset``), read by every worker of the NEXT epoch at init —
+    ``now - ts`` is that worker's ``elastic.restart_ms`` (or
+    ``serve.scaleup_ms`` when ``kind == "scaleup"``). ``warm`` records
+    whether a warm standby absorbed the restart, so the gauge can be
+    compared against the cold baseline."""
+    import time as _time
+
+    payload = {
+        "ts": _time.time(),
+        "epoch": int(epoch),
+        "reason": str(reason),
+        "warm": bool(warm),
+        "kind": str(kind),
+    }
+    store_or_client.put(
+        RESTART_SCOPE, "stamp", json.dumps(payload).encode()
+    )
+
+
+def read_restart_stamp(store_or_client) -> Optional[dict]:
+    """Worker side: the newest restart stamp, or None (first launch /
+    malformed blob — a corrupt stamp must never fail worker init)."""
+    raw = store_or_client.get(RESTART_SCOPE, "stamp")
+    if raw is None:
+        return None
+    try:
+        obj = json.loads(raw.decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if isinstance(obj, dict) and "ts" in obj and "epoch" in obj:
+        return obj
+    return None
+
+
 def _client_from_cfg(cfg) -> "RendezvousClient":
     """Shared construction of the worker-side KV client from config
     (secret decode + endpoint) — used by the object collectives and the

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -292,6 +293,21 @@ class InferenceEngine:
             cfg.serve_paged_attn if paged_attn is None else paged_attn,
             model_cfg,
         )
+        # persistent executable disk tier (common/exe_cache.py): below
+        # the in-memory exact/bucket tables. When HOROVOD_EXE_CACHE is
+        # unset every path below is byte-identical to the memory-only
+        # engine. ``_promoting`` tracks in-flight background
+        # bucket→exact promotions (the PR 17 hot-path-compile fix).
+        from ..common import exe_cache as _exe_cache
+
+        self._exe_base = _exe_cache.cache_dir()
+        self._exe_fp = (
+            _exe_cache.topology_fingerprint() if self._exe_base else None
+        )
+        self._promoting: set = set()
+        self._promote_threads: list = []
+        if self._exe_base:
+            self._warm_start()
 
     def _resolve_paged_attn(self, requested, model_cfg) -> bool:
         """Resolve the ``HOROVOD_SERVE_PAGED_ATTN`` tri-state against
@@ -389,8 +405,39 @@ class InferenceEngine:
             kwargs["out_shardings"] = out_sh
         return jax.jit(fn, **kwargs).lower(*args)
 
-    def _compile(self, fn, args, kind: str, decode: bool = False):
-        exe = self._lower(fn, args, decode=decode).compile()
+    def _donation_sig(self, n_args: int, decode: bool) -> str:
+        from ..common import exe_cache as _exe_cache
+
+        if not self.donate:
+            return "none"
+        donate = (1,) + ((n_args - 1,) if decode else ())
+        return _exe_cache.donation_signature(donate)
+
+    def _compile(self, fn, args, kind: str, decode: bool = False,
+                 meta=None):
+        """Compile through the disk tier when one is configured: a hit
+        deserializes a previously-persisted executable
+        (``{kind}_disk_hits``, NOT a compile — warm processes assert
+        ``decode_compiles == 0``), a miss compiles and persists for
+        the next process/standby."""
+        lowered = self._lower(fn, args, decode=decode)
+        if self._exe_base is not None:
+            from ..common import exe_cache as _exe_cache
+
+            exe, hit = _exe_cache.get_or_compile(
+                lowered,
+                family=f"serve.{kind}",
+                donation=self._donation_sig(len(args), decode),
+                meta=meta,
+                fingerprint=self._exe_fp,
+                base=self._exe_base,
+            )
+            with self._lock:
+                self._counters[
+                    f"{kind}_disk_hits" if hit else f"{kind}_compiles"
+                ] += 1
+            return exe
+        exe = lowered.compile()
         with self._lock:
             self._counters[f"{kind}_compiles"] += 1
         return exe
@@ -542,6 +589,7 @@ class InferenceEngine:
                 self._prefill_fn(width),
                 self._prefill_args(width),
                 "prefill",
+                meta={"width": int(width), "tier": "bucket"},
             )
             self._prefill_bucket[width] = exe
         else:
@@ -570,22 +618,219 @@ class InferenceEngine:
         bucket = min(
             max(next_pow2(length), self.min_bucket), self.prefill_ceiling
         )
-        if count >= self.promote_after or (
-            avail is not None and bucket > avail
-        ):
-            exe = self._compile(
-                self._prefill_fn(length),
-                self._prefill_args(length),
-                "prefill",
-            )
-            exact[length] = exe
-            self._counters["prefill_promotions"] += 1
-            while len(exact) > self._exact_capacity:
-                exact.popitem(last=False)
-            return exe, length
+        forced = avail is not None and bucket > avail
+        if count >= self.promote_after or forced:
+            # disk tier FIRST: a recurring prompt length a prior run
+            # promoted deserializes instead of paying the promotion
+            # compile (the PR 17 hot-path latency spike)
+            exe = self._disk_prefill_exact(length)
+            if exe is not None:
+                self._install_exact(length, exe)
+                return exe, length
+            if forced:
+                # the padded bucket would overrun the slot — no bucket
+                # executable CAN serve this chunk, so the compile has
+                # to happen here, synchronously
+                exe = self._compile(
+                    self._prefill_fn(length),
+                    self._prefill_args(length),
+                    "prefill",
+                    meta={"width": int(length), "tier": "exact"},
+                )
+                self._install_exact(length, exe)
+                return exe, length
+            # off the hot path: the bucket executable keeps serving
+            # while a background thread compiles (and persists) the
+            # exact one; it installs under the lock when ready
+            self._spawn_promotion(length)
         exe = self._bucket_exe(bucket)
         self._counters["prefill_pad_tokens"] += bucket - length
         return exe, bucket
+
+    def _install_exact(self, length: int, exe) -> None:
+        with self._lock:
+            self._prefill_exact[length] = exe
+            self._counters["prefill_promotions"] += 1
+            while len(self._prefill_exact) > self._exact_capacity:
+                self._prefill_exact.popitem(last=False)
+
+    def _disk_prefill_exact(self, length: int):
+        """Exact-width prefill entry from the disk tier, or None.
+        Costs one trace (no XLA compile) + one file read — scheduler-
+        thread safe."""
+        if self._exe_base is None or self.role == "decode":
+            return None
+        from ..common import exe_cache as _exe_cache
+
+        args = self._abstract_prefill_args(length)
+        lowered = self._lower(self._prefill_fn(length), args)
+        exe = _exe_cache.load(
+            "serve.prefill",
+            _exe_cache.hlo_fingerprint(lowered),
+            donation=self._donation_sig(len(args), False),
+            fingerprint=self._exe_fp,
+            base=self._exe_base,
+        )
+        if exe is not None:
+            with self._lock:
+                self._counters["prefill_disk_hits"] += 1
+        return exe
+
+    def _spawn_promotion(self, length: int) -> None:
+        """Background bucket→exact promotion: lowers from ABSTRACT
+        avals (the live donated cache buffers are never touched off
+        the scheduler thread), compiles, persists to the disk tier,
+        installs under the lock. Deduplicated per length."""
+        with self._lock:
+            if length in self._promoting:
+                return
+            self._promoting.add(length)
+
+        def work():
+            try:
+                exe = self._compile(
+                    self._prefill_fn(length),
+                    self._abstract_prefill_args(length),
+                    "prefill",
+                    meta={"width": int(length), "tier": "exact"},
+                )
+                self._install_exact(length, exe)
+                with self._lock:
+                    self._counters["prefill_bg_promotions"] += 1
+            except Exception:  # pragma: no cover — keep serving on the
+                _log.exception(  # bucket tier; promotion is an upgrade
+                    "background promotion for width %d failed", length
+                )
+            finally:
+                with self._lock:
+                    self._promoting.discard(length)
+
+        t = threading.Thread(
+            target=work, daemon=True, name=f"serve-promote-{length}"
+        )
+        self._promote_threads.append(t)
+        t.start()
+
+    def drain_promotions(self, timeout: float = 60.0) -> bool:
+        """Join outstanding background promotions (tests/bench warmup:
+        deterministic compile counts need a join point). True when
+        everything landed."""
+        deadline = time.monotonic() + timeout
+        for t in list(self._promote_threads):
+            t.join(max(deadline - time.monotonic(), 0.0))
+        self._promote_threads = [
+            t for t in self._promote_threads if t.is_alive()
+        ]
+        return not self._promote_threads
+
+    def _abstract_prefill_args(self, width: int):
+        """:meth:`_prefill_args` as avals: background/warm-start
+        lowering must not hold references to the donated cache carry
+        (a decode step may consume it mid-trace)."""
+        import jax
+
+        from jax.sharding import NamedSharding
+
+        def _sds(leaf):
+            # keep a leaf's MESH sharding only: the abstract lowering
+            # must hash to the same HLO fingerprint as the concrete
+            # one, and an explicit SingleDeviceSharding on the aval
+            # stamps mhlo.sharding attrs a committed array doesn't.
+            # shape/dtype/sharding attributes survive donation (only
+            # the buffer is deleted).
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=sh
+                )
+            return jax.ShapeDtypeStruct(np.shape(leaf), np.asarray(leaf).dtype)
+
+        params = jax.tree_util.tree_map(_sds, self._params)
+        cache = jax.tree_util.tree_map(_sds, self.manager.cache)
+        concrete = self._prefill_args(width)
+        return (params, cache) + concrete[2:]
+
+    # ----------------------------------------------------------- warm start
+
+    def _warm_start(self) -> None:
+        """Role-gated table warm-start from the disk tier at init: the
+        decode executable loads by exact key; prefill entries are
+        enumerated from the cache headers (the engine cannot know
+        which widths prior runs promoted), each candidate re-lowered
+        at its recorded width and loaded by key — an entry from a
+        different model, world size, or JAX version simply misses
+        (the invalidation rules live in ``exe_cache.load``). Decode
+        workers load ONLY decode entries; prefill workers only
+        prefill ones. Zero compiles happen here by construction: a
+        miss leaves the table cold for the normal lazy path."""
+        from ..common import exe_cache as _exe_cache
+
+        t0 = time.monotonic()
+        loaded = 0
+        if self.role in ("unified", "decode"):
+            args = self._decode_args(np.zeros((self.slots,), np.int32))
+            lowered = self._lower(self._decode_fn(), args, decode=True)
+            exe = _exe_cache.load(
+                "serve.decode",
+                _exe_cache.hlo_fingerprint(lowered),
+                donation=self._donation_sig(len(args), True),
+                fingerprint=self._exe_fp,
+                base=self._exe_base,
+            )
+            if exe is not None:
+                self._decode_exe = exe
+                with self._lock:
+                    self._counters["decode_disk_hits"] += 1
+                loaded += 1
+        if self.role in ("unified", "prefill"):
+            candidates = []
+            seen = set()
+            for header in _exe_cache.scan(
+                "serve.prefill", fingerprint=self._exe_fp,
+                base=self._exe_base,
+            ):
+                meta = header.get("meta") or {}
+                width, tier = meta.get("width"), meta.get("tier")
+                if (
+                    not isinstance(width, int)
+                    or tier not in ("bucket", "exact")
+                    or not 0 < width <= self.max_len
+                    or (width, tier) in seen
+                ):
+                    continue
+                seen.add((width, tier))
+                candidates.append((width, tier))
+            for width, tier in candidates[: self._exact_capacity + 16]:
+                args = self._prefill_args(width)
+                lowered = self._lower(self._prefill_fn(width), args)
+                exe = _exe_cache.load(
+                    "serve.prefill",
+                    _exe_cache.hlo_fingerprint(lowered),
+                    donation=self._donation_sig(len(args), False),
+                    fingerprint=self._exe_fp,
+                    base=self._exe_base,
+                )
+                if exe is None:
+                    continue
+                with self._lock:
+                    self._counters["prefill_disk_hits"] += 1
+                    if tier == "exact":
+                        self._prefill_exact[width] = exe
+                        while (
+                            len(self._prefill_exact) > self._exact_capacity
+                        ):
+                            self._prefill_exact.popitem(last=False)
+                    else:
+                        self._prefill_bucket[width] = exe
+                loaded += 1
+        if loaded:
+            ms = (time.monotonic() - t0) * 1e3
+            _metrics.gauge("serve.warm_start_ms", ms)
+            _metrics.counter("serve.warm_started_exes", loaded)
+            _log.info(
+                "warm-started %d executable(s) from %s in %.0f ms",
+                loaded, self._exe_base, ms,
+            )
 
     # ------------------------------------------------------------ execution
 
@@ -714,7 +959,8 @@ class InferenceEngine:
         args = self._decode_args(tokens)
         if self._decode_exe is None:
             self._decode_exe = self._compile(
-                self._decode_fn(), args, "decode", decode=True
+                self._decode_fn(), args, "decode", decode=True,
+                meta={"slots": int(self.slots)},
             )
         out, self.manager.cache, self._sample_keys = self._decode_exe(
             *args
@@ -859,6 +1105,8 @@ class InferenceEngine:
             "chunked_prefill_chunks", "prefill_chunks_skipped",
             "prefill_tokens_skipped", "transfer_ingests",
             "paged_attn_calls", "paged_attn_fallbacks",
+            "prefill_disk_hits", "decode_disk_hits",
+            "prefill_bg_promotions",
         ):
             out.setdefault(key, 0)
         out["prefill_exact_entries"] = len(self._prefill_exact)

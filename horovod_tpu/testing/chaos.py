@@ -38,6 +38,12 @@ site                      where it fires
                           exhaustion falls the request back to LOCAL
                           decode — ``serve.transfer_fallbacks`` — never
                           a client-visible 500)
+``exe_cache.load``        persistent executable-cache read
+                          (common/exe_cache.py; ``bitflip`` corrupts
+                          the payload before the digest check so the
+                          entry degrades to a COUNTED cold compile —
+                          ``exe_cache.corrupt`` — never a failed init;
+                          ``delay`` models slow disk)
 ========================  ====================================================
 
 Sites the library doesn't own (a bench/smoke script's training loop)

@@ -24,6 +24,17 @@ step (``train.nan@3:nan`` — the update must be SKIPPED and
 bitflip (``checkpoint.save@2:bitflip`` — ``restore_latest_good`` must
 fall back past the digest mismatch), with every counter asserted over
 the worker's live ``/metrics`` scrape.
+
+A **standby-swap drill** (PR 18) runs last: the same SIGKILL-a-worker
+story, twice — once cold (no cache, no standby) and once with
+``HOROVOD_WARM_STANDBY=1`` + a shared ``HOROVOD_EXE_CACHE``. In the
+warm pass the kill lands only after the driver's warmer announces
+``armed`` over rendezvous KV; the restart swaps the standby host into
+the gang (exactly ONE gang restart — the swap-in costs zero additional
+resets), every survivor resolves its compile-heavy executable from the
+persistent cache (``exe_cache.misses == 0`` — zero new compiles), and
+the live-scraped ``hvd_elastic_restart_ms`` beats the cold pass, whose
+restarted workers each paid the multi-second XLA recompile.
 """
 
 import json
@@ -130,6 +141,14 @@ def _prom_value(text: str, name: str) -> float:
         if line.startswith(name + " "):
             return float(line.split()[1])
     raise AssertionError(f"metric {name} not in scrape:\n{text[:600]}")
+
+
+def _prom_value_or(text: str, name: str, default: float) -> float:
+    """A counter that never incremented is ABSENT from the scrape."""
+    try:
+        return _prom_value(text, name)
+    except AssertionError:
+        return default
 
 
 INTEGRITY_WORKER = """\
@@ -269,6 +288,276 @@ def integrity_drill() -> None:
     )
 
 
+STANDBY_WORKER = """\
+import json, os, signal, sys, time
+sys.path.insert(0, os.getcwd())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+rank = int(os.environ["HOROVOD_RANK"])
+epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+host = os.environ.get("HOROVOD_HOSTNAME", "")
+workdir = os.environ["CHAOS_SMOKE_DIR"]
+
+from horovod_tpu.common import telemetry
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.metrics import registry
+from horovod_tpu.elastic.worker import WorkerNotificationManager
+from horovod_tpu.runner.rendezvous import _client_from_cfg
+
+import jax
+import jax.numpy as jnp
+
+def chain(x):
+    for i in range(220):
+        x = jnp.tanh(x @ x.T * (1.0 + 0.01 * i) + i) @ (x * 0.5 + 1.0)
+        if i % 7 == 0:
+            x = jax.nn.softmax(x, axis=-1) + x
+    return x
+
+# resolve the gang's one executable through the persistent cache: a
+# cold worker pays the multi-second XLA compile, a warm-restarted one
+# deserializes the epoch-0 entry in milliseconds — THE delta the
+# restart clock below exists to show
+t0 = time.time()
+lowered = jax.jit(chain).lower(jnp.ones((48, 48), jnp.float32))
+if os.environ.get("HOROVOD_EXE_CACHE"):
+    from horovod_tpu.common import exe_cache
+    exe, hit = exe_cache.get_or_compile(lowered, "smoke.chain")
+    # drain the write-behind BEFORE parking: epoch-0 workers are
+    # reaped by SIGTERM, which never runs atexit hooks
+    assert exe_cache.flush(60), "exe-cache write-behind did not drain"
+else:
+    exe, hit = lowered.compile(), False
+resolve_ms = (time.time() - t0) * 1e3
+
+# the executable is READY: close the restart clock exactly the way a
+# real worker's init does (the driver stamped wall time at teardown)
+client = _client_from_cfg(Config.from_env())
+WorkerNotificationManager.__new__(
+    WorkerNotificationManager
+)._publish_restart_ms(client, str(epoch))
+
+out = os.path.join(workdir, f"result.e{epoch}.r{rank}.json")
+with open(out + ".tmp", "w") as f:
+    json.dump({
+        "epoch": epoch, "rank": rank, "host": host, "hit": bool(hit),
+        "resolve_ms": resolve_ms, "metrics": registry.snapshot(),
+    }, f)
+os.replace(out + ".tmp", out)
+
+# exactly ONE victim: the 127.0.0.1 workers elect through an exclusive
+# lock file (per-slot placement makes every process its own "host")
+victim = False
+if epoch == 0 and host == "127.0.0.1":
+    try:
+        fd = os.open(
+            os.path.join(workdir, "victim.lock"),
+            os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+        )
+        os.close(fd)
+        victim = True
+    except FileExistsError:
+        pass
+if victim:
+    # hold fire until every sibling has dumped its epoch-0 result AND
+    # the gate has confirmed the standby is armed (kill.go) — the
+    # contract under test is a SIGKILL *with one standby armed*
+    world = int(os.environ["HOROVOD_SIZE"])
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        done = [
+            n for n in os.listdir(workdir) if n.startswith("result.e0.")
+        ]
+        if len(done) >= world and os.path.exists(
+            os.path.join(workdir, "kill.go")
+        ):
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(0.05)
+    sys.exit(3)  # gate timed out; surface as a worker failure
+
+if epoch >= 1 and rank == 0:
+    # serve the live scrape endpoint until the gate has read it
+    server = telemetry.MetricsServer(port=0)
+    port = server.start()
+    port_file = os.path.join(workdir, "standby_port")
+    with open(port_file + ".tmp", "w") as f:
+        f.write(str(port))
+    os.replace(port_file + ".tmp", port_file)
+    ack = os.path.join(workdir, "standby.ok")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not os.path.exists(ack):
+        time.sleep(0.1)
+if epoch == 0:
+    time.sleep(180)  # park; the gang restart reaps us
+sys.exit(0)
+"""
+
+
+def _touch(path: str) -> None:
+    with open(path + ".tmp", "w") as f:
+        f.write("ok")
+    os.replace(path + ".tmp", path)
+
+
+def standby_swap_drill() -> None:
+    """PR 18: SIGKILL a worker with one warm standby armed — the swap-in
+    must cost zero additional gang restarts, the survivors must resolve
+    their executables with ZERO new compiles, and the live-scraped
+    ``elastic.restart_ms`` must beat a cold (no-cache, no-standby)
+    baseline of the same drill."""
+    import socket
+
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import HostInfo
+
+    cache = tempfile.mkdtemp(prefix="hvd-standby-exe-cache-")
+    # three *local* host labels so both the gang and the warmer launch
+    # as plain subprocesses; reservation takes the tail of the sorted
+    # list, so the standby is never the victim host (letters sort above
+    # "127.0.0.1")
+    third = socket.gethostname()
+    if third in ("localhost", "127.0.0.1", "::1"):
+        third = "::1"
+
+    def phase(warm: bool) -> float:
+        workdir = tempfile.mkdtemp(prefix="hvd-standby-smoke-")
+        script = os.path.join(workdir, "standby_worker.py")
+        with open(script, "w") as f:
+            f.write(STANDBY_WORKER)
+        extra = {
+            "CHAOS_SMOKE_DIR": workdir,
+            "HOROVOD_RETRY_BACKOFF_MS": "10",
+            # the warmer imports jax to preload cached executables; on
+            # this CPU smoke box it must not probe for TPU metadata
+            "JAX_PLATFORMS": "cpu",
+        }
+        if warm:
+            extra["HOROVOD_EXE_CACHE"] = cache
+            os.environ["HOROVOD_WARM_STANDBY"] = "1"
+        else:
+            os.environ.pop("HOROVOD_WARM_STANDBY", None)
+        driver = ElasticDriver(
+            FixedHosts([
+                HostInfo("127.0.0.1", 2),
+                HostInfo("localhost", 2),
+                HostInfo(third, 2),
+            ]),
+            [sys.executable, script],
+            min_np=4,  # epoch 1 (two hosts) must not re-reserve
+            discovery_interval=0.2,
+            output_filename=(
+                os.path.join(workdir, "logs")
+                if os.environ.get("CHAOS_SMOKE_LOGS")
+                else None
+            ),
+            extra_env=extra,
+        )
+        result = {}
+        try:
+            driver.host_manager.refresh()
+            t = threading.Thread(
+                target=lambda: result.update(rc=driver.run())
+            )
+            t.start()
+            if warm:
+                # the kill lands only once the warmer has announced
+                # ``armed`` over rendezvous KV (announce → stage → armed)
+                armed = None
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline and not armed:
+                    armed = next((
+                        hn
+                        for hn, ann in driver.standby_status().items()
+                        if ann.get("state") == "armed"
+                    ), None)
+                    time.sleep(0.2)
+                assert armed, (
+                    f"no armed standby before the kill: "
+                    f"{driver.standby_status()}"
+                )
+                assert armed != "127.0.0.1", "standby on the victim host"
+            _touch(os.path.join(workdir, "kill.go"))
+
+            # the post-swap rank 0 publishes its ephemeral scrape port
+            port_file = os.path.join(workdir, "standby_port")
+            deadline = time.monotonic() + 240
+            while (
+                time.monotonic() < deadline
+                and not os.path.exists(port_file)
+            ):
+                time.sleep(0.1)
+            assert os.path.exists(port_file), (
+                "post-swap gang never served /metrics"
+            )
+            with open(port_file) as f:
+                port = int(f.read().strip())
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                text = resp.read().decode()
+
+            restart_ms = _prom_value(text, "hvd_elastic_restart_ms")
+            assert restart_ms > 0, restart_ms
+            assert _prom_value(text, "hvd_elastic_restart_warm") == (
+                1.0 if warm else 0.0
+            )
+            if warm:
+                # the scraped survivor resolved from disk: zero compiles
+                assert _prom_value(text, "hvd_exe_cache_hits") >= 1
+                assert _prom_value_or(
+                    text, "hvd_exe_cache_misses", 0
+                ) == 0
+
+            _touch(os.path.join(workdir, "standby.ok"))
+            t.join(timeout=120)
+            assert not t.is_alive(), "driver did not converge"
+        finally:
+            driver.shutdown()
+            os.environ.pop("HOROVOD_WARM_STANDBY", None)
+
+        assert result.get("rc") == 0, f"driver exit {result.get('rc')}"
+        # the swap-in cost ZERO additional gang restarts
+        assert driver._resets == 1, driver._resets
+        assert driver.host_manager.is_blacklisted("127.0.0.1")
+
+        def _results(prefix):
+            out = []
+            for name in os.listdir(workdir):
+                if name.startswith(prefix):
+                    with open(os.path.join(workdir, name)) as f:
+                        out.append(json.load(f))
+            return out
+
+        e0, e1 = _results("result.e0."), _results("result.e1.")
+        # cold: all 6 slots active in epoch 0; warm: one host held out
+        assert len(e0) == (4 if warm else 6), [r["rank"] for r in e0]
+        assert len(e1) == 4, [r["rank"] for r in e1]
+        if warm:
+            assert driver._standby_swapins == 1, driver._standby_swapins
+            # the released standby actually serves in the new gang
+            assert driver._standby_released & {
+                r["host"] for r in e1
+            }, (driver._standby_released, [r["host"] for r in e1])
+            for r in e1:  # zero new compiles on ANY survivor
+                assert r["hit"], r
+                assert r["metrics"].get("exe_cache.misses", 0) == 0, r
+        else:
+            assert all(not r["hit"] for r in e1)
+        return restart_ms
+
+    cold_ms = phase(False)
+    warm_ms = phase(True)
+    assert warm_ms < cold_ms, (
+        f"warm swap-in restart ({warm_ms:.0f} ms) did not beat the "
+        f"cold baseline ({cold_ms:.0f} ms)"
+    )
+    print(
+        f"standby-swap OK: armed standby swapped in on 1 gang restart, "
+        f"0 new compiles on survivors, restart_ms {warm_ms:.0f} warm "
+        f"vs {cold_ms:.0f} cold"
+    )
+
+
 def main() -> int:
     integrity_drill()
     workdir = tempfile.mkdtemp(prefix="hvd-chaos-smoke-")
@@ -364,6 +653,8 @@ def main() -> int:
         f"{len(e0) + len(e1)} workers absorbed their KV flake, "
         f"scrape port {port}"
     )
+
+    standby_swap_drill()
     return 0
 
 

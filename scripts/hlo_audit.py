@@ -614,6 +614,51 @@ def prog_serve_paged_attn():
     return pairs
 
 
+def prog_serve_warm_start():
+    """PR 18: a second engine against a populated ``HOROVOD_EXE_CACHE``
+    serves the SAME traffic with ZERO prefill and ZERO decode compiles
+    — the decode table and every seen prefill width deserialize from
+    the persistent executable store (warm restarts recompile nothing).
+    A cold engine populates the cache first (its own budget is the
+    usual ``decode_compiles == 1``), writes are drained, then the warm
+    engine replays the trace."""
+    import tempfile
+
+    from horovod_tpu.common import exe_cache
+
+    cache = tempfile.mkdtemp(prefix="hloaudit-exe-cache-")
+    prev = os.environ.get("HOROVOD_EXE_CACHE")
+    os.environ["HOROVOD_EXE_CACHE"] = cache
+    try:
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(1, 60, size=n).tolist()
+                   for n in (5, 6, 7)]
+
+        def trace(eng):
+            for i, p in enumerate(prompts):
+                slot = eng.manager.alloc(f"r{i}")
+                eng.prefill(slot, p)
+            for _ in range(4):
+                eng.decode_step(np.zeros(eng.slots, np.int32))
+            eng.drain_promotions()
+            return eng.stats()
+
+        cold_stats = trace(_serve_engine(paged=False))
+        assert exe_cache.flush(30), "cache writes did not drain"
+        warm_stats = trace(_serve_engine(paged=False))
+    finally:
+        if prev is None:
+            os.environ.pop("HOROVOD_EXE_CACHE", None)
+        else:
+            os.environ["HOROVOD_EXE_CACHE"] = prev
+    return [
+        (rules.CompileBudget(decode_compiles=1), cold_stats),
+        (rules.CompileBudget(
+            decode_compiles=0, prefill_compiles=0, decode_disk_hits=1,
+        ), warm_stats),
+    ]
+
+
 ROSTER = {
     "fused_allreduce_fp32": prog_fused_allreduce_fp32,
     "fused_allreduce_int8": prog_fused_allreduce_int8,
@@ -631,6 +676,7 @@ ROSTER = {
     "serve_prefill_role": prog_serve_prefill_role,
     "serve_decode_role": prog_serve_decode_role,
     "serve_paged_attn": prog_serve_paged_attn,
+    "serve_warm_start": prog_serve_warm_start,
 }
 
 

@@ -61,6 +61,9 @@ ENV_READ_ALLOWED = {
     "horovod_tpu/elastic/driver.py",
     "horovod_tpu/runner/tpu_discovery.py",
     "horovod_tpu/runner/launch.py",
+    # HOROVOD_STANDBY_HOSTNAME / _FINGERPRINT / CHECKPOINT_DIR are
+    # identity stamped by the driver's warmer launch, same contract
+    "horovod_tpu/elastic/standby.py",
     "horovod_tpu/runner/rendezvous.py",
     "horovod_tpu/executor.py",
     # bootstrap surfaces that run before/While config exists
@@ -68,6 +71,10 @@ ENV_READ_ALLOWED = {
     "horovod_tpu/common/metrics.py",
     "horovod_tpu/common/telemetry.py",
     "horovod_tpu/common/autotune.py",
+    # HOROVOD_EXE_CACHE resolves live like HOROVOD_TUNER_CACHE above:
+    # drills/benches flip the cache root mid-process, after any init
+    # snapshot (typed knob exists in config.py for the standby warmer)
+    "horovod_tpu/common/exe_cache.py",
     "horovod_tpu/testing/chaos.py",
     "horovod_tpu/testing/fake_ray.py",
     "horovod_tpu/_native/loader.py",

@@ -1,0 +1,612 @@
+"""Persistent executable cache (common/exe_cache.py) + warm-standby
+elastic: entry-key anatomy, store/load round-trip with bitwise output
+parity, corruption and chaos degradation (counted cold compile, never
+a failed init), cross-version/topology/donation rejection BY KEY (a
+mismatched entry is never deserialized), fusion disk tier, serving
+engine warm start (zero compiles for seen keys, including a fresh
+disk-only subprocess), schedule sidecars, standby reservation /
+swap-in / serve scale-up planning, and the restart-stamp clock."""
+
+import glob
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.common import exe_cache
+from horovod_tpu.common.metrics import registry
+from horovod_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+@pytest.fixture()
+def cache_base(tmp_path, monkeypatch):
+    base = str(tmp_path / "exe-cache")
+    monkeypatch.setenv("HOROVOD_EXE_CACHE", base)
+    return base
+
+
+def _delta(name, before):
+    return registry.snapshot().get(name, 0.0) - before.get(name, 0.0)
+
+
+def _lowered(scale=2.0):
+    return jax.jit(lambda x: x * scale + 1.0).lower(
+        jnp.ones((8,), jnp.float32)
+    )
+
+
+def _rewrite_header(path, **patch):
+    """Tamper one pinned header field in-place (payload untouched)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    off = len(exe_cache.MAGIC)
+    (hlen,) = struct.unpack(">I", blob[off:off + 4])
+    header = json.loads(blob[off + 4:off + 4 + hlen].decode())
+    header.update(patch)
+    hdr = json.dumps(header, sort_keys=True).encode()
+    with open(path, "wb") as f:
+        f.write(
+            exe_cache.MAGIC + struct.pack(">I", len(hdr)) + hdr
+            + blob[off + 4 + hlen:]
+        )
+
+
+# ------------------------------------------------------------------ keys
+
+
+class TestKeys:
+    def test_donation_signature(self):
+        assert exe_cache.donation_signature(None) == "none"
+        assert exe_cache.donation_signature(()) == "none"
+        assert exe_cache.donation_signature((0, 1)) == "d0.1"
+        assert exe_cache.donation_signature((3,)) == "d3"
+
+    def test_entry_path_off_without_env(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_EXE_CACHE", raising=False)
+        assert exe_cache.cache_dir() is None
+        assert exe_cache.entry_path("f", "abc") is None
+
+    def test_entry_path_key_fields(self, cache_base):
+        p = exe_cache.entry_path(
+            "serve/prefill", "h1", wire="int8", donation="d1",
+            fingerprint="w8-l8-cpu",
+        )
+        name = os.path.basename(p)
+        assert name.startswith("serve_prefill-w8-l8-cpu-")
+        assert name.endswith(".hvdexe")
+        # every key dimension lands in a DIFFERENT file: world size,
+        # wire, and donation signature can never collide by path
+        others = [
+            exe_cache.entry_path("serve/prefill", "h1", wire="int8",
+                                 donation="d1", fingerprint="w6-l6-cpu"),
+            exe_cache.entry_path("serve/prefill", "h1", wire="fp32",
+                                 donation="d1", fingerprint="w8-l8-cpu"),
+            exe_cache.entry_path("serve/prefill", "h1", wire="int8",
+                                 donation="none", fingerprint="w8-l8-cpu"),
+            exe_cache.entry_path("serve/prefill", "h2", wire="int8",
+                                 donation="d1", fingerprint="w8-l8-cpu"),
+        ]
+        assert len({p, *others}) == 5
+
+
+# ------------------------------------------------------- store / load
+
+
+class TestRoundTrip:
+    def test_store_load_bitwise(self, cache_base):
+        before = registry.snapshot()
+        low = _lowered()
+        fp = exe_cache.hlo_fingerprint(low)
+        exe, hit = exe_cache.get_or_compile(low, "test.rt")
+        assert hit is False
+        assert exe_cache.flush(10)
+        assert _delta("exe_cache.stores", before) == 1
+        got = exe_cache.load("test.rt", fp)
+        assert got is not None
+        x = jnp.arange(8, dtype=jnp.float32)
+        a = np.asarray(exe(x))
+        b = np.asarray(got(x))
+        assert a.tobytes() == b.tobytes()
+        assert _delta("exe_cache.hits", before) == 1
+        assert _delta("exe_cache.bytes", before) > 0
+        assert _delta("exe_cache.deserialize_ms", before) >= 0
+
+    def test_second_get_or_compile_is_a_hit(self, cache_base):
+        exe_cache.get_or_compile(_lowered(), "test.hit")
+        exe_cache.flush(10)
+        exe, hit = exe_cache.get_or_compile(_lowered(), "test.hit")
+        assert hit is True
+
+    def test_absent_entry_counts_miss(self, cache_base):
+        before = registry.snapshot()
+        assert exe_cache.load("test.absent", "deadbeef") is None
+        assert _delta("exe_cache.misses", before) == 1
+
+    def test_no_tmp_leftovers(self, cache_base):
+        exe_cache.get_or_compile(_lowered(), "test.tmp")
+        exe_cache.flush(10)
+        assert not glob.glob(os.path.join(cache_base, ".tmp-*"))
+
+
+# ------------------------------------------- corruption / invalidation
+
+
+class TestDegradation:
+    def _seed_entry(self, family="test.corrupt"):
+        low = _lowered()
+        fp = exe_cache.hlo_fingerprint(low)
+        path = exe_cache.store(
+            low.compile(), family, fp, sync=True
+        )
+        assert path and os.path.exists(path)
+        return fp, path
+
+    def test_flipped_payload_byte_is_counted_corrupt(self, cache_base):
+        fp, path = self._seed_entry()
+        with open(path, "rb") as f:
+            blob = f.read()
+        blob = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+        with open(path, "wb") as f:
+            f.write(blob)
+        before = registry.snapshot()
+        assert exe_cache.load("test.corrupt", fp) is None
+        assert _delta("exe_cache.corrupt", before) == 1
+
+    def test_truncated_and_bad_magic_are_corrupt(self, cache_base):
+        fp, path = self._seed_entry()
+        before = registry.snapshot()
+        with open(path, "wb") as f:
+            f.write(b"HV")  # torn write
+        assert exe_cache.load("test.corrupt", fp) is None
+        with open(path, "wb") as f:
+            f.write(b"NOTMAGIC" + b"\0" * 64)
+        assert exe_cache.load("test.corrupt", fp) is None
+        assert _delta("exe_cache.corrupt", before) == 2
+
+    def test_chaos_bitflip_degrades_to_cold_compile(self, cache_base):
+        """The ``exe_cache.load`` chaos site: a bitflipped entry falls
+        back to a counted cold compile — never an aborted init."""
+        low = _lowered()
+        exe_cache.get_or_compile(low, "test.chaos")
+        exe_cache.flush(10)
+        chaos.configure("exe_cache.load@1:bitflip")
+        before = registry.snapshot()
+        exe, hit = exe_cache.get_or_compile(_lowered(), "test.chaos")
+        assert hit is False  # corrupt read -> compiled cold
+        assert exe is not None
+        assert _delta("exe_cache.corrupt", before) == 1
+        exe_cache.flush(10)
+        # fault is one-shot: the re-persisted entry now hits clean
+        exe, hit = exe_cache.get_or_compile(_lowered(), "test.chaos")
+        assert hit is True
+
+    def test_chaos_delay_still_loads(self, cache_base):
+        low = _lowered()
+        fp = exe_cache.hlo_fingerprint(low)
+        exe_cache.store(low.compile(), "test.delay", fp, sync=True)
+        chaos.configure("exe_cache.load@1:delay:ms=10")
+        assert exe_cache.load("test.delay", fp) is not None
+
+    def test_mismatched_entries_rejected_never_deserialized(
+        self, cache_base, monkeypatch
+    ):
+        """Cross-version/topology safety: entries whose header pins a
+        different JAX/jaxlib version, platform, or format are rejected
+        by the invalidation rules BEFORE deserialization; a different
+        world size or donation signature never even resolves to the
+        same file."""
+        from jax.experimental import serialize_executable as se
+
+        fp, path = self._seed_entry("test.rej")
+
+        def _boom(*a, **kw):  # proves the payload is never loaded
+            raise AssertionError("deserialized a mismatched entry")
+
+        monkeypatch.setattr(se, "deserialize_and_load", _boom)
+        before = registry.snapshot()
+        for patch in (
+            {"jax": "0.0.1"},
+            {"jaxlib": "0.0.1"},
+            {"platform": "tpu"},
+            {"format": exe_cache.FORMAT_VERSION + 1},
+        ):
+            self._seed_entry("test.rej")  # restore a clean entry
+            _rewrite_header(path, **patch)
+            assert exe_cache.load("test.rej", fp) is None
+        assert _delta("exe_cache.rejected", before) == 4
+        # different topology fingerprint / donation: a DIFFERENT key,
+        # so the reader misses on the absent file — by construction the
+        # 8-world entry cannot load into a 6-world reader
+        before = registry.snapshot()
+        assert exe_cache.load("test.rej", fp,
+                              fingerprint="w6-l6-cpu") is None
+        assert exe_cache.load("test.rej", fp, donation="d1") is None
+        assert _delta("exe_cache.misses", before) == 2
+        assert _delta("exe_cache.rejected", before) == 0
+
+
+# ------------------------------------------------------ scan / preload
+
+
+class TestScanPreload:
+    def test_scan_filters_family_and_topology(self, cache_base):
+        low = _lowered()
+        fp = exe_cache.hlo_fingerprint(low)
+        exe = low.compile()
+        exe_cache.store(exe, "fam.a", fp, meta={"width": 8}, sync=True)
+        exe_cache.store(exe, "fam.b", fp, sync=True)
+        headers = exe_cache.scan("fam.a")
+        assert len(headers) == 1
+        h = headers[0]
+        assert h["family"] == "fam.a"
+        assert h["meta"] == {"width": 8}
+        assert os.path.exists(h["path"])
+        assert exe_cache.scan("fam.a", fingerprint="w999-l1-cpu") == []
+
+    def test_preload_deserializes_everything(self, cache_base):
+        low = _lowered()
+        fp = exe_cache.hlo_fingerprint(low)
+        exe_cache.store(low.compile(), "fam.pre", fp, sync=True)
+        loaded, nbytes = exe_cache.preload("fam.pre")
+        assert loaded == 1 and nbytes > 0
+        # corrupt entries are skipped, not raised (standby staging must
+        # survive a torn cache)
+        path = exe_cache.scan("fam.pre")[0]["path"]
+        with open(path, "r+b") as f:
+            f.seek(-1, os.SEEK_END)
+            f.write(b"\0")
+        loaded, _ = exe_cache.preload("fam.pre")
+        assert loaded == 0
+
+
+# ----------------------------------------------------------- sidecars
+
+
+class TestSidecars:
+    def test_merge_on_persist(self, cache_base):
+        exe_cache.persist_json("sc", {"a": 1})
+        exe_cache.persist_json("sc", {"b": 2})
+        assert exe_cache.load_json("sc") == {"a": 1, "b": 2}
+
+    def test_corrupt_sidecar_reads_empty(self, cache_base):
+        path = exe_cache.persist_json("sc2", {"a": 1})
+        with open(path, "w") as f:
+            f.write("{not json")
+        before = registry.snapshot()
+        assert exe_cache.load_json("sc2") == {}
+        assert _delta("exe_cache.corrupt", before) == 1
+
+    def test_overlap_schedule_persists_and_reloads(self, cache_base):
+        from horovod_tpu.ops import overlap
+
+        overlap.reset_schedule_cache()
+        tree = {"a": jnp.ones((64, 8)), "b": jnp.ones((16,))}
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        s1 = overlap.schedule_for(leaves, treedef, n_buckets=2,
+                                  min_bucket_bytes=1)
+        assert exe_cache.load_json("overlap_schedule")  # persisted
+        # a fresh in-memory cache (restarted worker) reconstructs the
+        # SAME partition from the sidecar instead of re-deriving it
+        overlap.reset_schedule_cache()
+        s2 = overlap.schedule_for(leaves, treedef, n_buckets=2,
+                                  min_bucket_bytes=1)
+        assert s2 == s1
+        assert overlap.schedule_cache_stats()["disk_hits"] == 1
+        overlap.reset_schedule_cache()
+
+
+# ------------------------------------------------------- fusion tier
+
+
+class TestFusionDiskTier:
+    def _drill(self):
+        """test_fusion_injit's promotion pattern: exact compile for the
+        first composition, core compile + two sightings for the second
+        (the second sighting promotes)."""
+        import horovod_tpu as hvd
+
+        def batch(sizes, tag):
+            hs = [
+                hvd.allreduce_async(
+                    np.stack([
+                        (r + 1.0) * np.arange(1, n + 1, dtype=np.float32)
+                        for r in range(hvd.size())
+                    ]),
+                    name=f"{tag}{i}",
+                )
+                for i, n in enumerate(sizes)
+            ]
+            return [np.asarray(h.wait()) for h in hs]
+
+        batch([6, 2], "x")
+        batch([3, 5], "y")
+        batch([3, 5], "y")
+        return batch([3, 5], "y")
+
+    def test_disk_tier_round_trip_bitwise(self, cache_base):
+        import horovod_tpu as hvd
+
+        hvd.init()
+        try:
+            f = hvd.common.basics.state().fusion
+            f.cycle_time_ms = 1e6  # eager-flush only via wait()
+            out1 = self._drill()
+            s = f.cache_stats()
+            assert s["promotions"] == 1
+            assert s["disk_misses"] == 3  # exact + core + promoted
+            assert s["disk_hits"] == 0
+        finally:
+            hvd.shutdown()
+        assert exe_cache.flush(10)
+        hvd.init()
+        try:
+            f = hvd.common.basics.state().fusion
+            f.cycle_time_ms = 1e6
+            out2 = self._drill()
+            s = f.cache_stats()
+            # zero fused-dispatch compiles for seen keys: every build —
+            # including the bucket->exact promotion — resolves from disk
+            assert s["disk_hits"] == 3
+            assert s["disk_misses"] == 0
+            assert s["promotions"] == 1
+            for a, b in zip(out1, out2):
+                assert a.tobytes() == b.tobytes()
+        finally:
+            hvd.shutdown()
+
+
+# --------------------------------------------------- serving warm start
+
+
+def _toy_engine(tmp_base, **kw):
+    from horovod_tpu.models.transformer import Transformer, TransformerConfig
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    cfg = TransformerConfig(
+        vocab_size=61, num_layers=1, d_model=16, num_heads=2, d_ff=32,
+        max_len=64, causal=True, dtype=jnp.float32,
+    )
+    model = Transformer(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), train=False
+    )
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("min_bucket", 4)
+    return InferenceEngine(model, params, **kw)
+
+
+def _serve_round(eng, prompt, n):
+    slot = eng.manager.alloc("r")
+    out = [eng.prefill(slot, prompt)]
+    for _ in range(n - 1):
+        toks = np.zeros(eng.slots, np.int32)
+        toks[slot] = out[-1]
+        nxt = eng.decode_step(toks)
+        eng.manager.advance(slot)
+        out.append(int(nxt[slot]))
+    return out
+
+
+class TestServeWarmStart:
+    def test_fresh_engine_serves_with_zero_compiles(self, cache_base):
+        eng = _toy_engine(cache_base, promote_after=2)
+        prompt = [5, 7, 11, 2, 9]
+        cold = _serve_round(eng, prompt, 4)
+        _serve_round(eng, prompt, 1)  # second sighting -> promotion
+        assert eng.drain_promotions()
+        exe_cache.flush(10)
+        warm = _toy_engine(cache_base, promote_after=2)
+        s = warm.stats()
+        assert s.get("prefill_disk_hits", 0) >= 1
+        assert s.get("decode_disk_hits", 0) == 1
+        out = _serve_round(warm, prompt, 4)
+        s = warm.stats()
+        assert s["prefill_compiles"] == 0
+        assert s["decode_compiles"] == 0
+        assert out == cold
+
+    def test_decode_role_loads_only_decode_entries(self, cache_base):
+        eng = _toy_engine(cache_base, promote_after=2)
+        _serve_round(eng, [1, 2, 3, 4, 5], 3)
+        exe_cache.flush(10)
+        dec = _toy_engine(cache_base, role="decode")
+        s = dec.stats()
+        assert s.get("decode_disk_hits", 0) == 1
+        assert s.get("prefill_disk_hits", 0) == 0
+
+    @pytest.mark.slow
+    def test_disk_only_subprocess_is_bitwise_identical(
+        self, cache_base, tmp_path
+    ):
+        """The acceptance drill: a SECOND PROCESS against the populated
+        cache performs zero prefill/decode compiles for seen keys and
+        produces bitwise-identical tokens."""
+        eng = _toy_engine(cache_base, promote_after=2)
+        prompt = [5, 7, 11]
+        cold = _serve_round(eng, prompt, 5)
+        _serve_round(eng, prompt, 1)
+        assert eng.drain_promotions()
+        exe_cache.flush(10)
+        script = tmp_path / "warm_proc.py"
+        script.write_text(
+            "import os, json\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import sys; sys.path.insert(0, %r)\n"
+            "sys.path.insert(0, %r)\n"
+            "from test_exe_cache import _toy_engine, _serve_round\n"
+            "eng = _toy_engine(os.environ['HOROVOD_EXE_CACHE'],"
+            " promote_after=2)\n"
+            "out = _serve_round(eng, %r, 5)\n"
+            "print('RESULT', json.dumps({'out': out,"
+            " 'stats': eng.stats()}))\n"
+            % (os.path.dirname(__file__), "/root/repo", list(prompt))
+        )
+        env = dict(os.environ, HOROVOD_EXE_CACHE=cache_base)
+        r = subprocess.run(
+            [sys.executable, str(script)], env=env,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        line = [l for l in r.stdout.splitlines()
+                if l.startswith("RESULT ")][0]
+        got = json.loads(line[len("RESULT "):])
+        assert got["stats"]["prefill_compiles"] == 0
+        assert got["stats"]["decode_compiles"] == 0
+        assert got["out"] == cold
+
+
+# ------------------------------------------------------- warm standby
+
+
+class TestWarmStandby:
+    def _driver(self, hosts, **kw):
+        from horovod_tpu.elastic.discovery import HostDiscovery
+        from horovod_tpu.elastic.driver import ElasticDriver
+        from horovod_tpu.runner.hosts import HostInfo
+
+        class FakeDiscovery(HostDiscovery):
+            def __init__(self, hosts):
+                self.hosts = [HostInfo(h, s) for h, s in hosts]
+
+            def find_available_hosts_and_slots(self):
+                return list(self.hosts)
+
+        kw.setdefault("min_np", 2)
+        d = ElasticDriver(FakeDiscovery(hosts), ["true"], **kw)
+        d.host_manager.refresh()
+        return d
+
+    def test_reservation_holds_excess_host(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WARM_STANDBY", "1")
+        d = self._driver([("a", 2), ("b", 2)])
+        a = d.compute_assignment()
+        assert a.world_size == 2 and a.hostnames == ["a"]
+        assert d._standby_current == {"b"}
+
+    def test_tight_capacity_reserves_nothing(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WARM_STANDBY", "1")
+        d = self._driver([("a", 2)])
+        a = d.compute_assignment()
+        assert a.hostnames == ["a"]
+        assert d._standby_current == set()
+
+    def test_host_failure_swaps_standby_in(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WARM_STANDBY", "1")
+        d = self._driver([("a", 2), ("b", 2)])
+        d.compute_assignment()
+        d._standby_warmers["b"] = None  # a tracked (fake) warmer
+        d.handle_host_failure("a")
+        assert "b" in d._standby_released
+        a = d.compute_assignment()
+        assert a.hostnames == ["b"] and a.world_size == 2
+        assert d._standby_swapins == 1
+
+    def test_released_standby_is_never_rereserved(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WARM_STANDBY", "1")
+        d = self._driver([("a", 2), ("b", 2)])
+        d.compute_assignment()
+        d._standby_warmers["b"] = None
+        d._release_standby("test")
+        a = d.compute_assignment()
+        # the released host joins the gang; the pool may backfill a
+        # DIFFERENT host as the next standby, but never "b" again
+        assert "b" not in d._standby_current
+        assert "b" in a.hostnames
+
+    def test_serve_saturation_releases_standby(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WARM_STANDBY", "1")
+        d = self._driver([("a", 2), ("b", 2)])
+        d.compute_assignment()
+        d._standby_warmers["b"] = None
+        # headroom left: no scale-up
+        d._maybe_scale_up(
+            {"decode": {"workers": 2, "free_slots": 3, "free_pages": 8}}
+        )
+        assert d._scaleup_reason is None
+        # zero admission headroom on a live role: release + grow
+        d._maybe_scale_up(
+            {"decode": {"workers": 2, "free_slots": 0, "free_pages": 0}}
+        )
+        assert d._scaleup_reason is not None
+        assert "scaleup" in d._scaleup_reason
+        assert "b" in d._standby_released
+
+    def test_standby_lifecycle_announce_stage_release(self, tmp_path):
+        from horovod_tpu.elastic.standby import StandbyWarmer
+        from horovod_tpu.runner.rendezvous import (
+            KVStore, STANDBY_SCOPE, read_standbys,
+        )
+
+        base = str(tmp_path / "cache")
+        low = _lowered()
+        exe_cache.store(
+            low.compile(), "fam.sb", exe_cache.hlo_fingerprint(low),
+            sync=True, base=base,
+        )
+        store = KVStore()
+        w = StandbyWarmer(store, "standby-1", exe_cache_base=base)
+        w._announce("announce")
+        detail = w.stage()
+        assert detail["exes"] == 1 and detail["exe_bytes"] > 0
+        w._announce("armed", detail)
+        st = read_standbys(store)
+        assert st["standby-1"]["state"] == "armed"
+        assert st["standby-1"]["exes"] == 1
+        assert not w._released()
+        store.put(STANDBY_SCOPE, "release.standby-1", b"1")
+        assert w._released()
+
+
+# ----------------------------------------------------- restart clock
+
+
+class TestRestartStamp:
+    def test_stamp_round_trip(self):
+        from horovod_tpu.runner.rendezvous import (
+            KVStore, put_restart_stamp, read_restart_stamp,
+        )
+
+        store = KVStore()
+        assert read_restart_stamp(store) is None
+        put_restart_stamp(store, epoch=3, reason="host a failed",
+                          warm=True, kind="scaleup")
+        stamp = read_restart_stamp(store)
+        assert stamp["epoch"] == 3
+        assert stamp["warm"] is True
+        assert stamp["kind"] == "scaleup"
+        assert stamp["ts"] > 0
+
+    def test_worker_publishes_restart_ms(self):
+        from horovod_tpu.elastic.worker import WorkerNotificationManager
+        from horovod_tpu.runner.rendezvous import (
+            KVStore, put_restart_stamp,
+        )
+
+        store = KVStore()
+        put_restart_stamp(store, epoch=2, reason="quarantine",
+                          warm=True, kind="scaleup")
+        mgr = WorkerNotificationManager.__new__(WorkerNotificationManager)
+        before = dict(registry.snapshot())
+        registry.gauge("elastic.restart_ms", -1.0)
+        mgr._publish_restart_ms(store, "1")  # stale epoch: no-op
+        assert registry.snapshot()["elastic.restart_ms"] == -1.0
+        mgr._publish_restart_ms(store, "2")
+        snap = registry.snapshot()
+        assert snap["elastic.restart_ms"] >= 0.0
+        assert snap["elastic.restart_warm"] == 1.0
+        assert snap["serve.scaleup_ms"] == snap["elastic.restart_ms"]

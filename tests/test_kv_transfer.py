@@ -676,6 +676,9 @@ def test_driver_per_role_capacity_gauges():
     fake = types.SimpleNamespace(
         _server=types.SimpleNamespace(store=store),
         _serve_cap_seen={},
+        # PR 18: the capacity poll feeds the standby scale-up check;
+        # its behavior is covered in test_exe_cache.py
+        _maybe_scale_up=lambda per_role: None,
     )
     ElasticDriver._poll_serve_capacity(fake)
     snap = _metrics.snapshot()

@@ -109,11 +109,17 @@ def test_prefill_two_tier_hit_miss_promotion(toy):
     s = eng.stats()
     assert s["prefill_compiles"] == 1
     assert s["prefill_bucket_hits"] == 1
-    # length 5 again -> second sighting promotes to an exact executable
+    # length 5 again -> second sighting kicks off a background promotion
+    # while the request itself is still served off the bucket tier (the
+    # serving hot path never blocks on a promotion compile).
     eng.prefill(eng.manager.alloc(), [9, 8, 7, 6, 5])
+    s = eng.stats()
+    assert s["prefill_bucket_hits"] == 2  # served padded, not blocked
+    assert eng.drain_promotions()
     s = eng.stats()
     assert s["prefill_compiles"] == 2
     assert s["prefill_promotions"] == 1
+    assert s["prefill_bg_promotions"] == 1
     assert s["prefill_exact_entries"] == 1
     # and a third length-5 prompt is an exact hit: no compile, no pad
     pad_before = s["prefill_pad_tokens"]
@@ -129,6 +135,7 @@ def test_exact_tier_is_lru_bounded(toy):
     for ln in (3, 4, 5, 6):
         eng.prefill(eng.manager.alloc() or 0, list(range(1, ln + 1)))
         # slots exhaust; reuse slot 0 — allocator state is irrelevant here
+    eng.drain_promotions()
     assert eng.stats()["prefill_exact_entries"] <= 2
 
 
