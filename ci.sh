@@ -154,11 +154,12 @@ bench_smoke() {
     test -s "$art_dir/lm_ab_local_sgd_${leg}.json" \
       || { echo "missing artifact: lm_ab_local_sgd_${leg}.json" >&2; exit 1; }
   done
-  step "bench-smoke: bench_serve.py dryrun (static-vs-continuous + paged-KV + prefix-cache + disaggregated + paged-attention + warm-cache A/B)"
+  step "bench-smoke: bench_serve.py dryrun (static-vs-continuous + paged-KV + prefix-cache + disaggregated + paged-attention + warm-cache + failover A/B)"
   JAX_PLATFORMS=cpu \
     BENCH_PLATFORM=cpu BENCH_DRYRUN=1 BENCH_ARTIFACT_DIR="$art_dir" \
     python bench_serve.py
-  for leg in static continuous paged prefix disagg paged_attn warm_cache; do
+  for leg in static continuous paged prefix disagg paged_attn warm_cache \
+             failover; do
     test -s "$art_dir/serve_ab_${leg}.json" \
       || { echo "missing artifact: serve_ab_${leg}.json" >&2; exit 1; }
   done

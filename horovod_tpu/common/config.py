@@ -86,6 +86,16 @@ DEFAULT_SERVE_TRANSFER_PORT = 0
 # oracle) elsewhere; on = force the kernel (interpret-mode on CPU —
 # what the parity tests and the A/B bench run); off = always gather.
 DEFAULT_SERVE_PAGED_ATTN = "auto"
+# Crash-safe serving (serving/frontend.py Router + drain path): hedge
+# delay in ms before the Router fires a first-writer-wins backup
+# request (0 = off), the SIGTERM drain deadline in seconds past which
+# in-flight sequences are live-migrated to a peer instead of run to
+# completion (0 = run to completion, the classic drain), and the TTL of
+# the completed-result dedupe cache that makes client retries by
+# request_id idempotent.
+DEFAULT_SERVE_HEDGE_MS = 0.0
+DEFAULT_SERVE_DRAIN_DEADLINE_S = 0.0
+DEFAULT_SERVE_DEDUPE_TTL_S = 120.0
 
 
 def _env_bool(name: str, default: bool = False) -> bool:
@@ -366,6 +376,12 @@ class Config:
     serve_transfer_port: int = DEFAULT_SERVE_TRANSFER_PORT
     # paged-attention kernel read: auto / on / off
     serve_paged_attn: str = DEFAULT_SERVE_PAGED_ATTN
+    # crash-safe serving: Router hedge delay (ms, 0 = off), SIGTERM
+    # drain deadline before live migration (s, 0 = run to completion),
+    # completed-result dedupe cache TTL (s)
+    serve_hedge_ms: float = DEFAULT_SERVE_HEDGE_MS
+    serve_drain_deadline_s: float = DEFAULT_SERVE_DRAIN_DEADLINE_S
+    serve_dedupe_ttl_s: float = DEFAULT_SERVE_DEDUPE_TTL_S
 
     # --- logging ---
     log_level: str = "warning"
@@ -584,6 +600,16 @@ class Config:
             serve_paged_attn=_env_choice(
                 "HOROVOD_SERVE_PAGED_ATTN", DEFAULT_SERVE_PAGED_ATTN,
                 ("auto", "on", "off"),
+            ),
+            serve_hedge_ms=_env_float(
+                "HOROVOD_SERVE_HEDGE_MS", DEFAULT_SERVE_HEDGE_MS
+            ),
+            serve_drain_deadline_s=_env_float(
+                "HOROVOD_SERVE_DRAIN_DEADLINE_S",
+                DEFAULT_SERVE_DRAIN_DEADLINE_S,
+            ),
+            serve_dedupe_ttl_s=_env_float(
+                "HOROVOD_SERVE_DEDUPE_TTL_S", DEFAULT_SERVE_DEDUPE_TTL_S
             ),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_timestamp=_env_bool("HOROVOD_LOG_TIMESTAMP", True),

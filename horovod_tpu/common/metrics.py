@@ -240,6 +240,34 @@ SERVING_PAGED_ATTN_METRICS = (
     "serve.paged_attn_fallbacks",
 )
 
+# Crash-safe serving families (PR 19 — router durability + live
+# migration, serving/frontend.py + serving/kv_transfer.py; the
+# docs/robustness.md "serving failure ladder" runbook, rendered as
+# `hvd_serve_*` on /metrics):
+#   serve.replay_dedupe_hits     /generate answered from the TTL ledger
+#                                by client request_id — a retry or a
+#                                hedge loser absorbed without recompute
+#   serve.replays                routed payloads replayed on a live
+#                                peer after a DARK worker failure (an
+#                                orderly 503 fails over without one)
+#   serve.hedges                 hedged second launches past
+#                                HOROVOD_SERVE_HEDGE_MS (first writer
+#                                wins)
+#   serve.migrations             in-flight sequences streamed OUT past
+#                                the drain deadline (sender counter)
+#   serve.migrations_in          migrated sequences landed and resumed
+#                                mid-decode (receiver counter)
+#   serve.migration_ms           pack + wire wall-ms per migration
+#                                (sender counter)
+SERVING_FAILOVER_METRICS = (
+    "serve.replay_dedupe_hits",
+    "serve.replays",
+    "serve.hedges",
+    "serve.migrations",
+    "serve.migrations_in",
+    "serve.migration_ms",
+)
+
 # Persistent-executable-cache + warm-restart families (PR 18 —
 # common/exe_cache.py, elastic/driver.py + standby.py, elastic/worker
 # init; legend for docs/observability.md's warm-restart table):

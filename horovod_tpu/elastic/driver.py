@@ -229,7 +229,30 @@ class ElasticDriver:
         releasing one standby into the pool — the restart that follows
         swaps it in instead of shrinking the world."""
         self.host_manager.blacklist(hostname)
+        self._publish_dead_hosts()
         self._release_standby(f"host {hostname} failed")
+
+    def _publish_dead_hosts(self) -> None:
+        """Push the blacklist into the serve KV scope (dead-set
+        channel, runner/rendezvous.py): the serving Router evicts a
+        dead worker's announcement the moment the driver declares the
+        host dead, instead of waiting out the announcement freshness
+        window — failure detection feeding routing. Best-effort: a KV
+        hiccup must never block the failure handling itself."""
+        if self._server is None:
+            return
+        try:
+            dead = self.host_manager.blacklisted
+            with self._lock:
+                ranks = sorted(
+                    int(b["HOROVOD_RANK"]) for b in self._blocks
+                    if b.get("HOROVOD_HOSTNAME") in dead
+                )
+            from ..runner.rendezvous import put_dead_hosts
+
+            put_dead_hosts(self._server.store, dead, ranks=ranks)
+        except Exception as e:  # noqa: BLE001 — observability, not control
+            _log.debug("dead-host publication failed: %s", e)
 
     # ---------------------------------------------------------- gang ops
 
@@ -947,6 +970,7 @@ class ElasticDriver:
             self.host_manager.blacklist(hostname)
             _metrics.counter("driver.quarantined_hosts")
             self._release_standby(f"{why}: {hostname}")
+        self._publish_dead_hosts()
         return True
 
     def _poll_audit(self, now: float) -> Optional[str]:

@@ -762,6 +762,49 @@ def read_restart_stamp(store_or_client) -> Optional[dict]:
     return None
 
 
+def put_dead_hosts(store_or_client, hosts, ranks=()) -> None:
+    """Driver side of the dead-set channel: publish the blacklisted/
+    quarantined host set into the SERVE scope (key ``dead_hosts`` — a
+    non-numeric key, so ``read_announcements`` skips it by
+    construction) so the serving Router evicts a dead worker's
+    announcement IMMEDIATELY instead of waiting out the freshness
+    window. ``ranks`` carries the worker ranks the driver mapped onto
+    those hosts at publication time (announcements are keyed by rank;
+    the host name is the fallback match)."""
+    import time as _time
+
+    payload = {
+        "ts": _time.time(),
+        "hosts": sorted(str(h) for h in hosts),
+        "ranks": sorted(int(r) for r in ranks),
+    }
+    store_or_client.put(
+        "serve", "dead_hosts", json.dumps(payload).encode()
+    )
+
+
+def read_dead_hosts(store_or_client) -> Dict[str, list]:
+    """Router side: ``{"hosts": [...], "ranks": [...]}`` — empty lists
+    on first launch or a malformed blob (the dead set accelerates
+    eviction; a corrupt one must never break routing)."""
+    raw = store_or_client.get("serve", "dead_hosts")
+    if raw is None:
+        return {"hosts": [], "ranks": []}
+    try:
+        obj = json.loads(raw.decode())
+    except (ValueError, UnicodeDecodeError):
+        return {"hosts": [], "ranks": []}
+    if not isinstance(obj, dict):
+        return {"hosts": [], "ranks": []}
+    return {
+        "hosts": [str(h) for h in obj.get("hosts", ()) or ()],
+        "ranks": [
+            int(r) for r in obj.get("ranks", ()) or ()
+            if isinstance(r, (int, float, str)) and str(r).lstrip("-").isdigit()
+        ],
+    }
+
+
 def _client_from_cfg(cfg) -> "RendezvousClient":
     """Shared construction of the worker-side KV client from config
     (secret decode + endpoint) — used by the object collectives and the

@@ -35,7 +35,13 @@ prompt + generated-so-far, usually through the prefix cache).
 Draining (``drain()``, wired to SIGTERM via
 ``preemption.register_drain``) stops ADMISSION of new submissions but
 runs queue + in-flight to completion — every accepted request finishes
-before the worker leaves the gang.
+before the worker leaves the gang. With a drain DEADLINE
+(``HOROVOD_SERVE_DRAIN_DEADLINE_S``), sequences still in flight past
+it are live-migrated instead: :meth:`export_inflight` detaches each
+slot's pages + generated tokens + armed sampling state and the
+frontend streams them to a reserved peer over the kv_transfer wire
+(the ``migrate`` frame), where they resume mid-decode without
+re-prefill.
 
 Role-split fleets (``HOROVOD_SERVE_ROLE``, serving/kv_transfer.py): a
 ``prefill``-role batcher reserves decode capacity BEFORE each fresh
@@ -63,6 +69,7 @@ import numpy as np
 from ..common import telemetry as _telemetry
 from ..common.logging import get_logger
 from ..common.metrics import registry as _metrics
+from ..testing import chaos as _chaos
 from .paged_kv import PagePoolExhausted
 from .slo import LatencyRecorder
 
@@ -178,6 +185,7 @@ class ContinuousBatcher:
         self._queue: "deque[Request]" = deque()
         self._slot_req: Dict[int, Request] = {}
         self._draining = False
+        self._drain_active = False  # a drain() loop is live-stepping
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._decode_steps = 0
@@ -325,6 +333,67 @@ class ContinuousBatcher:
         self._publish_gauges()
         return req
 
+    def submit_migrated(
+        self,
+        prompt,
+        tokens,
+        max_new_tokens: int,
+        logical,
+        arrays,
+        length: int,
+        deadline_ms: Optional[float] = None,
+        sample: Optional[dict] = None,
+    ) -> Request:
+        """Admit a live-migrated in-flight sequence (the ``migrate``
+        frame, serving/kv_transfer.py receiver). Unlike
+        :meth:`submit_ingested` the request arrives MID-DECODE: the
+        full generated-token history seeds ``out_tokens`` (the newest
+        one feeds the next decode step — the same frontier it left the
+        sender at) and ``sample`` carries the sender's armed sampling
+        snapshot including the raw mid-stream PRNG key, so sampled
+        sequences continue bit-identically. No prefix publication: the
+        pages hold generated tokens, not a shareable prompt prefix."""
+        if not self.engine.paged:
+            raise Rejected("migration needs the paged plane")
+        toks = [int(t) for t in tokens]
+        if not toks:
+            raise Rejected("migrated sequence carries no tokens")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pages = list(logical)
+        if len(pages) > self.engine.manager.num_pages:
+            _metrics.counter("serve.rejected")
+            raise Rejected(
+                f"migration of {len(pages)} pages exceeds the "
+                f"{self.engine.manager.num_pages}-page pool"
+            )
+        req = Request(
+            id=next(self._ids),
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            deadline_ts=(
+                time.monotonic() + float(deadline_ms) / 1e3
+                if deadline_ms and float(deadline_ms) > 0
+                else None
+            ),
+        )
+        req.out_tokens.extend(toks)
+        req.ingest = {
+            "logical": [int(lp) for lp in pages],
+            "arrays": arrays,
+            "length": int(length),
+            "hashes": [],
+            "sample": sample,
+        }
+        with self._cond:
+            if self._draining:
+                _metrics.counter("serve.rejected")
+                raise Rejected("worker is draining (shutdown in progress)")
+            self._queue.append(req)
+            self._cond.notify_all()
+        _metrics.counter("serve.requests_total")
+        self._publish_gauges()
+        return req
+
     def requeue_fallback(self, req: Request, kept, length: int) -> None:
         """Transfer failed after the prefill (retries exhausted, or the
         decode worker answered with an error status): bring the request
@@ -338,7 +407,10 @@ class ContinuousBatcher:
         req.status = QUEUED
         with self._cond:
             self._handoffs -= 1
-            if self._draining and not self._running and self._thread is None:
+            if (
+                self._draining and not self._drain_active
+                and not self._running and self._thread is None
+            ):
                 # scheduler crashed or already stopped: nothing will
                 # ever serve the queue — fail loudly, don't park waiters
                 req.kept_pages = None
@@ -392,30 +464,95 @@ class ContinuousBatcher:
             self._thread.join(timeout=10)
             self._thread = None
 
-    def drain(self, timeout: float = 30.0) -> bool:
+    def drain(
+        self,
+        timeout: float = 30.0,
+        migrate_after: Optional[float] = None,
+        on_deadline=None,
+    ) -> bool:
         """Stop admitting NEW submissions; run everything already
         accepted (queued + in-flight) to completion. Returns True when
         the plane is empty. Works both loop-driven and manually-stepped
-        (tests): without a running loop the drain steps inline."""
+        (tests): without a running loop the drain steps inline.
+
+        With ``migrate_after`` (seconds) AND an ``on_deadline``
+        callback, sequences still in flight past that point are
+        exported (:meth:`export_inflight`) and handed to the callback —
+        the frontend's live-migration hook. The exported records count
+        as handoffs, so the drain keeps waiting until each one's result
+        lands (remote completion) or its fallback requeue is served
+        inline."""
         with self._cond:
             self._draining = True
             self._cond.notify_all()
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if (
+        start = time.monotonic()
+        deadline = start + timeout
+        migrate_at = (
+            start + max(float(migrate_after), 0.0)
+            if migrate_after is not None and on_deadline is not None
+            else None
+        )
+        self._drain_active = True
+        try:
+            while time.monotonic() < deadline:
+                if (
+                    not self._queue and not self._slot_req
+                    and not self._handoffs
+                ):
+                    return True
+                if (
+                    migrate_at is not None
+                    and time.monotonic() >= migrate_at
+                ):
+                    migrate_at = None
+                    if self._slot_req:
+                        records = self.export_inflight()
+                        _log.info(
+                            "drain deadline: migrating %d in-flight "
+                            "sequence(s)", len(records),
+                        )
+                        on_deadline(records)
+                    continue
+                if self._running:
+                    time.sleep(0.005)
+                elif not self.step():
+                    # idle but handoffs still in flight: they finish
+                    # (or fall back into the queue) on their own threads
+                    time.sleep(0.005)
+            return (
                 not self._queue and not self._slot_req
                 and not self._handoffs
-            ):
-                return True
-            if self._running:
-                time.sleep(0.005)
-            elif not self.step():
-                # idle but handoffs still in flight: they finish (or
-                # fall back into the queue) on their own threads
-                time.sleep(0.005)
-        return (
-            not self._queue and not self._slot_req and not self._handoffs
-        )
+            )
+        finally:
+            self._drain_active = False
+
+    def export_inflight(self) -> List[dict]:
+        """Detach every in-flight sequence for live migration (the
+        drain-deadline path). Stops the scheduler loop first — the
+        drain thread becomes the single consumer — then, per slot:
+        snapshot the armed sampling state BEFORE the detach (the raw
+        mid-stream PRNG key; clearing after detach keeps the next
+        occupant clean), detach the pages with refcounts transferred,
+        and count the record as an in-flight handoff so drain() waits
+        for its remote result or fallback exactly like a streamed
+        prefill."""
+        self.stop()
+        records: List[dict] = []
+        for slot in sorted(self._slot_req):
+            req = self._slot_req.pop(slot)
+            sample = self.engine.export_sampling(slot)
+            kept, length = self.engine.manager.detach_keep(slot)
+            self.engine.clear_sampling(slot)
+            records.append({
+                "req": req,
+                "kept": kept,
+                "length": length,
+                "sample": sample,
+            })
+        with self._cond:
+            self._handoffs += len(records)
+        self._publish_gauges(min_interval=0.0)
+        return records
 
     def _run(self) -> None:
         while True:
@@ -470,6 +607,11 @@ class ContinuousBatcher:
     def step(self) -> bool:
         """One scheduler round: expire → admit → decode → retire.
         Returns False when there was nothing to do (idle)."""
+        # chaos site `serve.worker_kill`: a transport-kind fault raises
+        # here — the loop's crash handler aborts every accepted request
+        # (the Router's replay path fires); the `kill` kind SIGKILLs
+        # the process for the subprocess drills
+        _chaos.inject("serve.worker_kill")
         now = time.monotonic()
         self._expire_queued(now)
         admitted = self._admit(now)
@@ -533,6 +675,7 @@ class ContinuousBatcher:
         )
         paged = self.engine.paged
         while admitted < limit:
+            sample_armed = False
             with self._cond:
                 if not self._queue:
                     break
@@ -580,6 +723,13 @@ class ContinuousBatcher:
                     with self._cond:
                         self._queue.appendleft(req)
                     break
+                if ing.get("sample"):
+                    # migrated resume: import the sender's armed
+                    # sampling snapshot (raw mid-stream key) verbatim —
+                    # the common arming block below would re-seed and
+                    # fork the sampled sequence
+                    self.engine.import_sampling(slot, ing["sample"])
+                    sample_armed = True
                 req.ingest = None
                 req.status = RUNNING
                 _metrics.counter("serve.transfer_admits")
@@ -646,8 +796,12 @@ class ContinuousBatcher:
                 _metrics.counter("serve.admitted_mid_decode")
             admitted += 1
             # arm the slot's sampling knobs for every admission path
-            # (fresh, resume, ingest): data writes, never a retrace
-            if req.temperature > 0 or req.top_k > 0:
+            # (fresh, resume, ingest): data writes, never a retrace —
+            # except a migrated resume, whose imported key already IS
+            # the armed state
+            if sample_armed:
+                pass
+            elif req.temperature > 0 or req.top_k > 0:
                 self.engine.set_sampling(
                     slot, req.temperature, req.top_k,
                     seed=req.id if req.seed is None else req.seed,

@@ -995,6 +995,31 @@ class InferenceEngine:
         self._sample_temps[slot] = 0.0
         self._sample_topks[slot] = 0
 
+    def export_sampling(self, slot: int) -> dict:
+        """Snapshot a slot's armed sampling state for live migration:
+        knobs plus the RAW mid-stream PRNG key (NOT the seed — the key
+        has been split once per decode step, so re-seeding on the
+        receiver would fork the sampled sequence; importing the key
+        data continues it bit-identically)."""
+        key = np.asarray(self._sample_keys[int(slot)], np.uint32)
+        return {
+            "temperature": float(self._sample_temps[int(slot)]),
+            "top_k": int(self._sample_topks[int(slot)]),
+            "key": [int(x) for x in key.reshape(-1)],
+        }
+
+    def import_sampling(self, slot: int, state: dict) -> None:
+        """Arm a slot from an :meth:`export_sampling` snapshot — data
+        ops only (host arrays + an eager ``.at[].set`` on the key
+        carry), so a migrated resume never retraces."""
+        self._sample_temps[int(slot)] = float(state.get("temperature", 0.0))
+        self._sample_topks[int(slot)] = int(state.get("top_k", 0))
+        key = state.get("key")
+        if key is not None:
+            self._sample_keys = self._sample_keys.at[int(slot)].set(
+                np.asarray(key, np.uint32)
+            )
+
     # ----------------------------------------------- KV transfer primitives
 
     def gather_pages(self, kept):

@@ -44,14 +44,36 @@ and stay routable.
 ``preemption.register_drain``, so a SIGTERM under ``GracefulShutdown``
 (or the handler ``serve()`` installs itself) finishes every accepted
 request, lets the in-flight HTTP responses flush, and only then lets
-the worker leave the gang.
+the worker leave the gang. With ``HOROVOD_SERVE_DRAIN_DEADLINE_S``
+set, sequences still in flight past the deadline are LIVE-MIGRATED to
+a reserved peer over the kv_transfer wire instead of run to completion
+— the preemption grace window is honored without dropping a request.
+
+**Crash-safe routing** (docs/robustness.md "serving failure model"):
+the Router keeps each request's full submission (it IS the journal —
+prompt, sampling knobs, client request_id) and, when a worker dies
+mid-call, transparently REPLAYS it on a live worker
+(``serve.replays``), tombstoning the dead worker's announcement for
+one freshness period so the stale blob can't re-attract the next
+request. Workers dedupe by client ``request_id`` in a bounded TTL
+cache (``serve.replay_dedupe_hits``), so a router-side timeout retry
+returns the cached result instead of recomputing. The driver's
+dead-host set (scope ``serve`` key ``dead_hosts``,
+runner/rendezvous.py) evicts announcements immediately — routing never
+waits out the freshness window on a host the control plane already
+declared dead. ``HOROVOD_SERVE_HEDGE_MS`` arms tail-latency hedging:
+a backup request fires after the delay, first writer wins
+(``serve.hedges``).
 """
 
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
+import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -71,6 +93,9 @@ DEFAULT_ANNOUNCE_INTERVAL_S = 1.0
 # announcements older than this are a dead/partitioned worker as far
 # as routing is concerned
 DEFAULT_ANNOUNCE_TTL_S = 10.0
+# completed-result dedupe cache bound (entries): TTL prunes first, this
+# caps worst-case memory under a flood of unique request_ids
+DEDUPE_MAX_ENTRIES = 1024
 
 
 def put_announcement(client, rank: int, payload: dict) -> None:
@@ -121,6 +146,17 @@ class ServeFrontend:
         self._draining = False
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # completed-result dedupe cache: client request_id → (result,
+        # expiry). A Router replay or client retry of work this worker
+        # already finished returns the cached result — the idempotency
+        # half of crash-safe serving (a retry after a router-side
+        # timeout must not recompute, and MUST answer even mid-drain).
+        self._dedupe: "OrderedDict[str, tuple]" = OrderedDict()
+        self._dedupe_lock = threading.Lock()
+        # live-migration coordinator, built lazily on the first
+        # deadline-bounded drain (unified workers have no transfer
+        # coordinator wired otherwise)
+        self._migrator = None
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -176,10 +212,6 @@ class ServeFrontend:
                     return self._reply(
                         404, b"not found\n", "text/plain; charset=utf-8"
                     )
-                if outer.draining:
-                    return self._json(
-                        503, {"error": "draining", "retry": True}
-                    )
                 try:
                     payload = json.loads(body or b"{}")
                     if not isinstance(payload, dict):
@@ -190,6 +222,20 @@ class ServeFrontend:
                     tokens = payload["tokens"]
                 except (json.JSONDecodeError, KeyError, ValueError) as e:
                     return self._json(400, {"error": f"bad request: {e}"})
+                request_id = str(payload.get("request_id") or "")
+                if request_id:
+                    # the dedupe check runs BEFORE the draining gate: a
+                    # retry for work this worker already completed must
+                    # get its cached answer even mid-drain — that's the
+                    # whole point of keying results by request_id
+                    hit = outer._dedupe_get(request_id)
+                    if hit is not None:
+                        _metrics.counter("serve.replay_dedupe_hits")
+                        return self._json(200, hit)
+                if outer.draining:
+                    return self._json(
+                        503, {"error": "draining", "retry": True}
+                    )
                 with outer._inflight_lock:
                     outer._inflight += 1
                 try:
@@ -224,7 +270,10 @@ class ServeFrontend:
                     # 500 so the router fails over instead of the
                     # client treating it as a completion
                     code = 500 if req.status == "error" else 200
-                    return self._json(code, req.result())
+                    result = req.result()
+                    if request_id and code == 200:
+                        outer._dedupe_put(request_id, result)
+                    return self._json(code, result)
                 finally:
                     with outer._inflight_lock:
                         outer._inflight -= 1
@@ -273,6 +322,9 @@ class ServeFrontend:
             "slots_total": mgr["slots_total"],
             "queue_depth": self.batcher.queue_depth(),
             "draining": draining,
+            # the driver's dead-host set names HOSTS (its blacklist
+            # unit); announcing ours lets the Router match either way
+            "host": socket.gethostname(),
             "ts": time.time(),
         }
         if self.transfer_server is not None:
@@ -357,13 +409,34 @@ class ServeFrontend:
         except (OSError, RuntimeError) as e:
             _log.debug("serve announce failed: %s", e)
 
-    def drain(self, timeout: float = 30.0) -> bool:
+    def drain(
+        self, timeout: float = 30.0,
+        migrate_after: Optional[float] = None,
+    ) -> bool:
         """SIGTERM half of the lifecycle: refuse new work, finish the
         accepted work, let the in-flight responses flush. Announces the
-        drained state so the router stops sending traffic."""
+        drained state so the router stops sending traffic.
+
+        ``migrate_after`` (default: ``HOROVOD_SERVE_DRAIN_DEADLINE_S``;
+        0 = off) bounds how long in-flight sequences may keep decoding
+        locally: past it, they are live-migrated to a reserved peer
+        over the kv_transfer wire and finish there — the preemption
+        grace window is honored without dropping a request."""
         self._draining = True
         self.announce()
-        ok = self.batcher.drain(timeout=timeout)
+        if migrate_after is None:
+            from ..common import basics
+
+            deadline_s = basics.live_config().serve_drain_deadline_s
+            migrate_after = deadline_s if deadline_s > 0 else None
+        if migrate_after is not None and self.batcher.engine.paged:
+            ok = self.batcher.drain(
+                timeout=timeout,
+                migrate_after=float(migrate_after),
+                on_deadline=self._migrate_inflight,
+            )
+        else:
+            ok = self.batcher.drain(timeout=timeout)
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
             with self._inflight_lock:
@@ -372,6 +445,83 @@ class ServeFrontend:
             time.sleep(0.01)
         self.announce()
         return ok
+
+    def _resolve_migrator(self):
+        """The TransferCoordinator the deadline drain streams through:
+        a prefill worker reuses the batcher's wired coordinator; other
+        roles build one lazily against the same announcement channel."""
+        if self.batcher.transfer is not None:
+            return self.batcher.transfer
+        if self._migrator is None:
+            from .kv_transfer import TransferCoordinator
+
+            self._migrator = TransferCoordinator(
+                self.batcher.engine,
+                client_factory=self._resolve_announce_client,
+            )
+        return self._migrator
+
+    def _migrate_inflight(self, records) -> None:
+        """batcher.drain's on_deadline hook: stream every exported
+        in-flight record to a reserved peer; a record that can't go
+        anywhere falls back to the local queue (the drain keeps
+        stepping it inline). Never raises — a migration failure must
+        degrade to the classic run-to-completion drain, not kill the
+        drain thread."""
+        try:
+            coord = self._resolve_migrator()
+        except Exception as e:  # noqa: BLE001 — degrade, don't die
+            _log.warning(
+                "no migration coordinator (%s); draining %d sequence(s) "
+                "locally", e, len(records),
+            )
+            coord = None
+        for rec in records:
+            if coord is None:
+                self.batcher.requeue_fallback(
+                    rec["req"], rec["kept"], rec["length"]
+                )
+                continue
+            try:
+                coord.migrate(self.batcher, rec)
+            except Exception as e:  # noqa: BLE001 — per-record fallback
+                _log.warning(
+                    "migration of request %d failed at export (%s); "
+                    "falling back to local decode", rec["req"].id, e,
+                )
+                self.batcher.requeue_fallback(
+                    rec["req"], rec["kept"], rec["length"]
+                )
+
+    # ----------------------------------------------------------- dedupe cache
+
+    def _dedupe_get(self, request_id: str) -> Optional[dict]:
+        with self._dedupe_lock:
+            hit = self._dedupe.get(request_id)
+            if hit is None:
+                return None
+            result, expiry = hit
+            if time.monotonic() >= expiry:
+                del self._dedupe[request_id]
+                return None
+            return result
+
+    def _dedupe_put(self, request_id: str, result: dict) -> None:
+        from ..common import basics
+
+        ttl = float(basics.live_config().serve_dedupe_ttl_s)
+        if ttl <= 0:
+            return
+        now = time.monotonic()
+        with self._dedupe_lock:
+            for k in [
+                k for k, (_, exp) in self._dedupe.items() if exp <= now
+            ]:
+                del self._dedupe[k]
+            self._dedupe[request_id] = (result, now + ttl)
+            self._dedupe.move_to_end(request_id)
+            while len(self._dedupe) > DEDUPE_MAX_ENTRIES:
+                self._dedupe.popitem(last=False)
 
     def stop(self) -> None:
         self._announce_stop.set()
@@ -414,6 +564,12 @@ class Router:
         # clock domain, so cross-host wall-clock skew can't silently
         # drop a live worker (or keep a dead one) from routing
         self._seen_ts: Dict[int, tuple] = {}
+        # rank -> (announced ts at failure, monotonic expiry): a worker
+        # that failed a live call is tombstoned for one freshness
+        # period — its pre-crash announcement must not re-attract the
+        # NEXT request; a ts ADVANCE (the worker actually announcing
+        # again) clears it early
+        self._tombstones: Dict[int, tuple] = {}
         self._lock = threading.Lock()
 
     def snapshot(self) -> Dict[int, dict]:
@@ -426,11 +582,29 @@ class Router:
         its next announce instead of being silently unroutable."""
         now = time.monotonic()
         out = {}
+        dead_hosts, dead_ranks = self._dead_set()
         with self._lock:
             for rank, ann in read_announcements(self._store).items():
                 if ann.get("draining"):
                     continue
+                if (
+                    rank in dead_ranks
+                    or str(ann.get("host") or "") in dead_hosts
+                    or str(ann.get("addr") or "") in dead_hosts
+                ):
+                    # the driver already declared this host dead: evict
+                    # NOW instead of waiting out the freshness window
+                    continue
                 ts = float(ann.get("ts", 0))
+                tomb = self._tombstones.get(rank)
+                if tomb is not None:
+                    if ts == tomb[0] and now < tomb[1]:
+                        # the same blob the worker announced before it
+                        # failed a live call: a pre-crash leftover
+                        continue
+                    # ts advanced (the worker is actually alive) or
+                    # the tombstone aged out: forgive
+                    del self._tombstones[rank]
                 prev = self._seen_ts.get(rank)
                 if prev is None:
                     # wall tiebreak, once: mark wall-stale first sights
@@ -446,6 +620,32 @@ class Router:
                 elif now - prev[1] <= self._ttl:
                     out[rank] = ann
         return out
+
+    def _dead_set(self):
+        """The driver's published dead/quarantined set (scope ``serve``
+        key ``dead_hosts``): hostnames + the serve ranks mapped onto
+        them at publication. Empty on any read failure — the dead set
+        accelerates eviction, it never blocks routing."""
+        from ..runner.rendezvous import read_dead_hosts
+
+        try:
+            dead = read_dead_hosts(self._store)
+        except (OSError, RuntimeError, ValueError):
+            return set(), set()
+        return (
+            {str(h) for h in dead.get("hosts", ())},
+            {int(r) for r in dead.get("ranks", ())},
+        )
+
+    def tombstone(self, rank: int, ann: Optional[dict] = None) -> None:
+        """Mark a worker that failed a LIVE call: its current
+        announcement stays unroutable for one freshness period (or
+        until the worker announces a newer ts — proof of life)."""
+        with self._lock:
+            self._tombstones[int(rank)] = (
+                float((ann or {}).get("ts", 0.0)),
+                time.monotonic() + self._ttl,
+            )
 
     def straggler_ranks(self) -> List[int]:
         """The PR 4 ledger, read fleet-side: feed every heartbeat's
@@ -532,6 +732,40 @@ class Router:
             if self._debits.get(rank, 0) > 0:
                 self._debits[rank] -= 1
 
+    def _post_generate(self, ann: dict, body: bytes,
+                       timeout: float) -> dict:
+        """One /generate POST against one worker — the routing unit
+        every path (sequential, replay, hedge arm) shares."""
+        import urllib.request
+
+        url = (
+            f"http://{ann.get('addr', '127.0.0.1')}:{ann['port']}"
+            f"/generate"
+        )
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    def _note_failure(self, ann: dict, err: Exception) -> None:
+        """Classify a failed live call. A 503 is an ORDERLY refusal
+        (draining/rejected before admission) — plain failover, the
+        worker's own announcement will say so. Everything else (5xx,
+        transport fault, torn response) means the worker went dark with
+        the request possibly in flight: the retry on the next candidate
+        is a REPLAY (``serve.replays``) and the dark worker's stale
+        announcement is tombstoned so it can't re-attract traffic."""
+        import urllib.error
+
+        _metrics.counter("serve.route_failover")
+        if isinstance(err, urllib.error.HTTPError) and err.code == 503:
+            return
+        _metrics.counter("serve.replays")
+        self.tombstone(ann["rank"], ann)
+
     def route(
         self,
         tokens,
@@ -542,14 +776,21 @@ class Router:
         temperature: float = 0.0,
         top_k: int = 0,
         seed: Optional[int] = None,
+        request_id: Optional[str] = None,
+        hedge_ms: Optional[float] = None,
     ) -> dict:
         """POST /generate on the picked worker; a dead or draining pick
-        fails over to the next candidate. Sampling knobs ride the
+        fails over to the next candidate — the full submission below IS
+        the durability journal, so a worker that dies mid-call gets the
+        request transparently REPLAYED on a live one, idempotent by
+        ``request_id`` (generated here when the client brings none; the
+        workers' dedupe cache keys on it). Sampling knobs ride the
         payload verbatim (temperature 0 = greedy; a caller-pinned seed
-        keeps a retried/failed-over request reproducible on whichever
-        worker serves it)."""
+        keeps a replayed request reproducible on whichever worker
+        serves it). ``hedge_ms`` (default ``HOROVOD_SERVE_HEDGE_MS``,
+        0 = off) fires a backup request on a second worker after the
+        delay — first writer wins, the loser is discarded."""
         import urllib.error
-        import urllib.request
 
         payload: dict = {"tokens": list(map(int, tokens))}
         if max_tokens is not None:
@@ -562,9 +803,22 @@ class Router:
             payload["top_k"] = int(top_k)
         if seed is not None:
             payload["seed"] = int(seed)
+        payload["request_id"] = str(request_id or uuid.uuid4().hex)
         body = json.dumps(payload).encode()
         last_err: Optional[Exception] = None
         failed: set = set()
+        if hedge_ms is None:
+            from ..common import basics
+
+            hedge_ms = basics.live_config().serve_hedge_ms
+        if hedge_ms and float(hedge_ms) > 0:
+            out, failed, last_err = self._route_hedged(
+                body, timeout, float(hedge_ms) / 1e3
+            )
+            if out is not None:
+                return out
+            # both arms dark: fall through to the sequential replay
+            # loop with the failed ranks already excluded
         for _ in range(max(int(attempts), 1)):
             ann = self.pick(exclude=failed)
             if ann is None:
@@ -574,25 +828,15 @@ class Router:
                         f"({sorted(failed)}): {last_err}"
                     )
                 raise RuntimeError("no live serve workers announced")
-            url = (
-                f"http://{ann.get('addr', '127.0.0.1')}:{ann['port']}"
-                f"/generate"
-            )
-            req = urllib.request.Request(
-                url, data=body,
-                headers={"Content-Type": "application/json"},
-                method="POST",
-            )
             try:
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    return json.loads(resp.read().decode())
+                return self._post_generate(ann, body, timeout)
             except urllib.error.HTTPError as e:
                 if e.code == 503 or e.code >= 500:
                     # draining / server fault: the WORKER's problem,
                     # fail over to the next candidate
                     last_err = e
                     failed.add(ann["rank"])
-                    _metrics.counter("serve.route_failover")
+                    self._note_failure(ann, e)
                     continue
                 # 4xx: the REQUEST's problem — every worker would say
                 # the same thing; surface the actionable error instead
@@ -608,13 +852,71 @@ class Router:
             except (OSError, ValueError) as e:
                 last_err = e
                 failed.add(ann["rank"])
-                _metrics.counter("serve.route_failover")
+                self._note_failure(ann, e)
                 continue
             finally:
                 self.credit(ann["rank"])
         raise RuntimeError(
             f"routing failed after {attempts} attempts: {last_err}"
         )
+
+    def _route_hedged(self, body: bytes, timeout: float, hedge_s: float):
+        """Primary fires immediately; if no result lands within
+        ``hedge_s`` a backup fires on a second worker
+        (``serve.hedges``). First writer wins — the losing arm's
+        response is discarded when it eventually lands. Returns
+        ``(result_or_None, failed_ranks, last_err)``; the caller's
+        sequential loop finishes the job when every arm went dark."""
+        primary = self.pick()
+        if primary is None:
+            return None, set(), None
+        cv = threading.Condition()
+        box: dict = {"errors": []}
+
+        def arm(ann):
+            try:
+                out = self._post_generate(ann, body, timeout)
+            except Exception as e:  # noqa: BLE001 — arm failure is data
+                with cv:
+                    box["errors"].append((ann, e))
+                    cv.notify_all()
+            else:
+                with cv:
+                    box.setdefault("result", out)
+                    cv.notify_all()
+            finally:
+                self.credit(ann["rank"])
+
+        threading.Thread(
+            target=arm, args=(primary,),
+            name="hvd-route-primary", daemon=True,
+        ).start()
+        arms = 1
+        deadline = time.monotonic() + timeout
+        with cv:
+            cv.wait(timeout=hedge_s)
+            if "result" not in box and not box["errors"]:
+                backup = self.pick(exclude={primary["rank"]})
+                if backup is not None:
+                    _metrics.counter("serve.hedges")
+                    arms = 2
+                    threading.Thread(
+                        target=arm, args=(backup,),
+                        name="hvd-route-hedge", daemon=True,
+                    ).start()
+            while "result" not in box and len(box["errors"]) < arms:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not cv.wait(timeout=remaining):
+                    break
+            errors = list(box["errors"])
+            result = box.get("result")
+        failed: set = set()
+        last_err: Optional[Exception] = None
+        for ann, err in errors:
+            failed.add(ann["rank"])
+            last_err = err
+            self._note_failure(ann, err)
+        return result, failed, last_err
 
 
 class ServeHandle:
@@ -755,7 +1057,11 @@ def serve(
         role=role,
     )
     transfer_server = None
-    if role == "decode":
+    if role == "decode" or (role == "unified" and engine.paged):
+        # decode workers take prefill handoffs; paged unified workers
+        # run the server too so a draining peer can live-migrate its
+        # in-flight sequences here (the `migrate` frame) — a
+        # single-role fleet is still evacuable
         from .kv_transfer import KVTransferServer
 
         transfer_server = KVTransferServer(

@@ -43,6 +43,7 @@ outage degrades to PR 11 behavior, it never errors the request.
 
 from __future__ import annotations
 
+import functools
 import json
 import struct
 import threading
@@ -60,6 +61,7 @@ from ..testing import chaos as _chaos
 _log = get_logger("serve.kv_transfer")
 
 CHAOS_SITE = "serve.kv_transfer"
+MIGRATE_CHAOS_SITE = "serve.migrate"
 WIRE_FORMATS = ("fp32", "bf16", "int8")
 # int8 block granularity cap: clamped DOWN to the per-page element
 # count so a scale never spans two pages (the per-page quantize
@@ -266,6 +268,8 @@ class KVTransferServer:
                     return self._json(*outer._handle_reserve(body))
                 if path == "/kv/ingest":
                     return self._json(*outer._handle_ingest(body))
+                if path == "/kv/migrate":
+                    return self._json(*outer._handle_migrate(body))
                 return self._json(404, {"error": "not found"})
 
             def do_GET(self):
@@ -386,6 +390,50 @@ class KVTransferServer:
         _metrics.counter("serve.kv_transfer_pages_in", len(meta["pages"]))
         return 200, {"rid": rid}
 
+    def _handle_migrate(self, body: bytes):
+        """The ``migrate`` frame beside ``ingest``: a live-migrated
+        in-flight sequence — pages AND its full generated-token history
+        AND armed sampling state — resuming mid-decode with no
+        re-prefill. Same idempotency ledger as ingest (a retried stream
+        after a mid-flight reset admits exactly once)."""
+        try:
+            meta, blob = unframe(body)
+        except (ValueError, json.JSONDecodeError) as e:
+            return 400, {"error": f"bad migrate frame: {e}"}
+        request_id = str(meta.get("request_id", ""))
+        with self._lock:
+            rid = self._by_request.get(request_id)
+            if rid is not None:
+                return 200, {"rid": rid, "duplicate": True}
+            if meta.get("reservation"):
+                self._reservations.pop(meta["reservation"], None)
+        if self.batcher.draining:
+            return 503, {"error": "draining"}
+        try:
+            arrays = unpack_pages(meta, blob)
+            req = self.batcher.submit_migrated(
+                prompt=meta.get("prompt", ()),
+                tokens=meta["tokens"],
+                max_new_tokens=int(meta["max_new_tokens"]),
+                deadline_ms=meta.get("deadline_ms"),
+                logical=meta["pages"],
+                arrays=arrays,
+                length=int(meta["length"]),
+                sample=meta.get("sample"),
+            )
+        except Exception as e:  # Rejected, malformed frames
+            _log.warning("kv migrate rejected: %s", e)
+            return 503, {"error": str(e)}
+        rid = uuid.uuid4().hex
+        with self._lock:
+            if request_id:
+                self._by_request[request_id] = rid
+            self._results[rid] = req
+        _metrics.counter("serve.kv_transfer_bytes_in", len(body))
+        _metrics.counter("serve.kv_transfer_pages_in", len(meta["pages"]))
+        _metrics.counter("serve.migrations_in")
+        return 200, {"rid": rid}
+
     def _handle_result(self, params: dict):
         rid = params.get("rid", "")
         with self._lock:
@@ -451,9 +499,12 @@ class TransferCoordinator:
             self._client = self._client_factory()
         return self._client
 
-    def decode_targets(self, exclude=()) -> List[dict]:
-        """Announced decode workers, least-loaded first (announced page
-        headroom minus local reservation debits)."""
+    def decode_targets(self, exclude=(), roles=("decode",)) -> List[dict]:
+        """Announced transfer-capable workers of the wanted ``roles``,
+        least-loaded first (announced page headroom minus local
+        reservation debits). Prefill handoffs want pure decode workers;
+        live migration also accepts paged unified workers (they run a
+        transfer server too) — a single-role fleet can still evacuate."""
         from .frontend import read_announcements
 
         client = self._resolve_client()
@@ -474,7 +525,7 @@ class TransferCoordinator:
         return [
             dict(ann, rank=rank)
             for rank, ann in sorted(anns.items(), key=load)
-            if worker_role(ann) == "decode"
+            if worker_role(ann) in roles
             and not ann.get("draining")
             and ann.get("transfer_port")
             and rank not in exclude
@@ -482,7 +533,7 @@ class TransferCoordinator:
 
     # ------------------------------------------------------------- reserve
 
-    def reserve(self, pages: int) -> Optional[dict]:
+    def reserve(self, pages: int, roles=("decode",)) -> Optional[dict]:
         """Reserve ``pages`` on the best decode worker, failing over
         across candidates in-call; None when NO decode capacity exists
         anywhere — the sender's cue to take the unified/local path."""
@@ -491,7 +542,7 @@ class TransferCoordinator:
 
         failed: set = set()
         for _ in range(4):
-            targets = self.decode_targets(exclude=failed)
+            targets = self.decode_targets(exclude=failed, roles=roles)
             if not targets:
                 return None
             ann = targets[0]
@@ -558,7 +609,8 @@ class TransferCoordinator:
             daemon=True,
         ).start()
 
-    def _post(self, url: str, body: bytes, timeout: float) -> dict:
+    def _post(self, url: str, body: bytes, timeout: float,
+              site: str = CHAOS_SITE) -> dict:
         """One chaos-instrumented HTTP attempt (the RetryPolicy's unit
         of work): 5xx and transport faults raise — retryable; 4xx is
         the frame's own fault and surfaces immediately."""
@@ -566,7 +618,7 @@ class TransferCoordinator:
         import urllib.request
 
         try:
-            _chaos.inject(CHAOS_SITE)
+            _chaos.inject(site)
         except _chaos.InjectedServerError:
             raise  # retryable=True already
         req = urllib.request.Request(
@@ -677,3 +729,91 @@ class TransferCoordinator:
         raise TimeoutError(
             f"decode result for rid {rid} never arrived: {last}"
         )
+
+    # ------------------------------------------------------------ migration
+
+    def migrate(self, batcher, rec: dict) -> bool:
+        """Live-migrate one exported in-flight sequence (a
+        ``batcher.export_inflight`` record: request + detached pages +
+        armed sampling snapshot) to a reserved peer. Scheduler/drain
+        thread entry — only the async device gather runs here; the
+        host materialization and HTTP leg ride a handoff thread. No
+        capacity anywhere → the request comes home for a local decode
+        (``requeue_fallback``) and False is returned."""
+        req, kept, length = rec["req"], rec["kept"], rec["length"]
+        reservation = self.reserve(
+            len(kept), roles=("decode", "unified")
+        )
+        if reservation is None:
+            batcher.requeue_fallback(req, kept, length)
+            return False
+        raw = self.engine.gather_pages(kept)
+        threading.Thread(
+            target=self._stream_migrate,
+            args=(batcher, rec, reservation, raw),
+            name=f"hvd-kv-migrate-{req.id}",
+            daemon=True,
+        ).start()
+        return True
+
+    def _stream_migrate(self, batcher, rec, reservation, raw):
+        req, kept, length = rec["req"], rec["kept"], rec["length"]
+        base = f"http://{reservation['addr']}:{reservation['port']}"
+        t0 = time.perf_counter()
+        try:
+            raw = self.engine.pages_to_host(raw, kept, length)
+            meta, blob = pack_raw_pages(
+                raw, [lp for lp, _ in kept], length,
+                page_tokens=self.engine.manager.page_tokens,
+                wire=self.wire, seed=req.id,
+            )
+            remaining_ms = None
+            if req.deadline_ts is not None:
+                remaining_ms = max(
+                    (req.deadline_ts - time.monotonic()) * 1e3, 1.0
+                )
+            meta.update(
+                request_id=f"{id(self)}-mig-{req.id}",
+                reservation=reservation["rid"],
+                prompt=[int(t) for t in req.prompt],
+                # the FULL generated history (vs ingest's first_token):
+                # the receiver seeds out_tokens with it and continues
+                # mid-decode — no token is ever re-decoded
+                tokens=[int(t) for t in req.out_tokens],
+                max_new_tokens=int(req.max_new_tokens),
+                deadline_ms=remaining_ms,
+                sample=rec.get("sample"),
+            )
+            body = frame(meta, blob)
+            out = self._retry.call(
+                functools.partial(self._post, site=MIGRATE_CHAOS_SITE),
+                base + "/kv/migrate", body,
+                self._retry.attempt_timeout_s, peer=base,
+            )
+            _metrics.counter("serve.kv_transfer_bytes", len(body))
+            _metrics.counter("serve.kv_transfer_pages", len(kept))
+            _metrics.counter("serve.migrations")
+            _metrics.counter(
+                "serve.migration_ms", (time.perf_counter() - t0) * 1e3
+            )
+            result = self._await_result(base, out["rid"], req)
+        except Exception as e:  # noqa: BLE001 — any wire failure falls back
+            _log.warning(
+                "live migration of request %d to rank %s failed (%s); "
+                "falling back to local decode", req.id,
+                reservation.get("rank"), e,
+            )
+            self._credit(reservation)
+            batcher.requeue_fallback(req, kept, length)
+            return
+        self._credit(reservation)
+        if result.get("status") not in ("done", "deadline"):
+            _log.warning(
+                "migration target returned status %r for request %d; "
+                "falling back to local decode",
+                result.get("status"), req.id,
+            )
+            batcher.requeue_fallback(req, kept, length)
+            return
+        self.engine.manager.release_kept(kept)
+        batcher.complete_handoff(req, result)

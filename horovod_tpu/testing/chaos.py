@@ -44,6 +44,18 @@ site                      where it fires
                           entry degrades to a COUNTED cold compile —
                           ``exe_cache.corrupt`` — never a failed init;
                           ``delay`` models slow disk)
+``serve.worker_kill``     serving scheduler, top of every batcher round
+                          (serving/batcher.py ``step``; transport kinds
+                          crash the scheduler — accepted requests abort
+                          and the Router's REPLAY path fires,
+                          ``serve.replays``; ``kill`` SIGKILLs the
+                          worker for the subprocess drills)
+``serve.migrate``         each HTTP attempt of a live-migration stream
+                          (serving/kv_transfer.py ``migrate`` frame;
+                          transport faults retry under the RetryPolicy,
+                          exhaustion brings the sequence home for a
+                          local decode — ``serve.transfer_fallbacks`` —
+                          never a dropped request)
 ========================  ====================================================
 
 Sites the library doesn't own (a bench/smoke script's training loop)

@@ -25,7 +25,16 @@ bitflip (``checkpoint.save@2:bitflip`` — ``restore_latest_good`` must
 fall back past the digest mismatch), with every counter asserted over
 the worker's live ``/metrics`` scrape.
 
-A **standby-swap drill** (PR 18) runs last: the same SIGKILL-a-worker
+A **serve-failover drill** (PR 19) runs last: a two-worker serving
+fleet takes a burst of identical temperature-0 requests through the
+Router; one worker is SIGKILLed mid-burst (in-flight requests REPLAYED
+on the survivor — zero client-visible errors, every response
+bit-identical) and a third worker is then SIGTERMed with a short
+``HOROVOD_SERVE_DRAIN_DEADLINE_S`` so its in-flight sequences
+live-migrate to the survivor (``hvd_serve_migrations_in`` on the
+survivor's live scrape) and still answer the original clients.
+
+A **standby-swap drill** (PR 18): the same SIGKILL-a-worker
 story, twice — once cold (no cache, no standby) and once with
 ``HOROVOD_WARM_STANDBY=1`` + a shared ``HOROVOD_EXE_CACHE``. In the
 warm pass the kill lands only after the driver's warmer announces
@@ -37,6 +46,7 @@ the live-scraped ``hvd_elastic_restart_ms`` beats the cold pass, whose
 restarted workers each paid the multi-second XLA recompile.
 """
 
+import itertools
 import json
 import os
 import sys
@@ -558,6 +568,239 @@ def standby_swap_drill() -> None:
     )
 
 
+SERVE_WORKER = """\
+import os, sys
+sys.path.insert(0, os.getcwd())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+workdir = os.environ["CHAOS_SMOKE_DIR"]
+rank = int(os.environ["HOROVOD_RANK"])
+
+import jax
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+
+cfg = TransformerConfig(
+    vocab_size=61, num_layers=1, d_model=16, num_heads=2, d_ff=32,
+    max_len=256, causal=True, dtype=jnp.float32,
+)
+model = Transformer(cfg)
+# every worker seeds the SAME params: a temperature-0 request must
+# answer bit-identically wherever a replay or migration lands it
+params = model.init(
+    jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), train=False
+)
+handle = hvd.serve(
+    model, params, port=0, slots=4, max_len=256, max_new_tokens=200,
+    addr="127.0.0.1", handle_sigterm=True, paged=True,
+)
+port_file = os.path.join(workdir, f"serve_port.r{rank}")
+with open(port_file + ".tmp", "w") as f:
+    f.write(str(handle.port))
+os.replace(port_file + ".tmp", port_file)
+handle.wait(timeout=600)  # SIGTERM drains (and migrates) via the hook
+sys.exit(0)
+"""
+
+
+def serve_failover_drill() -> None:
+    """PR 19: SIGKILL a serving worker mid-burst — the Router replays
+    its in-flight requests on the survivor with zero client-visible
+    errors and bit-identical temperature-0 output; then SIGTERM a
+    worker under a short drain deadline — its in-flight sequences
+    live-migrate to the survivor and still answer the original
+    clients."""
+    import signal
+    import subprocess
+
+    from horovod_tpu.common.metrics import registry
+    from horovod_tpu.runner.rendezvous import (
+        RendezvousClient,
+        RendezvousServer,
+    )
+    from horovod_tpu.runner.secret import make_secret_key
+    from horovod_tpu.serving.frontend import Router
+
+    os.environ["HOROVOD_RENDEZVOUS_BACKEND"] = "python"
+    key = make_secret_key()
+    server = RendezvousServer(secret_key=key)
+    rdv_port = server.start()
+    workdir = tempfile.mkdtemp(prefix="hvd-serve-failover-")
+    script = os.path.join(workdir, "serve_worker.py")
+    with open(script, "w") as f:
+        f.write(SERVE_WORKER)
+
+    def spawn(rank, extra_env=None):
+        env = dict(os.environ)
+        env.update({
+            "CHAOS_SMOKE_DIR": workdir,
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_RENDEZVOUS_BACKEND": "python",
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rdv_port),
+            "HOROVOD_SECRET_KEY": key.hex(),
+        })
+        env.update(extra_env or {})
+        return subprocess.Popen(
+            [sys.executable, script], env=env, cwd=os.getcwd()
+        )
+
+    def wait_port(procs, rank):
+        pf = os.path.join(workdir, f"serve_port.r{rank}")
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline and not os.path.exists(pf):
+            assert procs[rank].poll() is None, (
+                f"serve worker {rank} died rc={procs[rank].returncode}"
+            )
+            time.sleep(0.1)
+        assert os.path.exists(pf), f"worker {rank} never served"
+        with open(pf) as f:
+            return int(f.read().strip())
+
+    prompt = [7, 11, 13]
+    procs = {0: spawn(0), 1: spawn(1)}
+    try:
+        ports = {r: wait_port(procs, r) for r in (0, 1)}
+        client = RendezvousClient("127.0.0.1", rdv_port, secret_key=key)
+        router = Router(client)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(router.snapshot()) < 2:
+            time.sleep(0.2)
+        assert set(router.snapshot()) == {0, 1}, router.snapshot()
+
+        # ---- replay leg: SIGKILL worker 0 mid-burst
+        results, errors = {}, []
+
+        def one(i):
+            try:
+                results[i] = router.route(
+                    prompt, timeout=240.0, attempts=4,
+                    request_id=f"burst-{i}",
+                )
+            except Exception as e:  # noqa: BLE001 — a failure IS the signal
+                errors.append((i, e))
+
+        before = registry.snapshot().get("serve.replays", 0.0)
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.8)  # mid-burst: first requests still in flight
+        os.kill(procs[0].pid, signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, f"client-visible failures: {errors[:3]}"
+        assert len(results) == 12
+        assert all(r["status"] == "done" for r in results.values())
+        outs = {tuple(r["tokens"]) for r in results.values()}
+        assert len(outs) == 1, (
+            f"temp-0 outputs diverged across replay: {len(outs)} variants"
+        )
+        replays = registry.snapshot().get("serve.replays", 0.0) - before
+        assert replays >= 1, "the kill was absorbed without any replay"
+
+        # ---- migration leg: SIGTERM worker 2 under a short deadline.
+        # A 5ms per-step chaos delay slows decode to ~1s/sequence:
+        # without it, CPU decode outruns the 0.25s metrics publish
+        # interval and all sequences finish before the SIGTERM gate
+        # below can catch them in flight (nothing left to migrate)
+        procs[2] = spawn(
+            2, {
+                "HOROVOD_SERVE_DRAIN_DEADLINE_S": "0.05",
+                "HOROVOD_FAULT_PLAN": "serve.worker_kill:p=1:delay:ms=5",
+            }
+        )
+        port2 = wait_port(procs, 2)
+        mig_results, mig_errors = {}, []
+
+        def mig_one(i):
+            body = json.dumps(
+                {"tokens": prompt, "request_id": f"mig-{i}"}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port2}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    mig_results[i] = json.loads(resp.read().decode())
+            except Exception as e:  # noqa: BLE001 — a failure IS the signal
+                mig_errors.append((i, e))
+
+        mthreads = [
+            threading.Thread(target=mig_one, args=(i,)) for i in range(3)
+        ]
+        for t in mthreads:
+            t.start()
+        # SIGTERM only once decode is well under way (>= ~10 tokens per
+        # sequence): the drill is about IN-FLIGHT sequences, not queued
+        # ones, and the depth makes the history-prefix check meaningful
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port2}/metrics", timeout=10
+            ) as resp:
+                text = resp.read().decode()
+            if _prom_value_or(text, "hvd_serve_tokens_out", 0) >= 30:
+                break
+            time.sleep(0.1)
+        procs[2].send_signal(signal.SIGTERM)
+        for t in mthreads:
+            t.join(timeout=300)
+        assert not mig_errors, f"migration leg failures: {mig_errors[:3]}"
+        assert len(mig_results) == 3
+        assert all(r["status"] == "done" for r in mig_results.values())
+        # migration streams over the default int8 KV wire — lossy, so
+        # greedy argmax after the resume point is only approximately
+        # stable. The hard guarantees: every client gets its FULL
+        # answer, and the generated history carried over the wire is
+        # verbatim (>= 8 matching tokens: the >=10/sequence decoded
+        # pre-SIGTERM, minus admission stagger) — migrated sequences
+        # resume, they are never re-decoded or re-sampled
+        ref = list(outs)[0]
+        for i, r in sorted(mig_results.items()):
+            toks = r["tokens"]
+            assert len(toks) == len(ref), (i, len(toks), len(ref))
+            shared = sum(
+                1 for _ in itertools.takewhile(
+                    lambda ab: ab[0] == ab[1], zip(ref, toks)
+                )
+            )
+            assert shared >= 8, (
+                f"mig-{i} shares only {shared} leading tokens with the "
+                f"uninterrupted reference: carried history was lost"
+            )
+        # the survivor's LIVE scrape proves where the sequences landed.
+        # Engine counters reach /metrics on the batcher's publish
+        # interval, so poll rather than one-shot assert
+        migrations_in = 0.0
+        poll_deadline = time.monotonic() + 60
+        while time.monotonic() < poll_deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ports[1]}/metrics", timeout=10
+            ) as resp:
+                text = resp.read().decode()
+            migrations_in = _prom_value_or(text, "hvd_serve_migrations_in", 0)
+            if migrations_in >= 1:
+                break
+            time.sleep(0.25)
+        assert migrations_in >= 1, migrations_in
+        procs[2].wait(timeout=60)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+    print(
+        f"serve-failover OK: {int(replays)} replay(s) after SIGKILL with "
+        f"12/12 bit-identical answers, {int(migrations_in)} live "
+        f"migration(s) after SIGTERM with 3/3 answered"
+    )
+
+
 def main() -> int:
     integrity_drill()
     workdir = tempfile.mkdtemp(prefix="hvd-chaos-smoke-")
@@ -655,6 +898,7 @@ def main() -> int:
     )
 
     standby_swap_drill()
+    serve_failover_drill()
     return 0
 
 

@@ -659,6 +659,78 @@ def prog_serve_warm_start():
     ]
 
 
+def prog_serve_migrate_resume():
+    """PR 19: live-migrated sequences resume MID-DECODE on the
+    receiver through the ingest admission path — pages, the full
+    generated history, and the armed sampling state are data writes
+    into the donated decode carry, never shapes. Two sequences are
+    exported mid-decode at DIFFERENT lengths from a unified source
+    batcher and resumed on one decode-role receiver, rolling (the
+    second lands while the first is still decoding): the receiver
+    compiles ONE decode executable across both resumes and ZERO
+    prefill executables — a resume that re-prefilled would break the
+    budget, a re-trace would break the donation."""
+    from horovod_tpu.serving.batcher import ContinuousBatcher
+    from horovod_tpu.serving.kv_transfer import (
+        pack_raw_pages,
+        unpack_pages,
+    )
+
+    src = _serve_engine(paged=True, role="unified")
+    sbat = ContinuousBatcher(src, default_max_new_tokens=12)
+    rng = np.random.default_rng(19)
+    r1 = sbat.submit(rng.integers(1, 60, size=5).tolist(),
+                     max_new_tokens=12)
+    r2 = sbat.submit(rng.integers(1, 60, size=9).tolist(),
+                     max_new_tokens=10)
+    for _ in range(4):
+        sbat.step()
+    assert r1.status == "running" and r2.status == "running"
+    assert len(r1.out_tokens) != len(r2.out_tokens) or (
+        len(r1.out_tokens) > 1
+    )
+    records = sbat.export_inflight()
+    assert len(records) == 2, len(records)
+
+    deng = _serve_engine(paged=True, role="decode")
+    dbat = ContinuousBatcher(deng, role="decode",
+                             default_max_new_tokens=12)
+    pt = src.manager.page_tokens
+    resumed = []
+    for rec in records:
+        req, kept, length = rec["req"], rec["kept"], rec["length"]
+        raw = src.extract_pages(kept, length)
+        meta, blob = pack_raw_pages(
+            raw, [lp for lp, _ in kept], length,
+            page_tokens=pt, wire="fp32",
+        )
+        resumed.append(dbat.submit_migrated(
+            prompt=[int(t) for t in req.prompt],
+            tokens=list(req.out_tokens),
+            max_new_tokens=req.max_new_tokens,
+            logical=meta["pages"],
+            arrays=unpack_pages(meta, blob),
+            length=meta["length"],
+            sample=rec.get("sample"),
+        ))
+        src.manager.release_kept(kept)
+        dbat.step()  # rolling: resume #2 admits mid-decode of #1
+    guard = 0
+    while not all(r.finished() for r in resumed):
+        dbat.step()
+        guard += 1
+        assert guard < 1000, "migrated resumes stalled"
+    assert all(r.status == "done" for r in resumed)
+    g = analysis.parse_module(deng.lowered_decode())
+    n_cache = len(jax.tree_util.tree_leaves(deng.manager.cache))
+    return [
+        (rules.DonationCoverage(min_donated=n_cache), g),
+        (rules.CompileBudget(
+            decode_compiles=1, prefill_compiles=0, transfer_ingests=2),
+         deng.stats()),
+    ]
+
+
 ROSTER = {
     "fused_allreduce_fp32": prog_fused_allreduce_fp32,
     "fused_allreduce_int8": prog_fused_allreduce_int8,
@@ -677,6 +749,7 @@ ROSTER = {
     "serve_decode_role": prog_serve_decode_role,
     "serve_paged_attn": prog_serve_paged_attn,
     "serve_warm_start": prog_serve_warm_start,
+    "serve_migrate_resume": prog_serve_migrate_resume,
 }
 
 

@@ -930,3 +930,90 @@ class TestChaosDrill:
         np.testing.assert_allclose(np.asarray(fresh.params["w"]), 1.0)
         assert _delta("checkpoint.fallback", before) >= 1
         fresh.close()
+
+
+# ------------------------------------------------------ serving chaos sites
+
+
+class TestServeChaos:
+    def test_serve_sites_parse_and_fire(self):
+        plan = chaos.configure(
+            "seed=3;serve.worker_kill@1:reset;serve.migrate@1:timeout"
+        )
+        with pytest.raises(ConnectionResetError):
+            chaos.inject("serve.worker_kill")
+        with pytest.raises(TimeoutError):
+            chaos.inject("serve.migrate")
+        assert {(f["site"], f["kind"]) for f in plan.fired()} == {
+            ("serve.worker_kill", "reset"),
+            ("serve.migrate", "timeout"),
+        }
+
+    def test_worker_kill_transport_fault_crashes_scheduler_to_replay(self):
+        """A transport-kind fault at serve.worker_kill lands at the top
+        of the batcher's step: the scheduler dies, accepted requests
+        fail LOUDLY and new submissions are refused — the dark-worker
+        face the Router's replay path keys on. (The ``kill`` kind
+        SIGKILLs outright for subprocess drills; its mechanics are
+        covered by test_kill_kind_terminates_a_worker_process.)"""
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from horovod_tpu.serving.batcher import ContinuousBatcher, Rejected
+        from horovod_tpu.serving.engine import InferenceEngine
+
+        cfg = TransformerConfig(
+            vocab_size=31, num_layers=1, d_model=8, num_heads=2,
+            d_ff=16, max_len=32, causal=True, dtype=jnp.float32,
+        )
+        model = Transformer(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32),
+            train=False,
+        )
+        eng = InferenceEngine(
+            model, params, slots=2, max_len=32, min_bucket=4
+        )
+        bat = ContinuousBatcher(eng, default_max_new_tokens=4)
+        chaos.configure("seed=3;serve.worker_kill@1:reset")
+        before = registry.snapshot()
+        r = bat.submit([1, 2, 3])  # accepted BEFORE the fault lands
+        bat.start()
+        try:
+            assert r.wait(timeout=30), "waiter stranded after kill fault"
+            assert r.status == "error"
+            with pytest.raises(Rejected):
+                bat.submit([4, 5])
+        finally:
+            bat.stop()
+        assert _delta("chaos.serve.worker_kill.reset", before) == 1
+
+
+def test_driver_publishes_dead_hosts_to_serve_scope():
+    """handle_host_failure/_try_blacklist wiring: the blacklisted host
+    set (plus the ranks mapped onto it) lands in the serve scope so the
+    Router can evict its announcements without waiting out the TTL."""
+    import threading
+    import types
+
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.rendezvous import KVStore, read_dead_hosts
+
+    store = KVStore()
+    fake = types.SimpleNamespace(
+        _server=_StoreServer(store),
+        host_manager=types.SimpleNamespace(blacklisted=["a"]),
+        _lock=threading.Lock(),
+        _blocks=[
+            {"HOROVOD_RANK": str(r), "HOROVOD_HOSTNAME": h}
+            for r, h in enumerate(["a"] * 2 + ["b"] * 2)
+        ],
+    )
+    ElasticDriver._publish_dead_hosts(fake)
+    dead = read_dead_hosts(store)
+    assert dead["hosts"] == ["a"]
+    assert dead["ranks"] == [0, 1]

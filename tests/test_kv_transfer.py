@@ -82,10 +82,10 @@ def _batcher(engine, role="unified", **kw):
     return ContinuousBatcher(engine, role=role, **kw)
 
 
-def _unified_tokens(model, params, prompt, n, **engine_kw):
+def _unified_tokens(model, params, prompt, n, submit_kw=None, **engine_kw):
     """Reference: the same prompt decoded end-to-end on one worker."""
     bat = _batcher(_engine(model, params, **engine_kw))
-    req = bat.submit(prompt, max_new_tokens=n)
+    req = bat.submit(prompt, max_new_tokens=n, **(submit_kw or {}))
     while not req.finished():
         bat.step()
     assert req.status == "done"
@@ -686,3 +686,188 @@ def test_driver_per_role_capacity_gauges():
     assert snap.get("driver.serve.decode.workers") == 1.0
     assert snap.get("driver.serve.unified.workers") == 1.0
     assert snap.get("driver.serve.decode.free_pages") == 7.0
+
+
+# -------------------------------------------------------- live migration
+
+
+def _migration_receiver(model, params):
+    """Decode-role worker behind a real KVTransferServer, scheduler
+    running — where migrated sequences land."""
+    from horovod_tpu.serving.kv_transfer import KVTransferServer
+
+    deng = _engine(model, params, role="decode")
+    dbat = _batcher(deng, role="decode")
+    server = KVTransferServer(dbat, port=0, addr="127.0.0.1")
+    server.start()
+    dbat.start()
+    return dbat, server
+
+
+def _source_mid_decode(model, params, prompt, n, coord_client, wire="fp32",
+                       submit_kw=None, retry=None):
+    """Unified source worker stepped a few decode rounds in: returns
+    (batcher, coordinator, request) with the request mid-decode."""
+    from horovod_tpu.serving.kv_transfer import TransferCoordinator
+
+    seng = _engine(model, params)
+    sbat = _batcher(seng)
+    coord = TransferCoordinator(
+        seng, client=coord_client, wire=wire, retry=retry
+    )
+    req = sbat.submit(prompt, max_new_tokens=n, **(submit_kw or {}))
+    for _ in range(4):
+        sbat.step()
+    assert req.status == "running"
+    assert 2 <= len(req.out_tokens) < n
+    return sbat, coord, req
+
+
+def test_live_migration_mid_decode_bit_parity(toy):
+    """Tentpole: a sequence detached MID-decode resumes on a decode
+    peer bit-identically — the full generated history crosses the wire
+    (no token re-decoded, no re-prefill) and the receiver's single
+    decode executable absorbs the resume without a retrace."""
+    model, params = toy
+    prompt = list(range(1, 9))
+    ref = _unified_tokens(model, params, prompt, 10)
+    dbat, server = _migration_receiver(model, params)
+    before = _metrics.snapshot()
+    try:
+        sbat, coord, req = _source_mid_decode(
+            model, params, prompt, 10,
+            _FakeAnnounceClient({0: _decode_ann(0, server.port)}),
+        )
+        records = sbat.export_inflight()
+        assert len(records) == 1
+        assert coord.migrate(sbat, records[0])
+        assert req.wait(timeout=30), "migrated request never completed"
+        assert req.status == "done"
+        assert req.result()["tokens"] == ref
+        snap = _metrics.snapshot()
+        assert snap.get("serve.migrations", 0) == before.get(
+            "serve.migrations", 0) + 1
+        assert snap.get("serve.migrations_in", 0) == before.get(
+            "serve.migrations_in", 0) + 1
+        # the receiver resumed mid-decode: one decode exe, NO prefill
+        assert dbat.engine.stats()["decode_compiles"] == 1
+        assert dbat.engine.stats()["prefill_compiles"] == 0
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_live_migration_preserves_sampling_stream(toy):
+    """The armed sampling snapshot carries the RAW mid-stream PRNG key
+    (split once per decode step), not the seed: a migrated sampled
+    sequence must continue exactly where it left off — re-seeding on
+    the receiver would fork the stream and this assert would catch it."""
+    model, params = toy
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    kw = dict(temperature=0.7, top_k=7, seed=42)
+    ref = _unified_tokens(model, params, prompt, 10, submit_kw=kw)
+    dbat, server = _migration_receiver(model, params)
+    try:
+        sbat, coord, req = _source_mid_decode(
+            model, params, prompt, 10,
+            _FakeAnnounceClient({0: _decode_ann(0, server.port)}),
+            submit_kw=kw,
+        )
+        records = sbat.export_inflight()
+        assert coord.migrate(sbat, records[0])
+        assert req.wait(timeout=30)
+        assert req.status == "done"
+        assert req.result()["tokens"] == ref
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_frontend_drain_deadline_migrates_inflight(toy):
+    """The SIGTERM path end to end: past the drain deadline the
+    frontend exports every in-flight slot and streams it out; the
+    drain still returns True and the accepted request completes
+    remotely with the uninterrupted answer."""
+    from horovod_tpu.serving.frontend import ServeFrontend
+
+    model, params = toy
+    prompt = list(range(2, 10))
+    ref = _unified_tokens(model, params, prompt, 10)
+    dbat, server = _migration_receiver(model, params)
+    try:
+        sbat, coord, req = _source_mid_decode(
+            model, params, prompt, 10,
+            _FakeAnnounceClient({0: _decode_ann(0, server.port)}),
+        )
+        sbat.transfer = coord
+        fe = ServeFrontend(sbat, port=0, addr="127.0.0.1")
+        try:
+            assert fe.drain(timeout=30.0, migrate_after=0.0)
+        finally:
+            fe.stop()
+        assert req.finished() and req.status == "done"
+        assert req.result()["tokens"] == ref
+        assert _metrics.snapshot().get("serve.migrations", 0) >= 1
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_migration_retried_reset_admits_exactly_once(toy):
+    """Chaos at the serve.migrate site: the first stream attempt dies
+    mid-flight, the retry re-POSTs the SAME frame, and the receiver's
+    idempotency ledger admits it exactly once — still bit-parity."""
+    model, params = toy
+    prompt = list(range(1, 8))
+    ref = _unified_tokens(model, params, prompt, 9)
+    chaos.configure("seed=7;serve.migrate@1:reset")
+    retry = RetryPolicy(
+        "serve.kv_transfer", attempts=3, backoff_ms=1.0,
+        attempt_timeout_s=10.0,
+    )
+    dbat, server = _migration_receiver(model, params)
+    before = _metrics.snapshot()
+    try:
+        sbat, coord, req = _source_mid_decode(
+            model, params, prompt, 9,
+            _FakeAnnounceClient({0: _decode_ann(0, server.port)}),
+            retry=retry,
+        )
+        records = sbat.export_inflight()
+        assert coord.migrate(sbat, records[0])
+        assert req.wait(timeout=30)
+        assert req.status == "done"
+        assert req.result()["tokens"] == ref
+        snap = _metrics.snapshot()
+        assert snap.get("chaos.serve.migrate.reset", 0) >= 1
+        assert snap.get("serve.migrations_in", 0) == before.get(
+            "serve.migrations_in", 0) + 1
+    finally:
+        dbat.stop()
+        server.stop()
+
+
+def test_migration_no_capacity_falls_back_to_local_decode(toy):
+    """No peer has room: the exported record comes home — requeued
+    paused on its own pages and finished locally by the same drain,
+    zero client-visible failures."""
+    model, params = toy
+    prompt = list(range(4, 12))
+    ref = _unified_tokens(model, params, prompt, 8)
+    sbat, coord, req = _source_mid_decode(
+        model, params, prompt, 8, _FakeAnnounceClient({})
+    )
+    before = _metrics.snapshot().get("serve.transfer_fallbacks", 0)
+
+    def on_deadline(records):
+        for rec in records:
+            assert not coord.migrate(sbat, rec)
+
+    assert sbat.drain(timeout=30.0, migrate_after=0.0,
+                      on_deadline=on_deadline)
+    assert req.status == "done"
+    assert req.result()["tokens"] == ref
+    assert (
+        _metrics.snapshot().get("serve.transfer_fallbacks", 0)
+        == before + 1
+    )

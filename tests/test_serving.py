@@ -706,3 +706,138 @@ def test_router_routes_to_live_worker_with_failover(toy):
             router.route(list(range(1, 65)), attempts=3)
     finally:
         handle.stop()
+
+
+# ---------------------------------------------------- crash-safe routing
+
+
+def test_generate_dedupe_replays_cached_result(toy):
+    """Satellite (timeout ambiguity): a replayed /generate carrying the
+    same client request_id is answered from the completed-results cache
+    — the work is NOT redone, so a client retry after a lost response
+    can never double-generate."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common.metrics import registry
+
+    model, params = toy
+    handle = hvd.serve(
+        model, params, port=0, slots=2, max_len=64,
+        max_new_tokens=4, addr="127.0.0.1", handle_sigterm=False,
+    )
+    try:
+        before = registry.snapshot().get("serve.replay_dedupe_hits", 0.0)
+        payload = {"tokens": [5, 6, 7], "request_id": "client-abc"}
+        s1, out1 = _post(handle.port, payload)
+        s2, out2 = _post(handle.port, payload)
+        assert s1 == s2 == 200
+        assert out1 == out2  # byte-identical replay, not a re-decode
+        assert (
+            registry.snapshot().get("serve.replay_dedupe_hits", 0.0)
+            == before + 1
+        )
+        # a different id is fresh work, not a cache hit
+        s3, out3 = _post(
+            handle.port, {"tokens": [5, 6, 7], "request_id": "client-def"}
+        )
+        assert s3 == 200 and out3["tokens"] == out1["tokens"]
+        assert (
+            registry.snapshot().get("serve.replay_dedupe_hits", 0.0)
+            == before + 1
+        )
+    finally:
+        handle.stop()
+
+
+def test_router_replays_on_dark_worker_and_tombstones(toy):
+    """Tentpole: the routed payload IS the journal — a worker that
+    goes dark mid-call gets the request replayed on a live peer, and
+    its pre-crash announcement is tombstoned so the NEXT request does
+    not walk into the same hole. A ts advance (proof of life) forgives
+    the tombstone."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common.metrics import registry
+    from horovod_tpu.runner.rendezvous import KVStore
+    from horovod_tpu.serving.frontend import Router
+
+    model, params = toy
+    handle = hvd.serve(
+        model, params, port=0, slots=2, max_len=64,
+        max_new_tokens=3, addr="127.0.0.1", handle_sigterm=False,
+    )
+    try:
+        store = KVStore()
+        _announce(store, 0, 1, free_slots=9)  # dark: nothing listens
+        _announce(store, 1, handle.port, free_slots=2)
+        router = Router(store)
+        before = registry.snapshot().get("serve.replays", 0.0)
+        out = router.route([4, 5, 6], attempts=3, request_id="rep-1")
+        assert out["status"] == "done"
+        assert out["tokens"] == _greedy_ref(model, params, [4, 5, 6], 3)
+        assert (
+            registry.snapshot().get("serve.replays", 0.0) == before + 1
+        )
+        # the dark worker's unchanged announcement is unroutable now
+        assert set(router.snapshot()) == {1}
+        # ...until it actually announces again
+        _announce(store, 0, 1, free_slots=9)
+        assert set(router.snapshot()) == {0, 1}
+    finally:
+        handle.stop()
+
+
+def test_router_evicts_driver_declared_dead_hosts(toy):
+    """Tentpole (failure detection feeds routing): the driver's
+    published dead set evicts a worker's announcement immediately —
+    no waiting out the freshness TTL — matched by rank or by host."""
+    from horovod_tpu.runner.rendezvous import KVStore, put_dead_hosts
+    from horovod_tpu.serving.frontend import Router
+
+    store = KVStore()
+    _announce(store, 0, 9000, free_slots=8)
+    _announce(store, 1, 9001, free_slots=2)
+    router = Router(store)
+    assert set(router.snapshot()) == {0, 1}
+    put_dead_hosts(store, [], ranks=[0])
+    assert set(router.snapshot()) == {1}
+    assert router.pick()["rank"] == 1
+    # host/addr matching catches ranks the driver could not map
+    put_dead_hosts(store, ["127.0.0.1"])
+    assert router.snapshot() == {}
+    assert router.pick() is None
+
+
+def test_router_hedges_to_second_worker_when_primary_stalls(toy):
+    """HOROVOD_SERVE_HEDGE_MS semantics: the primary accepts the POST
+    but never answers (scheduler not running); after the hedge delay a
+    backup fires on the second worker and its result wins."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common.metrics import registry
+    from horovod_tpu.runner.rendezvous import KVStore
+    from horovod_tpu.serving.batcher import ContinuousBatcher
+    from horovod_tpu.serving.frontend import Router, ServeFrontend
+
+    model, params = toy
+    stalled = ContinuousBatcher(
+        _engine(toy, slots=2), default_max_new_tokens=3
+    )
+    sfe = ServeFrontend(stalled, port=0, addr="127.0.0.1")
+    sfe.start()
+    handle = hvd.serve(
+        model, params, port=0, slots=2, max_len=64,
+        max_new_tokens=3, addr="127.0.0.1", handle_sigterm=False,
+    )
+    try:
+        store = KVStore()
+        _announce(store, 0, sfe.port, free_slots=9)  # stall looks best
+        _announce(store, 1, handle.port, free_slots=2)
+        router = Router(store)
+        before = registry.snapshot().get("serve.hedges", 0.0)
+        out = router.route([4, 5, 6], hedge_ms=50.0, timeout=30.0)
+        assert out["status"] == "done"
+        assert out["tokens"] == _greedy_ref(model, params, [4, 5, 6], 3)
+        assert (
+            registry.snapshot().get("serve.hedges", 0.0) == before + 1
+        )
+    finally:
+        sfe.stop()
+        handle.stop()
