@@ -5,9 +5,43 @@ bench_lm.py): compile the jitted step ONCE ahead of time (the same
 compiled object runs the timed loop — no second trace/compile), read
 the step's FLOPs from XLA cost analysis, and divide measured FLOP/s by
 the chip's peak bf16 FLOP/s.
+
+It also owns the bench RUN ID: one id per bench process (or one per
+sweep when the driver exports ``BENCH_RUN_ID``), stamped onto every
+JSON artifact line AND into the flight-recorder step records
+(``telemetry.set_run_id``) — a bench number and the step telemetry
+that produced it join on ``run_id`` instead of on filename archaeology.
 """
 
 import os
+import uuid
+
+_RUN_ID = None
+
+
+def run_id() -> str:
+    """This bench process's run id. ``BENCH_RUN_ID`` wins (a sweep
+    driver threads one id through every bench it launches); otherwise
+    a fresh 16-hex id. First call also stamps it into the telemetry
+    hub so flight-recorder records carry the same id."""
+    global _RUN_ID
+    if _RUN_ID is None:
+        _RUN_ID = os.environ.get("BENCH_RUN_ID") or uuid.uuid4().hex[:16]
+        try:
+            from horovod_tpu.common import telemetry
+
+            telemetry.set_run_id(_RUN_ID)
+        except Exception:  # bench without the package on path
+            pass
+    return _RUN_ID
+
+
+def stamp(line: dict) -> dict:
+    """Add ``run_id`` to a bench JSON record (in place, returned for
+    chaining). Never overwrites — a parent re-emitting a child's
+    already-stamped line keeps the child's id."""
+    line.setdefault("run_id", run_id())
+    return line
 
 # Public peak bf16 TFLOP/s per chip, keyed by the sandbox's generation
 # env var. Override with BENCH_PEAK_TFLOPS.

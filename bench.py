@@ -47,6 +47,8 @@ import subprocess
 import sys
 import time
 
+from _benchlib import stamp as _stamp
+
 P100_FP32_IMG_PER_SEC = 219.0
 
 from _benchlib import aot_compile as _aot_compile  # noqa: E402
@@ -204,7 +206,7 @@ def inner_main():
     result.update(
         _mfu_fields(flops, n_iters, dt, platform, step_bytes=step_bytes)
     )
-    print(json.dumps(result))
+    print(json.dumps(_stamp(result)))
 
 
 def _spawn(env, timeout):

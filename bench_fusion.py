@@ -46,6 +46,8 @@ import json
 import os
 import time
 
+from _benchlib import stamp as _stamp
+
 _SIM_NOTE = (
     "logic-validation only (CPU simulation); NOT a TPU dispatch number"
 )
@@ -140,12 +142,12 @@ def main():
             line.update(extra)
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
         if leg:
             with open(
                 os.path.join(artifact_dir, f"fusion_{leg}.json"), "a"
             ) as f:
-                f.write(json.dumps(line) + "\n")
+                f.write(json.dumps(_stamp(line)) + "\n")
         return ms
 
     total = n_tensors * nbytes
@@ -318,7 +320,7 @@ def main():
     }
     if platform != "tpu":
         line["note"] = _SIM_NOTE
-    print(json.dumps(line), flush=True)
+    print(json.dumps(_stamp(line)), flush=True)
 
     if trials > 0:
         from horovod_tpu.common.autotune import BayesianOptimizer
@@ -347,7 +349,7 @@ def main():
         }
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
 
     # restore shipped defaults (harmless — process exits anyway)
     fusion.threshold_bytes = default_threshold

@@ -39,6 +39,8 @@ import json
 import os
 import time
 
+from _benchlib import stamp as _stamp
+
 _SIM_NOTE = (
     "logic-validation only (CPU simulation); step-time is NOT a TPU "
     "wall-clock number — byte accounting and HLO shape are exact"
@@ -176,11 +178,11 @@ def main():
             line.update(extra)
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
         with open(
             os.path.join(artifact_dir, f"hier_{leg}.json"), "a"
         ) as f:
-            f.write(json.dumps(line) + "\n")
+            f.write(json.dumps(_stamp(line)) + "\n")
 
     # the schedule's bucket sizes drive the byte model: build it once
     leaves = [

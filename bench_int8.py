@@ -38,6 +38,8 @@ quarantine note).
 import json
 import os
 import time
+
+from _benchlib import stamp as _stamp
 from functools import partial
 
 _SIM_NOTE = (
@@ -137,7 +139,7 @@ def main():
         }
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
 
     _ab_fused(world, platform, dryrun, iters)
 
@@ -226,11 +228,11 @@ def _ab_fused(world, platform, dryrun, iters):
         line.update(extra)
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
         with open(
             os.path.join(artifact_dir, "int8_ab_fused.json"), "a"
         ) as f:
-            f.write(json.dumps(line) + "\n")
+            f.write(json.dumps(_stamp(line)) + "\n")
         return ms
 
     ms_serial, extra = run(1)

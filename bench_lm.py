@@ -35,6 +35,8 @@ BENCH_AB_STEPS (default 2·K), BENCH_BATCH/BENCH_SEQ as above.
 import json
 import os
 import time
+
+from _benchlib import stamp as _stamp
 from functools import partial
 
 import numpy as np
@@ -278,11 +280,11 @@ def run_ab_local_sgd():
     r8["inter_ratio_vs_k1"] = round(ratio, 2)
     r1["inter_ratio_vs_k1"] = 1.0
     for leg, line in results.items():
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
         with open(
             os.path.join(artifact_dir, f"lm_ab_local_sgd_{leg}.json"), "a"
         ) as f:
-            f.write(json.dumps(line) + "\n")
+            f.write(json.dumps(_stamp(line)) + "\n")
     # pre-registered gates (docs/perf.md): the sync rounds moved the
     # expected ÷K of the every-step wire's DCN bytes, and the K-step
     # leg kept at least half of k1's loss improvement
@@ -540,7 +542,7 @@ def main():
                              step_bytes=step_bytes))
     if flops_note:
         result["flops_note"] = flops_note
-    print(json.dumps(result))
+    print(json.dumps(_stamp(result)))
 
 
 def _effective_block(seq, cfg):

@@ -47,6 +47,8 @@ import json
 import os
 import time
 
+from _benchlib import stamp as _stamp
+
 _SIM_NOTE = (
     "logic-validation only (CPU simulation); step-time is NOT a TPU "
     "wall-clock number — byte accounting and HLO shape are exact"
@@ -184,11 +186,11 @@ def main():
             line.update(extra)
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
         with open(
             os.path.join(artifact_dir, f"moe_{leg}.json"), "a"
         ) as f:
-            f.write(json.dumps(line) + "\n")
+            f.write(json.dumps(_stamp(line)) + "\n")
 
     capacity = int(max(1, round(1.25 * tokens / world)))
     xd = jnp.asarray(x)

@@ -34,6 +34,8 @@ Env: BENCH_LAYERS / BENCH_WIDTH / BENCH_BUCKETS / BENCH_ITERS.
 import json
 import os
 import time
+
+from _benchlib import stamp as _stamp
 from functools import partial
 
 _SIM_NOTE = (
@@ -128,11 +130,11 @@ def main():
             line.update(extra)
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
         with open(
             os.path.join(artifact_dir, f"overlap_{leg}.json"), "a"
         ) as f:
-            f.write(json.dumps(line) + "\n")
+            f.write(json.dumps(_stamp(line)) + "\n")
 
     def timed(step, carry):
         carry = step(carry)  # compile + warm

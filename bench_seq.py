@@ -27,6 +27,8 @@ import json
 import os
 import time
 
+from _benchlib import stamp as _stamp
+
 _SIM_NOTE = (
     "logic-validation only (CPU interpret mode); NOT a TPU kernel "
     "number"
@@ -120,7 +122,7 @@ def main():
         }
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
 
     for t in seqs:
         run(

@@ -71,6 +71,8 @@ import json
 import os
 import time
 
+from _benchlib import stamp as _stamp
+
 
 def _pct(vals, q):
     """Nearest-rank percentile over a sorted list (shared by every leg
@@ -205,8 +207,8 @@ def main():
         line = run_leg(policy)
         path = os.path.join(artifact_dir, f"serve_ab_{policy}.json")
         with open(path, "w") as f:
-            f.write(json.dumps(line) + "\n")
-        print(json.dumps(line))
+            f.write(json.dumps(_stamp(line)) + "\n")
+        print(json.dumps(_stamp(line)))
 
     # ---------------------------------------------------- memory-plane legs
 
@@ -1163,8 +1165,8 @@ def main():
         line = leg_fn()
         path = os.path.join(artifact_dir, f"serve_ab_{name}.json")
         with open(path, "w") as f:
-            f.write(json.dumps(line) + "\n")
-        print(json.dumps(line))
+            f.write(json.dumps(_stamp(line)) + "\n")
+        print(json.dumps(_stamp(line)))
     print(f"bench_serve artifacts in {artifact_dir}")
 
 

@@ -42,6 +42,8 @@ BENCH_ITERS / BENCH_DRYRUN / BENCH_ARTIFACT_DIR.
 import json
 import os
 import time
+
+from _benchlib import stamp as _stamp
 from functools import partial
 
 _SIM_NOTE = (
@@ -154,11 +156,11 @@ def main():
             line["memory_analysis"] = mem
         if platform != "tpu":
             line["note"] = _SIM_NOTE
-        print(json.dumps(line), flush=True)
+        print(json.dumps(_stamp(line)), flush=True)
         with open(
             os.path.join(artifact_dir, f"zero_{leg}.json"), "a"
         ) as f:
-            f.write(json.dumps(line) + "\n")
+            f.write(json.dumps(_stamp(line)) + "\n")
         return line
 
     def timed(step, carry):

@@ -23,11 +23,17 @@
 #                    /metrics scrape; then a role-split fleet (1
 #                    prefill + 2 decode workers) streams KV pages over
 #                    the transfer wire with per-role routing asserted
-#                    on live scrapes and one decode worker SIGTERMed
-#                    mid-burst (reservations fail over); finally
-#                    SIGTERM the unified workers and assert the drain
-#                    completed every accepted request (exit 143) — the
-#                    serving plane can't silently rot
+#                    on live scrapes — this is also the TRACE-SMOKE
+#                    gate: with HOROVOD_TRACE=1 a crafted traceparent
+#                    must round-trip as X-Trace-Id and one routed
+#                    request must assemble (trace_assemble over live
+#                    /traces scrapes) into a single skew-corrected
+#                    trace covering router->prefill->transfer->decode
+#                    in monotonic order — then one decode worker is
+#                    SIGTERMed mid-burst (reservations fail over);
+#                    finally SIGTERM the unified workers and assert
+#                    the drain completed every accepted request (exit
+#                    143) — the serving plane can't silently rot
 #   7. audit-smoke — scripts/hlo_audit.py: the lowered-program
 #                    invariant catalog over the canonical roster
 #                    (fused fp32/int8 wire, overlap buckets, ZeRO-2/3,
@@ -48,7 +54,11 @@
 #                    with exactly one gang restart and nonzero
 #                    retry.* counters scraped from the live /metrics
 #                    endpoint — neither the chaos hardening nor the
-#                    integrity plane can silently rot
+#                    integrity plane can silently rot; the serve
+#                    failover drill runs with tracing ON and asserts
+#                    hedge/replay legs as tagged sibling spans plus a
+#                    live-migrated request assembling into one
+#                    connected trace spanning >= 3 processes
 #
 # Usage: ./ci.sh [lint|native|tests|bench-smoke|telemetry-smoke|serve-smoke|audit-smoke|chaos-smoke|all]
 # (default: all)
@@ -167,7 +177,7 @@ bench_smoke() {
 }
 
 serve_smoke() {
-  step "serve-smoke: routed fleet (unified + role-split prefill/decode), SLO + transfer scrapes, SIGTERM drains"
+  step "serve-smoke: routed fleet (unified + role-split prefill/decode), SLO + transfer scrapes, trace-plane assembly, SIGTERM drains"
   python scripts/serve_smoke.py
 }
 
@@ -178,7 +188,7 @@ telemetry_smoke() {
 }
 
 chaos_smoke() {
-  step "chaos-smoke: integrity drill (NaN skip + ckpt bitflip) + seeded FaultPlan gang drill (KV reset + SIGKILL)"
+  step "chaos-smoke: integrity drill (NaN skip + ckpt bitflip) + seeded FaultPlan gang drill (KV reset + SIGKILL) + traced failover/migration drill"
   python scripts/chaos_smoke.py
 }
 

@@ -290,6 +290,16 @@ class Config:
     # p50 exceeds this multiple of the gang median
     straggler_factor: float = 3.0
 
+    # --- trace plane (common/tracing.py) ---
+    # master switch for cross-host request/step spans; off by default so
+    # the decode hot path carries zero tracing cost
+    trace: bool = False
+    # fraction of minted root contexts that are sampled (descendant
+    # spans inherit the root's decision, so a trace is all-or-nothing)
+    trace_sample: float = 1.0
+    # per-worker span ring size; oldest spans are evicted first
+    trace_spans: int = 2048
+
     # --- stall inspector ---
     stall_check_disable: bool = False
     stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
@@ -515,6 +525,9 @@ class Config:
             flight_recorder=env.get("HOROVOD_FLIGHT_RECORDER") or None,
             metrics_port=_env_int("HOROVOD_METRICS_PORT", 0),
             straggler_factor=_env_float("HOROVOD_STRAGGLER_FACTOR", 3.0),
+            trace=_env_bool("HOROVOD_TRACE"),
+            trace_sample=_env_float("HOROVOD_TRACE_SAMPLE", 1.0),
+            trace_spans=_env_int("HOROVOD_TRACE_SPANS", 2048),
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
             stall_warning_seconds=_env_float(
                 "HOROVOD_STALL_CHECK_TIME_SECONDS", DEFAULT_STALL_WARNING_SECONDS

@@ -346,6 +346,14 @@ class RetryPolicy:
                     "%.0fms", self.site, attempt, self.attempts,
                     type(e).__name__, e, delay * 1e3,
                 )
+                # trace plane: pin this retry to the hop span it is
+                # running under (site + attempt + backoff) — a no-op
+                # thread-local read when the request is untraced
+                from . import tracing as _tracing
+
+                _tracing.annotate(
+                    f"retry:{self.site}#{attempt}@{delay * 1e3:.0f}ms"
+                )
                 self._sleep(delay)
             else:
                 self._record_outcome(peer, ok=True)

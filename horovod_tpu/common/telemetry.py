@@ -224,6 +224,10 @@ class TelemetryHub:
         # attached by basics.init(); both optional
         self.timeline = None
         self.stall_inspector = None
+        # bench↔flight-recorder correlation: when a bench harness
+        # stamps a run id, every record closed while it is set carries
+        # it, so on-chip captures are attributable after the fact
+        self.run_id: Optional[str] = None
         # bumped by MetricsServer.start()/stop() — a live scraper turns
         # the auto hooks on even without a flight-recorder path
         self.scrapers = 0
@@ -459,6 +463,8 @@ class TelemetryHub:
                 "tuner": tuner,
             }
         )
+        if self.run_id:
+            rec["run_id"] = self.run_id
         self._last_step_id = max(self._last_step_id, rec["step"])
         self._ring.append(rec)
         return rec
@@ -576,7 +582,27 @@ class TelemetryHub:
             for rec in records:
                 f.write(json.dumps(rec) + "\n")
         os.replace(tmp, path)
+        self._dump_spans(path)
         return path
+
+    def _dump_spans(self, path: str) -> None:
+        """Drain the trace-plane span ring beside the StepStats dump —
+        ``<flight_recorder>.spans`` — on the same atexit/SIGTERM hooks,
+        so a killed worker's spans survive for trace_assemble. Never
+        lets a tracing bug spoil the step-record dump."""
+        try:
+            from . import tracing
+
+            rec = tracing._recorder  # don't construct one just to drain it
+            if rec is not None and len(rec):
+                rec.dump(path + ".spans")
+        except Exception:
+            _log.debug("span-ring dump failed", exc_info=True)
+
+    def set_run_id(self, run_id: Optional[str]) -> None:
+        """Stamp (or clear) the bench run id carried by every record
+        closed from now on."""
+        self.run_id = run_id or None
 
     def _install_hooks(self) -> None:
         """atexit + chained SIGTERM dump — the 'killed worker leaves its
@@ -602,6 +628,10 @@ class TelemetryHub:
         try:
             if len(self):
                 self.dump()
+            elif self.flight_path:
+                # no step records, but the span ring may still hold a
+                # trace worth keeping (e.g. a pure-routing worker)
+                self._dump_spans(self.flight_path)
         except Exception:
             _log.debug("flight-recorder atexit dump failed", exc_info=True)
 
@@ -691,6 +721,12 @@ def device_step_tick(step, source: str = "opt") -> None:
         _log.debug("telemetry tick failed", exc_info=True)
 
 
+def set_run_id(run_id: Optional[str]) -> None:
+    """Module-level convenience for bench harnesses: stamp every
+    flight-recorder record closed from now on with ``run_id``."""
+    hub().set_run_id(run_id)
+
+
 def heartbeat_stats() -> Dict[str, float]:
     """Module-level convenience for the elastic worker's heartbeat."""
     h = _hub
@@ -764,7 +800,8 @@ class MetricsServer:
     """Per-worker live scrape endpoint on a stdlib http.server thread.
 
     Routes: ``/metrics`` (Prometheus text), ``/telemetry`` (JSON ring +
-    registry snapshot), ``/healthz``. Read-only and unauthenticated by
+    registry snapshot), ``/traces`` (trace-plane span ring +
+    worker identity + clock stamps), ``/healthz``. Read-only and unauthenticated by
     design — it exposes numbers, not control; bind it to an interface
     your scraper can reach (default all interfaces, matching the
     rendezvous server)."""
@@ -794,6 +831,26 @@ class MetricsServer:
             def do_GET(self):
                 h = outer.hub
                 path = self.path.split("?", 1)[0]
+                if path == "/traces":
+                    # span ring + this worker's identity + recv/send
+                    # wall stamps: the scrape itself is an NTP edge the
+                    # assembler can estimate this host's offset from
+                    recv_ts = time.time()
+                    from . import tracing
+
+                    rec = tracing.recorder()
+                    body = json.dumps(
+                        {
+                            "spans": rec.spans(),
+                            "capacity": rec.capacity,
+                            "host": rec.host,
+                            "pid": rec.pid,
+                            "role": rec.role,
+                            "recv_ts": recv_ts,
+                            "send_ts": time.time(),
+                        }
+                    ).encode()
+                    return self._reply(200, body, "application/json")
                 if path == "/metrics":
                     body = render_prometheus(
                         _metrics.snapshot(), h.percentiles()

@@ -171,6 +171,18 @@ class ElasticDriver:
         self._standby_swapins = 0
         self._scaleup_reason: Optional[str] = None
         self._last_scaleup = 0.0
+        # trace plane (common/tracing.py): one context per restart
+        # CYCLE — quarantine / standby-swap / restart events between
+        # two gang launches share it, so the assembled fleet view shows
+        # the whole remediation as one connected trace
+        self._cycle_tctx = None
+
+    def _cycle_trace(self):
+        from ..common import tracing as _tracing
+
+        if self._cycle_tctx is None:
+            self._cycle_tctx = _tracing.mint()
+        return self._cycle_tctx
 
     # ---------------------------------------------------------- planning
 
@@ -482,6 +494,15 @@ class ElasticDriver:
             "driver.standby.reserved",
             len(candidates) - 1,
         )
+        from ..common import tracing as _tracing
+
+        sspan = _tracing.start_span(
+            "elastic.standby_swap", self._cycle_trace(),
+            host=hostname, reason=reason,
+            armed=hostname in armed,
+        )
+        if sspan is not None:
+            sspan.end()
         _log.info(
             "releasing warm standby %s into the gang (%s); swap-in #%d",
             hostname, reason, self._standby_swapins,
@@ -966,9 +987,17 @@ class ElasticDriver:
             return False
         from ..common.metrics import registry as _metrics
 
+        from ..common import tracing as _tracing
+
         for hostname in hosts:
             self.host_manager.blacklist(hostname)
             _metrics.counter("driver.quarantined_hosts")
+            qspan = _tracing.start_span(
+                "elastic.quarantine", self._cycle_trace(),
+                host=hostname, reason=why,
+            )
+            if qspan is not None:
+                qspan.end()
             self._release_standby(f"{why}: {hostname}")
         self._publish_dead_hosts()
         return True
@@ -1116,6 +1145,20 @@ class ElasticDriver:
         _metrics.counter("driver.gang_restarts")
         self._epoch += 1
         _metrics.gauge("driver.epoch", self._epoch)
+        # the restart is the cycle trace's ROOT record — quarantine and
+        # standby-swap spans emitted since the last launch parent here;
+        # the context rotates so the next remediation is its own trace
+        from ..common import tracing as _tracing
+
+        rspan = _tracing.root_span(
+            "elastic.restart", self._cycle_tctx,
+            reason=reason, epoch=self._epoch,
+            resets=self._resets,
+            warm=bool(self._standby_released),
+        )
+        if rspan is not None:
+            rspan.end()
+        self._cycle_tctx = None
         # the restart clock: the NEXT epoch's workers read this stamp
         # at init and publish elastic.restart_ms / serve.scaleup_ms —
         # the telemetry that shows a warm swap-in beating a cold start

@@ -67,6 +67,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..common import telemetry as _telemetry
+from ..common import tracing as _tracing
 from ..common.logging import get_logger
 from ..common.metrics import registry as _metrics
 from ..testing import chaos as _chaos
@@ -120,6 +121,12 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0
     seed: Optional[int] = None
+    # trace plane (common/tracing.py): the request's TraceContext (None
+    # = untraced — every span site below skips on None, so the default
+    # path carries zero tracing cost) and the open admit→retire decode
+    # span riding it
+    trace: Optional[object] = dataclasses.field(default=None, repr=False)
+    span: Optional[object] = dataclasses.field(default=None, repr=False)
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
@@ -131,7 +138,7 @@ class Request:
         return self._done.is_set()
 
     def result(self) -> Dict:
-        return {
+        out = {
             "id": self.id,
             "status": self.status,
             "tokens": list(self.out_tokens),
@@ -139,6 +146,9 @@ class Request:
             "ttft_ms": round(self.ttft_ms, 3),
             "gen_ms": round(self.gen_ms, 3),
         }
+        if self.trace is not None:
+            out["trace_id"] = self.trace.trace_id
+        return out
 
 
 class ContinuousBatcher:
@@ -201,6 +211,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         top_k: int = 0,
         seed: Optional[int] = None,
+        trace=None,
     ) -> Request:
         if self.role == "decode":
             # the Router never sends prompts here (role-aware pick);
@@ -253,6 +264,7 @@ class ContinuousBatcher:
             temperature=float(temperature),
             top_k=int(top_k),
             seed=seed,
+            trace=trace,
         )
         with self._cond:
             # drain check and enqueue under ONE lock: a submit racing
@@ -285,6 +297,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         top_k: int = 0,
         seed: Optional[int] = None,
+        trace=None,
     ) -> Request:
         """Admit a KV-transferred request (serving/kv_transfer.py
         receiver). Called from an HTTP handler thread: only host-side
@@ -315,6 +328,7 @@ class ContinuousBatcher:
             temperature=float(temperature),
             top_k=int(top_k),
             seed=seed,
+            trace=trace,
         )
         req.out_tokens.append(int(first_token))
         req.ingest = {
@@ -343,6 +357,7 @@ class ContinuousBatcher:
         length: int,
         deadline_ms: Optional[float] = None,
         sample: Optional[dict] = None,
+        trace=None,
     ) -> Request:
         """Admit a live-migrated in-flight sequence (the ``migrate``
         frame, serving/kv_transfer.py receiver). Unlike
@@ -375,6 +390,7 @@ class ContinuousBatcher:
                 if deadline_ms and float(deadline_ms) > 0
                 else None
             ),
+            trace=trace,
         )
         req.out_tokens.extend(toks)
         req.ingest = {
@@ -598,6 +614,9 @@ class ContinuousBatcher:
                 self.engine.manager.release_kept(req.kept_pages)
                 req.kept_pages = None
             req.status = ERROR
+            if req.span is not None:
+                req.span.end(outcome="error", reason=reason)
+                req.span = None
             req._done.set()
             _metrics.counter("serve.errored")
         self._publish_gauges(min_interval=0.0)
@@ -705,6 +724,13 @@ class ContinuousBatcher:
                 req.paused = False
                 req.status = RUNNING
                 _metrics.counter("serve.resumed")
+                if req.trace is not None:
+                    s = _tracing.start_span(
+                        "serve.resume", req.trace, path="reattach",
+                        slot=slot,
+                    )
+                    if s is not None:
+                        s.end()
             elif req.ingest is not None:
                 # KV-transfer ingest: foreign pages land in the pool
                 # and pointer-attach — data changes, shapes don't, so
@@ -730,10 +756,18 @@ class ContinuousBatcher:
                     # fork the sampled sequence
                     self.engine.import_sampling(slot, ing["sample"])
                     sample_armed = True
+                npages = len(ing["logical"])
                 req.ingest = None
                 req.status = RUNNING
                 _metrics.counter("serve.transfer_admits")
                 _metrics.counter("serve.tokens_out")
+                if req.trace is not None:
+                    s = _tracing.start_span(
+                        "serve.ingest_admit", req.trace, pages=npages,
+                        slot=slot, migrated=bool(sample_armed),
+                    )
+                    if s is not None:
+                        s.end()
             else:
                 if req.paused and req.out_tokens:
                     # pages were reclaimed while paused: rebuild the
@@ -741,7 +775,15 @@ class ContinuousBatcher:
                     # (the prefix cache usually makes this cheap); the
                     # emitted token is discarded — the real newest
                     # token is fed to the next decode step
-                    self.engine.prefill(slot, self._resume_seq(req))
+                    pspan = _tracing.start_span(
+                        "serve.prefill", req.trace, resume=True,
+                        slot=slot,
+                    )
+                    self.engine.prefill(
+                        slot, self._resume_seq(req), trace=req.trace
+                    )
+                    if pspan is not None:
+                        pspan.end()
                     req.paused = False
                     req.status = RUNNING
                     _metrics.counter("serve.resumed")
@@ -759,17 +801,30 @@ class ContinuousBatcher:
                         need = self.engine.manager.pages_needed(
                             int(req.prompt.size) + req.max_new_tokens
                         )
-                        reservation = self.transfer.reserve(need)
+                        reservation = self.transfer.reserve(
+                            need, trace=req.trace
+                        )
                         if reservation is None:
                             # no decode capacity anywhere: the unified
                             # path — decode locally (this role compiles
                             # its decode table lazily, only here)
                             _metrics.counter("serve.transfer_local")
-                    first = self.engine.prefill(slot, req.prompt)
+                    pspan = _tracing.start_span(
+                        "serve.prefill", req.trace,
+                        prompt_len=int(req.prompt.size), slot=slot,
+                    )
+                    first = self.engine.prefill(
+                        slot, req.prompt, trace=req.trace
+                    )
                     req.status = RUNNING
                     req.ttft_ms = (time.monotonic() - req.submitted) * 1e3
                     req.out_tokens.append(int(first))
-                    self.recorder.record_ttft(req.ttft_ms)
+                    if pspan is not None:
+                        pspan.end(ttft_ms=round(req.ttft_ms, 3))
+                    self.recorder.record_ttft(
+                        req.ttft_ms,
+                        req.trace.trace_id if req.trace else "",
+                    )
                     _metrics.counter(
                         "serve.prefill_tokens", int(req.prompt.size)
                     )
@@ -808,6 +863,13 @@ class ContinuousBatcher:
                 )
             else:
                 self.engine.clear_sampling(slot)
+            if req.trace is not None and req.span is None:
+                # admit→retire lifecycle span: opened ONCE (survives
+                # pause/resume cycles), closed by _retire/_abort_all —
+                # no per-decode-step tracing work happens inside it
+                req.span = _tracing.start_span(
+                    "serve.decode", req.trace, slot=slot,
+                )
             self._slot_req[slot] = req
             if self._req_complete(req, now):
                 self._retire(slot, req)
@@ -838,6 +900,13 @@ class ContinuousBatcher:
         with self._cond:
             self._queue.appendleft(req)
         _metrics.counter("serve.paused")
+        if req.trace is not None:
+            s = _tracing.start_span(
+                "serve.pause", req.trace, slot=slot,
+                kept_pages=len(req.kept_pages),
+            )
+            if s is not None:
+                s.end()
         _log.debug(
             "page pool exhausted: paused request %d (kept %d pages)",
             req.id, len(req.kept_pages),
@@ -915,7 +984,9 @@ class ContinuousBatcher:
             self.engine.manager.advance(slot)
             req.out_tokens.append(int(nxt[slot]))
             req.gen_ms = (now - req.submitted) * 1e3 - req.ttft_ms
-            self.recorder.record_tpot(step_ms)
+            self.recorder.record_tpot(
+                step_ms, req.trace.trace_id if req.trace else ""
+            )
             _metrics.counter("serve.tokens_out")
             if self._req_complete(req, now):
                 self._retire(slot, req)
@@ -944,6 +1015,12 @@ class ContinuousBatcher:
         else:
             req.status = DONE
             _metrics.counter("serve.completed")
+        if req.span is not None:
+            req.span.end(
+                outcome=req.status, tokens=len(req.out_tokens),
+                steps=self._decode_steps,
+            )
+            req.span = None
         req._done.set()
 
     # --------------------------------------------------------------- stats

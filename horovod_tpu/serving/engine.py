@@ -45,6 +45,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from ..common import tracing as _tracing
 from ..common.logging import get_logger
 from ..common.metrics import registry as _metrics
 from .paged_kv import PagePoolExhausted  # noqa: F401  (engine API)
@@ -842,7 +843,7 @@ class InferenceEngine:
             return self.manager.table_row(slot)
         return np.int32(slot)
 
-    def prefill(self, slot: int, prompt) -> int:
+    def prefill(self, slot: int, prompt, trace=None) -> int:
         """Run the prompt through the slot's cache; returns the first
         greedy token. Prompts past the bucket ceiling stream as
         ceiling-sized chunks (each attends to the cache written so
@@ -895,6 +896,13 @@ class InferenceEngine:
         while n - start > ceiling:
             exe = self._bucket_exe(ceiling)
             self._counters["chunked_prefill_chunks"] += 1
+            # trace plane: one span per streamed chunk — spans open
+            # only for traced requests (trace=None ⇒ start_span is a
+            # no-op returning None), so the default path is untouched
+            cspan = _tracing.start_span(
+                "engine.prefill_chunk", trace,
+                start=int(start), width=int(ceiling), slot=slot,
+            )
             tok, self.manager.cache = exe(
                 self._params,
                 self.manager.cache,
@@ -903,6 +911,8 @@ class InferenceEngine:
                 np.int32(start),
                 np.int32(ceiling - 1),
             )
+            if cspan is not None:
+                cspan.end()
             if self.paged_attn:
                 self._counters["paged_attn_calls"] += 1
             start += ceiling
@@ -910,6 +920,10 @@ class InferenceEngine:
         exe, width = self._get_prefill_exe(tail, avail=self.max_len - start)
         tokens = np.zeros((1, width), np.int32)
         tokens[0, :tail] = prompt[start:]
+        cspan = _tracing.start_span(
+            "engine.prefill_chunk", trace,
+            start=int(start), width=int(width), slot=slot, tail=True,
+        )
         tok, self.manager.cache = exe(
             self._params,
             self.manager.cache,
@@ -918,6 +932,8 @@ class InferenceEngine:
             np.int32(start),
             np.int32(tail - 1),
         )
+        if cspan is not None:
+            cspan.end()
         if self.paged_attn:
             self._counters["paged_attn_calls"] += 1
         self.manager.set_length(slot, n)

@@ -77,6 +77,7 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from ..common import tracing as _tracing
 from ..common.logging import TRACE as _TRACE, get_logger
 from ..common.metrics import registry as _metrics
 from ..common.telemetry import (
@@ -153,6 +154,11 @@ class ServeFrontend:
         # timeout must not recompute, and MUST answer even mid-drain).
         self._dedupe: "OrderedDict[str, tuple]" = OrderedDict()
         self._dedupe_lock = threading.Lock()
+        # client-visible status mix (/generate replies only): the
+        # failure ladder counts replays/fallbacks, this counts what the
+        # CLIENT saw (docs/robustness.md runbook row)
+        self._status_lock = threading.Lock()
+        self._status_counts = {2: 0, 4: 0, 5: 0}
         # live-migration coordinator, built lazily on the first
         # deadline-bounded drain (unified workers have no transfer
         # coordinator wired otherwise)
@@ -165,16 +171,24 @@ class ServeFrontend:
             def log_message(self, fmt, *args):
                 _log.log(_TRACE, "http " + fmt, *args)
 
-            def _reply(self, code, body: bytes, ctype: str) -> None:
+            def _reply(
+                self, code, body: bytes, ctype: str, headers=None,
+            ) -> None:
+                self._last_code = code
+                if getattr(self, "_count_status", False):
+                    outer._note_status(code)
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _json(self, code, obj) -> None:
+            def _json(self, code, obj, headers=None) -> None:
                 self._reply(
-                    code, json.dumps(obj).encode(), "application/json"
+                    code, json.dumps(obj).encode(), "application/json",
+                    headers=headers,
                 )
 
             def do_GET(self):
@@ -197,6 +211,23 @@ class ServeFrontend:
                     return self._reply(
                         200, body.encode(), PROM_CONTENT_TYPE
                     )
+                if path == "/traces":
+                    # span ring + identity + clock stamps (same payload
+                    # as the MetricsServer route): serve workers run
+                    # their own HTTP plane, and trace_assemble must be
+                    # able to scrape them live — the scrape itself is
+                    # an NTP edge for the skew-corrected assembly
+                    recv_ts = time.time()
+                    rec = _tracing.recorder()
+                    return self._json(200, {
+                        "spans": rec.spans(),
+                        "capacity": rec.capacity,
+                        "host": rec.host,
+                        "pid": rec.pid,
+                        "role": rec.role,
+                        "recv_ts": recv_ts,
+                        "send_ts": time.time(),
+                    })
                 return self._reply(
                     404, b"not found\n", "text/plain; charset=utf-8"
                 )
@@ -205,6 +236,7 @@ class ServeFrontend:
                 # read the body FIRST: HTTP/1.1 keep-alive means an
                 # early reply that leaves body bytes on the socket
                 # desynchronizes the connection's next request
+                recv_ts = time.time()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 path = self.path.split("?", 1)[0]
@@ -212,6 +244,32 @@ class ServeFrontend:
                     return self._reply(
                         404, b"not found\n", "text/plain; charset=utf-8"
                     )
+                # client-visible status mix: counted for the request
+                # surface only, never the scrape GETs
+                self._count_status = True
+                # trace plane: adopt the incoming traceparent (or mint
+                # a root when tracing is on and the client brought
+                # none); every reply echoes X-Trace-Id plus the
+                # recv/send clock stamps the assembler's skew
+                # estimation feeds on. tctx None (the default) costs
+                # nothing downstream.
+                tctx = _tracing.adopt(
+                    self.headers.get(_tracing.TRACEPARENT_HEADER)
+                )
+                span = _tracing.start_span("http.generate", tctx)
+                hdrs = None
+                if tctx is not None:
+                    hdrs = _tracing.server_stamps(recv_ts)
+                    hdrs[_tracing.TRACE_ID_HEADER] = tctx.trace_id
+                try:
+                    return self._generate(body, span, hdrs)
+                finally:
+                    self._count_status = False
+                    if span is not None:
+                        span.end(code=getattr(self, "_last_code", 0))
+
+            def _generate(self, body, span, hdrs):
+                trace_ctx = span.ctx if span is not None else None
                 try:
                     payload = json.loads(body or b"{}")
                     if not isinstance(payload, dict):
@@ -221,8 +279,12 @@ class ServeFrontend:
                         )
                     tokens = payload["tokens"]
                 except (json.JSONDecodeError, KeyError, ValueError) as e:
-                    return self._json(400, {"error": f"bad request: {e}"})
+                    return self._json(
+                        400, {"error": f"bad request: {e}"}, headers=hdrs
+                    )
                 request_id = str(payload.get("request_id") or "")
+                if span is not None and request_id:
+                    span.tag(request_id=request_id)
                 if request_id:
                     # the dedupe check runs BEFORE the draining gate: a
                     # retry for work this worker already completed must
@@ -231,10 +293,13 @@ class ServeFrontend:
                     hit = outer._dedupe_get(request_id)
                     if hit is not None:
                         _metrics.counter("serve.replay_dedupe_hits")
-                        return self._json(200, hit)
+                        if span is not None:
+                            span.tag(outcome="dedupe_hit")
+                        return self._json(200, hit, headers=hdrs)
                 if outer.draining:
                     return self._json(
-                        503, {"error": "draining", "retry": True}
+                        503, {"error": "draining", "retry": True},
+                        headers=hdrs,
                     )
                 with outer._inflight_lock:
                     outer._inflight += 1
@@ -249,20 +314,24 @@ class ServeFrontend:
                             ),
                             top_k=int(payload.get("top_k", 0)),
                             seed=payload.get("seed"),
+                            trace=trace_ctx,
                         )
                     except Rejected as e:
                         # draining (planned or crash) is the WORKER's
                         # state -> 503 so the Router fails over; 429 is
                         # reserved for requests that can never fit
                         code = 503 if outer.draining else 429
-                        return self._json(code, {"error": str(e)})
+                        return self._json(
+                            code, {"error": str(e)}, headers=hdrs
+                        )
                     except (TypeError, ValueError) as e:
                         # well-formed JSON, malformed fields (string
                         # tokens, non-numeric budgets): the client's
                         # fault, so the client gets told — not a torn
                         # socket the router misreads as a dead worker
                         return self._json(
-                            400, {"error": f"bad request: {e}"}
+                            400, {"error": f"bad request: {e}"},
+                            headers=hdrs,
                         )
                     req.wait()
                     # "error" = the scheduler crashed under this
@@ -271,9 +340,11 @@ class ServeFrontend:
                     # client treating it as a completion
                     code = 500 if req.status == "error" else 200
                     result = req.result()
+                    if span is not None:
+                        span.tag(outcome=req.status)
                     if request_id and code == 200:
                         outer._dedupe_put(request_id, result)
-                    return self._json(code, result)
+                    return self._json(code, result, headers=hdrs)
                 finally:
                     with outer._inflight_lock:
                         outer._inflight -= 1
@@ -492,6 +563,25 @@ class ServeFrontend:
                 self.batcher.requeue_fallback(
                     rec["req"], rec["kept"], rec["length"]
                 )
+
+    def _note_status(self, code: int) -> None:
+        """Per-reply status accounting on the request surface:
+        ``serve.http_2xx/4xx/5xx`` counters plus the derived
+        ``serve.http_error_rate`` gauge (non-2xx fraction of every
+        /generate reply this worker ever sent)."""
+        klass = int(code) // 100
+        if klass not in (2, 4, 5):
+            klass = 5 if klass > 5 else 4
+        with self._status_lock:
+            self._status_counts[klass] += 1
+            counts = dict(self._status_counts)
+        _metrics.counter(f"serve.http_{klass}xx")
+        total = sum(counts.values())
+        if total:
+            _metrics.gauge(
+                "serve.http_error_rate",
+                (counts[4] + counts[5]) / total,
+            )
 
     # ----------------------------------------------------------- dedupe cache
 
@@ -733,37 +823,55 @@ class Router:
                 self._debits[rank] -= 1
 
     def _post_generate(self, ann: dict, body: bytes,
-                       timeout: float) -> dict:
+                       timeout: float, span=None) -> dict:
         """One /generate POST against one worker — the routing unit
-        every path (sequential, replay, hedge arm) shares."""
+        every path (sequential, replay, hedge arm) shares. With a leg
+        ``span``, the traceparent header carries its context to the
+        worker and the reply's clock-stamp echo is tagged onto it (the
+        NTP edge the skew-corrected assembly estimates offsets from)."""
         import urllib.request
 
         url = (
             f"http://{ann.get('addr', '127.0.0.1')}:{ann['port']}"
             f"/generate"
         )
+        headers = {"Content-Type": "application/json"}
+        if span is not None:
+            headers[_tracing.TRACEPARENT_HEADER] = (
+                span.ctx.to_traceparent()
+            )
         req = urllib.request.Request(
-            url, data=body,
-            headers={"Content-Type": "application/json"},
-            method="POST",
+            url, data=body, headers=headers, method="POST",
         )
+        t_send = time.time()
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read().decode())
+            out = json.loads(resp.read().decode())
+            _tracing.tag_hop(span, t_send, time.time(), resp.headers)
+        return out
 
-    def _note_failure(self, ann: dict, err: Exception) -> None:
+    def _note_failure(self, ann: dict, err: Exception, span=None) -> None:
         """Classify a failed live call. A 503 is an ORDERLY refusal
         (draining/rejected before admission) — plain failover, the
         worker's own announcement will say so. Everything else (5xx,
         transport fault, torn response) means the worker went dark with
         the request possibly in flight: the retry on the next candidate
         is a REPLAY (``serve.replays``) and the dark worker's stale
-        announcement is tombstoned so it can't re-attract traffic."""
+        announcement is tombstoned so it can't re-attract traffic.
+        The leg ``span``, when traced, closes tagged with the same
+        classification."""
         import urllib.error
 
         _metrics.counter("serve.route_failover")
         if isinstance(err, urllib.error.HTTPError) and err.code == 503:
+            if span is not None:
+                span.end(outcome="failover", code=503)
             return
         _metrics.counter("serve.replays")
+        if span is not None:
+            span.end(
+                outcome="replayed",
+                error=f"{type(err).__name__}: {err}",
+            )
         self.tombstone(ann["rank"], ann)
 
     def route(
@@ -778,6 +886,7 @@ class Router:
         seed: Optional[int] = None,
         request_id: Optional[str] = None,
         hedge_ms: Optional[float] = None,
+        trace=None,
     ) -> dict:
         """POST /generate on the picked worker; a dead or draining pick
         fails over to the next candidate — the full submission below IS
@@ -807,88 +916,135 @@ class Router:
         body = json.dumps(payload).encode()
         last_err: Optional[Exception] = None
         failed: set = set()
-        if hedge_ms is None:
-            from ..common import basics
+        # trace plane: the routing side mints the request's root
+        # context (or adopts the caller's); every leg below — first
+        # try, replay, hedge arm — is a SIBLING span under it tagged
+        # with its outcome, and the traceparent header carries the
+        # leg's context to the worker it hits.
+        tctx = trace if trace is not None else _tracing.mint()
+        root = _tracing.root_span(
+            "route", tctx, request_id=payload["request_id"]
+        )
+        try:
+            if hedge_ms is None:
+                from ..common import basics
 
-            hedge_ms = basics.live_config().serve_hedge_ms
-        if hedge_ms and float(hedge_ms) > 0:
-            out, failed, last_err = self._route_hedged(
-                body, timeout, float(hedge_ms) / 1e3
-            )
-            if out is not None:
-                return out
-            # both arms dark: fall through to the sequential replay
-            # loop with the failed ranks already excluded
-        for _ in range(max(int(attempts), 1)):
-            ann = self.pick(exclude=failed)
-            if ann is None:
-                if failed:
-                    raise RuntimeError(
-                        f"routing failed: every live worker errored "
-                        f"({sorted(failed)}): {last_err}"
+                hedge_ms = basics.live_config().serve_hedge_ms
+            if hedge_ms and float(hedge_ms) > 0:
+                out, failed, last_err = self._route_hedged(
+                    body, timeout, float(hedge_ms) / 1e3, tctx=tctx,
+                )
+                if out is not None:
+                    if root is not None:
+                        root.tag(outcome="ok")
+                        out.setdefault("trace_id", tctx.trace_id)
+                    return out
+                # both arms dark: fall through to the sequential replay
+                # loop with the failed ranks already excluded
+            for _ in range(max(int(attempts), 1)):
+                ann = self.pick(exclude=failed)
+                if ann is None:
+                    if failed:
+                        raise RuntimeError(
+                            f"routing failed: every live worker errored "
+                            f"({sorted(failed)}): {last_err}"
+                        )
+                    raise RuntimeError("no live serve workers announced")
+                leg = _tracing.start_span(
+                    "route.attempt", tctx, rank=int(ann["rank"]),
+                    mode="replay" if failed else "first",
+                )
+                try:
+                    out = self._post_generate(
+                        ann, body, timeout, span=leg
                     )
-                raise RuntimeError("no live serve workers announced")
-            try:
-                return self._post_generate(ann, body, timeout)
-            except urllib.error.HTTPError as e:
-                if e.code == 503 or e.code >= 500:
-                    # draining / server fault: the WORKER's problem,
-                    # fail over to the next candidate
+                    if leg is not None:
+                        leg.end(outcome="ok")
+                        out.setdefault("trace_id", tctx.trace_id)
+                    if root is not None:
+                        root.tag(outcome="ok")
+                    return out
+                except urllib.error.HTTPError as e:
+                    if e.code == 503 or e.code >= 500:
+                        # draining / server fault: the WORKER's problem,
+                        # fail over to the next candidate
+                        last_err = e
+                        failed.add(ann["rank"])
+                        self._note_failure(ann, e, span=leg)
+                        continue
+                    # 4xx: the REQUEST's problem — every worker would
+                    # say the same thing; surface the actionable error
+                    # instead of burning the fleet and masking it as
+                    # 'all dead'
+                    if leg is not None:
+                        leg.end(outcome="rejected", code=e.code)
+                    try:
+                        detail = json.loads(
+                            e.read().decode()
+                        ).get("error", "")
+                    except (ValueError, OSError):
+                        detail = ""
+                    raise RuntimeError(
+                        f"request rejected by rank {ann['rank']} "
+                        f"(HTTP {e.code}): {detail or e.reason}"
+                    ) from e
+                except (OSError, ValueError) as e:
                     last_err = e
                     failed.add(ann["rank"])
-                    self._note_failure(ann, e)
+                    self._note_failure(ann, e, span=leg)
                     continue
-                # 4xx: the REQUEST's problem — every worker would say
-                # the same thing; surface the actionable error instead
-                # of burning the fleet and masking it as 'all dead'
-                try:
-                    detail = json.loads(e.read().decode()).get("error", "")
-                except (ValueError, OSError):
-                    detail = ""
-                raise RuntimeError(
-                    f"request rejected by rank {ann['rank']} "
-                    f"(HTTP {e.code}): {detail or e.reason}"
-                ) from e
-            except (OSError, ValueError) as e:
-                last_err = e
-                failed.add(ann["rank"])
-                self._note_failure(ann, e)
-                continue
-            finally:
-                self.credit(ann["rank"])
-        raise RuntimeError(
-            f"routing failed after {attempts} attempts: {last_err}"
-        )
+                finally:
+                    self.credit(ann["rank"])
+            raise RuntimeError(
+                f"routing failed after {attempts} attempts: {last_err}"
+            )
+        finally:
+            if root is not None:
+                if "outcome" not in root.tags:
+                    root.tag(outcome="error")
+                root.end()
 
-    def _route_hedged(self, body: bytes, timeout: float, hedge_s: float):
+    def _route_hedged(
+        self, body: bytes, timeout: float, hedge_s: float, tctx=None,
+    ):
         """Primary fires immediately; if no result lands within
         ``hedge_s`` a backup fires on a second worker
         (``serve.hedges``). First writer wins — the losing arm's
         response is discarded when it eventually lands. Returns
         ``(result_or_None, failed_ranks, last_err)``; the caller's
-        sequential loop finishes the job when every arm went dark."""
+        sequential loop finishes the job when every arm went dark. Each
+        arm gets its own ``route.attempt`` sibling span under ``tctx``
+        tagged ``hedge=primary|backup`` — won/discarded/error outcomes
+        make the race legible in the assembled trace."""
         primary = self.pick()
         if primary is None:
             return None, set(), None
         cv = threading.Condition()
         box: dict = {"errors": []}
 
-        def arm(ann):
+        def arm(ann, hedge_tag):
+            leg = _tracing.start_span(
+                "route.attempt", tctx,
+                rank=int(ann["rank"]), hedge=hedge_tag,
+            )
             try:
-                out = self._post_generate(ann, body, timeout)
+                out = self._post_generate(ann, body, timeout, span=leg)
             except Exception as e:  # noqa: BLE001 — arm failure is data
                 with cv:
-                    box["errors"].append((ann, e))
+                    box["errors"].append((ann, e, leg))
                     cv.notify_all()
             else:
                 with cv:
+                    won = "result" not in box
                     box.setdefault("result", out)
                     cv.notify_all()
+                if leg is not None:
+                    leg.end(outcome="ok" if won else "discarded")
             finally:
                 self.credit(ann["rank"])
 
         threading.Thread(
-            target=arm, args=(primary,),
+            target=arm, args=(primary, "primary"),
             name="hvd-route-primary", daemon=True,
         ).start()
         arms = 1
@@ -901,7 +1057,7 @@ class Router:
                     _metrics.counter("serve.hedges")
                     arms = 2
                     threading.Thread(
-                        target=arm, args=(backup,),
+                        target=arm, args=(backup, "backup"),
                         name="hvd-route-hedge", daemon=True,
                     ).start()
             while "result" not in box and len(box["errors"]) < arms:
@@ -912,10 +1068,10 @@ class Router:
             result = box.get("result")
         failed: set = set()
         last_err: Optional[Exception] = None
-        for ann, err in errors:
+        for ann, err, leg in errors:
             failed.add(ann["rank"])
             last_err = err
-            self._note_failure(ann, err)
+            self._note_failure(ann, err, span=leg)
         return result, failed, last_err
 
 
@@ -1009,6 +1165,9 @@ def serve(
     from .engine import InferenceEngine
 
     cfg = basics.live_config()
+    # Label this worker's spans with its serving role so the trace
+    # assembler gets one row per (host, role) without guessing.
+    _tracing.set_role(role or cfg.serve_role)
     if port is None:
         port = cfg.serve_port
     if slots is None:

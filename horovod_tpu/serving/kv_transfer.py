@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import tracing as _tracing
 from ..common.logging import get_logger
 from ..common.metrics import registry as _metrics
 from ..common.retry import RetryPolicy
@@ -261,16 +262,23 @@ class KVTransferServer:
                 self.wfile.write(body)
 
             def do_POST(self):
+                recv_ts = time.time()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 path = self.path.split("?", 1)[0]
                 if path == "/kv/reserve":
-                    return self._json(*outer._handle_reserve(body))
-                if path == "/kv/ingest":
-                    return self._json(*outer._handle_ingest(body))
-                if path == "/kv/migrate":
-                    return self._json(*outer._handle_migrate(body))
-                return self._json(404, {"error": "not found"})
+                    code, obj = outer._handle_reserve(body)
+                elif path == "/kv/ingest":
+                    code, obj = outer._handle_ingest(body)
+                elif path == "/kv/migrate":
+                    code, obj = outer._handle_migrate(body)
+                else:
+                    code, obj = 404, {"error": "not found"}
+                if isinstance(obj, dict):
+                    # clock-stamp echo: every kv reply is an NTP edge
+                    # for the trace assembler (tracing.tag_hop_fields)
+                    obj.update(_tracing.json_stamps(recv_ts))
+                return self._json(code, obj)
 
             def do_GET(self):
                 path, _, query = self.path.partition("?")
@@ -330,12 +338,21 @@ class KVTransferServer:
             pages = int(payload["pages"])
         except (ValueError, KeyError):
             return 400, {"error": "bad reserve request"}
+        span = _tracing.start_span(
+            "kv.reserve",
+            _tracing.TraceContext.from_dict(payload.get("trace")),
+            pages=pages,
+        )
         if self.batcher.draining:
+            if span is not None:
+                span.end(outcome="draining")
             return 503, {"error": "draining"}
         mgr = self.batcher.engine.manager
         headroom = mgr.admission_headroom() - self.reserved_pages()
         if pages > headroom:
             _metrics.counter("serve.transfer_reserve_denied")
+            if span is not None:
+                span.end(outcome="denied", free=headroom)
             return 503, {"error": "no decode capacity", "free": headroom}
         rid = uuid.uuid4().hex
         with self._lock:
@@ -343,6 +360,8 @@ class KVTransferServer:
                 pages, time.monotonic() + self._ttl
             )
         _metrics.counter("serve.transfer_reservations")
+        if span is not None:
+            span.end(outcome="ok")
         return 200, {"reservation": rid, "pages": pages}
 
     # ----------------------------------------------------------------- ingest
@@ -353,15 +372,23 @@ class KVTransferServer:
         except (ValueError, json.JSONDecodeError) as e:
             return 400, {"error": f"bad transfer frame: {e}"}
         request_id = str(meta.get("request_id", ""))
+        tctx = _tracing.TraceContext.from_dict(meta.get("trace"))
+        span = _tracing.start_span(
+            "kv.ingest", tctx, pages=len(meta.get("pages", ())),
+        )
         with self._lock:
             rid = self._by_request.get(request_id)
             if rid is not None:
                 # retried stream after a mid-flight reset: the first
                 # frame already admitted — idempotent, never twice
+                if span is not None:
+                    span.end(outcome="duplicate")
                 return 200, {"rid": rid, "duplicate": True}
             if meta.get("reservation"):
                 self._reservations.pop(meta["reservation"], None)
         if self.batcher.draining:
+            if span is not None:
+                span.end(outcome="draining")
             return 503, {"error": "draining"}
         try:
             arrays = unpack_pages(meta, blob)
@@ -377,9 +404,12 @@ class KVTransferServer:
                 temperature=float(meta.get("temperature", 0.0)),
                 top_k=int(meta.get("top_k", 0)),
                 seed=meta.get("seed"),
+                trace=span.ctx if span is not None else tctx,
             )
         except Exception as e:  # Rejected, malformed frames
             _log.warning("kv transfer ingest rejected: %s", e)
+            if span is not None:
+                span.end(outcome="error", error=str(e))
             return 503, {"error": str(e)}
         rid = uuid.uuid4().hex
         with self._lock:
@@ -388,6 +418,8 @@ class KVTransferServer:
             self._results[rid] = req
         _metrics.counter("serve.kv_transfer_bytes_in", len(body))
         _metrics.counter("serve.kv_transfer_pages_in", len(meta["pages"]))
+        if span is not None:
+            span.end(outcome="ok", bytes=len(body))
         return 200, {"rid": rid}
 
     def _handle_migrate(self, body: bytes):
@@ -401,13 +433,21 @@ class KVTransferServer:
         except (ValueError, json.JSONDecodeError) as e:
             return 400, {"error": f"bad migrate frame: {e}"}
         request_id = str(meta.get("request_id", ""))
+        tctx = _tracing.TraceContext.from_dict(meta.get("trace"))
+        span = _tracing.start_span(
+            "kv.migrate", tctx, pages=len(meta.get("pages", ())),
+        )
         with self._lock:
             rid = self._by_request.get(request_id)
             if rid is not None:
+                if span is not None:
+                    span.end(outcome="duplicate")
                 return 200, {"rid": rid, "duplicate": True}
             if meta.get("reservation"):
                 self._reservations.pop(meta["reservation"], None)
         if self.batcher.draining:
+            if span is not None:
+                span.end(outcome="draining")
             return 503, {"error": "draining"}
         try:
             arrays = unpack_pages(meta, blob)
@@ -420,9 +460,12 @@ class KVTransferServer:
                 arrays=arrays,
                 length=int(meta["length"]),
                 sample=meta.get("sample"),
+                trace=span.ctx if span is not None else tctx,
             )
         except Exception as e:  # Rejected, malformed frames
             _log.warning("kv migrate rejected: %s", e)
+            if span is not None:
+                span.end(outcome="error", error=str(e))
             return 503, {"error": str(e)}
         rid = uuid.uuid4().hex
         with self._lock:
@@ -432,6 +475,8 @@ class KVTransferServer:
         _metrics.counter("serve.kv_transfer_bytes_in", len(body))
         _metrics.counter("serve.kv_transfer_pages_in", len(meta["pages"]))
         _metrics.counter("serve.migrations_in")
+        if span is not None:
+            span.end(outcome="ok", bytes=len(body))
         return 200, {"rid": rid}
 
     def _handle_result(self, params: dict):
@@ -533,43 +578,60 @@ class TransferCoordinator:
 
     # ------------------------------------------------------------- reserve
 
-    def reserve(self, pages: int, roles=("decode",)) -> Optional[dict]:
+    def reserve(
+        self, pages: int, roles=("decode",), trace=None,
+    ) -> Optional[dict]:
         """Reserve ``pages`` on the best decode worker, failing over
         across candidates in-call; None when NO decode capacity exists
-        anywhere — the sender's cue to take the unified/local path."""
+        anywhere — the sender's cue to take the unified/local path.
+        ``trace`` (an ``Optional[TraceContext]``) rides the reserve
+        body so the receiver's admission decision lands in the same
+        trace, and the reply's clock-stamp echo becomes an NTP edge."""
         import urllib.error
         import urllib.request
 
+        span = _tracing.start_span("kv.reserve", trace, pages=int(pages))
         failed: set = set()
         for _ in range(4):
             targets = self.decode_targets(exclude=failed, roles=roles)
             if not targets:
+                if span is not None:
+                    span.end(outcome="no_target")
                 return None
             ann = targets[0]
             url = (
                 f"http://{ann.get('addr', '127.0.0.1')}"
                 f":{ann['transfer_port']}/kv/reserve"
             )
-            body = json.dumps({"pages": int(pages)}).encode()
+            payload: dict = {"pages": int(pages)}
+            if span is not None:
+                payload["trace"] = span.ctx.to_dict()
+            body = json.dumps(payload).encode()
             try:
                 req = urllib.request.Request(
                     url, data=body, method="POST",
                     headers={"Content-Type": "application/json"},
                 )
+                t_send = time.time()
                 with urllib.request.urlopen(
                     req, timeout=self._reserve_timeout
                 ) as resp:
                     out = json.loads(resp.read().decode())
+                _tracing.tag_hop_fields(span, t_send, time.time(), out)
             except (OSError, ValueError, urllib.error.HTTPError) as e:
                 _log.debug(
                     "reserve on rank %s failed: %s", ann.get("rank"), e
                 )
+                if span is not None:
+                    span.annotate(f"rank{ann.get('rank')}:{e}")
                 failed.add(ann["rank"])
                 continue
             with self._lock:
                 self._debits[ann["rank"]] = (
                     self._debits.get(ann["rank"], 0) + int(pages)
                 )
+            if span is not None:
+                span.end(outcome="ok", rank=int(ann["rank"]))
             return {
                 "rank": ann["rank"],
                 "addr": ann.get("addr", "127.0.0.1"),
@@ -577,6 +639,8 @@ class TransferCoordinator:
                 "rid": out["reservation"],
                 "pages": int(pages),
             }
+        if span is not None:
+            span.end(outcome="exhausted")
         return None
 
     def _credit(self, reservation: dict) -> None:
@@ -626,8 +690,16 @@ class TransferCoordinator:
             headers={"Content-Type": "application/octet-stream"},
         )
         try:
+            t_send = time.time()
             with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return json.loads(resp.read().decode())
+                out = json.loads(resp.read().decode())
+            # per-attempt NTP stamps onto the stream/migrate span this
+            # handoff thread runs under (tracing.active); the last
+            # successful attempt's edge wins
+            _tracing.tag_hop_fields(
+                _tracing.current(), t_send, time.time(), out
+            )
+            return out
         except urllib.error.HTTPError as e:
             if e.code == 429 or 500 <= e.code <= 599:
                 raise OSError(f"transfer target HTTP {e.code}") from e
@@ -638,51 +710,70 @@ class TransferCoordinator:
     def _stream(self, batcher, req, kept, length, reservation, raw):
         base = f"http://{reservation['addr']}:{reservation['port']}"
         t0 = time.perf_counter()
+        # the handoff thread runs UNDER the stream span (tracing.active)
+        # so RetryPolicy annotations and the _post hop stamps land on it;
+        # the receiver parents its kv.ingest span off meta["trace"]
+        span = _tracing.start_span(
+            "kv.stream", getattr(req, "trace", None),
+            rank=int(reservation.get("rank", -1)),
+            pages=len(kept), wire=self.wire,
+        )
         try:
-            # blocking half of the page extraction: one batched
-            # device_get + tail zeroing, OFF the scheduler thread
-            raw = self.engine.pages_to_host(raw, kept, length)
-            meta, blob = pack_raw_pages(
-                raw, [lp for lp, _ in kept], length,
-                page_tokens=self.engine.manager.page_tokens,
-                wire=self.wire, seed=req.id,
-            )
-            from .paged_kv import page_hashes
-
-            remaining_ms = None
-            if req.deadline_ts is not None:
-                remaining_ms = max(
-                    (req.deadline_ts - time.monotonic()) * 1e3, 1.0
+            with _tracing.active(span):
+                # blocking half of the page extraction: one batched
+                # device_get + tail zeroing, OFF the scheduler thread
+                raw = self.engine.pages_to_host(raw, kept, length)
+                meta, blob = pack_raw_pages(
+                    raw, [lp for lp, _ in kept], length,
+                    page_tokens=self.engine.manager.page_tokens,
+                    wire=self.wire, seed=req.id,
                 )
-            meta.update(
-                request_id=f"{id(self)}-{req.id}",
-                reservation=reservation["rid"],
-                prompt=[int(t) for t in req.prompt],
-                first_token=int(req.out_tokens[-1]),
-                max_new_tokens=int(req.max_new_tokens),
-                deadline_ms=remaining_ms,
-                # sampling knobs ride the wire; the seed is resolved
-                # HERE (sender request id when unpinned) so the decode
-                # worker reproduces what a local decode would have drawn
-                temperature=float(req.temperature),
-                top_k=int(req.top_k),
-                seed=int(req.id if req.seed is None else req.seed),
-                hashes=[
-                    h.hex() for h in page_hashes(
-                        req.prompt, self.engine.manager.page_tokens
+                from .paged_kv import page_hashes
+
+                remaining_ms = None
+                if req.deadline_ts is not None:
+                    remaining_ms = max(
+                        (req.deadline_ts - time.monotonic()) * 1e3, 1.0
                     )
-                ],
-            )
-            body = frame(meta, blob)
-            out = self._retry.call(
-                self._post, base + "/kv/ingest", body,
-                self._retry.attempt_timeout_s, peer=base,
-            )
+                meta.update(
+                    request_id=f"{id(self)}-{req.id}",
+                    reservation=reservation["rid"],
+                    prompt=[int(t) for t in req.prompt],
+                    first_token=int(req.out_tokens[-1]),
+                    max_new_tokens=int(req.max_new_tokens),
+                    deadline_ms=remaining_ms,
+                    # sampling knobs ride the wire; the seed is resolved
+                    # HERE (sender request id when unpinned) so the
+                    # decode worker reproduces what a local decode
+                    # would have drawn
+                    temperature=float(req.temperature),
+                    top_k=int(req.top_k),
+                    seed=int(req.id if req.seed is None else req.seed),
+                    hashes=[
+                        h.hex() for h in page_hashes(
+                            req.prompt, self.engine.manager.page_tokens
+                        )
+                    ],
+                )
+                if span is not None:
+                    meta["trace"] = span.ctx.to_dict()
+                body = frame(meta, blob)
+                out = self._retry.call(
+                    self._post, base + "/kv/ingest", body,
+                    self._retry.attempt_timeout_s, peer=base,
+                )
             transfer_ms = (time.perf_counter() - t0) * 1e3
             _metrics.counter("serve.kv_transfer_bytes", len(body))
             _metrics.counter("serve.kv_transfer_pages", len(kept))
             _metrics.counter("serve.kv_transfer_ms", transfer_ms)
             _metrics.counter("serve.transfers")
+            if span is not None:
+                # the span covers pack+stream, not the remote decode —
+                # the receiver's own spans pick the story up from here
+                span.end(
+                    outcome="ok", bytes=len(body),
+                    transfer_ms=round(transfer_ms, 3),
+                )
             result = self._await_result(base, out["rid"], req)
         except Exception as e:  # noqa: BLE001 — any wire failure falls back
             _log.warning(
@@ -690,6 +781,11 @@ class TransferCoordinator:
                 "falling back to local decode", req.id,
                 reservation.get("rank"), e,
             )
+            if span is not None:
+                span.end(
+                    outcome="fallback",
+                    error=f"{type(e).__name__}: {e}",
+                )
             self._credit(reservation)
             batcher.requeue_fallback(req, kept, length)
             return
@@ -742,7 +838,8 @@ class TransferCoordinator:
         (``requeue_fallback``) and False is returned."""
         req, kept, length = rec["req"], rec["kept"], rec["length"]
         reservation = self.reserve(
-            len(kept), roles=("decode", "unified")
+            len(kept), roles=("decode", "unified"),
+            trace=getattr(req, "trace", None),
         )
         if reservation is None:
             batcher.requeue_fallback(req, kept, length)
@@ -760,42 +857,58 @@ class TransferCoordinator:
         req, kept, length = rec["req"], rec["kept"], rec["length"]
         base = f"http://{reservation['addr']}:{reservation['port']}"
         t0 = time.perf_counter()
+        span = _tracing.start_span(
+            "kv.migrate", getattr(req, "trace", None),
+            rank=int(reservation.get("rank", -1)),
+            pages=len(kept), wire=self.wire,
+            tokens=len(req.out_tokens),
+        )
         try:
-            raw = self.engine.pages_to_host(raw, kept, length)
-            meta, blob = pack_raw_pages(
-                raw, [lp for lp, _ in kept], length,
-                page_tokens=self.engine.manager.page_tokens,
-                wire=self.wire, seed=req.id,
-            )
-            remaining_ms = None
-            if req.deadline_ts is not None:
-                remaining_ms = max(
-                    (req.deadline_ts - time.monotonic()) * 1e3, 1.0
+            with _tracing.active(span):
+                raw = self.engine.pages_to_host(raw, kept, length)
+                meta, blob = pack_raw_pages(
+                    raw, [lp for lp, _ in kept], length,
+                    page_tokens=self.engine.manager.page_tokens,
+                    wire=self.wire, seed=req.id,
                 )
-            meta.update(
-                request_id=f"{id(self)}-mig-{req.id}",
-                reservation=reservation["rid"],
-                prompt=[int(t) for t in req.prompt],
-                # the FULL generated history (vs ingest's first_token):
-                # the receiver seeds out_tokens with it and continues
-                # mid-decode — no token is ever re-decoded
-                tokens=[int(t) for t in req.out_tokens],
-                max_new_tokens=int(req.max_new_tokens),
-                deadline_ms=remaining_ms,
-                sample=rec.get("sample"),
-            )
-            body = frame(meta, blob)
-            out = self._retry.call(
-                functools.partial(self._post, site=MIGRATE_CHAOS_SITE),
-                base + "/kv/migrate", body,
-                self._retry.attempt_timeout_s, peer=base,
-            )
+                remaining_ms = None
+                if req.deadline_ts is not None:
+                    remaining_ms = max(
+                        (req.deadline_ts - time.monotonic()) * 1e3, 1.0
+                    )
+                meta.update(
+                    request_id=f"{id(self)}-mig-{req.id}",
+                    reservation=reservation["rid"],
+                    prompt=[int(t) for t in req.prompt],
+                    # the FULL generated history (vs ingest's
+                    # first_token): the receiver seeds out_tokens with
+                    # it and continues mid-decode — no token is ever
+                    # re-decoded
+                    tokens=[int(t) for t in req.out_tokens],
+                    max_new_tokens=int(req.max_new_tokens),
+                    deadline_ms=remaining_ms,
+                    sample=rec.get("sample"),
+                )
+                if span is not None:
+                    meta["trace"] = span.ctx.to_dict()
+                body = frame(meta, blob)
+                out = self._retry.call(
+                    functools.partial(
+                        self._post, site=MIGRATE_CHAOS_SITE
+                    ),
+                    base + "/kv/migrate", body,
+                    self._retry.attempt_timeout_s, peer=base,
+                )
             _metrics.counter("serve.kv_transfer_bytes", len(body))
             _metrics.counter("serve.kv_transfer_pages", len(kept))
             _metrics.counter("serve.migrations")
-            _metrics.counter(
-                "serve.migration_ms", (time.perf_counter() - t0) * 1e3
-            )
+            migration_ms = (time.perf_counter() - t0) * 1e3
+            _metrics.counter("serve.migration_ms", migration_ms)
+            if span is not None:
+                span.end(
+                    outcome="ok", bytes=len(body),
+                    migration_ms=round(migration_ms, 3),
+                )
             result = self._await_result(base, out["rid"], req)
         except Exception as e:  # noqa: BLE001 — any wire failure falls back
             _log.warning(
@@ -803,6 +916,11 @@ class TransferCoordinator:
                 "falling back to local decode", req.id,
                 reservation.get("rank"), e,
             )
+            if span is not None:
+                span.end(
+                    outcome="fallback",
+                    error=f"{type(e).__name__}: {e}",
+                )
             self._credit(reservation)
             batcher.requeue_fallback(req, kept, length)
             return

@@ -32,6 +32,18 @@ from ..common.telemetry import _percentile
 DEFAULT_CAPACITY = 1024
 
 
+def _percentile_sample(sorted_samples, q: float):
+    """Nearest-rank percentile over (value, payload) pairs already
+    sorted by value — returns the WITNESS pair, not just the value, so
+    the exemplar trace_id rides along. None when empty."""
+    if not sorted_samples:
+        return None
+    idx = min(
+        int(q * (len(sorted_samples) - 1) + 0.5), len(sorted_samples) - 1
+    )
+    return sorted_samples[idx]
+
+
 class LatencyRecorder:
     """Bounded-ring p50/p95 for the two serving latency families."""
 
@@ -46,36 +58,43 @@ class LatencyRecorder:
         self._counts = {fam: 0 for fam in self.FAMILIES}
         self._sums = {fam: 0.0 for fam in self.FAMILIES}
 
-    def record_ttft(self, ms: float) -> None:
-        self._record("ttft_ms", ms)
+    def record_ttft(self, ms: float, trace_id: str = "") -> None:
+        self._record("ttft_ms", ms, trace_id)
 
-    def record_tpot(self, ms: float) -> None:
-        self._record("tpot_ms", ms)
+    def record_tpot(self, ms: float, trace_id: str = "") -> None:
+        self._record("tpot_ms", ms, trace_id)
 
-    def _record(self, fam: str, ms: float) -> None:
+    def _record(self, fam: str, ms: float, trace_id: str = "") -> None:
         with self._lock:
-            self._rings[fam].append(float(ms))
+            self._rings[fam].append((float(ms), trace_id or ""))
             self._counts[fam] += 1
             self._sums[fam] += float(ms)
 
     def summaries(self) -> Dict[str, Dict[str, float]]:
-        """{family: {p50, p95, count, sum}}. The quantiles are
-        ring-windowed (newest ``capacity`` samples, like the step-time
-        summary in common/telemetry.py); count AND sum are lifetime
-        cumulative — the Prometheus summary pair, so sum/count is a
-        true mean for any consumer computing rate(sum)/rate(count)."""
+        """{family: {p50, p95, count, sum, p95_exemplar}}. The
+        quantiles are ring-windowed (newest ``capacity`` samples, like
+        the step-time summary in common/telemetry.py); count AND sum
+        are lifetime cumulative — the Prometheus summary pair, so
+        sum/count is a true mean for any consumer computing
+        rate(sum)/rate(count). ``p95_exemplar`` is the trace_id of the
+        sample currently WITNESSING p95 ("" when that request was
+        untraced) — "why is p95 high" becomes an openable trace
+        (scripts/trace_assemble.py --trace)."""
         out: Dict[str, Dict[str, float]] = {}
         with self._lock:
             snap = {
                 fam: (sorted(ring), self._counts[fam], self._sums[fam])
                 for fam, ring in self._rings.items()
             }
-        for fam, (vals, count, total) in snap.items():
+        for fam, (samples, count, total) in snap.items():
+            vals = [ms for ms, _ in samples]
+            p95_witness = _percentile_sample(samples, 0.95)
             out[fam] = {
                 "p50": _percentile(vals, 0.50),
                 "p95": _percentile(vals, 0.95),
                 "count": count,
                 "sum": total,
+                "p95_exemplar": p95_witness[1] if p95_witness else "",
             }
         return out
 
@@ -102,10 +121,26 @@ class LatencyRecorder:
         }
         for fam, s in self.summaries().items():
             name = f"serve_{fam}"
+            exemplar = s.get("p95_exemplar", "")
             lines.append(f"# HELP {name} {helps[fam]}")
             lines.append(f"# TYPE {name} summary")
             lines.append(f'{name}{{quantile="0.5"}} {s["p50"]:.10g}')
-            lines.append(f'{name}{{quantile="0.95"}} {s["p95"]:.10g}')
+            p95_line = f'{name}{{quantile="0.95"}} {s["p95"]:.10g}'
+            if exemplar:
+                # OpenMetrics-style exemplar: the trace witnessing the
+                # current p95, openable via scripts/trace_assemble.py
+                p95_line += (
+                    f' # {{trace_id="{exemplar}"}} {s["p95"]:.10g}'
+                )
+            lines.append(p95_line)
             lines.append(f"{name}_sum {s['sum']:.10g}")
             lines.append(f"{name}_count {s['count']:.10g}")
+            if exemplar:
+                ename = f"serve_{fam[:-3]}_p95_exemplar"
+                lines.append(
+                    f"# HELP {ename} trace_id of the sample witnessing "
+                    f"the current {fam} p95."
+                )
+                lines.append(f"# TYPE {ename} gauge")
+                lines.append(f'{ename}{{trace_id="{exemplar}"}} 1')
         return lines

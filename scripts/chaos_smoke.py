@@ -32,7 +32,13 @@ on the survivor — zero client-visible errors, every response
 bit-identical) and a third worker is then SIGTERMed with a short
 ``HOROVOD_SERVE_DRAIN_DEADLINE_S`` so its in-flight sequences
 live-migrate to the survivor (``hvd_serve_migrations_in`` on the
-survivor's live scrape) and still answer the original clients.
+survivor's live scrape) and still answer the original clients. The
+drill runs with the fleet TRACE plane on and asserts its contracts
+under chaos: hedge and replay legs surface as tagged SIBLING
+``route.attempt`` spans under one route root, and a live-migrated
+request assembles (this client's ring + the survivor's live
+``/traces`` scrape + the SIGTERMed worker's crash-drained ``.spans``
+file) into a single connected trace spanning >= 3 processes.
 
 A **standby-swap drill** (PR 18): the same SIGKILL-a-worker
 story, twice — once cold (no cache, no standby) and once with
@@ -59,6 +65,8 @@ import urllib.request
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+# the failover drill drives scripts/trace_assemble.py as a library
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = """\
 import json, os, sys, time
@@ -613,6 +621,9 @@ def serve_failover_drill() -> None:
     import signal
     import subprocess
 
+    import trace_assemble
+    from horovod_tpu.analysis import trace_merge
+    from horovod_tpu.common import tracing
     from horovod_tpu.common.metrics import registry
     from horovod_tpu.runner.rendezvous import (
         RendezvousClient,
@@ -640,6 +651,11 @@ def serve_failover_drill() -> None:
             "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
             "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rdv_port),
             "HOROVOD_SECRET_KEY": key.hex(),
+            # crash-safe span drain: a reaped worker leaves its trace
+            # ring beside the flight recorder for the assembly below
+            "HOROVOD_FLIGHT_RECORDER": os.path.join(
+                workdir, f"flight.r{rank}.jsonl"
+            ),
         })
         env.update(extra_env or {})
         return subprocess.Popen(
@@ -668,6 +684,41 @@ def serve_failover_drill() -> None:
         while time.monotonic() < deadline and len(router.snapshot()) < 2:
             time.sleep(0.2)
         assert set(router.snapshot()) == {0, 1}, router.snapshot()
+
+        # ---- hedge leg: one request with an aggressive hedge delay —
+        # both arms fire, first writer wins, and the race must be
+        # legible as two tagged SIBLING route.attempt spans under one
+        # route root in this process's trace ring
+        hres = router.route(
+            prompt, timeout=240.0, hedge_ms=1.0, request_id="hedge-0"
+        )
+        assert hres["status"] == "done", hres
+        htid = hres.get("trace_id")
+        assert htid, f"hedged result carries no trace_id: {hres}"
+        # the losing arm closes its leg when its response finally
+        # lands — poll until both legs are in the ring
+        hlegs = []
+        hdeadline = time.monotonic() + 120
+        while time.monotonic() < hdeadline:
+            hlegs = [
+                s for s in tracing.recorder().spans()
+                if s["trace_id"] == htid
+                and s["name"] == "route.attempt"
+            ]
+            if len(hlegs) >= 2:
+                break
+            time.sleep(0.2)
+        assert len(hlegs) >= 2, f"hedge fired no backup leg: {hlegs}"
+        assert {
+            (s.get("tags") or {}).get("hedge") for s in hlegs
+        } >= {"primary", "backup"}, hlegs
+        assert len({s["parent_id"] for s in hlegs}) == 1, (
+            f"hedge arms are not siblings: {hlegs}"
+        )
+        houtcomes = {
+            (s.get("tags") or {}).get("outcome") for s in hlegs
+        }
+        assert "ok" in houtcomes and "discarded" in houtcomes, hlegs
 
         # ---- replay leg: SIGKILL worker 0 mid-burst
         results, errors = {}, []
@@ -700,6 +751,32 @@ def serve_failover_drill() -> None:
         )
         replays = registry.snapshot().get("serve.replays", 0.0) - before
         assert replays >= 1, "the kill was absorbed without any replay"
+        # the replays are visible as tagged sibling spans: the leg that
+        # died on the SIGKILLed worker closed outcome="replayed", and a
+        # mode="replay" sibling under the same route root won
+        ring = tracing.recorder().spans()
+        rep_legs = [
+            s for s in ring
+            if s["name"] == "route.attempt"
+            and (s.get("tags") or {}).get("outcome") == "replayed"
+        ]
+        assert rep_legs, "no route.attempt leg tagged outcome=replayed"
+        rep_tids = {s["trace_id"] for s in rep_legs}
+        ok_replays = [
+            s for s in ring
+            if s["name"] == "route.attempt"
+            and s["trace_id"] in rep_tids
+            and (s.get("tags") or {}).get("mode") == "replay"
+            and (s.get("tags") or {}).get("outcome") == "ok"
+        ]
+        assert ok_replays, (
+            "no winning mode=replay sibling beside a replayed leg"
+        )
+        rep_parent = {s["trace_id"]: s["parent_id"] for s in rep_legs}
+        assert any(
+            s["parent_id"] == rep_parent[s["trace_id"]]
+            for s in ok_replays
+        ), "replay legs are not siblings under the same route root"
 
         # ---- migration leg: SIGTERM worker 2 under a short deadline.
         # A 5ms per-step chaos delay slows decode to ~1s/sequence:
@@ -713,22 +790,41 @@ def serve_failover_drill() -> None:
             }
         )
         port2 = wait_port(procs, 2)
-        mig_results, mig_errors = {}, []
+        mig_results, mig_errors, mig_traces = {}, [], {}
 
         def mig_one(i):
+            # each migration client mints its own trace root: the
+            # traceparent rides to the doomed worker, the migrate
+            # frames carry it to the survivor, and the assembly below
+            # must stitch all three processes back together
+            tctx = tracing.mint()
+            span = tracing.root_span(
+                "client.generate", tctx, request_id=f"mig-{i}"
+            )
+            headers = {"Content-Type": "application/json"}
+            if tctx is not None:
+                headers["traceparent"] = tctx.to_traceparent()
             body = json.dumps(
                 {"tokens": prompt, "request_id": f"mig-{i}"}
             ).encode()
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port2}/generate", data=body,
-                headers={"Content-Type": "application/json"},
+                headers=headers,
                 method="POST",
             )
             try:
+                t_send = time.time()
                 with urllib.request.urlopen(req, timeout=300) as resp:
                     mig_results[i] = json.loads(resp.read().decode())
+                    tracing.tag_hop(
+                        span, t_send, time.time(), resp.headers
+                    )
+                    mig_traces[i] = resp.headers.get("X-Trace-Id")
             except Exception as e:  # noqa: BLE001 — a failure IS the signal
                 mig_errors.append((i, e))
+            finally:
+                if span is not None:
+                    span.end()
 
         mthreads = [
             threading.Thread(target=mig_one, args=(i,)) for i in range(3)
@@ -789,6 +885,54 @@ def serve_failover_drill() -> None:
             time.sleep(0.25)
         assert migrations_in >= 1, migrations_in
         procs[2].wait(timeout=60)
+
+        # ---- the migrated request is ONE connected trace spanning
+        # >= 3 processes: this client (its own ring), the SIGTERMed
+        # worker (crash-drained <flight>.spans file), and the survivor
+        # (live /traces scrape — itself an NTP edge)
+        w1_spans, w1_edge = trace_assemble.scrape(
+            f"http://127.0.0.1:{ports[1]}/traces"
+        )
+        mig_tids = {
+            s["trace_id"] for s in w1_spans if s["name"] == "kv.migrate"
+        }
+        ours = {t for t in mig_traces.values() if t}
+        assert ours, f"no X-Trace-Id echoed: {mig_traces}"
+        migrated = mig_tids & ours
+        assert migrated, (
+            f"no kv.migrate span on the survivor belongs to a drill "
+            f"request: {mig_tids} vs {ours}"
+        )
+        mig_tid = sorted(migrated)[0]
+        w2_file = os.path.join(workdir, "flight.r2.jsonl.spans")
+        assert os.path.exists(w2_file), (
+            "SIGTERMed worker drained no span ring"
+        )
+        spans = (
+            tracing.recorder().spans()
+            + w1_spans
+            + trace_assemble.load_file(w2_file)
+        )
+        tspans = trace_merge.filter_trace(spans, mig_tid)
+        corrected, offsets = trace_merge.assemble(
+            tspans, edges=[w1_edge] if w1_edge else [],
+        )
+        mprocs = {trace_merge.proc_key(s) for s in tspans}
+        assert len(mprocs) >= 3, (
+            f"migrated trace spans only {len(mprocs)} process(es): "
+            f"{mprocs}"
+        )
+        assert mprocs <= set(offsets), (
+            f"migrated trace not connected on one clock: "
+            f"{mprocs - set(offsets)} unreachable"
+        )
+        mnames = {s["name"] for s in tspans}
+        for needle in ("client.generate", "http.generate", "kv.migrate"):
+            assert needle in mnames, (needle, sorted(mnames))
+        assert all(
+            a["ts_corrected"] <= b["ts_corrected"]
+            for a, b in zip(corrected, corrected[1:])
+        ), "assemble() did not sort by corrected time"
     finally:
         for p in procs.values():
             if p.poll() is None:
@@ -797,11 +941,18 @@ def serve_failover_drill() -> None:
     print(
         f"serve-failover OK: {int(replays)} replay(s) after SIGKILL with "
         f"12/12 bit-identical answers, {int(migrations_in)} live "
-        f"migration(s) after SIGTERM with 3/3 answered"
+        f"migration(s) after SIGTERM with 3/3 answered, migrated trace "
+        f"assembled across {len(mprocs)} processes"
     )
 
 
 def main() -> int:
+    # fleet trace plane ON (full sampling) for the whole gate: the
+    # serve-failover drill asserts the migrated request's assembled
+    # trace, and the elastic drills record their cycle spans along the
+    # way — chaos with tracing on is exactly the combination to guard
+    os.environ["HOROVOD_TRACE"] = "1"
+    os.environ["HOROVOD_TRACE_SAMPLE"] = "1.0"
     integrity_drill()
     workdir = tempfile.mkdtemp(prefix="hvd-chaos-smoke-")
     script = os.path.join(workdir, "worker.py")

@@ -19,9 +19,15 @@ capacity announcements against a driver-hosted RendezvousServer), then:
    must land every prompt on the prefill worker, stream its finished
    KV pages over the transfer wire (``hvd_serve_kv_transfer_pages`` >
    0 on the prefill worker's live scrape, transfer admits spread over
-   BOTH decode workers), then one decode worker is SIGTERMed
-   mid-burst — reservations fail over, every accepted request still
-   completes, the killed worker exits 143;
+   BOTH decode workers); the fleet TRACE plane is then asserted
+   end-to-end — a crafted ``traceparent`` round-trips as
+   ``X-Trace-Id``, and one routed request assembles (live ``/traces``
+   scrapes + this process's span ring, through
+   scripts/trace_assemble.py) into a single skew-corrected trace
+   covering router → prefill → KV transfer → decode in monotonic
+   order; then one decode worker is SIGTERMed mid-burst —
+   reservations fail over, every accepted request still completes,
+   the killed worker exits 143;
 5. fires a burst of in-flight requests at the unified fleet, SIGTERMs
    both workers mid-service, and asserts the drain contract: every
    ACCEPTED request completes with its full token budget, both
@@ -44,6 +50,8 @@ import urllib.request
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+# the trace phase drives scripts/trace_assemble.py as a library
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 GEN_TOKENS = 6
 BURST_TOKENS = 16
@@ -95,8 +103,16 @@ def _scrape_counter(port, name):
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # trace plane ON (full sampling) for the whole smoke: phase 3.5
+    # asserts the assembled cross-process trace, and every other phase
+    # doubles as proof that tracing-on changes no serving behavior
+    os.environ["HOROVOD_TRACE"] = "1"
+    os.environ["HOROVOD_TRACE_SAMPLE"] = "1.0"
+    from horovod_tpu.common import tracing
     from horovod_tpu.runner.rendezvous import RendezvousServer
     from horovod_tpu.serving.frontend import Router, read_announcements
+
+    tracing.set_role("router")
 
     workdir = tempfile.mkdtemp(prefix="hvd-serve-smoke-")
     server = RendezvousServer()
@@ -341,6 +357,129 @@ def main() -> int:
             print(f"phase 3 OK: {len(dis_prompts)} disagg completions, "
                   f"{int(pages_out)} pages streamed, "
                   f"decode spread {admits}")
+
+            # ---- phase 3.5: fleet trace plane on the disagg fleet.
+            # First the header contract: a crafted traceparent must
+            # round-trip as X-Trace-Id on the reply.
+            import trace_assemble
+            from horovod_tpu.analysis import trace_merge
+
+            want = "ab" * 16
+            treq = urllib.request.Request(
+                f"http://127.0.0.1:{fports[0]}/generate",
+                data=json.dumps(
+                    {"tokens": [3, 5, 7], "max_tokens": 4}
+                ).encode(),
+                headers={"traceparent": f"00-{want}-{'cd' * 8}-01"},
+                method="POST",
+            )
+            with urllib.request.urlopen(treq, timeout=120) as resp:
+                echoed = resp.headers.get("X-Trace-Id")
+                tout = json.load(resp)
+            assert tout["status"] == "done", tout
+            assert echoed == want, (
+                f"X-Trace-Id did not round-trip: {echoed!r}"
+            )
+
+            # one routed request: the Router (THIS process) mints the
+            # root, the traceparent header carries it to the prefill
+            # worker, and the kv_transfer meta frames carry it on to
+            # whichever decode worker admits the streamed pages
+            tres = router2.route(
+                [5, 9, 13, 17], max_tokens=GEN_TOKENS, timeout=120
+            )
+            assert tres["status"] == "done", tres
+            tid = tres.get("trace_id")
+            assert tid, f"routed result carries no trace_id: {tres}"
+
+            # scrape every worker's live /traces (each scrape is an
+            # NTP edge) + this process's own ring; span records land
+            # moments after the reply, so poll briefly
+            need = {
+                "route", "route.attempt", "http.generate",
+                "serve.prefill", "kv.reserve", "kv.stream",
+                "kv.ingest", "serve.decode",
+            }
+            deadline = time.monotonic() + 15
+            while True:
+                spans = tracing.recorder().spans()
+                edges = []
+                for r in roles:
+                    got, edge = trace_assemble.scrape(
+                        f"http://127.0.0.1:{fports[r]}/traces"
+                    )
+                    spans.extend(got)
+                    if edge is not None:
+                        edges.append(edge)
+                tspans = trace_merge.filter_trace(spans, tid)
+                names = {s["name"] for s in tspans}
+                if need <= names or time.monotonic() > deadline:
+                    break
+                time.sleep(0.1)
+            assert need <= names, (
+                f"assembled trace missing {sorted(need - names)} "
+                f"(has {sorted(names)})"
+            )
+            assert len(trace_merge.traces_in(tspans)) == 1
+
+            corrected, offsets = trace_merge.assemble(
+                tspans, edges=edges
+            )
+            tprocs = {trace_merge.proc_key(s) for s in corrected}
+            assert len(tprocs) >= 3, (
+                f"trace does not span router+prefill+decode: {tprocs}"
+            )
+            assert tprocs <= set(offsets), (
+                f"skew graph not connected: {tprocs - set(offsets)} "
+                f"unreachable from the reference clock"
+            )
+
+            def first_ts(name):
+                return min(
+                    s["ts_corrected"] for s in corrected
+                    if s["name"] == name
+                )
+
+            milestones = [
+                first_ts(n) for n in (
+                    "route", "serve.prefill", "kv.stream",
+                    "serve.decode",
+                )
+            ]
+            assert milestones == sorted(milestones), (
+                f"skew-corrected trace out of monotonic order: "
+                f"{milestones}"
+            )
+            assert all(
+                a["ts_corrected"] <= b["ts_corrected"]
+                for a, b in zip(corrected, corrected[1:])
+            ), "assemble() did not sort by corrected time"
+
+            # the CLI end-to-end: live scrapes + this process's ring
+            # dump -> one chrome://tracing JSON with one row per
+            # (host, role)
+            ring_file = os.path.join(workdir, "router.spans")
+            tracing.recorder().dump(ring_file)
+            chrome_out = os.path.join(workdir, "fleet_trace.json")
+            argv = ["--file", ring_file, "--trace", tid,
+                    "--out", chrome_out]
+            for r in roles:
+                argv += [
+                    "--url", f"http://127.0.0.1:{fports[r]}/traces",
+                ]
+            assert trace_assemble.main(argv) == 0
+            with open(chrome_out) as f:
+                chrome = json.load(f)
+            rows = {
+                e["args"]["name"]
+                for e in chrome["traceEvents"]
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+            }
+            for frag in ("[router]", "[prefill]", "[decode]"):
+                assert any(frag in r for r in rows), (frag, rows)
+            print(f"phase 3.5 OK: trace {tid[:8]} assembled across "
+                  f"{len(tprocs)} processes ({len(corrected)} spans), "
+                  f"X-Trace-Id round-tripped")
 
             # mid-burst decode-worker death: reservations fail over,
             # every accepted request still completes
